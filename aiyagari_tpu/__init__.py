@@ -1,0 +1,61 @@
+"""aiyagari_tpu — a TPU-native heterogeneous-agent macroeconomics framework.
+
+Re-designs the capability surface of kostastril/Aiyagari-Replication
+(five model configurations x two solution methods x a GE/statistics toolkit;
+see SURVEY.md) as an idiomatic JAX/XLA framework: jit+vmap'd Bellman and EGM
+kernels over HBM-resident grids, lax.scan panel simulation with explicit PRNG
+threading, sharded agent panels over a named device mesh, and host-side outer
+equilibrium loops.
+
+Primary entry point: solve(model_config, method=..., backend=...).
+"""
+
+from aiyagari_tpu.config import (
+    ALMConfig,
+    AiyagariConfig,
+    BackendConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    HouseholdPreferences,
+    IncomeProcess,
+    KrusellSmithConfig,
+    KSShockProcess,
+    SimConfig,
+    SolverConfig,
+    Technology,
+)
+from aiyagari_tpu.dispatch import solve
+from aiyagari_tpu.equilibrium.bisection import (
+    EquilibriumResult,
+    solve_equilibrium,
+    solve_household,
+)
+from aiyagari_tpu.models.aiyagari import (
+    AiyagariModel,
+    aiyagari_labor_preset,
+    aiyagari_preset,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "solve",
+    "solve_equilibrium",
+    "solve_household",
+    "AiyagariModel",
+    "aiyagari_preset",
+    "aiyagari_labor_preset",
+    "EquilibriumResult",
+    "AiyagariConfig",
+    "KrusellSmithConfig",
+    "KSShockProcess",
+    "HouseholdPreferences",
+    "Technology",
+    "IncomeProcess",
+    "GridSpecConfig",
+    "SolverConfig",
+    "SimConfig",
+    "EquilibriumConfig",
+    "ALMConfig",
+    "BackendConfig",
+]

@@ -1,0 +1,224 @@
+"""Typed, frozen, pytree-compatible configuration objects.
+
+The reference hardcodes scalar constants at the top of every script with
+inconsistent duplicated names across files (see /root/reference/Aiyagari_VFI.m:7-14,
+Krusell_Smith_VFI.m:5-13, and the psi/eta vs phi/theta naming clash between
+Aiyagari_Endogenous_Labor_VFI.m:14-15 and Aiyagari_Endogenous_Labor_EGM.m:10-11).
+Here every model/solver/simulation/backend knob is a frozen dataclass so configs
+hash (usable as jit static args) and serialize cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = [
+    "HouseholdPreferences",
+    "Technology",
+    "IncomeProcess",
+    "GridSpecConfig",
+    "AiyagariConfig",
+    "KSShockProcess",
+    "KrusellSmithConfig",
+    "SolverConfig",
+    "SimConfig",
+    "EquilibriumConfig",
+    "ALMConfig",
+    "BackendConfig",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class HouseholdPreferences:
+    """CRRA preferences with optional additively separable labor disutility.
+
+    u(c, l) = (c^(1-sigma) - 1)/(1-sigma) - psi * l^(1+eta)/(1+eta)
+
+    Reference: sigma at Aiyagari_VFI.m:8; labor disutility psi/eta at
+    Aiyagari_Endogenous_Labor_VFI.m:14-15 (called phi/theta in the EGM variant,
+    Aiyagari_Endogenous_Labor_EGM.m:10-11 -- same role, unified here).
+    """
+
+    beta: float = 0.96
+    sigma: float = 5.0
+    psi: float = 1.0    # labor-disutility weight (endogenous-labor models only)
+    eta: float = 2.0    # labor-disutility curvature (Frisch^-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class Technology:
+    """Cobb-Douglas production Y = z K^alpha L^(1-alpha), depreciation delta.
+
+    Reference: Aiyagari_VFI.m:9-10; Krusell_Smith_VFI.m:5.
+    """
+
+    alpha: float = 0.36
+    delta: float = 0.08
+
+
+@dataclasses.dataclass(frozen=True)
+class IncomeProcess:
+    """AR(1) log-productivity discretized by the Tauchen method.
+
+    log s' = rho log s + e,  e ~ N(0, sd^2), sd = sigma_e * sqrt(1-rho^2),
+    on a fixed grid l_i = (i - (n+1)/2) * sigma_e  (reference uses n=7 so the
+    grid is {-3..+3} * sigma_e; Aiyagari_VFI.m:18-23).
+    """
+
+    rho: float = 0.75
+    sigma_e: float = 0.75
+    n_states: int = 7
+
+
+@dataclasses.dataclass(frozen=True)
+class GridSpecConfig:
+    """Power-spaced asset grid: amin + (amax-amin) * linspace(0,1,n)^power.
+
+    Reference: quadratic (power=2) 400-point Aiyagari grid at Aiyagari_VFI.m:58;
+    power-7 100-point Krusell-Smith grid at Krusell_Smith_VFI.m:16.
+    Bounds of None mean "derive from model parameters" (Aiyagari_VFI.m:53-56).
+    """
+
+    n_points: int = 400
+    power: float = 2.0
+    amin: Optional[float] = None
+    amax: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class AiyagariConfig:
+    """Full parameterization of an Aiyagari-class economy.
+
+    endogenous_labor=False reproduces Aiyagari_VFI.m / Aiyagari_EGM.m;
+    True reproduces the Endogenous_Labor variants (10-point labor grid on
+    [0.01, 1.5] for VFI per Aiyagari_Endogenous_Labor_VFI.m:62, closed-form
+    intratemporal FOC for EGM per Aiyagari_Endogenous_Labor_EGM.m:61-62).
+    """
+
+    preferences: HouseholdPreferences = HouseholdPreferences()
+    technology: Technology = Technology()
+    income: IncomeProcess = IncomeProcess()
+    grid: GridSpecConfig = GridSpecConfig()
+    borrowing_limit: float = 0.0          # b at Aiyagari_VFI.m:11
+    endogenous_labor: bool = False
+    labor_grid_n: int = 10                # VFI labor-choice grid size
+    labor_grid_bounds: Tuple[float, float] = (0.01, 1.5)
+
+
+@dataclasses.dataclass(frozen=True)
+class KSShockProcess:
+    """Krusell-Smith joint (aggregate z x idiosyncratic employment) chain,
+    parameterized by duration targets exactly as Krusell_Smith_VFI.m:23-45.
+    """
+
+    z_good: float = 1.01
+    z_bad: float = 0.99
+    u_good: float = 0.04      # unemployment rate in good state (ug)
+    u_bad: float = 0.10       # unemployment rate in bad state (ub)
+    z_good_duration: float = 8.0
+    z_bad_duration: float = 8.0
+    u_good_duration: float = 1.5
+    u_bad_duration: float = 2.5
+    uu_rel_gb2bb: float = 1.25
+    uu_rel_bg2gg: float = 0.75
+
+
+@dataclasses.dataclass(frozen=True)
+class KrusellSmithConfig:
+    """Full parameterization of the Krusell-Smith economy.
+
+    Reference constants: Krusell_Smith_VFI.m:5-13.
+    """
+
+    preferences: HouseholdPreferences = HouseholdPreferences(beta=0.99, sigma=1.0)
+    technology: Technology = Technology(alpha=0.36, delta=0.025)
+    shocks: KSShockProcess = KSShockProcess()
+    k_min: float = 1e-4
+    k_max: float = 1000.0
+    k_size: int = 100
+    k_power: float = 7.0
+    K_min: float = 30.0
+    K_max: float = 50.0
+    K_size: int = 4
+    mu: float = 0.0           # home production of the unemployed (mu at :9)
+
+    @property
+    def l_bar(self) -> float:
+        # Labor endowment normalization 1/(1-ub): Krusell_Smith_VFI.m:10
+        return 1.0 / (1.0 - self.shocks.u_bad)
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Inner household-solver controls.
+
+    Reference: tol/max_iter at Aiyagari_VFI.m:49-50 (1e-5/1000);
+    K-S tol 1e-6, max 10000, 50 Howard sweeps with improvement every 5th
+    iteration at Krusell_Smith_VFI.m:12-13,148.
+    """
+
+    method: str = "vfi"               # {"vfi", "egm"}
+    tol: float = 1e-5
+    max_iter: int = 1000
+    howard_steps: int = 0             # 0 disables Howard acceleration
+    improve_every: int = 5            # policy improvement cadence under Howard
+    golden_iters: int = 48            # fixed golden-section iterations (fminbnd analogue)
+    relative_tol: bool = False        # K-S VFI uses a relative sup-norm (:195)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Simulation controls.
+
+    Reference: 10,000-period single-household ergodic run (Aiyagari_VFI.m:94);
+    K-S 10,000-agent x 1,100-period panel with 100 discarded
+    (Krusell_Smith_VFI.m:10-11). Unlike the reference's unseeded `rand`
+    (irreproducible), seeds are explicit PRNG keys.
+    """
+
+    periods: int = 10_000
+    n_agents: int = 1
+    discard: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class EquilibriumConfig:
+    """GE bisection on the interest rate. Reference: Aiyagari_VFI.m:133-136."""
+
+    max_iter: int = 10
+    tol: float = 1e-5
+    r_low: float = -0.05
+    r_high: Optional[float] = None    # None -> 1/beta - 1
+    r_init: float = 0.04              # warm-start rate (Aiyagari_VFI.m:63)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALMConfig:
+    """Krusell-Smith aggregate-law-of-motion outer loop.
+
+    Reference: max 100 iters, tol 1e-6, damping 0.3 (Krusell_Smith_VFI.m:11-12).
+    """
+
+    max_iter: int = 100
+    tol: float = 1e-6
+    damping: float = 0.3
+    T: int = 1100
+    population: int = 10_000
+    discard: int = 100
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendConfig:
+    """Execution-backend controls: dtype policy and device-mesh shape.
+
+    mesh_shape maps axis names to sizes; ("agents",) shards the K-S panel,
+    ("grid",) shards value/policy rows. None = single device.
+    """
+
+    backend: str = "jax"              # {"jax", "numpy"}
+    dtype: str = "float64"            # {"float32", "float64"}
+    mesh_axes: Tuple[str, ...] = ()
+    mesh_shape: Tuple[int, ...] = ()

@@ -1,0 +1,96 @@
+"""The solve(model, method, backend) dispatch boundary (BASELINE.json's north
+star): one entry point routing on model family, solution method, and execution
+backend.
+
+  solve(AiyagariConfig(...), method="vfi", backend="jax")   -> EquilibriumResult
+  solve(AiyagariConfig(...), method="egm", backend="numpy") -> EquilibriumResult
+  solve(KrusellSmithConfig(...), method="vfi")              -> KSResult
+
+The "numpy" backend is the framework's own CPU reference implementation — the
+measured baseline denominator (BASELINE.md: the reference publishes no
+numbers, so speedups are reported against this at the reference's scales).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from aiyagari_tpu.config import (
+    ALMConfig,
+    AiyagariConfig,
+    BackendConfig,
+    EquilibriumConfig,
+    KrusellSmithConfig,
+    SimConfig,
+    SolverConfig,
+)
+
+__all__ = ["solve"]
+
+
+def _dtype_of(backend: BackendConfig):
+    return jnp.float64 if backend.dtype == "float64" else jnp.float32
+
+
+def solve(
+    model: Union[AiyagariConfig, KrusellSmithConfig],
+    *,
+    method: Optional[str] = None,
+    backend: Union[str, BackendConfig] = "jax",
+    solver: Optional[SolverConfig] = None,
+    sim: Optional[SimConfig] = None,
+    equilibrium: Optional[EquilibriumConfig] = None,
+    alm: Optional[ALMConfig] = None,
+):
+    """Solve a full model to general equilibrium.
+
+    Aiyagari family -> interest-rate bisection (EquilibriumResult).
+    Krusell-Smith   -> aggregate-law-of-motion fixed point (KSResult).
+
+    The solution method comes from `method` or `solver.method`; passing both
+    with different values is an error (never silently overridden). With
+    neither, the default is "vfi". When `solver` is omitted, each model
+    family supplies its own reference-faithful solver defaults (e.g. the
+    Krusell-Smith tolerances/Howard schedule of Krusell_Smith_VFI.m:12-13).
+    """
+    if isinstance(backend, str):
+        backend = BackendConfig(backend=backend)
+    if backend.backend not in ("jax", "numpy"):
+        raise ValueError(
+            f"unknown backend {backend.backend!r}; expected 'jax' or 'numpy'"
+        )
+    if solver is not None and method is not None and solver.method != method:
+        raise ValueError(
+            f"conflicting methods: method={method!r} but solver.method={solver.method!r}"
+        )
+    method = method or (solver.method if solver is not None else "vfi")
+    if method not in ("vfi", "egm"):
+        raise ValueError(f"unknown method {method!r}; expected 'vfi' or 'egm'")
+
+    if isinstance(model, AiyagariConfig):
+        solver = solver or SolverConfig(method=method)
+        sim = sim or SimConfig()
+        equilibrium = equilibrium or EquilibriumConfig()
+        if backend.backend == "numpy":
+            from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
+
+            return solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
+        from aiyagari_tpu.equilibrium.bisection import solve_equilibrium
+        from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+        m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+        return solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
+
+    if isinstance(model, KrusellSmithConfig):
+        alm = alm or ALMConfig()
+        from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
+
+        # solver=None lets the KS loop apply its own reference defaults
+        # (tol 1e-6, Howard 50/improve-every-5) rather than the generic ones.
+        return solve_krusell_smith(
+            model, method=method, solver=solver, alm=alm, backend=backend
+        )
+
+    raise TypeError(f"unknown model config type: {type(model).__name__}")

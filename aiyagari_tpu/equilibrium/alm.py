@@ -1,0 +1,198 @@
+"""Krusell-Smith outer loop: fixed point on the aggregate law of motion (ALM).
+
+Host-side loop (Krusell_Smith_VFI.m:138-296): each iteration launches the
+device-resident household solver (Howard VFI or EGM), the device-resident
+panel simulation, and the on-device two-regime OLS, then applies the damped
+coefficient update B <- damping*B_new + (1-damping)*B on host. Shock paths are
+drawn once up front with explicit PRNG keys (the reference's unseeded rand
+panels, :58-94, made reproducible).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import ALMConfig, BackendConfig, KrusellSmithConfig, SolverConfig
+from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+from aiyagari_tpu.ops.regression import alm_regression
+from aiyagari_tpu.sim.ks_panel import (
+    simulate_aggregate_shocks,
+    simulate_capital_path,
+    simulate_employment_panel,
+)
+from aiyagari_tpu.solvers.ks_egm import solve_ks_egm
+from aiyagari_tpu.solvers.ks_vfi import solve_ks_vfi
+
+__all__ = ["KSResult", "solve_krusell_smith"]
+
+
+@dataclasses.dataclass
+class KSResult:
+    """Converged K-S economy: ALM coefficients, household solution, and the
+    simulated aggregate path."""
+
+    B: np.ndarray                 # [4] = [b0_good, b1_good, b0_bad, b1_bad]
+    r2: np.ndarray                # [2] per-regime ALM fit
+    solution: object              # KSSolution
+    K_ts: np.ndarray              # [T] simulated aggregate capital path
+    z_path: np.ndarray            # [T] aggregate state path
+    k_population: np.ndarray      # final cross-section of agent capital
+    iterations: int
+    converged: bool
+    diff_B: float
+    solve_seconds: float
+    per_iteration: list
+
+
+def _default_ks_solver_config(method: str) -> SolverConfig:
+    # Reference tolerances: Krusell_Smith_VFI.m:12-13 / Krusell_Smith_EGM.m:12.
+    return SolverConfig(
+        method=method,
+        tol=1e-6,
+        max_iter=10_000,
+        howard_steps=50,
+        improve_every=5,
+        relative_tol=(method == "vfi"),
+    )
+
+
+def solve_krusell_smith(
+    config: KrusellSmithConfig,
+    *,
+    method: str = "vfi",
+    solver: Optional[SolverConfig] = None,
+    alm: ALMConfig = ALMConfig(),
+    backend: BackendConfig = BackendConfig(),
+    on_iteration: Optional[Callable] = None,
+    double_alm: bool = False,
+) -> KSResult:
+    """Iterate household solve -> panel simulation -> ALM regression to a fixed
+    point of the forecasting coefficients B (Krusell_Smith_VFI.m:138-296).
+
+    Stops when max|B_new - B| < alm.tol; damped update otherwise. B starts at
+    [0, 1, 0, 1] (:99) — a unit-root forecast in each regime.
+    """
+    t0 = time.perf_counter()
+    dtype = jnp.float64 if backend.dtype == "float64" else jnp.float32
+    model = KrusellSmithModel.from_config(config, dtype)
+    solver = solver or _default_ks_solver_config(method)
+    prefs = config.preferences
+    tech = config.technology
+    sh = config.shocks
+
+    key = jax.random.PRNGKey(alm.seed)
+    k_z, k_eps = jax.random.split(key)
+    z_path = simulate_aggregate_shocks(model.pz, k_z, T=alm.T)
+    eps_panel = simulate_employment_panel(
+        z_path, model.eps_trans, sh.u_good, sh.u_bad, k_eps, T=alm.T, population=alm.population
+    )
+
+    # Device-mesh placement: with backend.mesh_axes containing "agents", the
+    # employment panel and the capital cross-section are sharded over the mesh
+    # so the per-step policy evaluation data-parallelizes and the K=mean(k)
+    # reduction lowers to a psum over ICI (SURVEY.md §2.4).
+    if backend.mesh_axes:
+        from aiyagari_tpu.parallel.mesh import agents_sharding, make_mesh
+
+        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+        eps_panel = jax.device_put(eps_panel, agents_sharding(mesh, batch_axis=1))
+        panel_sharding = agents_sharding(mesh, batch_axis=0)
+    else:
+        panel_sharding = None
+
+    ns, nK, nk = model.n_states, config.K_size, config.k_size
+    # Initial policy 0.9*k and implied consistent value guess (Krusell_Smith_VFI.m:97-98).
+    k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (ns, nK, nk)).astype(dtype)
+    value = jnp.log(jnp.maximum(0.1 / 0.9 * k_opt, 1e-12)) / (1.0 - prefs.beta)
+    # Initial cross-section at K_grid[0] (:100).
+    k_population = jnp.full((alm.population,), float(model.K_grid[0]), dtype)
+    if panel_sharding is not None:
+        k_population = jax.device_put(k_population, panel_sharding)
+    B = np.array([0.0, 1.0, 0.0, 1.0])
+
+    records = []
+    converged = False
+    diff_B = np.inf
+    r2 = np.zeros(2)
+    sol = None
+    for it in range(alm.max_iter):
+        it_t0 = time.perf_counter()
+        B_dev = jnp.asarray(B, dtype)
+        if solver.method == "vfi":
+            sol = solve_ks_vfi(
+                value, k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                model.r_table, model.w_table, model.eps_by_state,
+                theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
+                delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
+                tol=solver.tol, max_iter=solver.max_iter,
+                howard_steps=solver.howard_steps, improve_every=solver.improve_every,
+                golden_iters=solver.golden_iters, relative_tol=solver.relative_tol,
+            )
+            value = sol.value
+        elif solver.method == "egm":
+            sol = solve_ks_egm(
+                k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                model.r_table, model.w_table, model.eps_by_state,
+                model.z_by_state, model.L_by_state, tech.alpha,
+                theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
+                delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
+                tol=solver.tol, max_iter=solver.max_iter, double_alm=double_alm,
+            )
+        else:
+            raise ValueError(f"unknown method {solver.method!r}")
+        k_opt = sol.k_opt
+
+        K_ts, k_population_new = simulate_capital_path(
+            sol.k_opt, model.k_grid, model.K_grid, z_path, eps_panel,
+            k_population, T=alm.T,
+        )
+        B_new, r2_dev = alm_regression(K_ts, z_path, alm.discard)
+        B_new = np.asarray(B_new, np.float64)
+        r2 = np.asarray(r2_dev, np.float64)
+        diff_B = float(np.max(np.abs(B_new - B)))
+
+        rec = {
+            "iteration": it,
+            "B": B_new.tolist(),
+            "diff_B": diff_B,
+            "r2_good": float(r2[0]),
+            "r2_bad": float(r2[1]),
+            "solver_iterations": int(sol.iterations),
+            "solver_distance": float(sol.distance),
+            "K_mean": float(np.mean(np.asarray(K_ts)[alm.discard:])),
+            "seconds": time.perf_counter() - it_t0,
+        }
+        records.append(rec)
+        if on_iteration is not None:
+            on_iteration(rec)
+
+        if diff_B < alm.tol:
+            converged = True
+            B = B_new
+            k_population = k_population_new
+            break
+        B = alm.damping * B_new + (1.0 - alm.damping) * B
+        # Reference resets the panel to K_grid[0] implicitly by reusing
+        # k_population across B-iterations (:100, :246-247); we do the same.
+        k_population = k_population_new
+
+    K_ts_np = np.asarray(K_ts)
+    return KSResult(
+        B=B,
+        r2=r2,
+        solution=sol,
+        K_ts=K_ts_np,
+        z_path=np.asarray(z_path),
+        k_population=np.asarray(k_population),
+        iterations=len(records),
+        converged=converged,
+        diff_B=diff_B,
+        solve_seconds=time.perf_counter() - t0,
+        per_iteration=records,
+    )

@@ -1,0 +1,186 @@
+"""General-equilibrium closure for the Aiyagari family: bisection on the
+interest rate until capital supply (household side) equals capital demand
+(firm side). Host-side outer loop; each iteration launches two device
+programs (household fixed point, panel simulation).
+
+Reference: Aiyagari_VFI.m:133-206. Deviations (both documented in SURVEY.md
+§3.6 and deliberate):
+  * the wage is recomputed from r every iteration for the EGM methods too —
+    the reference's EGM scripts keep the r=0.04 wage inside the bisection
+    (Aiyagari_EGM.m:180 updates r but never w, the 'stale wage' quirk);
+  * the simulator redraws its initial state per iteration from a fresh key
+    instead of silently reusing the previous pass's state (quirk 7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.sim.ergodic import PanelSeries, simulate_panel
+from aiyagari_tpu.solvers.egm import solve_aiyagari_egm, solve_aiyagari_egm_labor
+from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi, solve_aiyagari_vfi_labor
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+
+__all__ = ["EquilibriumResult", "solve_household", "solve_equilibrium"]
+
+
+@dataclasses.dataclass
+class EquilibriumResult:
+    """GE solution and per-iteration history (the reference's k_demand /
+    k_supply / r_history triple, kept aligned rather than independently
+    sorted — quirk 5)."""
+
+    r: float
+    w: float
+    capital: float
+    solution: object                 # VFISolution or EGMSolution at r*
+    series: PanelSeries
+    r_history: list
+    k_supply: list
+    k_demand: list
+    iterations: int
+    converged: bool
+    solve_seconds: float
+    per_iteration: list              # IterationRecord dicts (diagnostics)
+
+
+def _initial_consumption_guess(model: AiyagariModel, r: float, w: float):
+    """EGM warm start: consume cash-on-hand at mean productivity
+    (Aiyagari_EGM.m:64)."""
+    mean_s = jnp.mean(model.s)
+    base = (1.0 + r) * model.a_grid + w * mean_s
+    return jnp.broadcast_to(base[None, :], (model.s.shape[0], model.a_grid.shape[0]))
+
+
+def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = SolverConfig(),
+                    warm_start=None, block_size: int = 0):
+    """Solve the household problem at interest rate r; returns a VFISolution
+    or EGMSolution depending on solver.method. `warm_start` is the previous
+    value function (VFI) or consumption policy (EGM)."""
+    prefs = model.preferences
+    tech = model.config.technology
+    w = wage_from_r(r, tech.alpha, tech.delta)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+
+    if solver.method == "vfi":
+        v0 = warm_start if warm_start is not None else jnp.zeros((N, na), model.dtype)
+        if model.config.endogenous_labor:
+            return solve_aiyagari_vfi_labor(
+                v0, model.a_grid, model.labor_grid, model.s, model.P, r, w,
+                sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
+                tol=solver.tol, max_iter=solver.max_iter, howard_steps=solver.howard_steps,
+                relative_tol=solver.relative_tol,
+            )
+        return solve_aiyagari_vfi(
+            v0, model.a_grid, model.s, model.P, r, w,
+            sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+            max_iter=solver.max_iter, howard_steps=solver.howard_steps,
+            block_size=block_size, relative_tol=solver.relative_tol,
+        )
+    if solver.method == "egm":
+        C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
+        if model.config.endogenous_labor:
+            return solve_aiyagari_egm_labor(
+                C0, model.a_grid, model.s, model.P, r, w, model.amin,
+                sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
+                tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
+            )
+        return solve_aiyagari_egm(
+            C0, model.a_grid, model.s, model.P, r, w, model.amin,
+            sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol, max_iter=solver.max_iter,
+            relative_tol=solver.relative_tol,
+        )
+    raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
+
+
+def _warm_state(solution, method: str):
+    return solution.v if method == "vfi" else solution.policy_c
+
+
+def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+                      sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
+                      on_iteration: Optional[Callable] = None) -> EquilibriumResult:
+    """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
+    midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
+
+    The household solution is warm-started across bisection iterations (the
+    reference carries v_old across its re-solves at :147-171). Supply is the
+    time/cross-section average of simulated wealth; demand is the firm FOC
+    curve labor*(alpha/(r+delta))^(1/(1-alpha)).
+    """
+    prefs = model.preferences
+    tech = model.config.technology
+    t0 = time.perf_counter()
+    key = jax.random.PRNGKey(sim.seed)
+
+    r_low = eq.r_low
+    r_high = eq.r_high if eq.r_high is not None else 1.0 / prefs.beta - 1.0
+
+    # Warm-start pass at r_init, as the reference does before its loop (:63-129).
+    warm = None
+    sol = solve_household(model, eq.r_init, solver=solver, warm_start=None)
+    warm = _warm_state(sol, solver.method)
+
+    r_hist, ks_hist, kd_hist, records = [], [], [], []
+    converged = False
+    r_mid = eq.r_init
+    series = None
+    for it in range(eq.max_iter):
+        it_t0 = time.perf_counter()
+        r_mid = 0.5 * (r_low + r_high)
+        w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
+        sol = solve_household(model, r_mid, solver=solver, warm_start=warm)
+        warm = _warm_state(sol, solver.method)
+        key, sub = jax.random.split(key)
+        series = simulate_panel(
+            sol.policy_k, sol.policy_c, sol.policy_l, model.a_grid, model.s, model.P,
+            r_mid, w, sub, periods=sim.periods, n_agents=sim.n_agents, delta=tech.delta,
+        )
+        supply = float(jnp.mean(series.k[sim.discard:]))
+        demand = float(capital_demand(r_mid, model.labor_raw, tech.alpha, tech.delta))
+        r_hist.append(r_mid)
+        ks_hist.append(supply)
+        kd_hist.append(demand)
+        rec = {
+            "iteration": it,
+            "r": r_mid,
+            "k_supply": supply,
+            "k_demand": demand,
+            "gap": supply - demand,
+            "solver_iterations": int(sol.iterations),
+            "solver_distance": float(sol.distance),
+            "seconds": time.perf_counter() - it_t0,
+        }
+        records.append(rec)
+        if on_iteration is not None:
+            on_iteration(rec)
+        if abs(supply - demand) < eq.tol:
+            converged = True
+            break
+        if supply > demand:
+            r_high = r_mid
+        else:
+            r_low = r_mid
+
+    w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
+    return EquilibriumResult(
+        r=r_mid,
+        w=w,
+        capital=ks_hist[-1],
+        solution=sol,
+        series=series,
+        r_history=r_hist,
+        k_supply=ks_hist,
+        k_demand=kd_hist,
+        iterations=len(r_hist),
+        converged=converged,
+        solve_seconds=time.perf_counter() - t0,
+        per_iteration=records,
+    )

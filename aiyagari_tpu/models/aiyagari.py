@@ -1,0 +1,84 @@
+"""Aiyagari (1994) model family: exogenous- and endogenous-labor variants.
+
+Bundles the discretized primitives (income chain, asset grid, labor grids)
+derived from an AiyagariConfig, converted once to device arrays of the
+backend dtype. Reference parameterizations: Aiyagari_VFI.m:7-14 (exogenous,
+rho=0.75, sigma_e=0.75) and Aiyagari_Endogenous_Labor_VFI.m:6-15 (endogenous,
+rho=0.6, sigma_e=0.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import AiyagariConfig, HouseholdPreferences, IncomeProcess
+from aiyagari_tpu.utils.grids import aiyagari_asset_bounds, aiyagari_asset_grid
+from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+
+__all__ = ["AiyagariModel", "aiyagari_preset", "aiyagari_labor_preset"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AiyagariModel:
+    """Discretized Aiyagari economy ready for the solvers/simulator."""
+
+    config: AiyagariConfig
+    a_grid: jnp.ndarray        # [na] asset grid
+    s: jnp.ndarray             # [N] normalized efficiency units
+    P: jnp.ndarray             # [N, N] income transition matrix
+    pi: jnp.ndarray            # [N] stationary distribution
+    labor_grid: jnp.ndarray    # [nl] labor-choice grid (endogenous labor only)
+    labor_raw: float           # pre-normalization aggregate labor (demand-curve factor)
+    amin: float
+    amax: float
+
+    @classmethod
+    def from_config(cls, config: AiyagariConfig, dtype=jnp.float64) -> "AiyagariModel":
+        l_grid, P = tauchen(config.income)
+        pi = stationary_distribution(P)
+        s, labor_raw = normalized_labor(l_grid, pi)
+        # Reuse the discretization just built (one Tauchen solve per model).
+        amin, amax = aiyagari_asset_bounds(config, s_min=float(s[0]))
+        a_grid = aiyagari_asset_grid(config, s_min=float(s[0]))
+        lo, hi = config.labor_grid_bounds
+        labor_grid = np.linspace(lo, hi, config.labor_grid_n)
+        return cls(
+            config=config,
+            a_grid=jnp.asarray(a_grid, dtype),
+            s=jnp.asarray(s, dtype),
+            P=jnp.asarray(P, dtype),
+            pi=jnp.asarray(pi, dtype),
+            labor_grid=jnp.asarray(labor_grid, dtype),
+            labor_raw=float(labor_raw),
+            amin=float(amin),
+            amax=float(amax),
+        )
+
+    @property
+    def preferences(self) -> HouseholdPreferences:
+        return self.config.preferences
+
+    @property
+    def dtype(self):
+        return self.a_grid.dtype
+
+
+def aiyagari_preset(grid_size: int = 400, dtype=jnp.float64) -> AiyagariModel:
+    """The canonical Aiyagari_VFI.m / Aiyagari_EGM.m parameterization."""
+    cfg = AiyagariConfig()
+    cfg = dataclasses.replace(cfg, grid=dataclasses.replace(cfg.grid, n_points=grid_size))
+    return AiyagariModel.from_config(cfg, dtype)
+
+
+def aiyagari_labor_preset(grid_size: int = 400, dtype=jnp.float64) -> AiyagariModel:
+    """The endogenous-labor parameterization (rho=0.6, sigma_e=0.2,
+    psi=1, eta=2; Aiyagari_Endogenous_Labor_VFI.m:6-15)."""
+    cfg = AiyagariConfig(
+        income=IncomeProcess(rho=0.6, sigma_e=0.2),
+        endogenous_labor=True,
+    )
+    cfg = dataclasses.replace(cfg, grid=dataclasses.replace(cfg.grid, n_points=grid_size))
+    return AiyagariModel.from_config(cfg, dtype)

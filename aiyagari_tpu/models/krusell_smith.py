@@ -1,0 +1,106 @@
+"""Krusell-Smith (1998) model: aggregate TFP shocks, idiosyncratic employment
+risk, and a log-linear aggregate law of motion (ALM) for forecasting K'.
+
+Bundles the discretized primitives derived from a KrusellSmithConfig:
+individual/aggregate capital grids, the joint 4-state (z x eps) chain, the
+conditional employment-transition matrices used by the shock simulator, and
+the (state, K) price tables. Reference: Krusell_Smith_VFI.m:5-135.
+
+State ordering (index s in 0..3): (good, employed), (bad, employed),
+(good, unemployed), (bad, unemployed) — see utils.markov.KS_STATE_GRID_ORDER.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import KrusellSmithConfig
+from aiyagari_tpu.utils.firm import ks_price_tables
+from aiyagari_tpu.utils.grids import ks_k_grid, ks_K_grid
+from aiyagari_tpu.utils.markov import (
+    KS_STATE_GRID_ORDER,
+    ks_conditional_eps_matrices,
+    ks_transition_matrix,
+)
+
+__all__ = ["KrusellSmithModel", "ks_preset", "state_index"]
+
+
+def state_index(z_idx, employed):
+    """Map (z index 0=good/1=bad, employed flag) -> joint state index,
+    replacing the reference's stringly-keyed containers.Map lookup
+    (Krusell_Smith_VFI.m:118-126) with integer arithmetic."""
+    return z_idx + 2 * (1 - employed)
+
+
+@dataclasses.dataclass(frozen=True)
+class KrusellSmithModel:
+    """Discretized K-S economy ready for the solvers/simulator."""
+
+    config: KrusellSmithConfig
+    k_grid: jnp.ndarray        # [nk] individual capital grid (power-7)
+    K_grid: jnp.ndarray        # [nK] aggregate capital grid
+    P: jnp.ndarray             # [4, 4] joint transition matrix
+    z_by_state: jnp.ndarray    # [4] TFP level per joint state
+    eps_by_state: jnp.ndarray  # [4] employment indicator per joint state
+    L_by_state: jnp.ndarray    # [4] aggregate labor per joint state
+    w_table: jnp.ndarray       # [4, nK]
+    r_table: jnp.ndarray       # [4, nK]
+    pz: jnp.ndarray            # [2, 2] aggregate chain
+    eps_trans: jnp.ndarray     # [2(z), 2(z'), 2(eps), 2(eps')] conditional chain
+
+    @classmethod
+    def from_config(cls, config: KrusellSmithConfig, dtype=jnp.float64) -> "KrusellSmithModel":
+        sh = config.shocks
+        k_grid = ks_k_grid(config)
+        K_grid = ks_K_grid(config)
+        P = ks_transition_matrix(sh)
+
+        z_levels = np.array([sh.z_good, sh.z_bad])
+        u_rates = np.array([sh.u_good, sh.u_bad])
+        z_by_state = np.array([z_levels[zi] for zi, _ in KS_STATE_GRID_ORDER])
+        eps_by_state = np.array([float(emp) for _, emp in KS_STATE_GRID_ORDER])
+        # Aggregate labor L = l_bar * (1 - u(z)): Krusell_Smith_VFI.m:112.
+        L_by_state = np.array([config.l_bar * (1.0 - u_rates[zi]) for zi, _ in KS_STATE_GRID_ORDER])
+        w_table, r_table = ks_price_tables(z_by_state, L_by_state, K_grid, config.technology.alpha)
+
+        pgg = 1.0 - 1.0 / sh.z_good_duration
+        pbb = 1.0 - 1.0 / sh.z_bad_duration
+        pz = np.array([[pgg, 1.0 - pgg], [1.0 - pbb, pbb]])
+
+        mats = ks_conditional_eps_matrices(sh)
+        # eps_trans[zi, zj] = 2x2 matrix [eps, eps'] (0=employed, 1=unemployed).
+        eps_trans = np.zeros((2, 2, 2, 2))
+        for (zi, zj), key in {(0, 0): "gg", (1, 1): "bb", (0, 1): "gb", (1, 0): "bg"}.items():
+            eps_trans[zi, zj] = mats[key]
+
+        as_dtype = lambda a: jnp.asarray(a, dtype)
+        return cls(
+            config=config,
+            k_grid=as_dtype(k_grid),
+            K_grid=as_dtype(K_grid),
+            P=as_dtype(P),
+            z_by_state=as_dtype(z_by_state),
+            eps_by_state=as_dtype(eps_by_state),
+            L_by_state=as_dtype(L_by_state),
+            w_table=as_dtype(w_table),
+            r_table=as_dtype(r_table),
+            pz=as_dtype(pz),
+            eps_trans=as_dtype(eps_trans),
+        )
+
+    @property
+    def dtype(self):
+        return self.k_grid.dtype
+
+    @property
+    def n_states(self) -> int:
+        return 4
+
+
+def ks_preset(dtype=jnp.float64, **overrides) -> KrusellSmithModel:
+    """The reference parameterization (Krusell_Smith_VFI.m:5-13)."""
+    return KrusellSmithModel.from_config(KrusellSmithConfig(**overrides), dtype)

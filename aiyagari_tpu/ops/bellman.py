@@ -1,0 +1,137 @@
+"""Bellman-operator kernels for the Aiyagari family, written as batched tensor
+reductions.
+
+TPU mapping: the expectation EV = beta * P @ v is a dense [N,N]x[N,na] matmul
+(MXU); the choice dimension a' becomes a trailing reduction axis for the VPU.
+The reference's per-(state, asset) scalar loop with a vectorized max
+(Aiyagari_VFI.m:70-83) becomes one [N, na, na'] tensor max; for grids too large
+for HBM the a'-axis is processed in blocks via lax.scan with a running
+max/argmax (same result, bounded memory).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.utils.utility import crra_utility, labor_disutility
+
+__all__ = ["bellman_step", "bellman_step_labor", "howard_eval_step", "howard_eval_step_labor"]
+
+
+def _neg_inf(dtype):
+    return jnp.array(-jnp.inf, dtype)
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "block_size"))
+def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size: int = 0):
+    """One application of the Bellman operator, exogenous labor.
+
+    v [N, na] -> (v_new [N, na], policy_idx [N, na] int32).
+
+    For each (i, j): v_new = max_{j'} u((1+r)a_j + w s_i - a_{j'}) + EV[i, j']
+    with infeasible (c<=0) choices masked to -inf, EV = beta * P @ v.
+    Mirrors Aiyagari_VFI.m:70-83 as a single batched reduction.
+
+    block_size > 0 processes the a' axis in chunks of that size (memory-bounded
+    path for very fine grids); 0 means one dense [N, na, na] tensor.
+    """
+    N, na = v.shape
+    EV = beta * P @ v                                     # [N, na']
+    coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
+
+    def block_scores(ap_vals, ev_vals):
+        c = coh[:, :, None] - ap_vals[None, None, :]      # [N, na, blk]
+        u = jnp.where(c > 0.0, crra_utility(jnp.where(c > 0.0, c, 1.0), sigma), _neg_inf(v.dtype))
+        return u + ev_vals[:, None, :]                    # [N, na, blk]
+
+    if block_size <= 0 or block_size >= na:
+        q = block_scores(a_grid, EV)
+        return jnp.max(q, axis=-1), jnp.argmax(q, axis=-1).astype(jnp.int32)
+
+    nblk = -(-na // block_size)
+    pad = nblk * block_size - na
+    ap_pad = jnp.pad(a_grid, (0, pad))
+    ev_pad = jnp.pad(EV, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+    ap_blocks = ap_pad.reshape(nblk, block_size)
+    ev_blocks = ev_pad.reshape(N, nblk, block_size).transpose(1, 0, 2)
+
+    def body(carry, blk):
+        best, best_idx, offset = carry
+        ap_vals, ev_vals = blk
+        q = block_scores(ap_vals, ev_vals)
+        m = jnp.max(q, axis=-1)
+        mi = jnp.argmax(q, axis=-1).astype(jnp.int32) + offset
+        take_new = m > best                                # strict: ties keep first (MATLAB max)
+        return (jnp.where(take_new, m, best), jnp.where(take_new, mi, best_idx), offset + block_size), None
+
+    init = (jnp.full((N, na), -jnp.inf, v.dtype), jnp.zeros((N, na), jnp.int32), jnp.int32(0))
+    (best, best_idx, _), _ = jax.lax.scan(body, init, (ap_blocks, ev_blocks))
+    return best, best_idx
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma: float, beta: float, psi: float, eta: float):
+    """One Bellman application with a joint (labor x a') discrete choice.
+
+    v [N, na] -> (v_new, policy_a_idx, policy_l_idx).
+
+    Mirrors Aiyagari_Endogenous_Labor_VFI.m:64-122: utility
+    u(c) - psi*l^(1+eta)/(1+eta) over the [nl, na'] choice grid, EV precomputed
+    once per sweep. The labor axis is scanned (nl is small) so peak memory is
+    one [N, na, na'] block per labor point.
+    """
+    N, na = v.shape
+    EV = beta * P @ v                                      # [N, na']
+    base = (1.0 + r) * a_grid[None, :]                     # [N=1 broadcast, na]
+
+    def per_labor(carry, l_val):
+        best, best_a, best_l, l_idx = carry
+        coh = base + (w * l_val) * s[:, None]              # [N, na]
+        c = coh[:, :, None] - a_grid[None, None, :]        # [N, na, na']
+        feas = c > 0.0
+        u = jnp.where(feas, crra_utility(jnp.where(feas, c, 1.0), sigma), _neg_inf(v.dtype))
+        q = u - labor_disutility(l_val, psi, eta) + EV[:, None, :]
+        m = jnp.max(q, axis=-1)
+        mi = jnp.argmax(q, axis=-1).astype(jnp.int32)
+        take = m > best
+        return (
+            jnp.where(take, m, best),
+            jnp.where(take, mi, best_a),
+            jnp.where(take, l_idx, best_l),
+            l_idx + 1,
+        ), None
+
+    init = (
+        jnp.full((N, na), -jnp.inf, v.dtype),
+        jnp.zeros((N, na), jnp.int32),
+        jnp.zeros((N, na), jnp.int32),
+        jnp.int32(0),
+    )
+    (best, best_a, best_l, _), _ = jax.lax.scan(per_labor, init, labor_grid)
+    return best, best_a, best_l
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta"))
+def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma: float, beta: float):
+    """Policy-evaluation sweep at a fixed discrete policy (Howard acceleration):
+    v <- u(c_pol) + beta * (P @ v) gathered at the policy indices."""
+    EV = beta * P @ v                                      # [N, na']
+    ap = a_grid[policy_idx]                                # [N, na]
+    c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - ap
+    u = crra_utility(jnp.maximum(c, 1e-300), sigma)
+    return u + jnp.take_along_axis(EV, policy_idx, axis=1)
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+def howard_eval_step_labor(v, policy_a_idx, policy_l_idx, a_grid, labor_grid, s, P, r, w, *,
+                           sigma: float, beta: float, psi: float, eta: float):
+    """Howard evaluation sweep for the endogenous-labor discrete policy."""
+    EV = beta * P @ v
+    ap = a_grid[policy_a_idx]
+    lv = labor_grid[policy_l_idx]
+    c = (1.0 + r) * a_grid[None, :] + w * lv * s[:, None] - ap
+    u = crra_utility(jnp.maximum(c, 1e-300), sigma) - labor_disutility(lv, psi, eta)
+    return u + jnp.take_along_axis(EV, policy_a_idx, axis=1)

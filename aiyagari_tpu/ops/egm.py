@@ -1,0 +1,79 @@
+"""Endogenous-grid-method operator kernels for the Aiyagari family.
+
+TPU mapping: the Euler-equation RHS is a dense [N,N]x[N,na] matmul (MXU);
+the endogenous-grid inversion is elementwise (VPU); the re-interpolation onto
+the exogenous grid is a vmapped searchsorted+gather. The reference's per-state
+loops (Aiyagari_EGM.m:74-110) collapse into batched array ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.utils.utility import (
+    crra_marginal,
+    crra_marginal_inverse,
+    labor_foc_inverse,
+)
+
+__all__ = ["egm_step", "egm_step_labor"]
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta"))
+def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
+    """One EGM policy update, exogenous labor.
+
+    C [N, na] (consumption policy on the exogenous grid) ->
+    (C_new [N, na], policy_k [N, na]).
+
+    Steps mirror Aiyagari_EGM.m:74-110:
+      1. RHS[i,:] = beta*(1+r) * sum_m P[i,m] u'(C[m,:])   (one matmul)
+      2. c_next = u'^{-1}(RHS)  — consumption consistent with choosing a'=grid
+      3. endogenous grid a_hat = (c_next + a' - w s)/(1+r)
+      4. interpolate a' as a function of a_hat back onto the exogenous grid
+      5. clamp at the borrowing limit
+      6. consumption from the budget constraint
+    """
+    RHS = beta * (1.0 + r) * (P @ crra_marginal(C, sigma))        # [N, na]
+    c_next = crra_marginal_inverse(RHS, sigma)                    # [N, na]
+    a_hat = (c_next + a_grid[None, :] - w * s[:, None]) / (1.0 + r)
+
+    # a_hat is increasing in a' (c_next is), so linear interp + extrapolation
+    # matches interp1(a_hat, a_grid, a_grid, 'linear', 'extrap') at :95.
+    policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
+    policy_k = jnp.maximum(policy_k, amin)                        # :98
+    C_new = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
+    return C_new, policy_k
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float, psi: float, eta: float):
+    """One EGM policy update with endogenous labor via the closed-form
+    intratemporal FOC l = ((w s u'(c))/psi)^(1/eta).
+
+    C [N, na] -> (C_new, policy_k, policy_l).
+
+    Mirrors Aiyagari_Endogenous_Labor_EGM.m:67-107, including its two
+    documented sequencing choices (kept because they are no-ops at the
+    shipped amin=0 parameterization, and flagged in SURVEY.md §3.6):
+    the borrowing constraint is imposed on the interpolated *consumption*
+    policy where a_grid < amin (:91), and the asset policy is floored at 0
+    (:99) rather than amin.
+    """
+    ws = w * s[:, None]                                            # [N, 1]
+    RHS = beta * (1.0 + r) * (P @ crra_marginal(C, sigma))
+    c_next = crra_marginal_inverse(RHS, sigma)
+    l_endo = labor_foc_inverse(ws * crra_marginal(c_next, sigma), psi, eta)   # :86
+    a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)              # :87
+
+    # Interpolate the consumption (not asset) policy onto the exogenous grid (:90).
+    g_c = jax.vmap(lambda ah, cn: linear_interp(ah, cn, a_grid))(a_hat, c_next)
+    g_c = jnp.where(a_grid[None, :] < amin, amin, g_c)                        # :91
+    policy_l = labor_foc_inverse(ws * crra_marginal(g_c, sigma), psi, eta)    # :95
+    policy_k = (1.0 + r) * a_grid[None, :] + ws * policy_l - g_c              # :98
+    policy_k = jnp.maximum(policy_k, 0.0)                                     # :99
+    return g_c, policy_k, policy_l

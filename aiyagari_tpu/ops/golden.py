@@ -1,0 +1,54 @@
+"""Batched golden-section maximization: the trace-friendly replacement for the
+reference's 1,600 per-point fminbnd calls (Krusell_Smith_VFI.m:161-165).
+
+Fixed iteration count (no data-dependent convergence), every candidate
+evaluation batched over all points at once — one vectorized objective call per
+iteration instead of 1,600 scalar optimizations per improvement step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["golden_section_max"]
+
+_INVPHI = 0.6180339887498949   # (sqrt(5)-1)/2
+_INVPHI2 = 0.3819660112501051  # (3-sqrt(5))/2
+
+
+def golden_section_max(f: Callable, lo: jnp.ndarray, hi: jnp.ndarray, n_iters: int = 48) -> jnp.ndarray:
+    """Maximize a concave-ish scalar objective elementwise over [lo, hi].
+
+    f maps candidate arrays (same shape as lo/hi) to objective values of the
+    same shape. After n_iters the bracket width is (hi-lo)*invphi^n_iters
+    (n=48 on a width-1000 bracket -> ~1e-7 absolute), tighter than fminbnd's
+    default 1e-4 TolX. Returns the bracket midpoint.
+    """
+    h = hi - lo
+    x1 = lo + _INVPHI2 * h
+    x2 = lo + _INVPHI * h
+    f1 = f(x1)
+    f2 = f(x2)
+
+    def body(_, carry):
+        lo, hi, x1, x2, f1, f2 = carry
+        take_left = f1 > f2
+        # Left: [lo, x2] with interior x1 -> new x1 probes lower third.
+        new_hi = jnp.where(take_left, x2, hi)
+        new_lo = jnp.where(take_left, lo, x1)
+        h = new_hi - new_lo
+        cand_left = new_lo + _INVPHI2 * h
+        cand_right = new_lo + _INVPHI * h
+        new_x1 = jnp.where(take_left, cand_left, x2)
+        new_x2 = jnp.where(take_left, x1, cand_right)
+        probe = jnp.where(take_left, cand_left, cand_right)
+        fp = f(probe)
+        new_f1 = jnp.where(take_left, fp, f2)
+        new_f2 = jnp.where(take_left, f1, fp)
+        return new_lo, new_hi, new_x1, new_x2, new_f1, new_f2
+
+    lo, hi, *_ = jax.lax.fori_loop(0, n_iters, body, (lo, hi, x1, x2, f1, f2))
+    return 0.5 * (lo + hi)

@@ -1,0 +1,55 @@
+"""Masked per-regime OLS for the aggregate law of motion: log K' = b0 + b1 log K
+fit separately by aggregate state, with R-squared — fully on device with static
+shapes (the reference grows per-state design matrices in a Python loop and
+mldivides them, Krusell_Smith_VFI.m:250-289).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["masked_ols_loglinear", "alm_regression"]
+
+
+def masked_ols_loglinear(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray):
+    """Weighted simple regression y = b0 + b1 x over points where mask=1.
+
+    Returns (b0, b1, r2). Closed-form normal equations from masked sums —
+    no dynamic shapes, so regimes of any (data-dependent) size jit cleanly.
+    """
+    m = mask.astype(x.dtype)
+    n = jnp.sum(m)
+    sx = jnp.sum(m * x)
+    sy = jnp.sum(m * y)
+    sxx = jnp.sum(m * x * x)
+    sxy = jnp.sum(m * x * y)
+    denom = n * sxx - sx * sx
+    b1 = jnp.where(denom != 0.0, (n * sxy - sx * sy) / denom, 0.0)
+    b0 = jnp.where(n > 0.0, (sy - b1 * sx) / jnp.maximum(n, 1.0), 0.0)
+    resid = m * (y - b0 - b1 * x)
+    ss_res = jnp.sum(resid**2)
+    ybar = jnp.where(n > 0.0, sy / jnp.maximum(n, 1.0), 0.0)
+    ss_tot = jnp.sum(m * (y - ybar) ** 2)
+    r2 = jnp.where(ss_tot > 0.0, 1.0 - ss_res / ss_tot, 0.0)
+    return b0, b1, r2
+
+
+def alm_regression(K_ts: jnp.ndarray, z_path: jnp.ndarray, discard: int):
+    """Fit the two-regime aggregate law of motion from a simulated capital path.
+
+    K_ts [T], z_path [T] (0=good, 1=bad). Uses transitions t -> t+1 for
+    t in [discard-1, T-2] (the reference's `for t = T_discard:T-1` with
+    1-based indexing, Krusell_Smith_VFI.m:253-261).
+
+    Returns (B [4] = [b0_g, b1_g, b0_b, b1_b], r2 [2]).
+    """
+    T = K_ts.shape[0]
+    x = jnp.log(K_ts[:-1])
+    y = jnp.log(K_ts[1:])
+    t_idx = jnp.arange(T - 1)
+    in_window = t_idx >= (discard - 1)
+    good = (z_path[:-1] == 0) & in_window
+    bad = (z_path[:-1] == 1) & in_window
+    b0g, b1g, r2g = masked_ols_loglinear(x, y, good)
+    b0b, b1b, r2b = masked_ols_loglinear(x, y, bad)
+    return jnp.stack([b0g, b1g, b0b, b1b]), jnp.stack([r2g, r2b])

@@ -1,0 +1,87 @@
+"""Device-mesh construction and sharding helpers — the framework's distributed
+communication backend surface.
+
+The reference has no distributed machinery at all (single-process MATLAB;
+SURVEY.md §2.4). The TPU-native design: axis-named meshes via jax.make_mesh,
+NamedSharding annotations on the agent panel ("agents" axis — the DP analogue)
+and on value/policy grids ("grid" axis — the TP analogue); XLA lowers the
+cross-shard reductions (panel means, sup-norms) onto ICI collectives within a
+slice and DCN across slices. Multi-host extends the same mesh via
+jax.distributed.initialize without code changes here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "make_mesh",
+    "agents_sharding",
+    "grid_sharding",
+    "replicated",
+    "shard_panel",
+    "force_host_device_count",
+]
+
+AGENTS_AXIS = "agents"
+GRID_AXIS = "grid"
+
+
+def force_host_device_count(n: int) -> None:
+    """Request n virtual host devices (call BEFORE any jax initialization).
+
+    This is the no-hardware test path (SURVEY.md §4.4): an 8-virtual-device CPU
+    mesh exercises the same shardings and collectives as a v5e-8 slice.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+def make_mesh(axis_names: Sequence[str] = (AGENTS_AXIS,),
+              axis_sizes: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    """Build a named mesh over the available devices.
+
+    Default: a 1-D mesh over all devices named "agents". axis_sizes=None uses
+    all devices on the first axis.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = [len(devices)] + [1] * (len(axis_names) - 1)
+    # Auto axis types: classic GSPMD sharding propagation. (jax 0.9's
+    # make_mesh defaults to Explicit sharding-in-types, which rejects gathers
+    # whose output sharding is ambiguous.)
+    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+    return jax.make_mesh(
+        tuple(axis_sizes), tuple(axis_names), devices=devices.ravel(), axis_types=axis_types
+    )
+
+
+def agents_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
+    """Shard an agent-panel array along its agent axis."""
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = AGENTS_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def grid_sharding(mesh: Mesh, grid_axis: int = -1, ndim: int = 2) -> NamedSharding:
+    """Shard a value/policy array along its (fine) asset-grid axis."""
+    spec: list = [None] * ndim
+    spec[grid_axis] = GRID_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_panel(array, mesh: Mesh, batch_axis: int = 0):
+    """Place a panel array with its agent axis sharded across the mesh."""
+    return jax.device_put(array, agents_sharding(mesh, batch_axis))

@@ -1,0 +1,79 @@
+"""Ergodic panel simulation for the Aiyagari family.
+
+The reference simulates ONE household for 10,000 periods with a scalar Python
+loop (Aiyagari_VFI.m:94-129) and aggregates by the time average (ergodicity).
+Here the time axis is a lax.scan (inherently sequential) carrying a whole
+*cross-section* of agents as a vector — a panel of 1 reproduces the reference;
+a panel of n_agents shards across devices for the scaled runs (SURVEY.md §5.7).
+PRNG keys are threaded explicitly, unlike the reference's unseeded `rand`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.interp import linear_interp_rows
+
+__all__ = ["PanelSeries", "simulate_panel"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PanelSeries:
+    """Simulated series, each [T, n_agents]: wealth k, consumption c, net
+    income y, gross income gy, savings sav, labor l, and the income-state
+    index z. Recorded formulas follow Aiyagari_VFI.m:119-122 (with the labor
+    factor included for endogenous-labor models, i.e. the VFI variant's
+    accounting at Aiyagari_Endogenous_Labor_VFI.m:146 — SURVEY.md §3.6 quirk 4
+    resolved in favor of the consistent definition)."""
+
+    k: jax.Array
+    c: jax.Array
+    y: jax.Array
+    gy: jax.Array
+    sav: jax.Array
+    l: jax.Array
+    z: jax.Array
+
+
+@partial(jax.jit, static_argnames=("periods", "n_agents", "delta"))
+def simulate_panel(policy_k, policy_c, policy_l, a_grid, s, P, r, w, key, *,
+                   periods: int, n_agents: int = 1, delta: float = 0.08) -> PanelSeries:
+    """Simulate `n_agents` independent households for `periods` steps.
+
+    policy_* are [N, na] grid policies evaluated by per-agent linear
+    interpolation with extrapolation (Aiyagari_VFI.m:113). The Markov draw
+    z' ~ P[z, :] uses the inverse-CDF method: z' = #(cumsum(P[z]) < u), the
+    vectorized form of find(rand < cumsum(P(z,:)), 1) at :106.
+
+    Initial conditions mirror :101-102: z0 uniform over states, k0 uniform
+    over grid points.
+    """
+    if periods < 1 or n_agents < 1:
+        raise ValueError(f"periods and n_agents must be >= 1, got {periods=}, {n_agents=}")
+    N, na = policy_k.shape
+    cumP = jnp.cumsum(P, axis=1)
+    k_init, k_z, k_scan = jax.random.split(key, 3)
+    z0 = jax.random.randint(k_z, (n_agents,), 0, N)
+    k0 = a_grid[jax.random.randint(k_init, (n_agents,), 0, na)]
+
+    def step(carry, key_t):
+        z, k = carry
+        u = jax.random.uniform(key_t, (n_agents,), dtype=a_grid.dtype)
+        z_new = jnp.sum(cumP[z] < u[:, None], axis=1).astype(z.dtype)
+        k_new = linear_interp_rows(a_grid, policy_k[z_new], k)
+        c_new = linear_interp_rows(a_grid, policy_c[z_new], k)
+        l_new = linear_interp_rows(a_grid, policy_l[z_new], k)
+        labor_inc = w * s[z_new] * l_new
+        y = r * k_new + labor_inc
+        gy = y + delta * k_new
+        sav = gy - c_new
+        return (z_new, k_new), (k_new, c_new, y, gy, sav, l_new, z_new)
+
+    keys = jax.random.split(k_scan, periods)
+    _, (k, c, y, gy, sav, l, z) = jax.lax.scan(step, (z0, k0), keys)
+    return PanelSeries(k, c, y, gy, sav, l, z)

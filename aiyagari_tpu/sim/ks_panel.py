@@ -1,0 +1,115 @@
+"""Krusell-Smith shock processes and the cross-sectional agent-panel
+simulator — the framework's flagship data-parallel workload.
+
+The reference generates the T x 10,000 idiosyncratic shock panel with a scalar
+double loop (Krusell_Smith_VFI.m:70-94) and steps the panel by grouping agents
+per state and calling 2-D interpolants (:222-248). Here both are lax.scans over
+time carrying the whole cross-section as a vector: per-step work is a batched
+gather/interpolation over agents, so the agent axis shards across TPU devices
+(jax.sharding) and the per-step aggregate K_{t+1} = mean(k) becomes a
+cross-device reduction that XLA lowers onto ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.models.krusell_smith import state_index
+from aiyagari_tpu.ops.interp import linear_interp
+
+__all__ = ["simulate_aggregate_shocks", "simulate_employment_panel", "simulate_capital_path"]
+
+
+@partial(jax.jit, static_argnames=("T",))
+def simulate_aggregate_shocks(pz, key, *, T: int):
+    """Two-state aggregate z path (0=good, 1=bad), started in the good state
+    (Krusell_Smith_VFI.m:58-68). Returns int32 [T]."""
+
+    def step(z, key_t):
+        u = jax.random.uniform(key_t, dtype=pz.dtype)
+        stay = pz[z, z]
+        z_new = jnp.where(u > stay, 1 - z, z)
+        return z_new, z_new
+
+    keys = jax.random.split(key, T - 1)
+    _, tail = jax.lax.scan(step, jnp.int32(0), keys)
+    return jnp.concatenate([jnp.zeros((1,), jnp.int32), tail])
+
+
+@partial(jax.jit, static_argnames=("T", "population"))
+def simulate_employment_panel(z_path, eps_trans, u_good, u_bad, key, *, T: int, population: int):
+    """Employment panel [T, population] (0=employed, 1=unemployed), each agent
+    following the conditional chain selected by (z_{t-1} -> z_t)
+    (Krusell_Smith_VFI.m:70-94, vectorized over agents).
+
+    Initial cross-section: unemployed with the period-1 state's unemployment
+    rate. (The reference's initialization `(rand > ug) + 1` at :71 makes 96%
+    of agents *unemployed* under its eps_grid ordering — an initial-condition
+    slip that washes out after the discard window; we use the intended rate.)
+    """
+    k0, k_scan = jax.random.split(key)
+    u0 = jnp.where(z_path[0] == 0, u_good, u_bad)
+    eps0 = (jax.random.uniform(k0, (population,), dtype=eps_trans.dtype) < u0).astype(jnp.int32)
+
+    def step(carry, inp):
+        eps_prev, z_prev = carry
+        z_t, key_t = inp
+        u = jax.random.uniform(key_t, (population,), dtype=eps_trans.dtype)
+        # Stay probability given previous employment status:
+        # employed (0): p11 = eps_trans[zp, zt, 0, 0]; unemployed (1): p00 = [.,., 1, 1].
+        p_emp = jnp.where(
+            eps_prev == 0,
+            eps_trans[z_prev, z_t, 0, 0],   # employed -> employed
+            eps_trans[z_prev, z_t, 1, 0],   # unemployed -> employed
+        )
+        eps_new = (u > p_emp).astype(jnp.int32)   # 0 employed iff u <= p_emp (:87-92)
+        return (eps_new, z_t), eps_new
+
+    keys = jax.random.split(k_scan, T - 1)
+    (_, _), tail = jax.lax.scan(step, (eps0, z_path[0]), (z_path[1:], keys))
+    return jnp.concatenate([eps0[None, :], tail], axis=0)
+
+
+@partial(jax.jit, static_argnames=("T",), donate_argnames=("k_population",))
+def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, *, T: int):
+    """Step the agent panel through T-1 periods under the policy k_opt
+    [ns, nK, nk]; returns (K_ts [T], k_population_final).
+
+    Per step (Krusell_Smith_VFI.m:222-248): each agent's joint state from
+    (z_t, eps_{t,i}); policy evaluated by bilinear interpolation in (k, K) —
+    realized as a 1-D linear interpolation in K (scalar weight per step) nested
+    with a batched per-agent linear interpolation in k; K_{t+1} = mean(k').
+    The agent axis (k_population, eps_panel columns) may be sharded across
+    devices; the mean lowers to a psum over ICI.
+    """
+    nK = K_grid.shape[0]
+
+    ns = k_opt.shape[0]
+
+    def step(carry, inp):
+        k_pop, K_t = carry
+        z_t, eps_t = inp
+        s_t = state_index(z_t, 1 - eps_t)                       # [pop] joint state
+        # Interpolate the policy table in K at the scalar K_t (linear,
+        # extrapolating with edge segments like griddedInterpolant 'linear').
+        iK = jnp.clip(jnp.searchsorted(K_grid, K_t, side="right") - 1, 0, nK - 2)
+        tK = (K_t - K_grid[iK]) / (K_grid[iK + 1] - K_grid[iK])
+        pol_at_K = k_opt[:, iK, :] * (1.0 - tK) + k_opt[:, iK + 1, :] * tK   # [ns, nk]
+        # Evaluate every state's policy at each agent's k, then select by the
+        # agent's state via one-hot combine. ns is tiny (4), and the one-hot
+        # keeps everything elementwise along the (sharded) agent axis — no
+        # gather with sharded indices into the replicated table.
+        vals = jax.vmap(lambda pol: linear_interp(k_grid, pol, k_pop))(pol_at_K)  # [ns, pop]
+        onehot = (s_t[None, :] == jnp.arange(ns)[:, None]).astype(k_pop.dtype)
+        k_new = jnp.sum(vals * onehot, axis=0)
+        K_next = jnp.mean(k_new)
+        return (k_new, K_next), K_t
+
+    (k_population, K_last), K_head = jax.lax.scan(
+        step, (k_population, jnp.mean(k_population)), (z_path[:-1], eps_panel[:-1])
+    )
+    K_ts = jnp.concatenate([K_head, K_last[None]])
+    return K_ts, k_population

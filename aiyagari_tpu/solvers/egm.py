@@ -1,0 +1,76 @@
+"""Endogenous-grid-method solver: fixed point on the consumption policy via
+lax.while_loop (Carroll 2006). Reference: Aiyagari_EGM.m:74-110 and
+Aiyagari_Endogenous_Labor_EGM.m:67-107.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.egm import egm_step, egm_step_labor
+
+__all__ = ["EGMSolution", "solve_aiyagari_egm", "solve_aiyagari_egm_labor"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EGMSolution:
+    """Converged policies on the exogenous grid. policy_l is all-ones for
+    exogenous-labor models."""
+
+    policy_c: jax.Array       # [N, na]
+    policy_k: jax.Array       # [N, na]
+    policy_l: jax.Array       # [N, na]
+    iterations: jax.Array
+    distance: jax.Array
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol"))
+def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+                       tol: float, max_iter: int, relative_tol: bool = False) -> EGMSolution:
+    """Iterate the EGM operator until max|C_new - C| < tol
+    (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations)."""
+
+    def cond(carry):
+        _, _, dist, it = carry
+        return (dist >= tol) & (it < max_iter)
+
+    def body(carry):
+        C, _, _, it = carry
+        C_new, policy_k = egm_step(C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta)
+        diff = jnp.abs(C_new - C)
+        dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        return C_new, policy_k, dist, it + 1
+
+    init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
+    C, policy_k, dist, it = jax.lax.while_loop(cond, body, init)
+    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist)
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol"))
+def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+                             psi: float, eta: float, tol: float, max_iter: int,
+                             relative_tol: bool = False) -> EGMSolution:
+    """EGM with the closed-form intratemporal labor FOC
+    (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+
+    def cond(carry):
+        return (carry[3] >= tol) & (carry[4] < max_iter)
+
+    def body(carry):
+        C, _, _, _, it = carry
+        C_new, policy_k, policy_l = egm_step_labor(
+            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+        )
+        diff = jnp.abs(C_new - C)
+        dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        return C_new, policy_k, policy_l, dist, it + 1
+
+    z = jnp.zeros_like(C_init)
+    init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
+    C, policy_k, policy_l, dist, it = jax.lax.while_loop(cond, body, init)
+    return EGMSolution(C, policy_k, policy_l, it, dist)

@@ -1,0 +1,154 @@
+"""Krusell-Smith household solver: Howard-accelerated value-function iteration
+with batched golden-section policy improvement.
+
+The reference (Krusell_Smith_VFI.m:141-204) runs 1,600 scalar fminbnd
+optimizations every 5th sweep and 50 interpreted Howard evaluation sweeps per
+iteration, refreshing 16 pchip interpolants each sweep. Here the whole fixed
+point is one XLA program: the improvement step is a vectorized golden-section
+search over all (state, K, k) points at once, Howard evaluation is a lax.scan,
+and pchip slope tables are recomputed as batched kernels.
+
+Array layout: value/policy arrays are [ns, nK, nk] with the fine k axis last
+(TPU lanes dimension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.golden import golden_section_max
+from aiyagari_tpu.ops.interp import pchip_interp, pchip_slopes
+from aiyagari_tpu.utils.utility import crra_utility
+
+__all__ = ["KSSolution", "alm_predict", "solve_ks_vfi"]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class KSSolution:
+    """Converged K-S household solution on the [ns, nK, nk] grid."""
+
+    value: jax.Array        # [ns, nK, nk] (all-zeros for the EGM solver)
+    k_opt: jax.Array        # [ns, nK, nk] capital policy
+    iterations: jax.Array
+    distance: jax.Array
+
+
+def alm_predict(B, K, z_index):
+    """K' = exp(b0 + b1 log K) with regime-(z) coefficients B=[b0_g,b1_g,b0_b,b1_b]
+    (Krusell_Smith_VFI.m:335-340, incl. the log(max(K,1e-8)) guard)."""
+    logK = jnp.log(jnp.maximum(K, 1e-8))
+    b0 = jnp.where(z_index == 0, B[0], B[2])
+    b1 = jnp.where(z_index == 0, B[1], B[3])
+    return jnp.exp(b0 + b1 * logK)
+
+
+def _alm_next_K_index(B, K_grid, ns: int):
+    """Per-(state, K) nearest-grid-point index of the ALM-predicted K'
+    (the reference's clamp + snap at Krusell_Smith_VFI.m:340-343).
+    Returns [ns, nK] int32. State ordering: z_index = s % 2."""
+    z_index = jnp.arange(ns) % 2                              # [ns]
+    Kp = alm_predict(B, K_grid[None, :], z_index[:, None])    # [ns, nK]
+    Kp = jnp.clip(Kp, K_grid[0], K_grid[-1])
+    return jnp.argmin(jnp.abs(K_grid[None, None, :] - Kp[:, :, None]), axis=-1).astype(jnp.int32)
+
+
+def _gather_next_tables(value, Kp_idx, k_grid):
+    """V_next[s, K, s', :] = value[s', Kp_idx[s, K], :] plus its pchip slope
+    table — the batched analogue of refreshing the 16 V_interp interpolants
+    (Krusell_Smith_VFI.m:128-135,186-191). Slopes are computed once per
+    distinct (s', K') row of `value` (ns*nK rows) and gathered alongside,
+    not recomputed per (s, K, s') combination."""
+    flat = value.reshape(-1, value.shape[-1])
+    d = jax.vmap(pchip_slopes, in_axes=(None, 0))(k_grid, flat).reshape(value.shape)
+    V_next = jnp.moveaxis(value[:, Kp_idx, :], 0, 2)   # [ns, nK, s', nk]
+    slopes = jnp.moveaxis(d[:, Kp_idx, :], 0, 2)
+    return V_next, slopes
+
+
+def _expected_value(kp, V_next, slopes, P, k_grid):
+    """EV[s,K,k] = sum_s' P[s,s'] * pchip(k_grid, V_next[s,K,s',:], kp[s,K,k])
+    with queries clamped to the grid (Krusell_Smith_VFI.m:346-349)."""
+
+    def per_point(kp_row, V_row, d_row, P_row):
+        # kp_row [nk]; V_row/d_row [ns, nk]; P_row [ns]
+        vals = jax.vmap(lambda v, d: pchip_interp(k_grid, v, kp_row, d))(V_row, d_row)
+        return P_row @ vals                        # [nk]
+
+    return jax.vmap(jax.vmap(per_point, in_axes=(0, 0, 0, None)), in_axes=(0, 0, 0, 0))(
+        kp, V_next, slopes, P
+    )
+
+
+@partial(jax.jit, static_argnames=("theta", "beta", "mu", "l_bar", "tol", "max_iter",
+                                   "howard_steps", "improve_every", "golden_iters",
+                                   "relative_tol"))
+def solve_ks_vfi(value_init, k_opt_init, B, k_grid, K_grid, P, r_table, w_table,
+                 eps_by_state, *, theta: float, beta: float, mu: float, l_bar: float,
+                 delta: float, k_min: float, k_max: float, tol: float, max_iter: int,
+                 howard_steps: int = 50, improve_every: int = 5,
+                 golden_iters: int = 48, relative_tol: bool = True) -> KSSolution:
+    """Howard-accelerated VFI given ALM coefficients B.
+
+    Matches Krusell_Smith_VFI.m:141-204: policy improvement every
+    `improve_every` iterations (continuous maximization over k' in
+    [k_min, min(resources, k_max)]), `howard_steps` evaluation sweeps per
+    iteration, relative sup-norm convergence (:195).
+    """
+    ns, nK, nk = value_init.shape
+
+    # Resources: (r + 1 - delta) k + w (eps l_bar + (1-eps) mu). The reference
+    # includes the mu term in the improvement-step resources (:152-153) but not
+    # in bellman_value's consumption (:355); mu=0 makes them identical, and we
+    # use the consistent form everywhere (SURVEY.md §3.6 quirk 6).
+    labor_endow = eps_by_state * l_bar + (1.0 - eps_by_state) * mu       # [ns]
+    resources = (
+        (r_table + 1.0 - delta)[:, :, None] * k_grid[None, None, :]
+        + (w_table * labor_endow[:, None])[:, :, None]
+    )                                                                     # [ns, nK, nk]
+    Kp_idx = _alm_next_K_index(B, K_grid, ns)                             # [ns, nK]
+
+    def bellman_at(kp, V_next, slopes):
+        EV = _expected_value(kp, V_next, slopes, P, k_grid)
+        c = jnp.maximum(resources - kp, 1e-10)                            # :355-359
+        return crra_utility(c, theta) + beta * EV
+
+    def improve(value, k_opt):
+        V_next, slopes = _gather_next_tables(value, Kp_idx, k_grid)
+        f = lambda kp: bellman_at(kp, V_next, slopes)
+        lo = jnp.full_like(resources, k_min)
+        hi = jnp.minimum(resources, k_max)                                # :159
+        return golden_section_max(f, lo, hi, n_iters=golden_iters)
+
+    def howard(value, k_opt):
+        def sweep(v, _):
+            V_next, slopes = _gather_next_tables(v, Kp_idx, k_grid)
+            return bellman_at(k_opt, V_next, slopes), None
+
+        value, _ = jax.lax.scan(sweep, value, None, length=howard_steps)
+        return value
+
+    def cond(carry):
+        _, _, dist, it = carry
+        return (dist >= tol) & (it < max_iter)
+
+    def body(carry):
+        value, k_opt, _, it = carry
+        k_opt = jax.lax.cond(
+            it % improve_every == 0,
+            lambda: improve(value, k_opt),
+            lambda: k_opt,
+        )
+        value_new = howard(value, k_opt)
+        diff = jnp.abs(value_new - value)
+        # Relative sup-norm is the reference's criterion (Krusell_Smith_VFI.m:195).
+        dist = jnp.max(diff / (jnp.abs(value) + 1e-10)) if relative_tol else jnp.max(diff)
+        return value_new, k_opt, dist, it + 1
+
+    init = (value_init, k_opt_init, jnp.array(jnp.inf, value_init.dtype), jnp.int32(0))
+    value, k_opt, dist, it = jax.lax.while_loop(cond, body, init)
+    return KSSolution(value, k_opt, it, dist)

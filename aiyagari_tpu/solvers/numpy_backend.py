@@ -1,0 +1,261 @@
+"""Pure-NumPy reference backend: the framework's own CPU implementation of the
+Aiyagari solvers, simulator, and GE bisection.
+
+Purpose (BASELINE.md "denominator policy"): the reference publishes no
+performance numbers, so TPU speedups are reported against this implementation
+measured at the reference's problem scales. It is also the oracle for
+backend-equivalence tests (same math, no JAX) — kept fully vectorized so the
+baseline is honest, just un-jitted and host-bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from aiyagari_tpu.config import AiyagariConfig, EquilibriumConfig, SimConfig, SolverConfig
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+from aiyagari_tpu.utils.grids import aiyagari_asset_bounds, aiyagari_asset_grid
+from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+
+__all__ = [
+    "aiyagari_arrays_numpy",
+    "vfi_numpy",
+    "egm_numpy",
+    "vfi_labor_numpy",
+    "egm_labor_numpy",
+    "simulate_numpy",
+    "solve_equilibrium_numpy",
+]
+
+
+def aiyagari_arrays_numpy(cfg: AiyagariConfig):
+    l_grid, P = tauchen(cfg.income)
+    pi = stationary_distribution(P)
+    s, labor_raw = normalized_labor(l_grid, pi)
+    amin, _ = aiyagari_asset_bounds(cfg, s_min=float(s[0]))
+    a_grid = aiyagari_asset_grid(cfg, s_min=float(s[0]))
+    return a_grid, s, P, pi, labor_raw, amin
+
+
+def _crra(c, sigma):
+    if sigma == 1.0:
+        return np.log(c)
+    return (c ** (1.0 - sigma) - 1.0) / (1.0 - sigma)
+
+
+def vfi_numpy(v, a_grid, s, P, r, w, *, sigma, beta, tol, max_iter):
+    """Vectorized NumPy VFI (Aiyagari_VFI.m:65-90)."""
+    N, na = len(s), len(a_grid)
+    coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]
+    c = coh[:, :, None] - a_grid[None, None, :]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        u = np.where(c > 0.0, _crra(np.where(c > 0.0, c, 1.0), sigma), -np.inf)
+    it = 0
+    for it in range(1, max_iter + 1):
+        EV = beta * (P @ v)
+        q = u + EV[:, None, :]
+        v_new = q.max(axis=2)
+        idx = q.argmax(axis=2)
+        dist = np.max(np.abs(v_new - v))
+        v = v_new
+        if dist < tol:
+            break
+    policy_k = a_grid[idx]
+    policy_c = coh - policy_k
+    return v, idx, policy_k, policy_c, np.ones_like(policy_k), it
+
+
+def egm_numpy(C, a_grid, s, P, r, w, amin, *, sigma, beta, tol, max_iter):
+    """Vectorized NumPy EGM (Aiyagari_EGM.m:74-110)."""
+    it = 0
+    policy_k = np.zeros_like(C)
+    for it in range(1, max_iter + 1):
+        RHS = beta * (1.0 + r) * (P @ C ** (-sigma))
+        c_next = RHS ** (-1.0 / sigma)
+        a_hat = (c_next + a_grid[None, :] - w * s[:, None]) / (1.0 + r)
+        for j in range(len(s)):
+            policy_k[j] = np.interp(a_grid, a_hat[j], a_grid)
+            lo, hi = a_hat[j, 0], a_hat[j, -1]
+            below, above = a_grid < lo, a_grid > hi
+            # np.interp clamps; extend linearly like interp1(...,'extrap').
+            sl_lo = (a_grid[1] - a_grid[0]) / (a_hat[j, 1] - a_hat[j, 0])
+            sl_hi = (a_grid[-1] - a_grid[-2]) / (a_hat[j, -1] - a_hat[j, -2])
+            policy_k[j, below] = a_grid[0] + (a_grid[below] - lo) * sl_lo
+            policy_k[j, above] = a_grid[-1] + (a_grid[above] - hi) * sl_hi
+        policy_k = np.maximum(policy_k, amin)
+        C_new = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
+        dist = np.max(np.abs(C_new - C))
+        C = C_new
+        if dist < tol:
+            break
+    return C, policy_k, np.ones_like(C), it
+
+
+def vfi_labor_numpy(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, eta, tol, max_iter):
+    """Vectorized NumPy endogenous-labor VFI (Aiyagari_Endogenous_Labor_VFI.m:64-122)."""
+    N, na = len(s), len(a_grid)
+    nl = len(labor_grid)
+    disu = psi * labor_grid ** (1.0 + eta) / (1.0 + eta)
+    it = 0
+    for it in range(1, max_iter + 1):
+        EV = beta * (P @ v)
+        best = np.full((N, na), -np.inf)
+        best_a = np.zeros((N, na), np.int64)
+        best_l = np.zeros((N, na), np.int64)
+        for li in range(nl):
+            coh = (1.0 + r) * a_grid[None, :] + w * labor_grid[li] * s[:, None]
+            c = coh[:, :, None] - a_grid[None, None, :]
+            u = np.where(c > 0.0, _crra(np.where(c > 0.0, c, 1.0), sigma), -np.inf) - disu[li]
+            q = u + EV[:, None, :]
+            m = q.max(axis=2)
+            mi = q.argmax(axis=2)
+            take = m > best
+            best = np.where(take, m, best)
+            best_a = np.where(take, mi, best_a)
+            best_l = np.where(take, li, best_l)
+        dist = np.max(np.abs(best - v))
+        v = best
+        if dist < tol:
+            break
+    policy_k = a_grid[best_a]
+    policy_l = labor_grid[best_l]
+    policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] * policy_l - policy_k
+    return v, best_a, policy_k, policy_c, policy_l, it
+
+
+def egm_labor_numpy(C, a_grid, s, P, r, w, amin, *, sigma, beta, psi, eta, tol, max_iter):
+    """Vectorized NumPy endogenous-labor EGM (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+    it = 0
+    policy_k = np.zeros_like(C)
+    policy_l = np.zeros_like(C)
+    for it in range(1, max_iter + 1):
+        RHS = beta * (1.0 + r) * (P @ C ** (-sigma))
+        c_next = RHS ** (-1.0 / sigma)
+        ws = w * s[:, None]
+        l_endo = (ws * c_next ** (-sigma) / psi) ** (1.0 / eta)
+        a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)
+        g_c = np.empty_like(C)
+        for j in range(len(s)):
+            g_c[j] = np.interp(a_grid, a_hat[j], c_next[j])
+            lo, hi = a_hat[j, 0], a_hat[j, -1]
+            below, above = a_grid < lo, a_grid > hi
+            sl_lo = (c_next[j, 1] - c_next[j, 0]) / (a_hat[j, 1] - a_hat[j, 0])
+            sl_hi = (c_next[j, -1] - c_next[j, -2]) / (a_hat[j, -1] - a_hat[j, -2])
+            g_c[j, below] = c_next[j, 0] + (a_grid[below] - lo) * sl_lo
+            g_c[j, above] = c_next[j, -1] + (a_grid[above] - hi) * sl_hi
+        g_c = np.where(a_grid[None, :] < amin, amin, g_c)
+        policy_l = (ws * g_c ** (-sigma) / psi) ** (1.0 / eta)
+        policy_k = np.maximum((1.0 + r) * a_grid[None, :] + ws * policy_l - g_c, 0.0)
+        dist = np.max(np.abs(g_c - C))
+        C = g_c
+        if dist < tol:
+            break
+    return C, policy_k, policy_l, it
+
+
+def simulate_numpy(policy_k, policy_c, policy_l, a_grid, s, P, r, w, delta, rng,
+                   periods, n_agents=1):
+    """Panel simulation with linear interpolation (Aiyagari_VFI.m:94-129)."""
+    N, na = policy_k.shape
+    cumP = np.cumsum(P, axis=1)
+    z = rng.integers(0, N, n_agents)
+    k = a_grid[rng.integers(0, na, n_agents)]
+    out_k = np.empty((periods, n_agents))
+    out_c = np.empty((periods, n_agents))
+    out_y = np.empty((periods, n_agents))
+    out_gy = np.empty((periods, n_agents))
+    out_s = np.empty((periods, n_agents))
+    for t in range(periods):
+        u = rng.random(n_agents)
+        z = (cumP[z] < u[:, None]).sum(axis=1)
+        k_new = np.array([np.interp(k[i], a_grid, policy_k[z[i]]) for i in range(n_agents)])
+        c_new = np.array([np.interp(k[i], a_grid, policy_c[z[i]]) for i in range(n_agents)])
+        l_new = np.array([np.interp(k[i], a_grid, policy_l[z[i]]) for i in range(n_agents)])
+        y = r * k_new + w * s[z] * l_new
+        out_k[t], out_c[t], out_y[t] = k_new, c_new, y
+        out_gy[t] = y + delta * k_new
+        out_s[t] = out_gy[t] - c_new
+        k = k_new
+    return out_k, out_c, out_y, out_gy, out_s
+
+
+@dataclasses.dataclass
+class NumpyEquilibriumResult:
+    r: float
+    w: float
+    capital: float
+    policy_k: np.ndarray
+    policy_c: np.ndarray
+    policy_l: np.ndarray
+    sim_k: np.ndarray
+    r_history: list
+    k_supply: list
+    k_demand: list
+    converged: bool
+    solve_seconds: float
+
+
+def solve_equilibrium_numpy(cfg: AiyagariConfig, *, solver: SolverConfig = SolverConfig(),
+                            sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig()):
+    """GE bisection, NumPy backend (mirrors equilibrium.bisection.solve_equilibrium)."""
+    t0 = time.perf_counter()
+    prefs, tech = cfg.preferences, cfg.technology
+    a_grid, s, P, pi, labor_raw, amin = aiyagari_arrays_numpy(cfg)
+    rng = np.random.default_rng(sim.seed)
+    N, na = len(s), len(a_grid)
+
+    kwargs = dict(sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol, max_iter=solver.max_iter)
+    labor_grid = np.linspace(*cfg.labor_grid_bounds, cfg.labor_grid_n)
+
+    def household(r, warm):
+        w = wage_from_r(r, tech.alpha, tech.delta)
+        if solver.method == "vfi":
+            v0 = warm if warm is not None else np.zeros((N, na))
+            if cfg.endogenous_labor:
+                v, _, pk, pc, pl, _ = vfi_labor_numpy(v0, a_grid, labor_grid, s, P, r, w,
+                                                      psi=prefs.psi, eta=prefs.eta, **kwargs)
+            else:
+                v, _, pk, pc, pl, _ = vfi_numpy(v0, a_grid, s, P, r, w, **kwargs)
+            return v, pk, pc, pl
+        C0 = warm if warm is not None else np.tile((1.0 + r) * a_grid + w * s.mean(), (N, 1))
+        if cfg.endogenous_labor:
+            C, pk, pl, _ = egm_labor_numpy(C0, a_grid, s, P, r, w, amin,
+                                           psi=prefs.psi, eta=prefs.eta, **kwargs)
+        else:
+            C, pk, pl, _ = egm_numpy(C0, a_grid, s, P, r, w, amin, **kwargs)
+        return C, pk, C, pl
+
+    warm, *_ = household(eq.r_init, None)
+    r_low = eq.r_low
+    r_high = eq.r_high if eq.r_high is not None else 1.0 / prefs.beta - 1.0
+    r_hist, ks_hist, kd_hist = [], [], []
+    converged = False
+    r_mid = eq.r_init
+    for _ in range(eq.max_iter):
+        r_mid = 0.5 * (r_low + r_high)
+        w = wage_from_r(r_mid, tech.alpha, tech.delta)
+        warm, pk, pc, pl = household(r_mid, warm)
+        sim_k, sim_c, *_ = simulate_numpy(pk, pc, pl, a_grid, s, P, r_mid, w,
+                                          tech.delta, rng, sim.periods, sim.n_agents)
+        supply = sim_k[sim.discard:].mean()
+        demand = capital_demand(r_mid, labor_raw, tech.alpha, tech.delta)
+        r_hist.append(r_mid)
+        ks_hist.append(supply)
+        kd_hist.append(demand)
+        if abs(supply - demand) < eq.tol:
+            converged = True
+            break
+        if supply > demand:
+            r_high = r_mid
+        else:
+            r_low = r_mid
+    w = wage_from_r(r_mid, tech.alpha, tech.delta)
+    return NumpyEquilibriumResult(
+        r=float(r_mid), w=float(w), capital=float(ks_hist[-1]),
+        policy_k=pk, policy_c=pc, policy_l=pl, sim_k=sim_k,
+        r_history=r_hist, k_supply=ks_hist, k_demand=kd_hist,
+        converged=converged, solve_seconds=time.perf_counter() - t0,
+    )

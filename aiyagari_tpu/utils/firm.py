@@ -1,0 +1,55 @@
+"""Cobb-Douglas firm block: factor prices from firm FOCs and the capital
+demand curve.
+
+Reference: wage from r at Aiyagari_VFI.m:67; capital demand at :195; the
+Krusell-Smith (z, K)-dependent price tables at Krusell_Smith_VFI.m:103-116.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "wage_from_r",
+    "capital_demand",
+    "r_from_K",
+    "w_from_K",
+    "ks_price_tables",
+]
+
+
+def wage_from_r(r, alpha: float, delta: float):
+    """w = (1-alpha) * (alpha/(r+delta))^(alpha/(1-alpha)) with z=L=1
+    (Aiyagari_VFI.m:67). Works on scalars or arrays of any backend."""
+    return (1.0 - alpha) * (alpha / (r + delta)) ** (alpha / (1.0 - alpha))
+
+
+def capital_demand(r, labor: float, alpha: float, delta: float):
+    """K_d(r) = labor * (alpha/(r+delta))^(1/(1-alpha)) (Aiyagari_VFI.m:195)."""
+    return labor * (alpha / (r + delta)) ** (1.0 / (1.0 - alpha))
+
+
+def r_from_K(K, L, z, alpha: float):
+    """Marginal product of capital r = alpha z K^(alpha-1) L^(1-alpha)
+    (Krusell_Smith_VFI.m:114). Note: gross of depreciation, as in the
+    reference (consumption uses r + 1 - delta)."""
+    return alpha * z * K ** (alpha - 1.0) * L ** (1.0 - alpha)
+
+
+def w_from_K(K, L, z, alpha: float):
+    """Wage w = (1-alpha) z K^alpha L^(-alpha) (Krusell_Smith_VFI.m:113)."""
+    return (1.0 - alpha) * z * K**alpha * L ** (-alpha)
+
+
+def ks_price_tables(z_by_state: np.ndarray, L_by_state: np.ndarray, K_grid: np.ndarray, alpha: float):
+    """Precompute w(s, K) and r(s, K) tables over the joint state and the
+    aggregate-capital grid (Krusell_Smith_VFI.m:103-116).
+
+    z_by_state/L_by_state have shape [ns]; returns (w_table, r_table) [ns, nK].
+    """
+    z = np.asarray(z_by_state)[:, None]
+    L = np.asarray(L_by_state)[:, None]
+    K = np.asarray(K_grid)[None, :]
+    w = w_from_K(K, L, z, alpha)
+    r = r_from_K(K, L, z, alpha)
+    return w, r

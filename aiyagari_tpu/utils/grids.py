@@ -1,0 +1,71 @@
+"""Grid construction: power-spaced asset grids and derived model bounds.
+
+Reference: quadratic-spaced 400-point Aiyagari grid (Aiyagari_VFI.m:51-58),
+power-7 100-point Krusell-Smith individual grid plus 4-point aggregate grid
+(Krusell_Smith_VFI.m:16-21).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from aiyagari_tpu.config import AiyagariConfig, KrusellSmithConfig
+
+__all__ = [
+    "power_grid",
+    "aiyagari_asset_bounds",
+    "aiyagari_asset_grid",
+    "ks_k_grid",
+    "ks_K_grid",
+]
+
+
+def power_grid(lo: float, hi: float, n: int, power: float) -> np.ndarray:
+    """lo + (hi-lo) * linspace(0,1,n)^power — denser near lo for power>1."""
+    return lo + (hi - lo) * np.linspace(0.0, 1.0, n) ** power
+
+
+def aiyagari_asset_bounds(cfg: AiyagariConfig, s_min: float | None = None) -> tuple[float, float]:
+    """Derive [amin, amax] from model parameters as the reference does.
+
+    amin = min(b, wmin*s_min) with wmin the wage at the maximal interest rate
+    r = 1/beta - 1; amax = output+undepreciated capital at the golden-rule-like
+    kmax = delta^(1/(alpha-1)). Reference: Aiyagari_VFI.m:53-56. With b=0 and
+    s_min>0 this gives amin=0.
+
+    Pass the lowest normalized efficiency unit as s_min to reuse an
+    already-built income discretization; otherwise it is derived here.
+    """
+    if cfg.grid.amin is not None and cfg.grid.amax is not None:
+        return cfg.grid.amin, cfg.grid.amax
+    alpha, delta, beta = cfg.technology.alpha, cfg.technology.delta, cfg.preferences.beta
+    if s_min is None and cfg.grid.amin is None:
+        from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+
+        l_grid, P = tauchen(cfg.income)
+        pi = stationary_distribution(P)
+        s, _ = normalized_labor(l_grid, pi)
+        s_min = float(s[0])
+    wmin = (1 - alpha) * (alpha / ((1 / beta - 1) + delta)) ** (alpha / (1 - alpha))
+    amin = min(cfg.borrowing_limit, wmin * s_min) if cfg.grid.amin is None else cfg.grid.amin
+    kmax = delta ** (1.0 / (alpha - 1.0))
+    amax = kmax**alpha + (1 - delta) * kmax if cfg.grid.amax is None else cfg.grid.amax
+    return float(amin), float(amax)
+
+
+def aiyagari_asset_grid(cfg: AiyagariConfig, s_min: float | None = None) -> np.ndarray:
+    amin, amax = aiyagari_asset_bounds(cfg, s_min)
+    return power_grid(amin, amax, cfg.grid.n_points, cfg.grid.power)
+
+
+def ks_k_grid(cfg: KrusellSmithConfig) -> np.ndarray:
+    """Individual capital grid, power-spaced with pinned endpoints
+    (Krusell_Smith_VFI.m:16-17 pins k_grid(1)=k_min, k_grid(end)=k_max;
+    with the lo+(hi-lo)*t^p form those already hold exactly)."""
+    g = np.linspace(0.0, 1.0, cfg.k_size) ** cfg.k_power * (cfg.k_max - cfg.k_min) + cfg.k_min
+    g[0], g[-1] = cfg.k_min, cfg.k_max
+    return g
+
+
+def ks_K_grid(cfg: KrusellSmithConfig) -> np.ndarray:
+    return np.linspace(cfg.K_min, cfg.K_max, cfg.K_size)

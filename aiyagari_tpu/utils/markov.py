@@ -1,0 +1,140 @@
+"""Markov-chain construction: Tauchen discretization, stationary distributions,
+and the Krusell-Smith duration-targeted joint (z x eps) chain.
+
+TPU-first notes: everything here is tiny, dense linear algebra evaluated once at
+setup, so it runs in float64 on host by default; the outputs feed device kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from aiyagari_tpu.config import IncomeProcess, KSShockProcess
+
+__all__ = [
+    "tauchen",
+    "stationary_distribution",
+    "normalized_labor",
+    "ks_transition_matrix",
+    "ks_conditional_eps_matrices",
+    "KS_STATE_GRID_ORDER",
+]
+
+
+def _norm_cdf(x: np.ndarray) -> np.ndarray:
+    from math import sqrt
+
+    from scipy.special import erf  # type: ignore
+
+    return 0.5 * (1.0 + erf(x / sqrt(2.0)))
+
+
+def tauchen(process: IncomeProcess) -> tuple[np.ndarray, np.ndarray]:
+    """Discretize log s' = rho*log s + e, e~N(0, sd^2) on a fixed grid.
+
+    Matches the reference's variant (Aiyagari_VFI.m:18-35): grid points
+    l_i = (i - (n+1)/2) * sigma_e with half-integer break intervals
+    (..., -1.5, -0.5, 0.5, ...) * sigma_e, and row i of P given by the
+    probability mass of N(rho*l_i, sd) in each interval. The reference
+    computes the mass by adaptive quadrature of the normal pdf; the closed
+    form used here (CDF differences) is the same integral evaluated exactly.
+
+    Returns (l_grid [n], P [n, n]) in float64.
+    """
+    n = process.n_states
+    sigma_e = process.sigma_e
+    rho = process.rho
+    center = (n + 1) / 2.0
+    l_grid = (np.arange(1, n + 1) - center) * sigma_e
+    # Break intervals at half-integers times sigma_e, open at the ends.
+    edges = (np.arange(1, n) - center + 0.5) * sigma_e
+    edges = np.concatenate(([-np.inf], edges, [np.inf]))
+    sd = sigma_e * np.sqrt(1.0 - rho**2)
+    mu = rho * l_grid[:, None]                      # (n, 1) conditional means
+    z = (edges[None, :] - mu) / sd                  # (n, n+1)
+    cdf = np.where(np.isneginf(z), 0.0, np.where(np.isposinf(z), 1.0, _norm_cdf(z)))
+    P = np.diff(cdf, axis=1)
+    return l_grid, P
+
+
+def stationary_distribution(P: np.ndarray) -> np.ndarray:
+    """Stationary distribution pi with pi' P = pi', sum(pi)=1.
+
+    Solves the overdetermined system [P' - I; 1'] x = [0; 1] by least squares,
+    exactly as the reference's mldivide solve (Aiyagari_VFI.m:39-42).
+    """
+    n = P.shape[0]
+    A = np.vstack([P.T - np.eye(n), np.ones((1, n))])
+    b = np.concatenate([np.zeros(n), [1.0]])
+    pi, *_ = np.linalg.lstsq(A, b, rcond=None)
+    return pi
+
+
+def normalized_labor(l_grid: np.ndarray, pi: np.ndarray) -> tuple[np.ndarray, float]:
+    """Efficiency units s=exp(l) normalized so aggregate labor supply is 1.
+
+    Reference: Aiyagari_VFI.m:43-45. Returns (s_normalized [n], labor_raw).
+    `labor_raw` (the pre-normalization aggregate s @ pi) multiplies the
+    capital-demand curve (Aiyagari_VFI.m:195).
+    """
+    s = np.exp(l_grid)
+    labor = float(s @ pi)
+    return s / labor, labor
+
+
+def ks_conditional_eps_matrices(shocks: KSShockProcess) -> dict[str, np.ndarray]:
+    """The four conditional 2x2 employment-transition matrices, keyed by the
+    aggregate transition (gg, bb, gb, bg), built from duration targets.
+
+    Rows/cols ordered [employed, unemployed]. Matches Krusell_Smith_VFI.m:28-45.
+    Key 'gb' means aggregate state moved good -> bad.
+    """
+    ug, ub = shocks.u_good, shocks.u_bad
+    p00_gg = 1.0 - 1.0 / shocks.u_good_duration
+    p00_bb = 1.0 - 1.0 / shocks.u_bad_duration
+    p00_gb = shocks.uu_rel_gb2bb * p00_bb
+    p00_bg = shocks.uu_rel_bg2gg * p00_gg
+
+    out = {}
+    for key, p00, u_from, u_to in (
+        ("gg", p00_gg, ug, ug),
+        ("bb", p00_bb, ub, ub),
+        ("gb", p00_gb, ug, ub),
+        ("bg", p00_bg, ub, ug),
+    ):
+        p01 = 1.0 - p00
+        # Employment-to-unemployment probability pinned down by consistency of
+        # the unemployment rate: u' = u*p00 + (1-u)*p10  (Krusell_Smith_VFI.m:39-42).
+        p10 = (u_to - u_from * p00) / (1.0 - u_from)
+        p11 = 1.0 - p10
+        out[key] = np.array([[p11, p10], [p01, p00]])
+    return out
+
+
+# State ordering used throughout: index s in {0,1,2,3} maps to
+# (z, eps) = [(good, employed), (bad, employed), (good, unemployed), (bad, unemployed)].
+# This is the reference's meshgrid ordering s_grid = [Z(:), Eps(:)]
+# with z_grid=[zg, zb], eps_grid=[1, 0] (Krusell_Smith_VFI.m:18-21).
+KS_STATE_GRID_ORDER = ((0, 1), (1, 1), (0, 0), (1, 0))  # (z_index, employed_flag)
+
+
+def ks_transition_matrix(shocks: KSShockProcess) -> np.ndarray:
+    """Joint 4x4 transition matrix over (z, eps) states.
+
+    P[s, s'] = Pr(z'|z) * Pr(eps'|eps, z, z'), assembled exactly as
+    Krusell_Smith_VFI.m:47-55 under the state ordering KS_STATE_GRID_ORDER.
+    """
+    pgg = 1.0 - 1.0 / shocks.z_good_duration
+    pbb = 1.0 - 1.0 / shocks.z_bad_duration
+    pz = np.array([[pgg, 1.0 - pgg], [1.0 - pbb, pbb]])  # [z, z']
+    eps_mats = ks_conditional_eps_matrices(shocks)
+    key_by_pair = {(0, 0): "gg", (1, 1): "bb", (0, 1): "gb", (1, 0): "bg"}
+
+    P = np.zeros((4, 4))
+    for s, (zi, emp) in enumerate(KS_STATE_GRID_ORDER):
+        for sp, (zj, emp_p) in enumerate(KS_STATE_GRID_ORDER):
+            Peps = eps_mats[key_by_pair[(zi, zj)]]
+            row = 0 if emp else 1
+            col = 0 if emp_p else 1
+            P[s, sp] = pz[zi, zj] * Peps[row, col]
+    return P

@@ -1,0 +1,58 @@
+"""CRRA utility, marginal utility and inverses, labor disutility and the
+intratemporal first-order condition — all written dtype-generically so they
+jit/vmap on device (jnp) and also accept NumPy arrays for the reference backend.
+
+Reference: CRRA with log special case at Aiyagari_VFI.m:74-78; EGM marginal
+utility handles at Aiyagari_EGM.m:67-69; labor disutility and its inverse at
+Aiyagari_Endogenous_Labor_EGM.m:59-62.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "crra_utility",
+    "crra_marginal",
+    "crra_marginal_inverse",
+    "labor_disutility",
+    "labor_marginal_disutility",
+    "labor_foc_inverse",
+]
+
+
+def crra_utility(c, sigma: float):
+    """u(c) = (c^(1-sigma)-1)/(1-sigma), log(c) at sigma==1 (Aiyagari_VFI.m:74-78).
+
+    sigma is a static Python float so the branch resolves at trace time.
+    """
+    if sigma == 1.0:
+        return jnp.log(c)
+    return (c ** (1.0 - sigma) - 1.0) / (1.0 - sigma)
+
+
+def crra_marginal(c, sigma: float):
+    """u'(c) = c^(-sigma) (Aiyagari_EGM.m:68)."""
+    return c ** (-sigma)
+
+
+def crra_marginal_inverse(up, sigma: float):
+    """(u')^{-1}(x) = x^(-1/sigma) (Aiyagari_EGM.m:69)."""
+    return up ** (-1.0 / sigma)
+
+
+def labor_disutility(l, psi: float, eta: float):
+    """v(l) = psi * l^(1+eta)/(1+eta) (Aiyagari_Endogenous_Labor_VFI.m:96)."""
+    return psi * l ** (1.0 + eta) / (1.0 + eta)
+
+
+def labor_marginal_disutility(l, psi: float, eta: float):
+    """v'(l) = psi * l^eta (Aiyagari_Endogenous_Labor_EGM.m:61)."""
+    return psi * l**eta
+
+
+def labor_foc_inverse(x, psi: float, eta: float):
+    """(v')^{-1}(x) = (x/psi)^(1/eta): the closed-form intratemporal FOC
+    l = (w*s*u'(c)/psi)^(1/eta) used by endogenous-labor EGM
+    (Aiyagari_Endogenous_Labor_EGM.m:62,86)."""
+    return (x / psi) ** (1.0 / eta)

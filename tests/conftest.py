@@ -1,0 +1,29 @@
+"""Test configuration: force the CPU backend with 8 virtual devices and
+float64 BEFORE jax initializes (SURVEY.md §4.4 backend-equivalence strategy —
+shardings and collectives are exercised on a virtual mesh without TPU
+hardware; numerics are validated in f64)."""
+
+import os
+
+# Hard override (the session environment presets JAX_PLATFORMS=axon/TPU).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A pytest plugin (jaxtyping) imports jax before this conftest runs, so the
+# env vars above can be too late for jax's import-time config — set the flags
+# explicitly too (safe while no backend is initialized yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

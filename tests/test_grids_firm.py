@@ -1,0 +1,78 @@
+"""Grid-builder and firm-block unit tests (SURVEY.md §4.1)."""
+
+import numpy as np
+
+from aiyagari_tpu.config import AiyagariConfig, KrusellSmithConfig
+from aiyagari_tpu.utils.firm import capital_demand, ks_price_tables, r_from_K, w_from_K, wage_from_r
+from aiyagari_tpu.utils.grids import (
+    aiyagari_asset_bounds,
+    aiyagari_asset_grid,
+    ks_K_grid,
+    ks_k_grid,
+    power_grid,
+)
+
+
+class TestGrids:
+    def test_power_grid_endpoints_and_density(self):
+        g = power_grid(0.0, 10.0, 100, 2.0)
+        assert g[0] == 0.0 and g[-1] == 10.0
+        # Quadratic spacing: increments increase monotonically.
+        assert (np.diff(np.diff(g)) > -1e-12).all()
+
+    def test_aiyagari_bounds_formulas(self):
+        # amin = min(b, wmin*s_min) = 0 with b=0; amax from kmax = delta^(1/(alpha-1))
+        # (Aiyagari_VFI.m:53-56).
+        cfg = AiyagariConfig()
+        amin, amax = aiyagari_asset_bounds(cfg)
+        alpha, delta = cfg.technology.alpha, cfg.technology.delta
+        kmax = delta ** (1 / (alpha - 1))
+        assert amin == 0.0
+        np.testing.assert_allclose(amax, kmax**alpha + (1 - delta) * kmax)
+
+    def test_aiyagari_grid_matches_reference_formula(self):
+        cfg = AiyagariConfig()
+        g = aiyagari_asset_grid(cfg)
+        amin, amax = aiyagari_asset_bounds(cfg)
+        want = amin + (amax - amin) * np.linspace(0, 1, 400) ** 2
+        np.testing.assert_allclose(g, want, atol=1e-12)
+
+    def test_ks_grids(self):
+        cfg = KrusellSmithConfig()
+        k = ks_k_grid(cfg)
+        K = ks_K_grid(cfg)
+        assert k[0] == cfg.k_min and k[-1] == cfg.k_max and len(k) == 100
+        np.testing.assert_allclose(K, [30.0, 36.0 + 2.0 / 3.0, 43.0 + 1.0 / 3.0, 50.0])
+
+
+class TestFirm:
+    def test_price_duals_invert(self):
+        # w(r) via r->K/L ratio: r = alpha (K/L)^(alpha-1) and
+        # w = (1-alpha)(K/L)^alpha must be consistent.
+        alpha, delta = 0.36, 0.08
+        r = 0.03
+        k_over_l = (alpha / (r + delta)) ** (1 / (1 - alpha))
+        w = wage_from_r(r, alpha, delta)
+        np.testing.assert_allclose(w, (1 - alpha) * k_over_l**alpha, rtol=1e-12)
+        # And the marginal products at that ratio reproduce (r+delta, w).
+        np.testing.assert_allclose(r_from_K(k_over_l, 1.0, 1.0, alpha), r + delta, rtol=1e-12)
+        np.testing.assert_allclose(w_from_K(k_over_l, 1.0, 1.0, alpha), w, rtol=1e-12)
+
+    def test_capital_demand_downward_sloping(self):
+        rs = np.linspace(-0.02, 0.04, 20)
+        kd = capital_demand(rs, 1.0, 0.36, 0.08)
+        assert (np.diff(kd) < 0).all()
+
+    def test_ks_price_tables_shape_and_values(self):
+        cfg = KrusellSmithConfig()
+        z = np.array([1.01, 0.99, 1.01, 0.99])
+        L = np.array([cfg.l_bar * 0.96, cfg.l_bar * 0.90] * 2)
+        K = ks_K_grid(cfg)
+        w, r = ks_price_tables(z, L, K, cfg.technology.alpha)
+        assert w.shape == (4, 4) and r.shape == (4, 4)
+        # Spot check one cell against the scalar formula (Krusell_Smith_VFI.m:113-114).
+        np.testing.assert_allclose(
+            r[0, 0], 0.36 * 1.01 * K[0] ** (0.36 - 1) * L[0] ** (1 - 0.36), rtol=1e-12
+        )
+        # Wage increasing in K, interest decreasing in K.
+        assert (np.diff(w, axis=1) > 0).all() and (np.diff(r, axis=1) < 0).all()

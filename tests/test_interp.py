@@ -1,0 +1,126 @@
+"""Interpolation-kernel tests against SciPy oracles (SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.interpolate import PchipInterpolator, RegularGridInterpolator
+
+from aiyagari_tpu.ops.interp import (
+    interp2d_linear,
+    linear_interp,
+    linear_interp_rows,
+    masked_pchip_interp,
+    pchip_interp,
+    pchip_slopes,
+)
+
+
+class TestLinearInterp:
+    def test_matches_numpy_inside(self, rng):
+        x = np.sort(rng.uniform(0, 10, 40))
+        y = np.sin(x)
+        q = rng.uniform(x[0], x[-1], 100)
+        np.testing.assert_allclose(linear_interp(jnp.array(x), jnp.array(y), jnp.array(q)),
+                                   np.interp(q, x, y), atol=1e-12)
+
+    def test_linear_extrapolation(self):
+        x = np.array([0.0, 1.0, 2.0])
+        y = np.array([0.0, 2.0, 6.0])
+        # Below: slope 2 -> y(-1) = -2. Above: slope 4 -> y(3) = 10.
+        out = linear_interp(jnp.array(x), jnp.array(y), jnp.array([-1.0, 3.0]))
+        np.testing.assert_allclose(out, [-2.0, 10.0], atol=1e-12)
+
+    def test_rows_variant(self, rng):
+        x = np.sort(rng.uniform(0, 5, 30))
+        Y = rng.normal(size=(8, 30))
+        q = rng.uniform(-1, 6, 8)
+        got = linear_interp_rows(jnp.array(x), jnp.array(Y), jnp.array(q))
+        for i in range(8):
+            want = linear_interp(jnp.array(x), jnp.array(Y[i]), jnp.array(q[i]))
+            np.testing.assert_allclose(got[i], want, atol=1e-12)
+
+
+class TestPchip:
+    def test_matches_scipy(self, rng):
+        # SciPy's PchipInterpolator implements the same Fritsch-Carlson
+        # algorithm as MATLAB's pchip.
+        x = np.sort(rng.uniform(0, 10, 25))
+        y = np.cumsum(rng.uniform(0.1, 1.0, 25))  # monotone data
+        q = rng.uniform(x[0], x[-1], 200)
+        got = pchip_interp(jnp.array(x), jnp.array(y), jnp.array(q))
+        want = PchipInterpolator(x, y)(q)
+        np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+    def test_matches_scipy_nonmonotone(self, rng):
+        x = np.linspace(0, 4 * np.pi, 30)
+        y = np.sin(x) + 0.1 * rng.normal(size=30)
+        q = rng.uniform(x[0], x[-1], 200)
+        got = pchip_interp(jnp.array(x), jnp.array(y), jnp.array(q))
+        want = PchipInterpolator(x, y)(q)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+    def test_clamps_outside(self):
+        x = np.array([0.0, 1.0, 2.0, 3.0])
+        y = np.array([0.0, 1.0, 4.0, 9.0])
+        out = pchip_interp(jnp.array(x), jnp.array(y), jnp.array([-5.0, 8.0]))
+        np.testing.assert_allclose(out, [0.0, 9.0], atol=1e-12)
+
+    def test_monotonicity_preserved(self, rng):
+        x = np.sort(rng.uniform(0, 10, 20))
+        y = np.cumsum(rng.uniform(0.0, 1.0, 20))
+        q = np.linspace(x[0], x[-1], 500)
+        out = np.asarray(pchip_interp(jnp.array(x), jnp.array(y), jnp.array(q)))
+        assert (np.diff(out) >= -1e-12).all()
+
+    def test_slopes_shape(self, rng):
+        x = np.sort(rng.uniform(0, 1, 12))
+        y = rng.normal(size=12)
+        assert pchip_slopes(jnp.array(x), jnp.array(y)).shape == (12,)
+
+
+class TestMaskedPchip:
+    def test_matches_scipy_on_valid_subset(self, rng):
+        # Emulate the KS-EGM path: some knots invalid, queries within range,
+        # nearest extrapolation outside.
+        n = 40
+        x = np.sort(rng.uniform(0, 10, n))
+        y = np.cumsum(rng.uniform(0.05, 1.0, n))
+        valid = (x >= 2.0) & (x <= 8.0)
+        xs = np.where(valid, x, np.inf)
+        order = np.argsort(xs)
+        xs, ys = xs[order], y[order]
+        n_valid = int(valid.sum())
+        q = rng.uniform(0.0, 10.0, 300)
+        got = masked_pchip_interp(jnp.array(xs), jnp.array(ys), jnp.int32(n_valid), jnp.array(q))
+        ref = PchipInterpolator(x[valid], y[valid])
+        want = ref(np.clip(q, x[valid][0], x[valid][-1]))
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+    def test_all_valid_matches_plain(self, rng):
+        x = np.sort(rng.uniform(0, 10, 20))
+        y = np.cumsum(rng.uniform(0.05, 1.0, 20))
+        q = rng.uniform(0, 10, 50)
+        got = masked_pchip_interp(jnp.array(x), jnp.array(y), jnp.int32(20), jnp.array(q))
+        want = pchip_interp(jnp.array(x), jnp.array(y), jnp.array(q))
+        np.testing.assert_allclose(got, want, rtol=1e-11, atol=1e-11)
+
+
+class TestInterp2D:
+    def test_matches_scipy(self, rng):
+        x = np.sort(rng.uniform(0, 10, 15))
+        ygrid = np.sort(rng.uniform(0, 5, 7))
+        Z = rng.normal(size=(15, 7))
+        qx = rng.uniform(x[0], x[-1], 50)
+        qy = rng.uniform(ygrid[0], ygrid[-1], 50)
+        got = interp2d_linear(jnp.array(x), jnp.array(ygrid), jnp.array(Z),
+                              jnp.array(qx), jnp.array(qy))
+        want = RegularGridInterpolator((x, ygrid), Z)(np.stack([qx, qy], 1))
+        np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+    def test_extrapolates_linearly(self):
+        x = np.array([0.0, 1.0])
+        ygrid = np.array([0.0, 1.0])
+        Z = np.array([[0.0, 1.0], [2.0, 3.0]])  # Z = 2x + y
+        got = interp2d_linear(jnp.array(x), jnp.array(ygrid), jnp.array(Z),
+                              jnp.array([2.0, -1.0]), jnp.array([3.0, -2.0]))
+        np.testing.assert_allclose(got, [2 * 2.0 + 3.0, 2 * -1.0 + -2.0], atol=1e-12)

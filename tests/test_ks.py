@@ -1,0 +1,173 @@
+"""Krusell-Smith component and integration tests (SURVEY.md §4.2-4.3):
+golden-section oracle check, shock-panel ergodics, cross-method (VFI vs EGM)
+agreement of the ALM fixed point, and ALM R-squared > 0.99.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import minimize_scalar
+
+from aiyagari_tpu.config import ALMConfig, KrusellSmithConfig, SolverConfig
+from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
+from aiyagari_tpu.models.krusell_smith import KrusellSmithModel, state_index
+from aiyagari_tpu.ops.golden import golden_section_max
+from aiyagari_tpu.ops.regression import alm_regression, masked_ols_loglinear
+from aiyagari_tpu.sim.ks_panel import (
+    simulate_aggregate_shocks,
+    simulate_employment_panel,
+)
+
+SMALL = KrusellSmithConfig(k_size=25)
+ALM_SMALL = ALMConfig(T=400, population=2000, discard=80, max_iter=12, seed=7)
+SOLVER_VFI = SolverConfig(method="vfi", tol=1e-5, max_iter=300, howard_steps=20, improve_every=5)
+SOLVER_EGM = SolverConfig(method="egm", tol=1e-6, max_iter=3000)
+
+
+class TestGoldenSection:
+    def test_matches_scipy_bounded(self):
+        peaks = jnp.array([0.3, 1.7, 4.2, 9.9])
+
+        def f(x):
+            return -((x - peaks) ** 2) + jnp.sin(3 * x)
+
+        lo = jnp.zeros(4)
+        hi = jnp.full(4, 12.0)
+        got = np.asarray(golden_section_max(f, lo, hi, n_iters=60))
+        for i in range(4):
+            want = minimize_scalar(
+                lambda x: -(-((x - float(peaks[i])) ** 2) + np.sin(3 * x)),
+                bounds=(0.0, 12.0), method="bounded",
+                options={"xatol": 1e-10},
+            ).x
+            assert abs(got[i] - want) < 1e-6
+
+    def test_endpoint_maximum(self):
+        # Monotone objective: maximum at the upper bound.
+        f = lambda x: x
+        got = golden_section_max(f, jnp.zeros(1), jnp.full(1, 5.0), n_iters=60)
+        assert abs(float(got[0]) - 5.0) < 1e-6
+
+
+class TestRegression:
+    def test_masked_ols_matches_lstsq(self, rng):
+        x = rng.normal(size=200)
+        y = 0.3 + 0.9 * x + 0.01 * rng.normal(size=200)
+        mask = rng.random(200) < 0.6
+        b0, b1, r2 = masked_ols_loglinear(jnp.array(x), jnp.array(y), jnp.array(mask))
+        X = np.stack([np.ones(mask.sum()), x[mask]], 1)
+        beta, *_ = np.linalg.lstsq(X, y[mask], rcond=None)
+        np.testing.assert_allclose([float(b0), float(b1)], beta, atol=1e-10)
+        assert 0.99 < float(r2) <= 1.0
+
+    def test_alm_regression_recovers_truth(self, rng):
+        # Generate a path exactly following a two-regime loglinear law.
+        T = 500
+        z = rng.integers(0, 2, T)
+        B_true = np.array([0.2, 0.95, 0.1, 0.96])
+        K = np.empty(T)
+        K[0] = 40.0
+        for t in range(T - 1):
+            b0, b1 = (B_true[0], B_true[1]) if z[t] == 0 else (B_true[2], B_true[3])
+            K[t + 1] = np.exp(b0 + b1 * np.log(K[t]))
+        B, r2 = alm_regression(jnp.array(K), jnp.array(z), discard=50)
+        np.testing.assert_allclose(np.asarray(B), B_true, atol=1e-8)
+        np.testing.assert_allclose(np.asarray(r2), 1.0, atol=1e-10)
+
+
+class TestShocks:
+    def test_aggregate_duration(self):
+        model = KrusellSmithModel.from_config(SMALL)
+        z = np.asarray(simulate_aggregate_shocks(model.pz, jax.random.PRNGKey(0), T=60_000))
+        # Average spell duration ~ 8 quarters (Krusell_Smith_VFI.m:24).
+        switches = np.sum(z[1:] != z[:-1])
+        avg_dur = len(z) / max(switches, 1)
+        assert 6.0 < avg_dur < 10.5
+
+    def test_unemployment_rates_by_state(self):
+        model = KrusellSmithModel.from_config(SMALL)
+        sh = SMALL.shocks
+        key = jax.random.PRNGKey(3)
+        kz, ke = jax.random.split(key)
+        z = simulate_aggregate_shocks(model.pz, kz, T=4000)
+        eps = simulate_employment_panel(z, model.eps_trans, sh.u_good, sh.u_bad, ke,
+                                        T=4000, population=1500)
+        z_np, eps_np = np.asarray(z), np.asarray(eps)
+        # Conditional unemployment rate per aggregate state (after burn-in).
+        u_g = eps_np[200:][z_np[200:] == 0].mean()
+        u_b = eps_np[200:][z_np[200:] == 1].mean()
+        assert abs(u_g - sh.u_good) < 0.012
+        assert abs(u_b - sh.u_bad) < 0.02
+
+    def test_state_index_mapping(self):
+        # (z, employed) -> reference meshgrid ordering (Krusell_Smith_VFI.m:18-21).
+        assert int(state_index(0, 1)) == 0  # good, employed
+        assert int(state_index(1, 1)) == 1  # bad, employed
+        assert int(state_index(0, 0)) == 2  # good, unemployed
+        assert int(state_index(1, 0)) == 3  # bad, unemployed
+
+
+class TestDispatchKS:
+    def test_default_solver_uses_ks_defaults(self):
+        # Regression: solve(KrusellSmithConfig()) without an explicit solver
+        # must get the KS Howard defaults (not howard_steps=0, which would
+        # leave the value function untouched and "converge" instantly).
+        from aiyagari_tpu import solve
+        from aiyagari_tpu.config import ALMConfig as A
+
+        res = solve(KrusellSmithConfig(k_size=15), method="vfi",
+                    alm=A(T=100, population=200, discard=20, max_iter=1))
+        assert res.per_iteration[0]["solver_iterations"] >= 2
+        assert res.r2[0] > 0.9
+
+    def test_method_conflict_raises(self):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError, match="conflicting methods"):
+            solve(KrusellSmithConfig(k_size=15), method="vfi",
+                  solver=SolverConfig(method="egm"))
+
+    def test_solver_method_respected_without_method_kwarg(self):
+        # solver.method alone selects the method (no silent override).
+        from aiyagari_tpu import solve
+        from aiyagari_tpu.config import ALMConfig as A
+
+        res = solve(KrusellSmithConfig(k_size=15),
+                    solver=SolverConfig(method="egm", tol=1e-5, max_iter=500),
+                    alm=A(T=100, population=200, discard=20, max_iter=1))
+        assert res.iterations == 1
+
+
+@pytest.mark.slow
+class TestKSIntegration:
+    @pytest.fixture(scope="class")
+    def vfi_result(self):
+        return solve_krusell_smith(SMALL, method="vfi", solver=SOLVER_VFI, alm=ALM_SMALL)
+
+    @pytest.fixture(scope="class")
+    def egm_result(self):
+        return solve_krusell_smith(SMALL, method="egm", solver=SOLVER_EGM, alm=ALM_SMALL)
+
+    def test_alm_fit_quality(self, vfi_result):
+        assert vfi_result.r2[0] > 0.99
+        assert vfi_result.r2[1] > 0.99
+
+    def test_alm_coefficients_sane(self, vfi_result):
+        B = vfi_result.B
+        assert 0.0 < B[1] < 1.0 and 0.0 < B[3] < 1.0  # mean-reverting
+        assert B[0] > 0.0 and B[2] > 0.0
+        # Good-state intercept above bad-state (higher TFP -> more saving).
+        assert B[0] > B[2]
+
+    def test_capital_path_in_range(self, vfi_result):
+        K = vfi_result.K_ts[ALM_SMALL.discard:]
+        assert K.min() > 20.0 and K.max() < 60.0
+
+    def test_methods_agree(self, vfi_result, egm_result):
+        assert np.abs(vfi_result.B - egm_result.B).max() < 0.05
+        assert egm_result.r2.min() > 0.99
+
+    def test_policy_monotone(self, vfi_result):
+        k_opt = np.asarray(vfi_result.solution.k_opt)
+        assert (np.diff(k_opt, axis=-1) >= -1e-6).all()

@@ -1,0 +1,106 @@
+"""Unit tests for Markov-chain construction (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+from scipy.integrate import quad
+from scipy.stats import norm
+
+from aiyagari_tpu.config import IncomeProcess, KSShockProcess
+from aiyagari_tpu.utils.markov import (
+    KS_STATE_GRID_ORDER,
+    ks_conditional_eps_matrices,
+    ks_transition_matrix,
+    normalized_labor,
+    stationary_distribution,
+    tauchen,
+)
+
+
+class TestTauchen:
+    def test_rows_sum_to_one(self):
+        _, P = tauchen(IncomeProcess())
+        np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-12)
+
+    def test_grid_matches_reference_spec(self):
+        # l_i = (i-4)*sigma_e for i=1..7 (Aiyagari_VFI.m:18-21).
+        l, _ = tauchen(IncomeProcess(sigma_e=0.75, n_states=7))
+        np.testing.assert_allclose(l, (np.arange(1, 8) - 4) * 0.75)
+
+    def test_matches_quadrature(self):
+        # The reference integrates the normal pdf numerically
+        # (Aiyagari_VFI.m:27-35); our closed form must agree.
+        proc = IncomeProcess(rho=0.75, sigma_e=0.75, n_states=7)
+        l, P = tauchen(proc)
+        sd = proc.sigma_e * np.sqrt(1 - proc.rho**2)
+        edges = np.concatenate(([-np.inf], (np.arange(1, 7) - 3.5 + 0.5 - 1 + 0.5) * 0.0, [np.inf]))
+        # Rebuild edges exactly as the reference: +/-(0.5,1.5,2.5)*sigma_e.
+        edges = np.concatenate(
+            ([-np.inf], np.array([-2.5, -1.5, -0.5, 0.5, 1.5, 2.5]) * proc.sigma_e, [np.inf])
+        )
+        for i in range(7):
+            for j in range(7):
+                val, _ = quad(
+                    lambda x: norm.pdf(x, proc.rho * l[i], sd), edges[j], edges[j + 1]
+                )
+                assert abs(P[i, j] - val) < 1e-8
+
+    def test_persistence_monotone(self):
+        # Higher rho concentrates mass on the diagonal.
+        _, P_low = tauchen(IncomeProcess(rho=0.1))
+        _, P_high = tauchen(IncomeProcess(rho=0.9))
+        assert np.diag(P_high).sum() > np.diag(P_low).sum()
+
+
+class TestStationaryDistribution:
+    def test_is_fixed_point(self):
+        _, P = tauchen(IncomeProcess())
+        pi = stationary_distribution(P)
+        np.testing.assert_allclose(pi @ P, pi, atol=1e-10)
+        np.testing.assert_allclose(pi.sum(), 1.0, atol=1e-12)
+        assert (pi >= -1e-12).all()
+
+    def test_labor_normalization(self):
+        # After normalization aggregate labor s @ pi == 1 (Aiyagari_VFI.m:43-45).
+        l, P = tauchen(IncomeProcess())
+        pi = stationary_distribution(P)
+        s, labor_raw = normalized_labor(l, pi)
+        np.testing.assert_allclose(s @ pi, 1.0, atol=1e-12)
+        np.testing.assert_allclose(s * labor_raw, np.exp(l), atol=1e-12)
+
+
+class TestKSChain:
+    def test_rows_sum_to_one(self):
+        P = ks_transition_matrix(KSShockProcess())
+        np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-12)
+
+    def test_aggregate_marginal(self):
+        # Summing out employment must recover the 2-state z chain with
+        # persistence 1 - 1/duration = 7/8 (Krusell_Smith_VFI.m:24-26).
+        P = ks_transition_matrix(KSShockProcess())
+        # states: 0=(g,e), 1=(b,e), 2=(g,u), 3=(b,u); z index = s % 2.
+        for s in range(4):
+            z = s % 2
+            stay = P[s, z] + P[s, z + 2]    # prob z'==z summing over eps'
+            np.testing.assert_allclose(stay, 7.0 / 8.0, atol=1e-12)
+
+    def test_unemployment_consistency(self):
+        # u' = u p00 + (1-u) p10 for each aggregate transition
+        # (the identity that pins p10 at Krusell_Smith_VFI.m:39-42).
+        sh = KSShockProcess()
+        mats = ks_conditional_eps_matrices(sh)
+        u = {"g": sh.u_good, "b": sh.u_bad}
+        for key, m in mats.items():
+            u_from, u_to = u[key[0]], u[key[1]]
+            p10, p00 = m[0, 1], m[1, 1]
+            np.testing.assert_allclose(u_from * p00 + (1 - u_from) * p10, u_to, atol=1e-12)
+
+    def test_reference_values(self):
+        # Spot-check entries against hand-computed reference constants:
+        # p00_gg = 1 - 1/1.5 = 1/3; P[(g,u)->(g,u)] = pgg * p00_gg = 7/8 * 1/3.
+        P = ks_transition_matrix(KSShockProcess())
+        np.testing.assert_allclose(P[2, 2], (7.0 / 8.0) * (1.0 / 3.0), atol=1e-12)
+        # p00_bb = 1 - 1/2.5 = 0.6; P[(b,u)->(b,u)] = pbb * 0.6.
+        np.testing.assert_allclose(P[3, 3], (7.0 / 8.0) * 0.6, atol=1e-12)
+
+    def test_state_order(self):
+        assert KS_STATE_GRID_ORDER == ((0, 1), (1, 1), (0, 0), (1, 0))

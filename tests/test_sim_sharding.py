@@ -1,0 +1,91 @@
+"""Simulation determinism and device-mesh sharding tests (SURVEY.md §4.4-4.5):
+fixed keys reproduce bitwise-identical paths; sharded panel simulation over the
+8-virtual-device CPU mesh matches the unsharded result.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import KrusellSmithConfig, SolverConfig
+from aiyagari_tpu.equilibrium.bisection import solve_household
+from aiyagari_tpu.models.aiyagari import aiyagari_preset
+from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+from aiyagari_tpu.parallel.mesh import agents_sharding, make_mesh, shard_panel
+from aiyagari_tpu.sim.ergodic import simulate_panel
+from aiyagari_tpu.sim.ks_panel import (
+    simulate_aggregate_shocks,
+    simulate_capital_path,
+    simulate_employment_panel,
+)
+from aiyagari_tpu.utils.firm import wage_from_r
+
+
+@pytest.fixture(scope="module")
+def aiyagari_setup():
+    m = aiyagari_preset(grid_size=60)
+    sol = solve_household(m, 0.04, solver=SolverConfig(method="egm"))
+    w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+    return m, sol, w
+
+
+class TestDeterminism:
+    def test_same_key_same_path(self, aiyagari_setup):
+        m, sol, w = aiyagari_setup
+        args = (sol.policy_k, sol.policy_c, sol.policy_l, m.a_grid, m.s, m.P, 0.04, w)
+        s1 = simulate_panel(*args, jax.random.PRNGKey(42), periods=200, n_agents=16)
+        s2 = simulate_panel(*args, jax.random.PRNGKey(42), periods=200, n_agents=16)
+        np.testing.assert_array_equal(np.asarray(s1.k), np.asarray(s2.k))
+
+    def test_different_keys_differ(self, aiyagari_setup):
+        m, sol, w = aiyagari_setup
+        args = (sol.policy_k, sol.policy_c, sol.policy_l, m.a_grid, m.s, m.P, 0.04, w)
+        s1 = simulate_panel(*args, jax.random.PRNGKey(0), periods=200, n_agents=16)
+        s2 = simulate_panel(*args, jax.random.PRNGKey(1), periods=200, n_agents=16)
+        assert not np.array_equal(np.asarray(s1.k), np.asarray(s2.k))
+
+    def test_panel_ergodic_mean_stable(self, aiyagari_setup):
+        # Time-average of one long path ~ cross-section average of many agents
+        # (the ergodicity assumption the reference relies on; SURVEY.md §3.6/8).
+        m, sol, w = aiyagari_setup
+        args = (sol.policy_k, sol.policy_c, sol.policy_l, m.a_grid, m.s, m.P, 0.04, w)
+        long1 = simulate_panel(*args, jax.random.PRNGKey(5), periods=6000, n_agents=1)
+        wide = simulate_panel(*args, jax.random.PRNGKey(6), periods=600, n_agents=64)
+        t_avg = float(jnp.mean(long1.k[500:]))
+        x_avg = float(jnp.mean(wide.k[300:]))
+        assert abs(t_avg - x_avg) / x_avg < 0.15
+
+
+class TestSharding:
+    def test_eight_virtual_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_sharded_panel_matches_unsharded(self):
+        cfg = KrusellSmithConfig(k_size=20)
+        model = KrusellSmithModel.from_config(cfg)
+        key = jax.random.PRNGKey(11)
+        kz, ke = jax.random.split(key)
+        T, pop = 150, 800
+        z = simulate_aggregate_shocks(model.pz, kz, T=T)
+        eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
+                                        cfg.shocks.u_bad, ke, T=T, population=pop)
+        k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size))
+        k0 = jnp.full((pop,), float(model.K_grid[0]))
+
+        K_ref, kpop_ref = simulate_capital_path(k_opt, model.k_grid, model.K_grid,
+                                                z, eps, k0, T=T)
+
+        mesh = make_mesh(("agents",))
+        eps_sh = shard_panel(eps, mesh, batch_axis=1)
+        k0_sh = shard_panel(jnp.full((pop,), float(model.K_grid[0])), mesh, batch_axis=0)
+        K_sh, kpop_sh = simulate_capital_path(k_opt, model.k_grid, model.K_grid,
+                                              z, eps_sh, k0_sh, T=T)
+        np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_sh), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(kpop_ref), np.asarray(kpop_sh), rtol=1e-12)
+
+    def test_sharded_mean_is_global(self):
+        mesh = make_mesh(("agents",))
+        x = jnp.arange(8000, dtype=jnp.float64)
+        x_sh = jax.device_put(x, agents_sharding(mesh))
+        assert float(jnp.mean(x_sh)) == float(jnp.mean(x))

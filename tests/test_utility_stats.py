@@ -1,0 +1,86 @@
+"""Utility-function and inequality-statistics unit tests (SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.utils.stats import (
+    gaussian_kde,
+    gini,
+    lorenz_curve,
+    probability_histogram,
+    quantile_shares,
+)
+from aiyagari_tpu.utils.utility import (
+    crra_marginal,
+    crra_marginal_inverse,
+    crra_utility,
+    labor_disutility,
+    labor_foc_inverse,
+    labor_marginal_disutility,
+)
+
+
+class TestUtility:
+    def test_marginal_inverse_roundtrip(self, rng):
+        c = rng.uniform(0.1, 10.0, 100)
+        for sigma in (1.0, 2.0, 5.0):
+            up = crra_marginal(jnp.array(c), sigma)
+            np.testing.assert_allclose(crra_marginal_inverse(up, sigma), c, rtol=1e-12)
+
+    def test_log_special_case(self):
+        c = jnp.array([0.5, 1.0, 2.0])
+        np.testing.assert_allclose(crra_utility(c, 1.0), jnp.log(c), atol=1e-12)
+
+    def test_crra_limit_approaches_log(self):
+        c = jnp.array([0.5, 1.5, 3.0])
+        near = crra_utility(c, 1.0 + 1e-7)
+        np.testing.assert_allclose(near, jnp.log(c), atol=1e-5)
+
+    def test_labor_foc_roundtrip(self, rng):
+        l = rng.uniform(0.05, 1.4, 50)
+        for psi, eta in ((1.0, 2.0), (2.5, 0.7)):
+            x = labor_marginal_disutility(jnp.array(l), psi, eta)
+            np.testing.assert_allclose(labor_foc_inverse(x, psi, eta), l, rtol=1e-12)
+
+    def test_labor_disutility_convex(self):
+        l = jnp.linspace(0.01, 1.5, 100)
+        d2 = jnp.diff(labor_disutility(l, 1.0, 2.0), 2)
+        assert (d2 > 0).all()
+
+
+class TestInequality:
+    def test_gini_equal_distribution_zero(self):
+        x = jnp.ones(10_000)
+        assert abs(float(gini(x))) < 1e-3
+
+    def test_gini_uniform_one_third(self, rng):
+        # Uniform[0,1] has G = 1/3.
+        x = jnp.array(rng.uniform(0, 1, 200_000))
+        assert abs(float(gini(x)) - 1.0 / 3.0) < 5e-3
+
+    def test_gini_exponential_half(self, rng):
+        # Exponential has G = 1/2.
+        x = jnp.array(rng.exponential(1.0, 200_000))
+        assert abs(float(gini(x)) - 0.5) < 5e-3
+
+    def test_lorenz_endpoints(self, rng):
+        pop, cum = lorenz_curve(jnp.array(rng.uniform(0, 1, 1000)))
+        assert abs(float(cum[-1]) - 1.0) < 1e-12
+        assert abs(float(pop[-1]) - 1.0) < 1e-12
+        assert (jnp.diff(cum) >= 0).all()
+
+    def test_quintile_shares(self, rng):
+        x = jnp.array(rng.uniform(0, 1, 50_000))
+        shares = quantile_shares(x, 5)
+        np.testing.assert_allclose(float(shares.sum()), 100.0, atol=1e-8)
+        assert (jnp.diff(shares) > 0).all()  # increasing for any dispersion
+
+    def test_histogram_probability(self, rng):
+        edges, probs = probability_histogram(jnp.array(rng.normal(size=5000)), bins=30)
+        np.testing.assert_allclose(float(probs.sum()), 1.0, atol=1e-10)
+        assert edges.shape == (31,)
+
+    def test_kde_integrates_to_one(self, rng):
+        xi, f = gaussian_kde(jnp.array(rng.normal(size=3000)), n_points=200)
+        mass = float(jnp.trapezoid(f, xi))
+        assert abs(mass - 1.0) < 2e-2
