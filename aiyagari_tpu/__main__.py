@@ -1,0 +1,128 @@
+"""Command-line runner: reproduces each of the reference's six script
+workflows end-to-end (solve -> simulate -> statistics -> figures).
+
+  python -m aiyagari_tpu aiyagari --method vfi          # Aiyagari_VFI.m
+  python -m aiyagari_tpu aiyagari --method egm          # Aiyagari_EGM.m
+  python -m aiyagari_tpu aiyagari-labor --method vfi    # Aiyagari_Endogenous_Labor_VFI.m
+  python -m aiyagari_tpu aiyagari-labor --method egm    # Aiyagari_Endogenous_Labor_EGM.m
+  python -m aiyagari_tpu ks --method vfi                # Krusell_Smith_VFI.m
+  python -m aiyagari_tpu ks --method egm                # Krusell_Smith_EGM.m
+
+Defaults reproduce the reference problem scales (BASELINE.md); outputs land in
+--outdir as figures + summary.json + run log (JSONL).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])
+    ap.add_argument("--method", choices=["vfi", "egm"], default="vfi")
+    ap.add_argument("--outdir", default=None, help="default: runs/<model>_<method>")
+    ap.add_argument("--platform", choices=["cpu", "tpu"], default=None,
+                    help="force a jax platform (JAX_PLATFORMS env is overridden "
+                         "by this image's TPU plugin; use this flag)")
+    ap.add_argument("--f64", action="store_true", help="force float64")
+    ap.add_argument("--grid", type=int, default=400, help="asset grid points (Aiyagari)")
+    ap.add_argument("--periods", type=int, default=10_000, help="simulation length (Aiyagari)")
+    ap.add_argument("--agents", type=int, default=1, help="simulated households (Aiyagari)")
+    ap.add_argument("--k-size", type=int, default=100, help="individual capital grid (K-S)")
+    ap.add_argument("--population", type=int, default=10_000, help="agent panel size (K-S)")
+    ap.add_argument("--T", type=int, default=1100, help="panel length (K-S)")
+    ap.add_argument("--alm-iters", type=int, default=100, help="max ALM iterations (K-S)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None, help="enable checkpoint/resume")
+    ap.add_argument("--mesh-agents", action="store_true",
+                    help="shard the K-S agent panel over all local devices")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu" if args.platform == "cpu" else None)
+    import jax
+
+    from aiyagari_tpu.config import (
+        ALMConfig,
+        AiyagariConfig,
+        BackendConfig,
+        EquilibriumConfig,
+        GridSpecConfig,
+        IncomeProcess,
+        KrusellSmithConfig,
+        SimConfig,
+        SolverConfig,
+    )
+    from aiyagari_tpu.diagnostics.logging import ConsoleSink, JSONLSink, multiplex
+
+    outdir = args.outdir or f"runs/{args.model}_{args.method}"
+    sink = multiplex(
+        None if args.quiet else ConsoleSink(prefix=f"[{args.model}/{args.method}] "),
+        JSONLSink(f"{outdir}/iterations.jsonl"),
+    )
+    # f64 by default on CPU, f32 on TPU; requesting f64 requires enabling
+    # jax x64, otherwise jnp.float64 silently canonicalizes to f32.
+    use_f64 = args.f64 or (jax.default_backend() == "cpu")
+    if use_f64:
+        jax.config.update("jax_enable_x64", True)
+    backend = BackendConfig(dtype="float64" if use_f64 else "float32")
+
+    if args.model in ("aiyagari", "aiyagari-labor"):
+        import jax.numpy as jnp
+
+        from aiyagari_tpu.equilibrium.bisection import solve_equilibrium
+        from aiyagari_tpu.io_utils.report import equilibrium_report
+        from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+        if args.model == "aiyagari":
+            cfg = AiyagariConfig(grid=GridSpecConfig(n_points=args.grid))
+        else:
+            cfg = AiyagariConfig(
+                income=IncomeProcess(rho=0.6, sigma_e=0.2),
+                endogenous_labor=True,
+                grid=GridSpecConfig(n_points=args.grid),
+            )
+        model = AiyagariModel.from_config(
+            cfg, jnp.float64 if backend.dtype == "float64" else jnp.float32
+        )
+        res = solve_equilibrium(
+            model,
+            solver=SolverConfig(method=args.method),
+            sim=SimConfig(periods=args.periods, n_agents=args.agents, seed=args.seed),
+            eq=EquilibriumConfig(),
+            on_iteration=sink,
+            checkpoint_dir=args.checkpoint_dir,
+        )
+        summary = equilibrium_report(res, model, outdir)
+    else:
+        from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
+        from aiyagari_tpu.io_utils.report import krusell_smith_report
+
+        if args.mesh_agents:
+            backend = dataclasses.replace(backend, mesh_axes=("agents",))
+        res = solve_krusell_smith(
+            KrusellSmithConfig(k_size=args.k_size),
+            method=args.method,
+            alm=ALMConfig(T=args.T, population=args.population,
+                          max_iter=args.alm_iters, seed=args.seed),
+            backend=backend,
+            on_iteration=sink,
+            checkpoint_dir=args.checkpoint_dir,
+        )
+        summary = krusell_smith_report(res, outdir, discard=min(100, args.T // 4))
+
+    print(json.dumps(summary, indent=2))
+    print(f"figures + summary.json written to {outdir}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

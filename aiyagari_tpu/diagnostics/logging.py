@@ -1,0 +1,75 @@
+"""Structured iteration telemetry: typed records with pluggable sinks.
+
+The reference's observability is disp/fprintf progress lines (iteration
+indices at Aiyagari_VFI.m:89,205, EGM distances at Aiyagari_EGM.m:109, K-S ALM
+coefficients/R^2 at Krusell_Smith_VFI.m:287-289). Here the outer loops emit
+per-iteration dict records through an `on_iteration` callback; this module
+provides the standard sinks (stdout table, JSONL file, in-memory collector)
+and a multiplexer.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+__all__ = ["ConsoleSink", "JSONLSink", "CollectSink", "multiplex"]
+
+
+class ConsoleSink:
+    """Prints one aligned line per record to a stream (default stdout) —
+    the disp/fprintf analogue, but uniform across solvers."""
+
+    def __init__(self, stream=None, prefix: str = ""):
+        self.stream = stream or sys.stdout
+        self.prefix = prefix
+
+    def __call__(self, record: dict) -> None:
+        parts = []
+        for k, v in record.items():
+            if isinstance(v, float):
+                parts.append(f"{k}={v:.6g}")
+            elif isinstance(v, list):
+                parts.append(f"{k}=[{', '.join(f'{x:.4g}' if isinstance(x, float) else str(x) for x in v)}]")
+            else:
+                parts.append(f"{k}={v}")
+        print(self.prefix + " ".join(parts), file=self.stream)
+
+
+class JSONLSink:
+    """Appends each record as one JSON line — machine-readable run logs,
+    usable for resume diagnostics and benchmark post-processing."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._t0 = time.time()
+
+    def __call__(self, record: dict) -> None:
+        rec = {"wall_time": round(time.time() - self._t0, 4), **record}
+        with self.path.open("a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+class CollectSink:
+    """Collects records in memory (for tests and notebook use)."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def __call__(self, record: dict) -> None:
+        self.records.append(record)
+
+
+def multiplex(*sinks: Optional[Callable]) -> Callable:
+    """Combine several sinks into one on_iteration callback; Nones skipped."""
+    active = [s for s in sinks if s is not None]
+
+    def emit(record: dict) -> None:
+        for s in active:
+            s(record)
+
+    return emit

@@ -1,0 +1,71 @@
+"""Profiling harness: wall-clock timing with proper device fencing,
+compile-vs-run split, and jax.profiler trace capture.
+
+The reference's only instrumentation is an unrecorded tic/toc per K-S VFI
+iteration (Krusell_Smith_VFI.m:144,196-198). This module gives the framework
+a real measurement surface; bench.py is built on it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fence", "Timing", "time_fn", "trace"]
+
+
+def fence(tree) -> None:
+    """Wait until `tree`'s computation actually finished.
+
+    Uses a scalar device->host transfer of the first array leaf:
+    block_until_ready alone does not reliably fence on remote/experimental
+    TPU transports (observed on the tunneled v5e in this image)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    if leaves:
+        jnp.asarray(leaves[0]).ravel()[:1].block_until_ready()
+        float(jnp.sum(leaves[0].ravel()[:1]))
+
+
+@dataclasses.dataclass
+class Timing:
+    """Result of time_fn: first call (compile+run) vs steady-state run."""
+
+    compile_and_first_run_s: float
+    run_s: float                  # best of `reps` post-compile calls
+    reps: int
+
+    @property
+    def compile_s(self) -> float:
+        return max(0.0, self.compile_and_first_run_s - self.run_s)
+
+
+def time_fn(fn: Callable, *args, reps: int = 3, **kwargs) -> Timing:
+    """Time `fn(*args)` with fencing: one cold call, then `reps` hot calls."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    fence(out)
+    cold = time.perf_counter() - t0
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        fence(out)
+        best = min(best, time.perf_counter() - t0)
+    return Timing(cold, best, reps)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace (TensorBoard/Perfetto readable) around a
+    block: `with trace('/tmp/trace'): run()`."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -71,12 +71,17 @@ def solve_krusell_smith(
     backend: BackendConfig = BackendConfig(),
     on_iteration: Optional[Callable] = None,
     double_alm: bool = False,
+    checkpoint_dir: Optional[str] = None,
 ) -> KSResult:
     """Iterate household solve -> panel simulation -> ALM regression to a fixed
     point of the forecasting coefficients B (Krusell_Smith_VFI.m:138-296).
 
     Stops when max|B_new - B| < alm.tol; damped update otherwise. B starts at
     [0, 1, 0, 1] (:99) — a unit-root forecast in each regime.
+
+    With checkpoint_dir set, (B, value, policy, cross-section, histories) are
+    persisted each outer iteration and a restarted call resumes; shocks are
+    regenerated deterministically from alm.seed (SURVEY.md §5.3-5.4).
     """
     t0 = time.perf_counter()
     dtype = jnp.float64 if backend.dtype == "float64" else jnp.float32
@@ -117,11 +122,33 @@ def solve_krusell_smith(
     B = np.array([0.0, 1.0, 0.0, 1.0])
 
     records = []
+    start_it = 0
+    mgr = None
+    if checkpoint_dir is not None:
+        from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
+
+        mgr = CheckpointManager(
+            checkpoint_dir, f"ks_{solver.method}",
+            fingerprint=config_fingerprint(config, solver, alm),
+        )
+        resumed = mgr.restore()
+        if resumed is not None:
+            sc, arrays = resumed
+            B = np.asarray(sc["B"])
+            records = sc["records"]
+            start_it = min(sc["iteration"] + 1, alm.max_iter - 1)
+            records = records[:start_it]
+            value = jnp.asarray(arrays["value"], dtype)
+            k_opt = jnp.asarray(arrays["k_opt"], dtype)
+            k_population = jnp.asarray(arrays["k_population"], dtype)
+            if panel_sharding is not None:
+                k_population = jax.device_put(k_population, panel_sharding)
+
     converged = False
     diff_B = np.inf
     r2 = np.zeros(2)
     sol = None
-    for it in range(alm.max_iter):
+    for it in range(start_it, alm.max_iter):
         it_t0 = time.perf_counter()
         B_dev = jnp.asarray(B, dtype)
         if solver.method == "vfi":
@@ -181,7 +208,18 @@ def solve_krusell_smith(
         # Reference resets the panel to K_grid[0] implicitly by reusing
         # k_population across B-iterations (:100, :246-247); we do the same.
         k_population = k_population_new
+        if mgr is not None:
+            mgr.save(
+                scalars={"iteration": it, "B": B.tolist(), "records": records},
+                arrays={
+                    "value": np.asarray(value),
+                    "k_opt": np.asarray(k_opt),
+                    "k_population": np.asarray(k_population),
+                },
+            )
 
+    if mgr is not None:
+        mgr.delete()   # run finished; a later call should start fresh
     K_ts_np = np.asarray(K_ts)
     return KSResult(
         B=B,

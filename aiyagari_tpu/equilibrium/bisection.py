@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
 from aiyagari_tpu.models.aiyagari import AiyagariModel
@@ -106,7 +107,8 @@ def _warm_state(solution, method: str):
 
 def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
                       sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
-                      on_iteration: Optional[Callable] = None) -> EquilibriumResult:
+                      on_iteration: Optional[Callable] = None,
+                      checkpoint_dir: Optional[str] = None) -> EquilibriumResult:
     """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
     midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
 
@@ -114,6 +116,10 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
     reference carries v_old across its re-solves at :147-171). Supply is the
     time/cross-section average of simulated wealth; demand is the firm FOC
     curve labor*(alpha/(r+delta))^(1/(1-alpha)).
+
+    With checkpoint_dir set, the bisection state (bracket, histories,
+    warm-start policy) is persisted atomically every iteration and a restarted
+    call resumes from it (SURVEY.md §5.3-5.4; no analogue in the reference).
     """
     prefs = model.preferences
     tech = model.config.technology
@@ -123,16 +129,44 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
     r_low = eq.r_low
     r_high = eq.r_high if eq.r_high is not None else 1.0 / prefs.beta - 1.0
 
-    # Warm-start pass at r_init, as the reference does before its loop (:63-129).
-    warm = None
-    sol = solve_household(model, eq.r_init, solver=solver, warm_start=None)
-    warm = _warm_state(sol, solver.method)
+    mgr = None
+    resumed = None
+    if checkpoint_dir is not None:
+        from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
+
+        mgr = CheckpointManager(
+            checkpoint_dir, f"bisection_{solver.method}",
+            fingerprint=config_fingerprint(model.config, solver, sim, eq),
+        )
+        resumed = mgr.restore()
 
     r_hist, ks_hist, kd_hist, records = [], [], [], []
+    start_it = 0
+    if resumed is not None:
+        sc, arrays = resumed
+        r_low, r_high = sc["r_low"], sc["r_high"]
+        r_hist, ks_hist, kd_hist = sc["r_hist"], sc["ks_hist"], sc["kd_hist"]
+        records = sc["records"]
+        # Re-run at least the final iteration so the returned solution/series
+        # are materialized even for a max_iter-exhausted checkpoint; truncate
+        # the restored histories to the re-run point so nothing duplicates.
+        start_it = min(sc["iteration"] + 1, eq.max_iter - 1)
+        r_hist, ks_hist, kd_hist = r_hist[:start_it], ks_hist[:start_it], kd_hist[:start_it]
+        records = records[:start_it]
+        warm = jnp.asarray(arrays["warm"], model.dtype)
+        # Fast-forward the PRNG stream to where the run stopped.
+        for _ in range(start_it):
+            key, _ = jax.random.split(key)
+        sol = None
+    else:
+        # Warm-start pass at r_init, as the reference does before its loop (:63-129).
+        sol = solve_household(model, eq.r_init, solver=solver, warm_start=None)
+        warm = _warm_state(sol, solver.method)
+
     converged = False
     r_mid = eq.r_init
     series = None
-    for it in range(eq.max_iter):
+    for it in range(start_it, eq.max_iter):
         it_t0 = time.perf_counter()
         r_mid = 0.5 * (r_low + r_high)
         w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
@@ -168,7 +202,18 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
             r_high = r_mid
         else:
             r_low = r_mid
+        if mgr is not None:
+            mgr.save(
+                scalars={
+                    "iteration": it, "r_low": r_low, "r_high": r_high,
+                    "r_hist": r_hist, "ks_hist": ks_hist, "kd_hist": kd_hist,
+                    "records": records,
+                },
+                arrays={"warm": np.asarray(warm)},
+            )
 
+    if mgr is not None:
+        mgr.delete()   # run finished; a later call should start fresh
     w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
     return EquilibriumResult(
         r=r_mid,

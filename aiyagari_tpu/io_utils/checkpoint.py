@@ -1,0 +1,115 @@
+"""Outer-loop checkpoint/resume.
+
+The reference has no persistence at all (no save/load anywhere; all state is
+the MATLAB workspace — SURVEY.md §5.4). Here the tiny outer-loop state
+(bisection bracket or ALM coefficients, warm-start policies/value, iteration
+counters) is written at every outer iteration so a preempted run resumes
+exactly where it stopped — the preemption-tolerance pattern TPU pods require
+(SURVEY.md §5.3).
+
+Format: a single .npz per run (atomic replace), arrays + a JSON-encoded
+scalar-state blob. Policies at reference scale are MBs; at scaled-up grids
+checkpoint from the sharded representation via orbax instead (the API here is
+deliberately the same shape).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["save_checkpoint", "load_checkpoint", "config_fingerprint", "CheckpointManager"]
+
+
+def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> None:
+    """Atomically write scalar state (JSON-serializable) + named arrays."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {"__scalars__": np.frombuffer(json.dumps(scalars).encode(), dtype=np.uint8)}
+    for k, v in (arrays or {}).items():
+        payload[k] = np.asarray(v)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path) -> Optional[tuple[dict, dict]]:
+    """Returns (scalars, arrays) or None if no checkpoint exists."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    with np.load(path) as z:
+        scalars = json.loads(bytes(z["__scalars__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__scalars__"}
+    return scalars, arrays
+
+
+def config_fingerprint(*objs: Any) -> str:
+    """Stable fingerprint of run configuration (dataclasses or plain values),
+    stored with every checkpoint so stale state from a different run setup is
+    rejected instead of silently mixed in."""
+    import dataclasses
+    import hashlib
+
+    def norm(o):
+        if dataclasses.is_dataclass(o) and not isinstance(o, type):
+            return {"__cls__": type(o).__name__, **{
+                k: norm(v) for k, v in dataclasses.asdict(o).items()
+            }}
+        return o
+
+    blob = json.dumps([norm(o) for o in objs], sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class CheckpointManager:
+    """Per-run checkpoint helper bound to a directory.
+
+    Usage in an outer loop:
+        mgr = CheckpointManager(dir, "aiyagari_egm", fingerprint=config_fingerprint(cfg, solver))
+        state = mgr.restore()          # None on fresh start or config mismatch
+        ...
+        mgr.save(scalars={...}, arrays={...})   # each outer iteration
+        mgr.delete()                             # on successful completion
+    """
+
+    def __init__(self, directory, name: str, fingerprint: Optional[str] = None):
+        self.path = Path(directory) / f"{name}.ckpt.npz"
+        self.fingerprint = fingerprint
+
+    def restore(self) -> Optional[tuple[dict, dict]]:
+        state = load_checkpoint(self.path)
+        if state is None:
+            return None
+        scalars, arrays = state
+        if self.fingerprint is not None and scalars.get("__fingerprint__") != self.fingerprint:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at {self.path} was written under a different run "
+                "configuration; ignoring it and starting fresh",
+                stacklevel=2,
+            )
+            return None
+        scalars = {k: v for k, v in scalars.items() if k != "__fingerprint__"}
+        return scalars, arrays
+
+    def save(self, *, scalars: dict, arrays: Optional[dict] = None) -> None:
+        if self.fingerprint is not None:
+            scalars = {**scalars, "__fingerprint__": self.fingerprint}
+        save_checkpoint(self.path, scalars=scalars, arrays=arrays)
+
+    def delete(self) -> None:
+        if self.path.exists():
+            self.path.unlink()

@@ -1,0 +1,221 @@
+"""End-of-run reports: every figure and printed statistic the reference
+produces, generated from solved results and saved to a directory, plus a
+machine-readable summary JSON.
+
+Reference output surface (SURVEY.md §1 L7):
+  Aiyagari scripts — capital demand/supply vs r cross (Aiyagari_VFI.m:217-229),
+  asset policy functions (:231-243), ksdensity densities (:245-279),
+  probability histograms (:281-312), Lorenz curves (:314-372), Gini printouts
+  (:353-357), quintile wealth shares + bar chart (:374-420).
+  K-S scripts — true vs ALM-approximated aggregate capital path and the
+  per-regime K'(K) maps vs the 45-degree line (Krusell_Smith_VFI.m:298-325).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.utils.stats import gaussian_kde, gini, lorenz_curve, quantile_shares
+
+__all__ = ["equilibrium_report", "krusell_smith_report"]
+
+_SERIES_LABELS = {
+    "k": "Wealth",
+    "c": "Consumption",
+    "y": "Net Income",
+    "gy": "Gross Income",
+    "sav": "Savings",
+}
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
+    """Write the Aiyagari figure set + summary.json; returns the summary dict.
+
+    `result` is an EquilibriumResult, `model` the AiyagariModel it came from.
+    """
+    plt = _plt()
+    out = Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    series = {
+        name: np.asarray(getattr(result.series, name))[discard:].ravel()
+        for name in _SERIES_LABELS
+    }
+    a_grid = np.asarray(model.a_grid)
+
+    # 1. Capital market cross: demand & supply points vs r, with the
+    #    complete-markets rate line (Aiyagari_VFI.m:217-229). History kept
+    #    aligned (not independently sorted like the reference's :211-213).
+    order = np.argsort(result.r_history)
+    r_h = np.asarray(result.r_history)[order]
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.plot(np.asarray(result.k_demand)[order], r_h, "r-o", lw=2, label="Capital Demand")
+    ax.plot(np.asarray(result.k_supply)[order], r_h, "b--s", lw=2, label="Capital Supply")
+    ax.axhline((1 - model.preferences.beta) / model.preferences.beta, color="k", lw=0.8)
+    ax.set_xlabel("Total Assets")
+    ax.set_ylabel("Interest Rate")
+    ax.set_title("Steady State: capital market")
+    ax.legend()
+    ax.grid(True)
+    fig.savefig(out / "capital_market.png", dpi=120)
+    plt.close(fig)
+
+    # 2. Asset policy functions for the lowest/highest productivity states
+    #    (Aiyagari_VFI.m:231-243).
+    pk = np.asarray(result.solution.policy_k)
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.plot(a_grid, pk[0], "r:", lw=2, label="lowest productivity")
+    ax.plot(a_grid, pk[-1], "b--", lw=2, label="highest productivity")
+    ax.plot(a_grid, a_grid, "k-", lw=0.5)
+    ax.set_xlabel("Assets")
+    ax.set_ylabel("Next-period assets")
+    ax.set_title("Asset policy functions")
+    ax.legend()
+    ax.grid(True)
+    fig.savefig(out / "policies.png", dpi=120)
+    plt.close(fig)
+
+    # 3. Densities (the ksdensity analogue; Aiyagari_VFI.m:245-279).
+    fig, axes = plt.subplots(1, 2, figsize=(12, 5))
+    xi, f = gaussian_kde(series["k"])
+    axes[0].plot(np.asarray(xi), np.asarray(f), "b-", lw=2)
+    axes[0].set_title("Density of Wealth")
+    axes[0].grid(True)
+    for name in ("c", "y", "gy", "sav"):
+        xi, f = gaussian_kde(series[name])
+        axes[1].plot(np.asarray(xi), np.asarray(f), lw=2, label=_SERIES_LABELS[name])
+    axes[1].set_title("Densities")
+    axes[1].legend()
+    axes[1].grid(True)
+    fig.savefig(out / "densities.png", dpi=120)
+    plt.close(fig)
+
+    # 4. Probability histograms (Aiyagari_VFI.m:281-312).
+    fig, axes = plt.subplots(1, 5, figsize=(22, 4))
+    for ax, (name, label) in zip(axes, _SERIES_LABELS.items()):
+        ax.hist(series[name], bins=50, weights=np.full(series[name].size, 1.0 / series[name].size))
+        ax.set_title(f"Histogram of {label}")
+    fig.savefig(out / "histograms.png", dpi=120)
+    plt.close(fig)
+
+    # 5. Lorenz curves for all five series (Aiyagari_VFI.m:359-372).
+    fig, ax = plt.subplots(figsize=(7, 6))
+    ginis = {}
+    for name, label in _SERIES_LABELS.items():
+        pop, cum = lorenz_curve(series[name])
+        ax.plot(np.asarray(pop), np.asarray(cum), lw=2, label=label)
+        ginis[name] = float(gini(series[name]))
+    ax.plot([0, 1], [0, 1], "k--")
+    ax.set_xlabel("Cumulative Share of Population")
+    ax.set_ylabel("Cumulative Share")
+    ax.set_title("Lorenz Curves")
+    ax.legend()
+    ax.grid(True)
+    fig.savefig(out / "lorenz.png", dpi=120)
+    plt.close(fig)
+
+    # 6. Quintile wealth shares bar chart (Aiyagari_VFI.m:374-420).
+    shares = np.asarray(quantile_shares(series["k"], 5))
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.bar(range(1, 6), shares, color="b")
+    ax.set_xticks(range(1, 6),
+                  ["Bottom 20%", "Next 20%", "Next 20%", "Next 20%", "Top 20%"])
+    ax.set_ylabel("Wealth Share (%)")
+    ax.set_title("Wealth Distribution Across Quintiles")
+    ax.grid(True)
+    fig.savefig(out / "quintiles.png", dpi=120)
+    plt.close(fig)
+
+    summary = {
+        "r_star": result.r,
+        "wage": result.w,
+        "capital": result.capital,
+        "savings_rate_percent": 100.0 * model.config.technology.delta
+        * model.config.technology.alpha
+        / (result.r + model.config.technology.delta),   # Aiyagari_VFI.m:208
+        "converged": result.converged,
+        "iterations": result.iterations,
+        "gini": ginis,
+        "quintile_shares_percent": shares.tolist(),
+        "solve_seconds": result.solve_seconds,
+    }
+    (out / "summary.json").write_text(json.dumps(summary, indent=2))
+    return summary
+
+
+def krusell_smith_report(result, outdir, discard: int = 100) -> dict:
+    """Write the K-S figure set + summary.json; returns the summary dict.
+
+    `result` is a KSResult. The approximate path recursion mirrors
+    compute_approxKprime (Krusell_Smith_VFI.m:367-375).
+    """
+    plt = _plt()
+    out = Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    K_ts = np.asarray(result.K_ts)
+    z = np.asarray(result.z_path)
+    B = np.asarray(result.B)
+
+    K_approx = np.empty_like(K_ts)
+    K_approx[discard] = K_ts[discard]
+    for t in range(discard, len(K_ts) - 1):
+        b0, b1 = (B[0], B[1]) if z[t] == 0 else (B[2], B[3])
+        K_approx[t + 1] = np.exp(b0 + b1 * np.log(K_approx[t]))
+
+    fig, axes = plt.subplots(2, 1, figsize=(9, 8))
+    axes[0].plot(K_ts[discard + 1:], "-r", label="True")
+    axes[0].plot(K_approx[discard + 1:], "--b", label="Approximation")
+    axes[0].set_title("Aggregate Capital Law of Motion")
+    axes[0].set_xlabel("Time")
+    axes[0].set_ylabel("K")
+    axes[0].legend()
+
+    K_lim = np.linspace(K_ts.min(), K_ts.max(), 100)
+    axes[1].plot(K_lim, np.exp(B[0] + B[1] * np.log(K_lim)), "b-", label="Good State")
+    axes[1].plot(K_lim, np.exp(B[2] + B[3] * np.log(K_lim)), "r-", label="Bad State")
+    axes[1].plot(K_lim, K_lim, "k--", label="45° Line")
+    axes[1].set_title("Tomorrow vs Today Aggregate Capital")
+    axes[1].set_xlabel("K_t")
+    axes[1].set_ylabel("K_{t+1}")
+    axes[1].legend()
+    fig.tight_layout()
+    fig.savefig(out / "alm.png", dpi=120)
+    plt.close(fig)
+
+    # Wealth distribution of the final cross-section (bonus over the
+    # reference: it never plots the K-S wealth distribution).
+    kpop = np.asarray(result.k_population)
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.hist(kpop, bins=60, weights=np.full(kpop.size, 1.0 / kpop.size))
+    ax.set_title("Cross-sectional wealth distribution (final period)")
+    ax.set_xlabel("k")
+    fig.savefig(out / "wealth_cross_section.png", dpi=120)
+    plt.close(fig)
+
+    err = np.abs(K_approx[discard + 1:] - K_ts[discard + 1:]) / K_ts[discard + 1:]
+    summary = {
+        "B": B.tolist(),
+        "r2_good": float(result.r2[0]),
+        "r2_bad": float(result.r2[1]),
+        "converged": result.converged,
+        "iterations": result.iterations,
+        "diff_B": result.diff_B,
+        "K_mean": float(K_ts[discard:].mean()),
+        "alm_path_max_rel_error": float(err.max()),
+        "wealth_gini": float(gini(jnp.asarray(kpop))),
+        "solve_seconds": result.solve_seconds,
+    }
+    (out / "summary.json").write_text(json.dumps(summary, indent=2))
+    return summary

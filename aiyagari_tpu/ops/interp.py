@@ -12,12 +12,15 @@ Krusell_Smith_VFI.m:241-244.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
     "bucket_index",
+    "bucket_onehot",
     "linear_interp",
     "linear_interp_rows",
+    "state_policy_interp",
     "pchip_slopes",
     "pchip_interp",
     "masked_pchip_interp",
@@ -46,6 +49,51 @@ def bucket_index(x: jnp.ndarray, q: jnp.ndarray, hi_clip: int | None = None) -> 
     else:
         idx = jnp.searchsorted(x, q, side="right", method="scan_unrolled").astype(jnp.int32) - 1
     return jnp.clip(idx, 0, hi)
+
+
+def bucket_onehot(x: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """One-hot encoding of bucket_index over the n-1 grid intervals,
+    [..., n-1] float of the query dtype.
+
+    Built from differences of step functions over the interior knots, so
+    out-of-range queries land in the edge buckets (linear extrapolation
+    semantics) without any integer indexing. This is the gather-free route:
+    on TPU a gather of B indices costs ~B scalar cycles, while the one-hot
+    contraction is dense VPU/MXU work.
+    """
+    C = (q[..., None] >= x[1:-1]).astype(q.dtype)          # [..., n-2]
+    return jnp.concatenate(
+        [1.0 - C[..., :1], C[..., :-1] - C[..., 1:], C[..., -1:]], axis=-1
+    )
+
+
+def state_policy_interp(x: jnp.ndarray, policies: jnp.ndarray, state_idx: jnp.ndarray,
+                        q: jnp.ndarray) -> jnp.ndarray:
+    """Per-agent linear interpolation of each agent's state's policy row,
+    entirely gather-free: out[b] = interp(x, policies[state_idx[b]], q[b]).
+
+    x [n] sorted; policies [ns, n]; state_idx [B] int; q [B]. Linearly
+    extrapolates via edge segments (interp1 'linear','extrap' semantics).
+
+    This is the agent-panel hot path (Krusell_Smith_VFI.m:241-244 evaluates a
+    per-state interpolant for each agent group; Aiyagari_VFI.m:110-117 does it
+    per agent). Both the state selection and the bucket selection become
+    one-hot contractions: a [B, ns] x [ns, n] matmul picks policy rows, a
+    [B, n-1] one-hot picks segments. Contractions run at HIGHEST precision —
+    the default TPU f32 matmul is bf16-based and loses ~3 decimal digits,
+    which is visible in policy values O(100).
+    """
+    ns = policies.shape[0]
+    hi = jax.lax.Precision.HIGHEST
+    ohS = (state_idx[:, None] == jnp.arange(ns)[None, :]).astype(q.dtype)   # [B, ns]
+    Y = jnp.matmul(ohS, policies, precision=hi)                             # [B, n]
+    sel = bucket_onehot(x, q)                                               # [B, n-1]
+    x0 = jnp.matmul(sel, x[:-1], precision=hi)
+    x1 = jnp.matmul(sel, x[1:], precision=hi)
+    y0 = jnp.sum(sel * Y[:, :-1], axis=1)
+    y1 = jnp.sum(sel * Y[:, 1:], axis=1)
+    t = (q - x0) / (x1 - x0)
+    return y0 + t * (y1 - y0)
 
 
 def linear_interp(x: jnp.ndarray, y: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:

@@ -16,7 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from aiyagari_tpu.ops.interp import linear_interp_rows
+from aiyagari_tpu.ops.interp import state_policy_interp
 
 __all__ = ["PanelSeries", "simulate_panel"]
 
@@ -64,11 +64,16 @@ def simulate_panel(policy_k, policy_c, policy_l, a_grid, s, P, r, w, key, *,
     def step(carry, key_t):
         z, k = carry
         u = jax.random.uniform(key_t, (n_agents,), dtype=a_grid.dtype)
-        z_new = jnp.sum(cumP[z] < u[:, None], axis=1).astype(z.dtype)
-        k_new = linear_interp_rows(a_grid, policy_k[z_new], k)
-        c_new = linear_interp_rows(a_grid, policy_c[z_new], k)
-        l_new = linear_interp_rows(a_grid, policy_l[z_new], k)
-        labor_inc = w * s[z_new] * l_new
+        # Markov draw via one-hot row selection (gather-free) + inverse CDF.
+        ohZ = (z[:, None] == jnp.arange(N)[None, :]).astype(a_grid.dtype)
+        rowP = jnp.matmul(ohZ, cumP, precision=jax.lax.Precision.HIGHEST)
+        z_new = jnp.sum(rowP < u[:, None], axis=1).astype(z.dtype)
+        k_new = state_policy_interp(a_grid, policy_k, z_new, k)
+        c_new = state_policy_interp(a_grid, policy_c, z_new, k)
+        l_new = state_policy_interp(a_grid, policy_l, z_new, k)
+        ohZn = (z_new[:, None] == jnp.arange(N)[None, :]).astype(a_grid.dtype)
+        s_new = jnp.matmul(ohZn, s, precision=jax.lax.Precision.HIGHEST)
+        labor_inc = w * s_new * l_new
         y = r * k_new + labor_inc
         gy = y + delta * k_new
         sav = gy - c_new

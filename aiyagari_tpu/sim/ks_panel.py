@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.models.krusell_smith import state_index
-from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.ops.interp import state_policy_interp
 
 __all__ = ["simulate_aggregate_shocks", "simulate_employment_panel", "simulate_capital_path"]
 
@@ -87,8 +87,6 @@ def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population
     """
     nK = K_grid.shape[0]
 
-    ns = k_opt.shape[0]
-
     def step(carry, inp):
         k_pop, K_t = carry
         z_t, eps_t = inp
@@ -98,13 +96,11 @@ def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population
         iK = jnp.clip(jnp.searchsorted(K_grid, K_t, side="right") - 1, 0, nK - 2)
         tK = (K_t - K_grid[iK]) / (K_grid[iK + 1] - K_grid[iK])
         pol_at_K = k_opt[:, iK, :] * (1.0 - tK) + k_opt[:, iK + 1, :] * tK   # [ns, nk]
-        # Evaluate every state's policy at each agent's k, then select by the
-        # agent's state via one-hot combine. ns is tiny (4), and the one-hot
-        # keeps everything elementwise along the (sharded) agent axis — no
-        # gather with sharded indices into the replicated table.
-        vals = jax.vmap(lambda pol: linear_interp(k_grid, pol, k_pop))(pol_at_K)  # [ns, pop]
-        onehot = (s_t[None, :] == jnp.arange(ns)[:, None]).astype(k_pop.dtype)
-        k_new = jnp.sum(vals * onehot, axis=0)
+        # Gather-free policy evaluation: state selection and bucket selection
+        # are one-hot contractions (ops/interp.py state_policy_interp) — TPU
+        # gathers of agent-indexed rows were the measured bottleneck, and the
+        # one-hot form also shards cleanly along the agent axis.
+        k_new = state_policy_interp(k_grid, pol_at_K, s_t, k_pop)
         K_next = jnp.mean(k_new)
         return (k_new, K_next), K_t
 

@@ -1,0 +1,217 @@
+"""Auxiliary-subsystem tests (SURVEY.md §5): logging sinks, profiler harness,
+checkpoint/resume of both outer loops, report generation, and the CLI."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import (
+    ALMConfig,
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    KrusellSmithConfig,
+    SimConfig,
+    SolverConfig,
+)
+from aiyagari_tpu.diagnostics.logging import CollectSink, ConsoleSink, JSONLSink, multiplex
+from aiyagari_tpu.diagnostics.profiler import Timing, time_fn
+from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
+from aiyagari_tpu.equilibrium.bisection import solve_equilibrium
+from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, load_checkpoint, save_checkpoint
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+SMALL = AiyagariConfig(grid=GridSpecConfig(n_points=60))
+SIM = SimConfig(periods=600, n_agents=4, discard=100, seed=5)
+
+
+class TestLogging:
+    def test_jsonl_and_collect_sinks(self, tmp_path):
+        path = tmp_path / "log.jsonl"
+        collect = CollectSink()
+        sink = multiplex(JSONLSink(path), collect, None)
+        sink({"iteration": 0, "dist": 1.5})
+        sink({"iteration": 1, "dist": 0.5, "B": [1.0, 2.0]})
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(lines) == 2 and lines[1]["dist"] == 0.5
+        assert "wall_time" in lines[0]
+        assert len(collect.records) == 2
+
+    def test_console_sink_formats(self, capsys):
+        ConsoleSink(prefix="x ")({"it": 1, "d": 0.123456789, "B": [1.0, 2]})
+        outp = capsys.readouterr().out
+        assert outp.startswith("x it=1") and "0.123457" in outp
+
+
+class TestProfiler:
+    def test_time_fn_fences_and_splits(self):
+        import jax
+
+        @jax.jit
+        def f(x):
+            return (x @ x).sum()
+
+        t = time_fn(f, jnp.ones((200, 200)), reps=2)
+        assert isinstance(t, Timing)
+        assert t.compile_and_first_run_s >= t.run_s > 0
+        assert t.compile_s >= 0
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "c.npz"
+        save_checkpoint(p, scalars={"it": 3, "hist": [1.0, 2.0]},
+                        arrays={"v": np.arange(6.0).reshape(2, 3)})
+        sc, arrays = load_checkpoint(p)
+        assert sc == {"it": 3, "hist": [1.0, 2.0]}
+        np.testing.assert_array_equal(arrays["v"], np.arange(6.0).reshape(2, 3))
+
+    def test_missing_returns_none(self, tmp_path):
+        assert load_checkpoint(tmp_path / "nope.npz") is None
+
+    def test_bisection_resume(self, tmp_path):
+        model = AiyagariModel.from_config(SMALL)
+        solver = SolverConfig(method="egm")
+        eq = EquilibriumConfig(max_iter=4)
+        full = solve_equilibrium(model, solver=solver, sim=SIM, eq=eq)
+
+        # Interrupted run: stop after 2 iterations (checkpointing on).
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_equilibrium(model, solver=solver, sim=SIM, eq=eq,
+                              on_iteration=interrupt, checkpoint_dir=tmp_path)
+        resumed = solve_equilibrium(model, solver=solver, sim=SIM, eq=eq,
+                                    checkpoint_dir=tmp_path)
+        # Resumed run continues the same bisection: identical bracket path.
+        np.testing.assert_allclose(resumed.r_history, full.r_history, atol=1e-12)
+        assert abs(resumed.r - full.r) < 1e-12
+
+    def test_checkpoint_deleted_on_completion(self, tmp_path):
+        model = AiyagariModel.from_config(SMALL)
+        solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=SIM,
+                          eq=EquilibriumConfig(max_iter=2), checkpoint_dir=tmp_path)
+        assert not list(tmp_path.glob("*.npz"))
+
+    def test_fingerprint_mismatch_starts_fresh(self, tmp_path):
+        model = AiyagariModel.from_config(SMALL)
+        eq = EquilibriumConfig(max_iter=3)
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            # Interrupt after iteration 0's checkpoint has been written (the
+            # save happens post-callback, so trigger on the next iteration).
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=SIM, eq=eq,
+                              on_iteration=interrupt, checkpoint_dir=tmp_path)
+        assert list(tmp_path.glob("*.npz"))
+        # Different sim seed => different fingerprint => checkpoint ignored.
+        sim2 = SimConfig(periods=600, n_agents=4, discard=100, seed=99)
+        with pytest.warns(UserWarning, match="different run configuration"):
+            res = solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=sim2,
+                                    eq=eq, checkpoint_dir=tmp_path)
+        assert res.iterations == 3  # fresh full run, not a resume
+
+    def test_exhausted_run_resume_no_duplicates(self, tmp_path):
+        # Interrupt on the LAST iteration so the checkpoint describes a run
+        # that used its whole budget; resuming must not duplicate history.
+        model = AiyagariModel.from_config(SMALL)
+        eq = EquilibriumConfig(max_iter=3)
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 2:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=SIM, eq=eq,
+                              on_iteration=interrupt, checkpoint_dir=tmp_path)
+        resumed = solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=SIM,
+                                    eq=eq, checkpoint_dir=tmp_path)
+        assert resumed.iterations <= eq.max_iter
+        its = [r["iteration"] for r in resumed.per_iteration]
+        assert len(its) == len(set(its))  # no duplicated iteration labels
+
+    def test_ks_resume(self, tmp_path):
+        cfg = KrusellSmithConfig(k_size=15)
+        alm = ALMConfig(T=120, population=300, discard=30, max_iter=3, seed=2)
+        kw = dict(method="vfi",
+                  solver=SolverConfig(method="vfi", tol=1e-4, max_iter=50, howard_steps=10))
+        full = solve_krusell_smith(cfg, alm=alm, **kw)
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 0:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_krusell_smith(cfg, alm=alm, on_iteration=interrupt,
+                                checkpoint_dir=tmp_path, **kw)
+        resumed = solve_krusell_smith(cfg, alm=alm, checkpoint_dir=tmp_path, **kw)
+        np.testing.assert_allclose(resumed.B, full.B, atol=1e-10)
+
+
+class TestReports:
+    def test_equilibrium_report(self, tmp_path):
+        from aiyagari_tpu.io_utils.report import equilibrium_report
+
+        model = AiyagariModel.from_config(SMALL)
+        res = solve_equilibrium(model, solver=SolverConfig(method="egm"), sim=SIM,
+                                eq=EquilibriumConfig(max_iter=3))
+        summary = equilibrium_report(res, model, tmp_path, discard=100)
+        for f in ("capital_market.png", "policies.png", "densities.png",
+                  "histograms.png", "lorenz.png", "quintiles.png", "summary.json"):
+            assert (tmp_path / f).exists(), f
+        assert set(summary["gini"]) == {"k", "c", "y", "gy", "sav"}
+        assert abs(sum(summary["quintile_shares_percent"]) - 100.0) < 1e-6
+
+    def test_ks_report(self, tmp_path):
+        from aiyagari_tpu.io_utils.report import krusell_smith_report
+
+        cfg = KrusellSmithConfig(k_size=15)
+        res = solve_krusell_smith(
+            cfg, method="vfi",
+            solver=SolverConfig(method="vfi", tol=1e-4, max_iter=50, howard_steps=10),
+            alm=ALMConfig(T=120, population=300, discard=30, max_iter=2, seed=2),
+        )
+        summary = krusell_smith_report(res, tmp_path, discard=30)
+        assert (tmp_path / "alm.png").exists()
+        assert (tmp_path / "wealth_cross_section.png").exists()
+        assert summary["r2_good"] > 0.9
+        assert summary["alm_path_max_rel_error"] < 0.2
+
+
+@pytest.mark.slow
+class TestCLI:
+    def test_cli_aiyagari_end_to_end(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "aiyagari_tpu", "aiyagari", "--method", "egm",
+             "--grid", "60", "--periods", "500", "--agents", "4",
+             "--platform", "cpu", "--f64", "--quiet",
+             "--outdir", str(tmp_path / "run")],
+            capture_output=True, text=True, cwd=str(Path(__file__).resolve().parents[1]),
+            timeout=500,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        summary = json.loads((tmp_path / "run" / "summary.json").read_text())
+        assert -0.05 < summary["r_star"] < 0.05
+        assert (tmp_path / "run" / "iterations.jsonl").exists()

@@ -40,6 +40,22 @@ class TestLinearInterp:
             np.testing.assert_allclose(got[i], want, atol=1e-12)
 
 
+class TestStatePolicyInterp:
+    def test_matches_gather_path(self, rng):
+        from aiyagari_tpu.ops.interp import state_policy_interp
+
+        x = np.sort(rng.uniform(0, 100, 60))
+        policies = rng.normal(size=(4, 60)) * 50
+        states = rng.integers(0, 4, 500)
+        q = rng.uniform(-10, 120, 500)  # includes extrapolation range
+        got = np.asarray(state_policy_interp(jnp.array(x), jnp.array(policies),
+                                             jnp.array(states), jnp.array(q)))
+        for b in range(500):
+            want = float(linear_interp(jnp.array(x), jnp.array(policies[states[b]]),
+                                       jnp.array(q[b])))
+            assert abs(got[b] - want) < 1e-9, b
+
+
 class TestPchip:
     def test_matches_scipy(self, rng):
         # SciPy's PchipInterpolator implements the same Fritsch-Carlson
