@@ -165,6 +165,7 @@ class SolverConfig:
     improve_every: int = 5            # policy improvement cadence under Howard
     golden_iters: int = 48            # fixed golden-section iterations (fminbnd analogue)
     relative_tol: bool = False        # K-S VFI uses a relative sup-norm (:195)
+    use_pallas: bool = False          # fused VMEM-tiled Bellman kernel (TPU)
 
 
 @dataclasses.dataclass(frozen=True)

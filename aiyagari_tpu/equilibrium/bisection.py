@@ -84,6 +84,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
             max_iter=solver.max_iter, howard_steps=solver.howard_steps,
             block_size=block_size, relative_tol=solver.relative_tol,
+            use_pallas=solver.use_pallas,
         )
     if solver.method == "egm":
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)

@@ -25,8 +25,9 @@ def _neg_inf(dtype):
     return jnp.array(-jnp.inf, dtype)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "block_size"))
-def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size: int = 0):
+@partial(jax.jit, static_argnames=("sigma", "beta", "block_size", "use_pallas"))
+def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size: int = 0,
+                 use_pallas: bool = False):
     """One application of the Bellman operator, exogenous labor.
 
     v [N, na] -> (v_new [N, na], policy_idx [N, na] int32).
@@ -37,10 +38,20 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size
 
     block_size > 0 processes the a' axis in chunks of that size (memory-bounded
     path for very fine grids); 0 means one dense [N, na, na] tensor.
+    use_pallas routes the choice reduction through the fused VMEM-tiled TPU
+    kernel (ops/pallas_bellman.py; interpreted off-TPU).
     """
     N, na = v.shape
     EV = beta * P @ v                                     # [N, na']
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
+
+    if use_pallas:
+        from aiyagari_tpu.ops.pallas_bellman import bellman_max_pallas
+
+        return bellman_max_pallas(
+            coh, a_grid, EV, sigma=sigma,
+            interpret=(jax.default_backend() != "tpu"),
+        )
 
     def block_scores(ap_vals, ev_vals):
         c = coh[:, :, None] - ap_vals[None, None, :]      # [N, na, blk]

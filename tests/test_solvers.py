@@ -89,6 +89,37 @@ class TestMethodEquivalence:
         assert np.abs(pl_v - pl_e)[in_bounds].max() < 2 * l_step
 
 
+class TestContinuousVFI:
+    def test_value_dominates_discrete(self, model, vfi_sol):
+        """Continuous choice can only improve on the discrete grid search:
+        v_cont >= v_discrete pointwise (up to interpolation error)."""
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
+
+        prefs = model.preferences
+        tech = model.config.technology
+        w = wage_from_r(R_TEST, tech.alpha, tech.delta)
+        v0 = jnp.zeros((7, GRID))
+        sc = solve_aiyagari_vfi_continuous(
+            v0, model.a_grid, model.s, model.P, R_TEST, w, model.amin,
+            sigma=prefs.sigma, beta=prefs.beta, tol=1e-5, max_iter=1000,
+            grid_power=2.0,
+        )
+        assert float(jnp.min(sc.v - vfi_sol.v)) > -1e-6
+        # Interior policies agree with the discrete search to ~one grid step.
+        pk_d, pk_c = np.asarray(vfi_sol.policy_k), np.asarray(sc.policy_k)
+        interior = pk_c < model.amax * 0.9
+        step = float(np.diff(np.asarray(model.a_grid)).max())
+        assert np.abs(pk_d - pk_c)[interior].max() < 2 * step
+
+    def test_power_locator_matches_generic(self, model):
+        from aiyagari_tpu.ops.interp import bucket_index, power_bucket_index
+
+        q = jnp.array(np.random.default_rng(3).uniform(-5, 60, 5000))
+        got = power_bucket_index(model.a_grid, q, model.a_grid[0], model.a_grid[-1], 2.0)
+        want = bucket_index(model.a_grid, q)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 class TestBackendEquivalence:
     def test_vfi_numpy_vs_jax(self, model, vfi_sol):
         prefs = model.preferences
@@ -183,3 +214,21 @@ class TestBlockedBellman:
                                     sigma=prefs.sigma, beta=prefs.beta, block_size=17)
         np.testing.assert_allclose(dense_v, blk_v, atol=1e-12)
         np.testing.assert_array_equal(np.asarray(dense_i), np.asarray(blk_i))
+
+    def test_pallas_matches_dense(self, model):
+        # Interpreted off-TPU; exercises the tiling/masking/accumulation logic
+        # of the fused kernel, including non-tile-multiple grid sizes.
+        prefs = model.preferences
+        tech = model.config.technology
+        w = wage_from_r(R_TEST, tech.alpha, tech.delta)
+        v = jnp.array(np.random.default_rng(1).normal(size=(7, GRID)))
+        dense_v, dense_i = bellman_step(v, model.a_grid, model.s, model.P, R_TEST, w,
+                                        sigma=prefs.sigma, beta=prefs.beta)
+        from aiyagari_tpu.ops.pallas_bellman import bellman_max_pallas
+
+        EV = prefs.beta * model.P @ v
+        coh = (1.0 + R_TEST) * model.a_grid[None, :] + w * model.s[:, None]
+        pal_v, pal_i = bellman_max_pallas(coh, model.a_grid, EV, sigma=prefs.sigma,
+                                          block_j=32, block_jp=48, interpret=True)
+        np.testing.assert_allclose(dense_v, pal_v, atol=1e-11)
+        np.testing.assert_array_equal(np.asarray(dense_i), np.asarray(pal_i))
