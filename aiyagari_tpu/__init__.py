@@ -28,6 +28,7 @@ from aiyagari_tpu.dispatch import solve
 from aiyagari_tpu.equilibrium.bisection import (
     EquilibriumResult,
     solve_equilibrium,
+    solve_equilibrium_distribution,
     solve_household,
 )
 from aiyagari_tpu.models.aiyagari import (
@@ -41,6 +42,7 @@ __version__ = "0.1.0"
 __all__ = [
     "solve",
     "solve_equilibrium",
+    "solve_equilibrium_distribution",
     "solve_household",
     "AiyagariModel",
     "aiyagari_preset",

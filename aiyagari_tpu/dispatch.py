@@ -43,6 +43,7 @@ def solve(
     sim: Optional[SimConfig] = None,
     equilibrium: Optional[EquilibriumConfig] = None,
     alm: Optional[ALMConfig] = None,
+    aggregation: str = "simulation",
 ):
     """Solve a full model to general equilibrium.
 
@@ -54,6 +55,11 @@ def solve(
     neither, the default is "vfi". When `solver` is omitted, each model
     family supplies its own reference-faithful solver defaults (e.g. the
     Krusell-Smith tolerances/Howard schedule of Krusell_Smith_VFI.m:12-13).
+
+    `aggregation` selects the Aiyagari capital-supply closure: "simulation"
+    (the reference's Monte-Carlo time average, Aiyagari_VFI.m:94-129) or
+    "distribution" (deterministic Young-histogram stationary distribution,
+    sim/distribution.py — jax backend only).
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -69,21 +75,39 @@ def solve(
     if method not in ("vfi", "egm"):
         raise ValueError(f"unknown method {method!r}; expected 'vfi' or 'egm'")
 
+    if aggregation not in ("simulation", "distribution"):
+        raise ValueError(
+            f"unknown aggregation {aggregation!r}; expected 'simulation' or 'distribution'"
+        )
+
     if isinstance(model, AiyagariConfig):
         solver = solver or SolverConfig(method=method)
         sim = sim or SimConfig()
         equilibrium = equilibrium or EquilibriumConfig()
         if backend.backend == "numpy":
+            if aggregation != "simulation":
+                raise ValueError("aggregation='distribution' requires backend='jax'")
             from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
 
             return solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
-        from aiyagari_tpu.equilibrium.bisection import solve_equilibrium
+        from aiyagari_tpu.equilibrium.bisection import (
+            solve_equilibrium,
+            solve_equilibrium_distribution,
+        )
         from aiyagari_tpu.models.aiyagari import AiyagariModel
 
         m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+        if aggregation == "distribution":
+            return solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
         return solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
 
     if isinstance(model, KrusellSmithConfig):
+        if aggregation != "simulation":
+            raise ValueError(
+                "aggregation='distribution' is not available for Krusell-Smith "
+                "models: the ALM closure is defined over a simulated aggregate "
+                "path (Krusell_Smith_VFI.m:250-296)"
+            )
         alm = alm or ALMConfig()
         from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
 

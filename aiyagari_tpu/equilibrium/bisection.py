@@ -29,7 +29,12 @@ from aiyagari_tpu.solvers.egm import solve_aiyagari_egm, solve_aiyagari_egm_labo
 from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi, solve_aiyagari_vfi_labor
 from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
 
-__all__ = ["EquilibriumResult", "solve_household", "solve_equilibrium"]
+__all__ = [
+    "EquilibriumResult",
+    "solve_household",
+    "solve_equilibrium",
+    "solve_equilibrium_distribution",
+]
 
 
 @dataclasses.dataclass
@@ -50,6 +55,8 @@ class EquilibriumResult:
     converged: bool
     solve_seconds: float
     per_iteration: list              # IterationRecord dicts (diagnostics)
+    mu: object = None                # [N, na] stationary distribution, when the
+                                     # non-stochastic closure produced one
 
 
 def _initial_consumption_guess(model: AiyagariModel, r: float, w: float):
@@ -106,26 +113,85 @@ def _warm_state(solution, method: str):
     return solution.v if method == "vfi" else solution.policy_c
 
 
-def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
-                      sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
-                      on_iteration: Optional[Callable] = None,
-                      checkpoint_dir: Optional[str] = None) -> EquilibriumResult:
-    """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
-    midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
+class _SimulationAggregator:
+    """Capital supply as the Monte-Carlo time/cross-section average of a
+    simulated panel (the reference's closure, Aiyagari_VFI.m:94-129,174-188)."""
 
-    The household solution is warm-started across bisection iterations (the
-    reference carries v_old across its re-solves at :147-171). Supply is the
-    time/cross-section average of simulated wealth; demand is the firm FOC
-    curve labor*(alpha/(r+delta))^(1/(1-alpha)).
+    checkpoint_tag = ""   # keeps existing checkpoint names stable
 
-    With checkpoint_dir set, the bisection state (bracket, histories,
-    warm-start policy) is persisted atomically every iteration and a restarted
-    call resumes from it (SURVEY.md §5.3-5.4; no analogue in the reference).
-    """
+    def __init__(self, model: AiyagariModel, sim: SimConfig):
+        self.model = model
+        self.sim = sim
+        self.key = jax.random.PRNGKey(sim.seed)
+        self.series = None
+        self.mu = None
+
+    def restore(self, start_it: int, arrays: dict) -> None:
+        # Fast-forward the PRNG stream to where the run stopped.
+        for _ in range(start_it):
+            self.key, _ = jax.random.split(self.key)
+
+    def supply(self, sol, r_mid: float, w: float):
+        model, sim = self.model, self.sim
+        self.key, sub = jax.random.split(self.key)
+        self.series = simulate_panel(
+            sol.policy_k, sol.policy_c, sol.policy_l, model.a_grid, model.s,
+            model.P, r_mid, w, sub, periods=sim.periods, n_agents=sim.n_agents,
+            delta=model.config.technology.delta,
+        )
+        return float(jnp.mean(self.series.k[sim.discard:])), {}
+
+    def arrays(self) -> dict:
+        return {}
+
+
+class _DistributionAggregator:
+    """Capital supply as E[a] under the Young-histogram stationary
+    distribution (sim/distribution.py) — deterministic, no analogue in the
+    reference. The distribution is warm-started across bisection steps."""
+
+    checkpoint_tag = "_dist"
+
+    def __init__(self, model: AiyagariModel, dist_tol: float, dist_max_iter: int):
+        self.model = model
+        self.dist_tol = dist_tol
+        self.dist_max_iter = dist_max_iter
+        self.series = None
+        self.mu = None
+
+    def restore(self, start_it: int, arrays: dict) -> None:
+        if "mu" in arrays:
+            self.mu = jnp.asarray(arrays["mu"], self.model.dtype)
+
+    def supply(self, sol, r_mid: float, w: float):
+        from aiyagari_tpu.sim.distribution import (
+            aggregate_capital,
+            stationary_distribution,
+        )
+
+        dist_sol = stationary_distribution(
+            sol.policy_k, self.model.a_grid, self.model.P,
+            tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
+        )
+        self.mu = dist_sol.mu
+        supply = float(aggregate_capital(self.mu, self.model.a_grid))
+        return supply, {"distribution_iterations": int(dist_sol.iterations)}
+
+    def arrays(self) -> dict:
+        return {"mu": np.asarray(self.mu)}
+
+
+def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
+            eq: EquilibriumConfig, on_iteration: Optional[Callable],
+            checkpoint_dir: Optional[str], checkpoint_configs) -> EquilibriumResult:
+    """Shared GE bisection driver (Aiyagari_VFI.m:133-206): bracket r, re-solve
+    the household problem warm-started at each midpoint, ask the aggregator for
+    capital supply, compare against the firm FOC demand curve. Checkpoint/
+    resume persists the bracket, histories, warm start, and any aggregator
+    state every iteration."""
     prefs = model.preferences
     tech = model.config.technology
     t0 = time.perf_counter()
-    key = jax.random.PRNGKey(sim.seed)
 
     r_low = eq.r_low
     r_high = eq.r_high if eq.r_high is not None else 1.0 / prefs.beta - 1.0
@@ -136,8 +202,8 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
         from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
 
         mgr = CheckpointManager(
-            checkpoint_dir, f"bisection_{solver.method}",
-            fingerprint=config_fingerprint(model.config, solver, sim, eq),
+            checkpoint_dir, f"bisection_{solver.method}{aggregator.checkpoint_tag}",
+            fingerprint=config_fingerprint(model.config, solver, *checkpoint_configs, eq),
         )
         resumed = mgr.restore()
 
@@ -155,9 +221,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
         r_hist, ks_hist, kd_hist = r_hist[:start_it], ks_hist[:start_it], kd_hist[:start_it]
         records = records[:start_it]
         warm = jnp.asarray(arrays["warm"], model.dtype)
-        # Fast-forward the PRNG stream to where the run stopped.
-        for _ in range(start_it):
-            key, _ = jax.random.split(key)
+        aggregator.restore(start_it, arrays)
         sol = None
     else:
         # Warm-start pass at r_init, as the reference does before its loop (:63-129).
@@ -166,19 +230,13 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
 
     converged = False
     r_mid = eq.r_init
-    series = None
     for it in range(start_it, eq.max_iter):
         it_t0 = time.perf_counter()
         r_mid = 0.5 * (r_low + r_high)
         w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
         sol = solve_household(model, r_mid, solver=solver, warm_start=warm)
         warm = _warm_state(sol, solver.method)
-        key, sub = jax.random.split(key)
-        series = simulate_panel(
-            sol.policy_k, sol.policy_c, sol.policy_l, model.a_grid, model.s, model.P,
-            r_mid, w, sub, periods=sim.periods, n_agents=sim.n_agents, delta=tech.delta,
-        )
-        supply = float(jnp.mean(series.k[sim.discard:]))
+        supply, extras = aggregator.supply(sol, r_mid, w)
         demand = float(capital_demand(r_mid, model.labor_raw, tech.alpha, tech.delta))
         r_hist.append(r_mid)
         ks_hist.append(supply)
@@ -191,6 +249,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
             "gap": supply - demand,
             "solver_iterations": int(sol.iterations),
             "solver_distance": float(sol.distance),
+            **extras,
             "seconds": time.perf_counter() - it_t0,
         }
         records.append(rec)
@@ -210,7 +269,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
                     "r_hist": r_hist, "ks_hist": ks_hist, "kd_hist": kd_hist,
                     "records": records,
                 },
-                arrays={"warm": np.asarray(warm)},
+                arrays={"warm": np.asarray(warm), **aggregator.arrays()},
             )
 
     if mgr is not None:
@@ -221,7 +280,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
         w=w,
         capital=ks_hist[-1],
         solution=sol,
-        series=series,
+        series=aggregator.series,
         r_history=r_hist,
         k_supply=ks_hist,
         k_demand=kd_hist,
@@ -229,4 +288,56 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
         converged=converged,
         solve_seconds=time.perf_counter() - t0,
         per_iteration=records,
+        mu=aggregator.mu,
+    )
+
+
+def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+                      sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
+                      on_iteration: Optional[Callable] = None,
+                      checkpoint_dir: Optional[str] = None) -> EquilibriumResult:
+    """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
+    midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
+
+    The household solution is warm-started across bisection iterations (the
+    reference carries v_old across its re-solves at :147-171). Supply is the
+    time/cross-section average of simulated wealth; demand is the firm FOC
+    curve labor*(alpha/(r+delta))^(1/(1-alpha)).
+
+    With checkpoint_dir set, the bisection state (bracket, histories,
+    warm-start policy) is persisted atomically every iteration and a restarted
+    call resumes from it (SURVEY.md §5.3-5.4; no analogue in the reference).
+    """
+    return _bisect(
+        model, _SimulationAggregator(model, sim), solver=solver, eq=eq,
+        on_iteration=on_iteration, checkpoint_dir=checkpoint_dir,
+        checkpoint_configs=(sim,),
+    )
+
+
+def solve_equilibrium_distribution(
+    model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+    eq: EquilibriumConfig = EquilibriumConfig(),
+    dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+    on_iteration: Optional[Callable] = None,
+    checkpoint_dir: Optional[str] = None,
+) -> EquilibriumResult:
+    """Non-stochastic GE closure: same r-bisection as solve_equilibrium, but
+    capital supply is E[a] under the stationary distribution computed by the
+    Young (2010) histogram method (sim/distribution.py) instead of a
+    Monte-Carlo time average. Deterministic — the bisection sees an exact
+    supply curve, not one polluted by simulation noise — and typically far
+    faster, since the distribution fixed point is a few hundred fused device
+    sweeps rather than a 10,000-step sequential scan.
+
+    No analogue in the reference (its aggregation is the quirk-8 single-
+    household time average, Aiyagari_VFI.m:129). Returns an EquilibriumResult
+    with `mu` set and `series=None`; distributional statistics come from the
+    weighted stats (utils/stats.py: weighted_gini etc.) over (a_grid, mu).
+    """
+    return _bisect(
+        model, _DistributionAggregator(model, dist_tol, dist_max_iter),
+        solver=solver, eq=eq, on_iteration=on_iteration,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_configs=(dist_tol, dist_max_iter),
     )

@@ -19,7 +19,15 @@ from pathlib import Path
 import jax.numpy as jnp
 import numpy as np
 
-from aiyagari_tpu.utils.stats import gaussian_kde, gini, lorenz_curve, quantile_shares
+from aiyagari_tpu.utils.stats import (
+    gaussian_kde,
+    gini,
+    lorenz_curve,
+    quantile_shares,
+    weighted_gini,
+    weighted_lorenz_curve,
+    weighted_quantile_shares,
+)
 
 __all__ = ["equilibrium_report", "krusell_smith_report"]
 
@@ -41,18 +49,46 @@ def _plt():
     return plt
 
 
+def _result_series(result, model, discard: int):
+    """(values, weights) per series label. Simulation results yield the panel
+    sample with uniform weights (weights=None); distribution results
+    (series=None, mu set) yield the gridded policy values weighted by the
+    stationary mass — under stationarity (z', a') ~ mu too, so the recorded
+    formulas match PanelSeries' accounting (sim/ergodic.py:76-79)."""
+    if result.series is not None:
+        return {
+            name: (np.asarray(getattr(result.series, name))[discard:].ravel(), None)
+            for name in _SERIES_LABELS
+        }
+    if result.mu is None:
+        raise ValueError("result has neither a simulated series nor a stationary mu")
+    mu = np.asarray(result.mu)
+    sol = result.solution
+    r, w = result.r, result.w
+    delta = model.config.technology.delta
+    k = np.broadcast_to(np.asarray(model.a_grid)[None, :], mu.shape)
+    c = np.asarray(sol.policy_c)
+    l = np.asarray(sol.policy_l)
+    s = np.asarray(model.s)[:, None]
+    y = r * k + w * s * l
+    gy = y + delta * k
+    sav = gy - c
+    values = {"k": k, "c": c, "y": y, "gy": gy, "sav": sav}
+    return {name: (v.ravel(), mu.ravel()) for name, v in values.items()}
+
+
 def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
     """Write the Aiyagari figure set + summary.json; returns the summary dict.
 
     `result` is an EquilibriumResult, `model` the AiyagariModel it came from.
+    Works for both closures: simulation results use the panel sample,
+    distribution results (aggregation='distribution') use the stationary
+    distribution with the weighted statistics.
     """
     plt = _plt()
     out = Path(outdir)
     out.mkdir(parents=True, exist_ok=True)
-    series = {
-        name: np.asarray(getattr(result.series, name))[discard:].ravel()
-        for name in _SERIES_LABELS
-    }
+    series = _result_series(result, model, discard)
     a_grid = np.asarray(model.a_grid)
 
     # 1. Capital market cross: demand & supply points vs r, with the
@@ -89,12 +125,18 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
 
     # 3. Densities (the ksdensity analogue; Aiyagari_VFI.m:245-279).
     fig, axes = plt.subplots(1, 2, figsize=(12, 5))
-    xi, f = gaussian_kde(series["k"])
+
+    def _kde(name):
+        vals, wts = series[name]
+        return gaussian_kde(jnp.asarray(vals),
+                            weights=None if wts is None else jnp.asarray(wts))
+
+    xi, f = _kde("k")
     axes[0].plot(np.asarray(xi), np.asarray(f), "b-", lw=2)
     axes[0].set_title("Density of Wealth")
     axes[0].grid(True)
     for name in ("c", "y", "gy", "sav"):
-        xi, f = gaussian_kde(series[name])
+        xi, f = _kde(name)
         axes[1].plot(np.asarray(xi), np.asarray(f), lw=2, label=_SERIES_LABELS[name])
     axes[1].set_title("Densities")
     axes[1].legend()
@@ -105,7 +147,9 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
     # 4. Probability histograms (Aiyagari_VFI.m:281-312).
     fig, axes = plt.subplots(1, 5, figsize=(22, 4))
     for ax, (name, label) in zip(axes, _SERIES_LABELS.items()):
-        ax.hist(series[name], bins=50, weights=np.full(series[name].size, 1.0 / series[name].size))
+        vals, wts = series[name]
+        mass = np.full(vals.size, 1.0 / vals.size) if wts is None else wts / wts.sum()
+        ax.hist(vals, bins=50, weights=mass)
         ax.set_title(f"Histogram of {label}")
     fig.savefig(out / "histograms.png", dpi=120)
     plt.close(fig)
@@ -114,9 +158,14 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
     fig, ax = plt.subplots(figsize=(7, 6))
     ginis = {}
     for name, label in _SERIES_LABELS.items():
-        pop, cum = lorenz_curve(series[name])
+        vals, wts = series[name]
+        if wts is None:
+            pop, cum = lorenz_curve(jnp.asarray(vals))
+            ginis[name] = float(gini(jnp.asarray(vals)))
+        else:
+            pop, cum = weighted_lorenz_curve(jnp.asarray(vals), jnp.asarray(wts))
+            ginis[name] = float(weighted_gini(jnp.asarray(vals), jnp.asarray(wts)))
         ax.plot(np.asarray(pop), np.asarray(cum), lw=2, label=label)
-        ginis[name] = float(gini(series[name]))
     ax.plot([0, 1], [0, 1], "k--")
     ax.set_xlabel("Cumulative Share of Population")
     ax.set_ylabel("Cumulative Share")
@@ -127,7 +176,12 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
     plt.close(fig)
 
     # 6. Quintile wealth shares bar chart (Aiyagari_VFI.m:374-420).
-    shares = np.asarray(quantile_shares(series["k"], 5))
+    k_vals, k_wts = series["k"]
+    if k_wts is None:
+        shares = np.asarray(quantile_shares(jnp.asarray(k_vals), 5))
+    else:
+        shares = np.asarray(weighted_quantile_shares(jnp.asarray(k_vals),
+                                                     jnp.asarray(k_wts), 5))
     fig, ax = plt.subplots(figsize=(7, 5))
     ax.bar(range(1, 6), shares, color="b")
     ax.set_xticks(range(1, 6),

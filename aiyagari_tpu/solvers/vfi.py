@@ -140,9 +140,13 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         e1 = jnp.take_along_axis(EV, idx + 1, axis=1)
         return e0 * (1.0 - t) + e1 * t
 
+    # Dtype-aware consumption floor: a literal like 1e-300 underflows to 0.0
+    # in f32 and would turn the infeasibility penalty into u(0) = -inf.
+    c_floor = jnp.finfo(v_init.dtype).tiny
+
     def value_given_ev(EV, ap):
         idx, t = interp_weights(ap)
-        c = jnp.maximum(coh - ap, 1e-300)
+        c = jnp.maximum(coh - ap, c_floor)
         return _u(c, sigma) + ev_at(EV, idx, t)
 
     def improve(v):
@@ -154,7 +158,7 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     def howard(v, pol):
         # The policy is fixed across sweeps: locate it once, re-gather EV only.
         idx, t = interp_weights(pol)
-        u_pol = _u(jnp.maximum(coh - pol, 1e-300), sigma)
+        u_pol = _u(jnp.maximum(coh - pol, c_floor), sigma)
 
         def sweep(v, _):
             EV = beta * P @ v
@@ -178,8 +182,6 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     init = (v_init, jnp.zeros_like(coh), jnp.array(jnp.inf, v_init.dtype), jnp.int32(0))
     v, policy_k, dist, it = jax.lax.while_loop(cond, body, init)
     policy_c = coh - policy_k
-    from aiyagari_tpu.ops.interp import bucket_index
-
     idx = bucket_index(a_grid, policy_k, hi_clip=na - 1)
     return VFISolution(v, idx.astype(jnp.int32), policy_k, policy_c,
                        jnp.ones_like(policy_k), it, dist)

@@ -18,6 +18,9 @@ __all__ = [
     "quantile_shares",
     "probability_histogram",
     "gaussian_kde",
+    "weighted_lorenz_curve",
+    "weighted_gini",
+    "weighted_quantile_shares",
 ]
 
 
@@ -67,23 +70,78 @@ def probability_histogram(x: jnp.ndarray, bins: int = 50, lo=None, hi=None):
     return edges, counts / x.shape[0]
 
 
-def gaussian_kde(x: jnp.ndarray, n_points: int = 100, bandwidth=None):
+def weighted_lorenz_curve(x: jnp.ndarray, w: jnp.ndarray):
+    """Lorenz curve of a weighted sample / gridded distribution: (cumulative
+    population share, cumulative value share), sorted by value, prepended
+    with the (0, 0) origin. Used with the non-stochastic distribution
+    (sim/distribution.py), where each gridpoint carries a probability mass —
+    the reference's sample-based Lorenz (Aiyagari_VFI.m:317-337) is the
+    uniform-weight special case.
+    """
+    x, w = x.ravel(), w.ravel()
+    order = jnp.argsort(x)
+    xs, ws = x[order], w[order]
+    zero = jnp.zeros((1,), xs.dtype)
+    pop = jnp.concatenate([zero, jnp.cumsum(ws)])
+    pop = pop / pop[-1]
+    cum = jnp.concatenate([zero, jnp.cumsum(ws * xs)])
+    cum = cum / cum[-1]
+    return pop, cum
+
+
+def weighted_gini(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Gini of a weighted sample: 1 - 2 * area under the weighted Lorenz curve."""
+    pop, cum = weighted_lorenz_curve(x, w)
+    area = jnp.trapezoid(cum, pop)
+    return 1.0 - 2.0 * area
+
+
+def weighted_quantile_shares(x: jnp.ndarray, w: jnp.ndarray,
+                             n_quantiles: int = 5) -> jnp.ndarray:
+    """Share of total x held by each population quantile (percent), for a
+    weighted sample. Quantile boundaries fall at cumulative-weight cutoffs
+    q/n_quantiles; the gridpoint straddling a boundary is split between the
+    adjacent quantiles in proportion to its mass (the lottery analogue of the
+    reference's round(n*q) index arithmetic, Aiyagari_VFI.m:383-403)."""
+    pop, cum = weighted_lorenz_curve(x, w)
+    qs = jnp.arange(0, n_quantiles + 1, dtype=pop.dtype) / n_quantiles
+    cum_at_q = jnp.interp(qs, pop, cum)
+    return (cum_at_q[1:] - cum_at_q[:-1]) * 100.0
+
+
+def gaussian_kde(x: jnp.ndarray, n_points: int = 100, bandwidth=None, weights=None):
     """Gaussian kernel density on an evenly spaced evaluation grid —
     the MATLAB ksdensity analogue (Aiyagari_VFI.m:247-251: normal kernel,
     100 points, normal-reference-rule bandwidth).
 
-    Returns (xi [n_points], f [n_points]) with f a proper density.
+    With `weights` (same shape as x, any positive scale), each point
+    contributes its probability mass — used for gridded distributions from
+    sim/distribution.py; the bandwidth rule then uses Kish's effective sample
+    size in place of n. Returns (xi [n_points], f [n_points]) with f a
+    proper density.
     """
     x = x.ravel()
-    n = x.shape[0]
-    std = jnp.std(x, ddof=1)
-    iqr = jnp.quantile(x, 0.75) - jnp.quantile(x, 0.25)
+    if weights is None:
+        n_eff = x.shape[0]
+        wn = jnp.full(x.shape, 1.0 / x.shape[0], x.dtype)
+        std = jnp.std(x, ddof=1)
+        q75, q25 = jnp.quantile(x, 0.75), jnp.quantile(x, 0.25)
+    else:
+        wn = weights.ravel() / jnp.sum(weights)
+        n_eff = 1.0 / jnp.sum(wn**2)
+        mean = jnp.sum(wn * x)
+        std = jnp.sqrt(jnp.sum(wn * (x - mean) ** 2) * n_eff / jnp.maximum(n_eff - 1.0, 1.0))
+        order = jnp.argsort(x)
+        cum = jnp.cumsum(wn[order])
+        q25 = jnp.interp(0.25, cum, x[order])
+        q75 = jnp.interp(0.75, cum, x[order])
+    iqr = q75 - q25
     sig = jnp.minimum(std, iqr / 1.349)
     # MATLAB's default: Silverman's normal reference rule.
-    h = sig * (4.0 / (3.0 * n)) ** 0.2 if bandwidth is None else bandwidth
+    h = sig * (4.0 / (3.0 * n_eff)) ** 0.2 if bandwidth is None else bandwidth
     lo = jnp.min(x) - 3.0 * h
     hi = jnp.max(x) + 3.0 * h
     xi = jnp.linspace(lo, hi, n_points)
     z = (xi[:, None] - x[None, :]) / h
-    f = jnp.exp(-0.5 * z**2).sum(axis=1) / (n * h * jnp.sqrt(2.0 * jnp.pi))
+    f = (jnp.exp(-0.5 * z**2) * wn[None, :]).sum(axis=1) / (h * jnp.sqrt(2.0 * jnp.pi))
     return xi, f

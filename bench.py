@@ -94,6 +94,8 @@ def bench_scale(grid_scale: int, quick: bool) -> dict:
     from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
     from aiyagari_tpu.utils.firm import wage_from_r
 
+    if quick:
+        grid_scale = min(grid_scale, 40_000)   # 100x grid: fast smoke run
     r, tol, max_iter = 0.04, 1e-5, 1000
     platform = jax.default_backend()
     dtype = jnp.float32 if platform == "tpu" else jnp.float64

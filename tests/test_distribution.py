@@ -1,0 +1,271 @@
+"""Tests for the non-stochastic (Young 2010) distribution machinery
+(sim/distribution.py), the weighted inequality statistics, and the
+deterministic distribution-based GE closure.
+
+The reference has no analogue (its aggregation is a Monte-Carlo time average,
+Aiyagari_VFI.m:94-129); these tests pin the new capability to first
+principles: lottery conservation, fixed-point property, agreement of the
+income marginal with the Markov chain's stationary distribution, and
+agreement of the distribution-based GE with the simulation-based GE.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    SimConfig,
+    SolverConfig,
+)
+from aiyagari_tpu.equilibrium.bisection import (
+    solve_equilibrium,
+    solve_equilibrium_distribution,
+    solve_household,
+)
+from aiyagari_tpu.models.aiyagari import AiyagariModel, aiyagari_preset
+from aiyagari_tpu.sim.distribution import (
+    aggregate_capital,
+    distribution_step,
+    stationary_distribution,
+    young_lottery,
+)
+from aiyagari_tpu.utils.markov import stationary_distribution as markov_stationary
+from aiyagari_tpu.utils.stats import (
+    gini,
+    quantile_shares,
+    weighted_gini,
+    weighted_lorenz_curve,
+    weighted_quantile_shares,
+)
+
+
+@pytest.fixture(scope="module")
+def solved_small():
+    """Household solution at a fixed r on a small grid."""
+    model = aiyagari_preset(grid_size=80)
+    sol = solve_household(model, 0.03, solver=SolverConfig(method="egm"))
+    return model, sol
+
+
+class TestLottery:
+    def test_weights_reconstruct_policy(self, solved_small):
+        model, sol = solved_small
+        idx, w_lo = young_lottery(sol.policy_k, model.a_grid)
+        recon = w_lo * model.a_grid[idx] + (1.0 - w_lo) * model.a_grid[idx + 1]
+        clipped = jnp.clip(sol.policy_k, model.a_grid[0], model.a_grid[-1])
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(clipped), atol=1e-12)
+
+    def test_weights_in_unit_interval(self, solved_small):
+        model, sol = solved_small
+        _, w_lo = young_lottery(sol.policy_k, model.a_grid)
+        assert float(w_lo.min()) >= 0.0 and float(w_lo.max()) <= 1.0
+
+    def test_step_conserves_mass(self, solved_small):
+        model, sol = solved_small
+        idx, w_lo = young_lottery(sol.policy_k, model.a_grid)
+        N, na = sol.policy_k.shape
+        mu = jnp.full((N, na), 1.0 / (N * na))
+        mu1 = distribution_step(mu, idx, w_lo, model.P)
+        assert float(mu1.sum()) == pytest.approx(1.0, abs=1e-12)
+        assert float(mu1.min()) >= 0.0
+
+
+class TestStationaryDistribution:
+    @pytest.fixture(scope="class")
+    def mu_sol(self, solved_small):
+        model, sol = solved_small
+        return stationary_distribution(sol.policy_k, model.a_grid, model.P,
+                                       tol=1e-12, max_iter=20_000)
+
+    def test_probability_measure(self, mu_sol):
+        assert float(mu_sol.mu.sum()) == pytest.approx(1.0, abs=1e-10)
+        assert float(mu_sol.mu.min()) >= 0.0
+
+    def test_fixed_point(self, solved_small, mu_sol):
+        model, sol = solved_small
+        idx, w_lo = young_lottery(sol.policy_k, model.a_grid)
+        mu1 = distribution_step(mu_sol.mu, idx, w_lo, model.P)
+        np.testing.assert_allclose(np.asarray(mu1), np.asarray(mu_sol.mu), atol=1e-10)
+
+    def test_income_marginal_matches_markov_stationary(self, solved_small, mu_sol):
+        model, _ = solved_small
+        pi = markov_stationary(model.P)
+        np.testing.assert_allclose(
+            np.asarray(mu_sol.mu.sum(axis=1)), np.asarray(pi), atol=1e-8
+        )
+
+    def test_aggregate_capital_positive_and_on_grid(self, solved_small, mu_sol):
+        model, _ = solved_small
+        K = float(aggregate_capital(mu_sol.mu, model.a_grid))
+        assert float(model.a_grid[0]) <= K <= float(model.a_grid[-1])
+        assert K > 0.0
+
+    def test_agrees_with_monte_carlo_supply(self):
+        """The deterministic supply should sit near the Monte-Carlo time
+        average at the same policies (within MC sampling error). Run at
+        r=0.0, where the stationary distribution is interior — at higher r
+        the grid cap binds and the simulator's linear policy extrapolation
+        beyond amax diverges from the (grid-conditioned) histogram method."""
+        import jax
+
+        from aiyagari_tpu.sim.ergodic import simulate_panel
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        r = 0.0
+        model = aiyagari_preset(grid_size=80)
+        sol = solve_household(model, r, solver=SolverConfig(method="egm"))
+        mu_sol = stationary_distribution(sol.policy_k, model.a_grid, model.P,
+                                         tol=1e-12, max_iter=20_000)
+        tech = model.config.technology
+        w = wage_from_r(r, tech.alpha, tech.delta)
+        series = simulate_panel(
+            sol.policy_k, sol.policy_c, sol.policy_l, model.a_grid, model.s,
+            model.P, r, w, jax.random.PRNGKey(7),
+            periods=4000, n_agents=64, delta=tech.delta,
+        )
+        mc = float(jnp.mean(series.k[500:]))
+        det = float(aggregate_capital(mu_sol.mu, model.a_grid))
+        assert det == pytest.approx(mc, rel=0.05)
+
+
+class TestWeightedStats:
+    def test_uniform_weights_match_unweighted(self, rng):
+        x = jnp.asarray(rng.lognormal(0.0, 1.0, size=400))
+        w = jnp.ones_like(x)
+        assert float(weighted_gini(x, w)) == pytest.approx(float(gini(x)), abs=5e-3)
+        np.testing.assert_allclose(
+            np.asarray(weighted_quantile_shares(x, w)),
+            np.asarray(quantile_shares(x)),
+            atol=0.5,
+        )
+
+    def test_degenerate_distribution_gini_zero(self):
+        x = jnp.full((50,), 3.0)
+        w = jnp.ones((50,))
+        assert float(weighted_gini(x, w)) == pytest.approx(0.0, abs=1e-8)
+
+    def test_lorenz_endpoints(self, rng):
+        x = jnp.asarray(rng.uniform(0.1, 5.0, size=100))
+        w = jnp.asarray(rng.uniform(0.5, 2.0, size=100))
+        pop, cum = weighted_lorenz_curve(x, w)
+        assert float(pop[0]) == 0.0 and float(cum[0]) == 0.0
+        assert float(pop[-1]) == pytest.approx(1.0)
+        assert float(cum[-1]) == pytest.approx(1.0)
+
+    def test_quantile_shares_sum_to_100(self, rng):
+        x = jnp.asarray(rng.lognormal(0.0, 0.8, size=200))
+        w = jnp.asarray(rng.uniform(0.1, 1.0, size=200))
+        shares = weighted_quantile_shares(x, w)
+        assert float(shares.sum()) == pytest.approx(100.0, abs=1e-6)
+        # Lorenz dominance: shares increase across quantiles for positive x.
+        assert np.all(np.diff(np.asarray(shares)) > 0)
+
+    def test_replicated_weights_equal_expanded_sample(self):
+        """A mass-2 point must count exactly like two mass-1 copies."""
+        x = jnp.asarray([1.0, 2.0, 5.0])
+        w = jnp.asarray([2.0, 1.0, 1.0])
+        x_expanded = jnp.asarray([1.0, 1.0, 2.0, 5.0])
+        g1 = float(weighted_gini(x, w))
+        g2 = float(weighted_gini(x_expanded, jnp.ones(4)))
+        assert g1 == pytest.approx(g2, abs=1e-10)
+
+
+@pytest.mark.slow
+class TestDistributionGE:
+    @pytest.fixture(scope="class")
+    def cfg(self):
+        return AiyagariConfig(grid=GridSpecConfig(n_points=80))
+
+    @pytest.fixture(scope="class")
+    def dist_result(self, cfg):
+        model = AiyagariModel.from_config(cfg)
+        return solve_equilibrium_distribution(
+            model, solver=SolverConfig(method="egm"), eq=EquilibriumConfig()
+        )
+
+    def test_economics(self, dist_result, cfg):
+        beta = cfg.preferences.beta
+        assert -0.05 < dist_result.r < 1 / beta - 1
+        assert dist_result.mu is not None
+        assert float(dist_result.mu.sum()) == pytest.approx(1.0, abs=1e-8)
+
+    def test_agrees_with_simulation_ge(self, dist_result, cfg):
+        model = AiyagariModel.from_config(cfg)
+        sim_result = solve_equilibrium(
+            model, solver=SolverConfig(method="egm"),
+            sim=SimConfig(periods=2500, n_agents=8, discard=200, seed=3),
+            eq=EquilibriumConfig(),
+        )
+        assert dist_result.r == pytest.approx(sim_result.r, abs=5e-3)
+
+    def test_deterministic(self, cfg):
+        """Two runs produce bit-identical r* (no RNG anywhere)."""
+        model = AiyagariModel.from_config(cfg)
+        eq = EquilibriumConfig(max_iter=4)
+        r1 = solve_equilibrium_distribution(model, solver=SolverConfig(method="egm"), eq=eq).r
+        r2 = solve_equilibrium_distribution(model, solver=SolverConfig(method="egm"), eq=eq).r
+        assert r1 == r2
+
+    def test_dispatch_routes_distribution(self, cfg):
+        from aiyagari_tpu import solve
+
+        res = solve(cfg, method="egm", aggregation="distribution",
+                    equilibrium=EquilibriumConfig(max_iter=3))
+        assert res.mu is not None and res.series is None
+
+    def test_dispatch_rejects_numpy_distribution(self, cfg):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError):
+            solve(cfg, backend="numpy", aggregation="distribution")
+
+    def test_weighted_gini_from_mu(self, dist_result, cfg):
+        mu = dist_result.mu
+        model = AiyagariModel.from_config(cfg)
+        wealth = jnp.broadcast_to(model.a_grid[None, :], mu.shape)
+        g = float(weighted_gini(wealth, mu))
+        assert 0.05 < g < 0.95
+
+    def test_dispatch_rejects_ks_distribution(self):
+        from aiyagari_tpu import KrusellSmithConfig, solve
+
+        with pytest.raises(ValueError):
+            solve(KrusellSmithConfig(), aggregation="distribution")
+
+    def test_report_from_distribution_result(self, dist_result, cfg, tmp_path):
+        from aiyagari_tpu.io_utils.report import equilibrium_report
+
+        model = AiyagariModel.from_config(cfg)
+        summary = equilibrium_report(dist_result, model, tmp_path)
+        assert (tmp_path / "lorenz.png").exists()
+        assert (tmp_path / "densities.png").exists()
+        assert 0.0 < summary["gini"]["k"] < 1.0
+        assert abs(sum(summary["quintile_shares_percent"]) - 100.0) < 1e-6
+
+    def test_checkpoint_resume(self, cfg, tmp_path):
+        """The shared bisection driver checkpoints the distribution closure
+        too: an interrupted run resumes to the same r* as an uninterrupted
+        one (both deterministic)."""
+        model = AiyagariModel.from_config(cfg)
+        eq = EquilibriumConfig(max_iter=5)
+        solver = SolverConfig(method="egm")
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_equilibrium_distribution(model, solver=solver, eq=eq,
+                                           on_iteration=interrupt,
+                                           checkpoint_dir=tmp_path)
+        resumed = solve_equilibrium_distribution(model, solver=solver, eq=eq,
+                                                 checkpoint_dir=tmp_path)
+        fresh = solve_equilibrium_distribution(model, solver=solver, eq=eq)
+        assert resumed.r == pytest.approx(fresh.r, abs=1e-12)
+        assert len(resumed.r_history) == len(fresh.r_history)
