@@ -64,11 +64,16 @@ class IncomeProcess:
     log s' = rho log s + e,  e ~ N(0, sd^2), sd = sigma_e * sqrt(1-rho^2),
     on a fixed grid l_i = (i - (n+1)/2) * sigma_e  (reference uses n=7 so the
     grid is {-3..+3} * sigma_e; Aiyagari_VFI.m:18-23).
+
+    method selects the discretization: "tauchen" (the reference's scheme) or
+    "rouwenhorst" (exact persistence/variance match — preferred for rho near
+    1; no analogue in the reference).
     """
 
     rho: float = 0.75
     sigma_e: float = 0.75
     n_states: int = 7
+    method: str = "tauchen"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,7 +16,11 @@ import numpy as np
 
 from aiyagari_tpu.config import AiyagariConfig, HouseholdPreferences, IncomeProcess
 from aiyagari_tpu.utils.grids import aiyagari_asset_bounds, aiyagari_asset_grid
-from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+from aiyagari_tpu.utils.markov import (
+    discretize_income,
+    normalized_labor,
+    stationary_distribution,
+)
 
 __all__ = ["AiyagariModel", "aiyagari_preset", "aiyagari_labor_preset"]
 
@@ -37,10 +41,10 @@ class AiyagariModel:
 
     @classmethod
     def from_config(cls, config: AiyagariConfig, dtype=jnp.float64) -> "AiyagariModel":
-        l_grid, P = tauchen(config.income)
+        l_grid, P = discretize_income(config.income)
         pi = stationary_distribution(P)
         s, labor_raw = normalized_labor(l_grid, pi)
-        # Reuse the discretization just built (one Tauchen solve per model).
+        # Reuse the discretization just built (one discretization per model).
         amin, amax = aiyagari_asset_bounds(config, s_min=float(s[0]))
         a_grid = aiyagari_asset_grid(config, s_min=float(s[0]))
         lo, hi = config.labor_grid_bounds

@@ -9,8 +9,12 @@ The XLA path (ops/bellman.py) either materializes the full [N, na, na']
 utility tensor or scans a'-blocks with HBM-resident intermediates. This kernel
 tiles (j, j') into VMEM, fuses the budget/utility/mask/add/max chain in one
 pass, and accumulates the running max/argmax in the revisited output block —
-intermediates never touch HBM. Grid iterates (state, j-tile, j'-tile) with
-j' innermost; the first j'-step initializes the accumulators (@pl.when).
+intermediates never touch HBM. The (small) state axis stays whole inside each
+block — Mosaic requires the last two block dims be lane/sublane aligned or
+span the full array dim, and N (7 states, 4 for K-S) is far below the 8-row
+sublane tile, so splitting it is both illegal and wasteful. Grid iterates
+(j-tile, j'-tile) with j' innermost; the first j'-step initializes the
+accumulators (@pl.when).
 
 Reference semantics: Aiyagari_VFI.m:70-83 (c<=0 masked to -inf via NaN there;
 ties resolved to the first index by MATLAB max).
@@ -30,45 +34,49 @@ __all__ = ["bellman_max_pallas"]
 
 
 def _kernel(coh_ref, a_ref, ev_ref, v_ref, idx_ref, *, sigma: float, na: int, bjp: int):
-    pj = pl.program_id(2)
-    coh = coh_ref[0, :]                       # [bj]
+    pj = pl.program_id(1)
+    coh = coh_ref[...]                        # [N, bj]
     ap = a_ref[0, :]                          # [bjp]
-    ev = ev_ref[0, :]                         # [bjp]
+    ev = ev_ref[...]                          # [N, bjp]
 
-    c = coh[:, None] - ap[None, :]            # [bj, bjp]
+    c = coh[:, :, None] - ap[None, None, :]   # [N, bj, bjp]
     feasible = c > 0.0
     u = crra_utility(jnp.where(feasible, c, 1.0), sigma)
     neg_inf = jnp.array(-jnp.inf, u.dtype)
-    q = jnp.where(feasible, u + ev[None, :], neg_inf)
+    q = jnp.where(feasible, u + ev[:, None, :], neg_inf)
 
     # Mask a'-lanes beyond the true grid (last tile may be padded).
-    gidx = pj * bjp + jax.lax.broadcasted_iota(jnp.int32, q.shape, 1)
+    gidx = pj * bjp + jax.lax.broadcasted_iota(jnp.int32, q.shape, 2)
     q = jnp.where(gidx < na, q, neg_inf)
 
-    m = jnp.max(q, axis=1)                                     # [bj]
-    am = (jnp.argmax(q, axis=1) + pj * bjp).astype(jnp.int32)  # [bj] global index
+    m = jnp.max(q, axis=2)                                     # [N, bj]
+    am = (jnp.argmax(q, axis=2) + pj * bjp).astype(jnp.int32)  # [N, bj] global
 
     @pl.when(pj == 0)
     def _():
-        v_ref[0, :] = m
-        idx_ref[0, :] = am
+        v_ref[...] = m
+        idx_ref[...] = am
 
     @pl.when(pj != 0)
     def _():
-        prev = v_ref[0, :]
+        prev = v_ref[...]
         take = m > prev                       # strict: earlier tile wins ties
-        v_ref[0, :] = jnp.where(take, m, prev)
-        idx_ref[0, :] = jnp.where(take, am, idx_ref[0, :])
+        v_ref[...] = jnp.where(take, m, prev)
+        idx_ref[...] = jnp.where(take, am, idx_ref[...])
 
 
 @functools.partial(jax.jit, static_argnames=("sigma", "block_j", "block_jp", "interpret"))
-def bellman_max_pallas(coh, a_grid, EV, *, sigma: float, block_j: int = 256,
-                       block_jp: int = 512, interpret: bool = False):
+def bellman_max_pallas(coh, a_grid, EV, *, sigma: float, block_j: int = 128,
+                       block_jp: int = 2048, interpret: bool = False):
     """Fused Bellman choice reduction.
 
     coh [N, na] cash-on-hand; a_grid [na]; EV [N, na'] discounted expected
     values (beta * P @ v). Returns (v_new [N, na], idx [N, na] int32).
-    VMEM per step ~ block_j*block_jp floats (plus edges); defaults use ~0.6MB.
+    Defaults are the best measured config on a v5e chip (5.0 ms/sweep at
+    N=7, na=8000); note the XLA blocked path (ops/bellman.py, block_size>0)
+    measures ~3.3 ms/sweep on the same problem — XLA's own fusion wins here,
+    so this kernel is opt-in (SolverConfig.use_pallas), kept as the
+    hand-tiled alternative for shapes where the compiler schedule loses.
     """
     N, na = coh.shape
     bj = min(block_j, na)
@@ -84,15 +92,15 @@ def bellman_max_pallas(coh, a_grid, EV, *, sigma: float, block_j: int = 256,
 
     v, idx = pl.pallas_call(
         functools.partial(_kernel, sigma=sigma, na=na, bjp=bjp),
-        grid=(N, nj, njp),
+        grid=(nj, njp),
         in_specs=[
-            pl.BlockSpec((1, bj), lambda i, j, p: (i, j)),
-            pl.BlockSpec((1, bjp), lambda i, j, p: (0, p)),
-            pl.BlockSpec((1, bjp), lambda i, j, p: (i, p)),
+            pl.BlockSpec((N, bj), lambda j, p: (0, j)),
+            pl.BlockSpec((1, bjp), lambda j, p: (0, p)),
+            pl.BlockSpec((N, bjp), lambda j, p: (0, p)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bj), lambda i, j, p: (i, j)),
-            pl.BlockSpec((1, bj), lambda i, j, p: (i, j)),
+            pl.BlockSpec((N, bj), lambda j, p: (0, j)),
+            pl.BlockSpec((N, bj), lambda j, p: (0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((N, nj * bj), coh.dtype),

@@ -18,7 +18,11 @@ import numpy as np
 from aiyagari_tpu.config import AiyagariConfig, EquilibriumConfig, SimConfig, SolverConfig
 from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
 from aiyagari_tpu.utils.grids import aiyagari_asset_bounds, aiyagari_asset_grid
-from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+from aiyagari_tpu.utils.markov import (
+    discretize_income,
+    normalized_labor,
+    stationary_distribution,
+)
 
 __all__ = [
     "aiyagari_arrays_numpy",
@@ -32,7 +36,7 @@ __all__ = [
 
 
 def aiyagari_arrays_numpy(cfg: AiyagariConfig):
-    l_grid, P = tauchen(cfg.income)
+    l_grid, P = discretize_income(cfg.income)
     pi = stationary_distribution(P)
     s, labor_raw = normalized_labor(l_grid, pi)
     amin, _ = aiyagari_asset_bounds(cfg, s_min=float(s[0]))

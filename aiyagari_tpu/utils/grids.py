@@ -40,9 +40,13 @@ def aiyagari_asset_bounds(cfg: AiyagariConfig, s_min: float | None = None) -> tu
         return cfg.grid.amin, cfg.grid.amax
     alpha, delta, beta = cfg.technology.alpha, cfg.technology.delta, cfg.preferences.beta
     if s_min is None and cfg.grid.amin is None:
-        from aiyagari_tpu.utils.markov import normalized_labor, stationary_distribution, tauchen
+        from aiyagari_tpu.utils.markov import (
+            discretize_income,
+            normalized_labor,
+            stationary_distribution,
+        )
 
-        l_grid, P = tauchen(cfg.income)
+        l_grid, P = discretize_income(cfg.income)
         pi = stationary_distribution(P)
         s, _ = normalized_labor(l_grid, pi)
         s_min = float(s[0])

@@ -13,6 +13,8 @@ from aiyagari_tpu.config import IncomeProcess, KSShockProcess
 
 __all__ = [
     "tauchen",
+    "rouwenhorst",
+    "discretize_income",
     "stationary_distribution",
     "normalized_labor",
     "ks_transition_matrix",
@@ -55,6 +57,53 @@ def tauchen(process: IncomeProcess) -> tuple[np.ndarray, np.ndarray]:
     cdf = np.where(np.isneginf(z), 0.0, np.where(np.isposinf(z), 1.0, _norm_cdf(z)))
     P = np.diff(cdf, axis=1)
     return l_grid, P
+
+
+def rouwenhorst(process: IncomeProcess) -> tuple[np.ndarray, np.ndarray]:
+    """Rouwenhorst (1995) discretization of the same AR(1):
+    log s' = rho*log s + e, e ~ N(0, sd^2), sd = sigma_e*sqrt(1-rho^2),
+    so the stationary standard deviation is sigma_e.
+
+    Grid: n evenly spaced points on [-psi, psi] with psi = sigma_e*sqrt(n-1);
+    transition matrix built by the standard recursive construction with
+    p = q = (1+rho)/2. Unlike Tauchen (the reference's only method,
+    Aiyagari_VFI.m:18-35), Rouwenhorst matches the conditional mean
+    (E[l'|l] = rho*l), persistence, and stationary variance of the AR(1)
+    EXACTLY for any rho — the method of choice for highly persistent
+    processes, where Tauchen's fixed +/-3-sigma grid is badly inaccurate.
+
+    Returns (l_grid [n], P [n, n]) in float64.
+    """
+    n = process.n_states
+    rho, sigma_e = process.rho, process.sigma_e
+    if n < 2:
+        raise ValueError(f"rouwenhorst needs n_states >= 2, got {n}")
+    p = (1.0 + rho) / 2.0
+    P = np.array([[p, 1.0 - p], [1.0 - p, p]])
+    for m in range(3, n + 1):
+        Pn = np.zeros((m, m))
+        Pn[:-1, :-1] += p * P
+        Pn[:-1, 1:] += (1.0 - p) * P
+        Pn[1:, :-1] += (1.0 - p) * P
+        Pn[1:, 1:] += p * P
+        Pn[1:-1, :] /= 2.0   # interior rows are reached twice in the overlay
+        P = Pn
+    psi = sigma_e * np.sqrt(n - 1.0)
+    l_grid = np.linspace(-psi, psi, n)
+    return l_grid, P
+
+
+def discretize_income(process: IncomeProcess) -> tuple[np.ndarray, np.ndarray]:
+    """Dispatch on process.method: 'tauchen' (the reference's scheme) or
+    'rouwenhorst'. Returns (l_grid, P)."""
+    if process.method == "tauchen":
+        return tauchen(process)
+    if process.method == "rouwenhorst":
+        return rouwenhorst(process)
+    raise ValueError(
+        f"unknown discretization method {process.method!r}; "
+        "expected 'tauchen' or 'rouwenhorst'"
+    )
 
 
 def stationary_distribution(P: np.ndarray) -> np.ndarray:

@@ -104,3 +104,78 @@ class TestKSChain:
 
     def test_state_order(self):
         assert KS_STATE_GRID_ORDER == ((0, 1), (1, 1), (0, 0), (1, 0))
+
+
+class TestRouwenhorst:
+    """Rouwenhorst (1995) matches the AR(1)'s conditional mean, persistence,
+    and stationary variance exactly — the properties that define the method."""
+
+    def _build(self, rho=0.95, sigma_e=0.6, n=9):
+        from aiyagari_tpu.utils.markov import rouwenhorst
+
+        return rouwenhorst(IncomeProcess(rho=rho, sigma_e=sigma_e, n_states=n))
+
+    def test_rows_sum_to_one(self):
+        _, P = self._build()
+        np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-12)
+        assert (P >= 0).all()
+
+    def test_n2_closed_form(self):
+        rho = 0.7
+        _, P = self._build(rho=rho, n=2)
+        p = (1 + rho) / 2
+        np.testing.assert_allclose(P, [[p, 1 - p], [1 - p, p]], atol=1e-12)
+
+    def test_conditional_mean_exact(self):
+        rho = 0.95
+        l, P = self._build(rho=rho)
+        np.testing.assert_allclose(P @ l, rho * l, atol=1e-12)
+
+    def test_stationary_is_symmetric_binomial(self):
+        from math import comb
+
+        n = 9
+        l, P = self._build(n=n)
+        pi = stationary_distribution(P)
+        binom = np.array([comb(n - 1, k) for k in range(n)]) / 2.0 ** (n - 1)
+        np.testing.assert_allclose(pi, binom, atol=1e-10)
+
+    def test_stationary_variance_exact(self):
+        sigma_e = 0.6
+        l, P = self._build(sigma_e=sigma_e)
+        pi = stationary_distribution(P)
+        var = float(pi @ l**2) - float(pi @ l) ** 2
+        np.testing.assert_allclose(var, sigma_e**2, atol=1e-10)
+
+    def test_autocorrelation_exact(self):
+        rho = 0.95
+        l, P = self._build(rho=rho)
+        pi = stationary_distribution(P)
+        # corr(l_t, l_{t+1}) = E[l * E[l'|l]] / var = rho exactly.
+        cov = float(pi @ (l * (P @ l)))
+        var = float(pi @ l**2)
+        np.testing.assert_allclose(cov / var, rho, atol=1e-10)
+
+    def test_discretize_income_dispatch(self):
+        from aiyagari_tpu.utils.markov import discretize_income, rouwenhorst
+
+        proc = IncomeProcess(rho=0.9, sigma_e=0.5, n_states=5, method="rouwenhorst")
+        l1, P1 = discretize_income(proc)
+        l2, P2 = rouwenhorst(proc)
+        np.testing.assert_allclose(l1, l2)
+        np.testing.assert_allclose(P1, P2)
+        with np.testing.assert_raises(ValueError):
+            discretize_income(IncomeProcess(method="golden"))
+
+    def test_model_builds_and_solves_with_rouwenhorst(self):
+        from aiyagari_tpu.config import AiyagariConfig, GridSpecConfig, SolverConfig
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+        from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+        cfg = AiyagariConfig(
+            income=IncomeProcess(rho=0.9, sigma_e=0.4, n_states=5, method="rouwenhorst"),
+            grid=GridSpecConfig(n_points=60),
+        )
+        model = AiyagariModel.from_config(cfg)
+        sol = solve_household(model, 0.02, solver=SolverConfig(method="egm", max_iter=2000))
+        assert float(sol.distance) < 1e-5
