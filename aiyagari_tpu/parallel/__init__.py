@@ -1,0 +1,27 @@
+"""Device-mesh, sharding, and multi-host distributed runtime surface."""
+
+from aiyagari_tpu.parallel.distributed import (
+    DistributedContext,
+    initialize_distributed,
+    process_info,
+)
+from aiyagari_tpu.parallel.mesh import (
+    agents_sharding,
+    force_host_device_count,
+    grid_sharding,
+    make_mesh,
+    replicated,
+    shard_panel,
+)
+
+__all__ = [
+    "DistributedContext",
+    "initialize_distributed",
+    "process_info",
+    "agents_sharding",
+    "force_host_device_count",
+    "grid_sharding",
+    "make_mesh",
+    "replicated",
+    "shard_panel",
+]

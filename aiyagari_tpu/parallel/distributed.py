@@ -1,0 +1,96 @@
+"""Multi-host distributed runtime surface (SURVEY.md §5.8).
+
+The reference is a single MATLAB process with no communication backend at all
+(SURVEY.md §2.4). The TPU-native design scales the same workloads across hosts
+by initializing JAX's distributed runtime (one process per host, all devices
+visible globally) and building ONE global mesh over every device in the job;
+ICI carries intra-slice collectives and DCN inter-slice, both invisible behind
+the NamedSharding / shard_map annotations used everywhere else in the
+framework. No solver or simulator code changes between single-host and
+multi-host — only this initialization step and the mesh construction differ.
+
+Single-process (a laptop, one chip, the CPU test mesh) is the common case, so
+`initialize_distributed()` is an explicit no-op there rather than an error.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+
+__all__ = ["DistributedContext", "initialize_distributed", "process_info"]
+
+
+@dataclass(frozen=True)
+class DistributedContext:
+    """What the runtime looks like after (possible) initialization."""
+
+    initialized: bool          # True iff jax.distributed.initialize() ran
+    process_id: int            # this host's index (0 in single-process)
+    num_processes: int         # world size (1 in single-process)
+    local_device_count: int    # devices attached to this host
+    global_device_count: int   # devices across the whole job
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> DistributedContext:
+    """Initialize the JAX distributed runtime for a multi-host job.
+
+    Arguments default from the standard environment variables
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID), matching
+    how TPU pod launchers pass topology; on Cloud TPU pods
+    jax.distributed.initialize also auto-detects everything, so calling with
+    no arguments there is correct. When neither arguments nor environment
+    describe a multi-process job (num_processes in (None, 1) and no
+    coordinator), this is a no-op returning a single-process context — the
+    same code path then runs unchanged on one host.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    num_processes = num_processes if num_processes is not None else _env_int(
+        "JAX_NUM_PROCESSES"
+    )
+    process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+
+    if jax.distributed.is_initialized():
+        # Idempotent re-entry: a launcher and a library entry point may both
+        # call this defensively; a second jax.distributed.initialize raises.
+        return process_info(initialized=True)
+
+    multi = (num_processes is not None and num_processes > 1) or (
+        coordinator_address is not None
+    )
+    if multi:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    return process_info(initialized=multi)
+
+
+def process_info(initialized: Optional[bool] = None) -> DistributedContext:
+    """Snapshot of the current process topology."""
+    return DistributedContext(
+        initialized=bool(initialized)
+        if initialized is not None
+        else jax.process_count() > 1,
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )

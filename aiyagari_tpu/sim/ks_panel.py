@@ -12,7 +12,7 @@ cross-device reduction that XLA lowers onto ICI.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -20,7 +20,12 @@ import jax.numpy as jnp
 from aiyagari_tpu.models.krusell_smith import state_index
 from aiyagari_tpu.ops.interp import state_policy_interp
 
-__all__ = ["simulate_aggregate_shocks", "simulate_employment_panel", "simulate_capital_path"]
+__all__ = [
+    "simulate_aggregate_shocks",
+    "simulate_employment_panel",
+    "simulate_capital_path",
+    "simulate_capital_path_shardmap",
+]
 
 
 @partial(jax.jit, static_argnames=("T",))
@@ -73,17 +78,15 @@ def simulate_employment_panel(z_path, eps_trans, u_good, u_bad, key, *, T: int, 
     return jnp.concatenate([eps0[None, :], tail], axis=0)
 
 
-@partial(jax.jit, static_argnames=("T",), donate_argnames=("k_population",))
-def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, *, T: int):
-    """Step the agent panel through T-1 periods under the policy k_opt
-    [ns, nK, nk]; returns (K_ts [T], k_population_final).
+def _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, mean_fn):
+    """The per-period panel transition shared by both simulator variants
+    (mean_fn is jnp.mean for the jit/GSPMD path, a pmean-of-local-mean for the
+    explicit shard_map path; the sharding tests assert 1e-12 agreement).
 
     Per step (Krusell_Smith_VFI.m:222-248): each agent's joint state from
     (z_t, eps_{t,i}); policy evaluated by bilinear interpolation in (k, K) —
     realized as a 1-D linear interpolation in K (scalar weight per step) nested
     with a batched per-agent linear interpolation in k; K_{t+1} = mean(k').
-    The agent axis (k_population, eps_panel columns) may be sharded across
-    devices; the mean lowers to a psum over ICI.
     """
     nK = K_grid.shape[0]
 
@@ -101,11 +104,70 @@ def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population
         # gathers of agent-indexed rows were the measured bottleneck, and the
         # one-hot form also shards cleanly along the agent axis.
         k_new = state_policy_interp(k_grid, pol_at_K, s_t, k_pop)
-        K_next = jnp.mean(k_new)
-        return (k_new, K_next), K_t
+        return (k_new, mean_fn(k_new)), K_t
 
     (k_population, K_last), K_head = jax.lax.scan(
-        step, (k_population, jnp.mean(k_population)), (z_path[:-1], eps_panel[:-1])
+        step, (k_population, mean_fn(k_population)), (z_path[:-1], eps_panel[:-1])
     )
     K_ts = jnp.concatenate([K_head, K_last[None]])
     return K_ts, k_population
+
+
+@partial(jax.jit, static_argnames=("T",), donate_argnames=("k_population",))
+def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, *, T: int):
+    """Step the agent panel through T-1 periods under the policy k_opt
+    [ns, nK, nk]; returns (K_ts [T], k_population_final).
+
+    The agent axis (k_population, eps_panel columns) may be sharded across
+    devices; the mean lowers to a psum over ICI (implicitly, via GSPMD — see
+    simulate_capital_path_shardmap for the explicit-collective form).
+    """
+    return _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, jnp.mean)
+
+
+@lru_cache(maxsize=None)
+def _shardmap_panel_fn(mesh, axis: str):
+    """Build (and cache per mesh/axis, so repeated calls hit jit's trace
+    cache instead of recompiling the scan) the shard_map panel program."""
+    from jax.sharding import PartitionSpec as P
+
+    def shard_body(k_opt, k_grid, K_grid, z_path, eps_local, k_pop_local):
+        def gmean(x):
+            return jax.lax.pmean(jnp.mean(x), axis)
+
+        K_ts, k_pop_local = _panel_scan(
+            k_opt, k_grid, K_grid, z_path, eps_local, k_pop_local, gmean
+        )
+        return K_ts, k_pop_local
+
+    return jax.jit(jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(None, axis), P(axis)),
+        out_specs=(P(), P(axis)),
+    ))
+
+
+def simulate_capital_path_shardmap(mesh, k_opt, k_grid, K_grid, z_path, eps_panel,
+                                   k_population, *, axis: str = "agents"):
+    """simulate_capital_path with the cross-device collective written
+    explicitly: the panel runs under jax.shard_map with each device holding a
+    [T, population/n_devices] shard, and the per-step aggregate
+    K_{t+1} = mean(k') is a local mean followed by lax.pmean over the mesh
+    axis — the literal psum-over-ICI reduction of SURVEY.md §2.4(2), rather
+    than the implicit one GSPMD derives for the jit path.
+
+    Semantically identical to simulate_capital_path (the sharding tests assert
+    allclose at 1e-12); exists so the collective layer has an explicit,
+    inspectable form and so per-device work cannot be resharded by the
+    compiler. Requires population % mesh.shape[axis] == 0 (pmean of equal
+    local means is only then the global mean).
+    """
+    n = mesh.shape[axis]
+    population = int(k_population.shape[0])
+    if population % n != 0:
+        raise ValueError(
+            f"population {population} not divisible by mesh axis {axis!r} size {n}"
+        )
+    run = _shardmap_panel_fn(mesh, axis)
+    return run(k_opt, k_grid, K_grid, z_path, eps_panel, k_population)

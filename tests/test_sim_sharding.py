@@ -17,6 +17,7 @@ from aiyagari_tpu.sim.ergodic import simulate_panel
 from aiyagari_tpu.sim.ks_panel import (
     simulate_aggregate_shocks,
     simulate_capital_path,
+    simulate_capital_path_shardmap,
     simulate_employment_panel,
 )
 from aiyagari_tpu.utils.firm import wage_from_r
@@ -89,3 +90,57 @@ class TestSharding:
         x = jnp.arange(8000, dtype=jnp.float64)
         x_sh = jax.device_put(x, agents_sharding(mesh))
         assert float(jnp.mean(x_sh)) == float(jnp.mean(x))
+
+    def test_shardmap_panel_matches_gspmd(self):
+        # The explicit shard_map+pmean collective path (SURVEY.md §2.4(2))
+        # agrees with the implicit GSPMD path on the same inputs.
+        cfg = KrusellSmithConfig(k_size=20)
+        model = KrusellSmithModel.from_config(cfg)
+        key = jax.random.PRNGKey(7)
+        kz, ke = jax.random.split(key)
+        T, pop = 120, 640
+        z = simulate_aggregate_shocks(model.pz, kz, T=T)
+        eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
+                                        cfg.shocks.u_bad, ke, T=T, population=pop)
+        k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size))
+        k0 = jnp.full((pop,), float(model.K_grid[0]))
+
+        K_ref, kpop_ref = simulate_capital_path(k_opt, model.k_grid, model.K_grid,
+                                                z, eps, k0, T=T)
+        mesh = make_mesh(("agents",))
+        k0_fresh = jnp.full((pop,), float(model.K_grid[0]))  # k0 was donated above
+        K_sm, kpop_sm = simulate_capital_path_shardmap(
+            mesh, k_opt, model.k_grid, model.K_grid, z, eps, k0_fresh
+        )
+        np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_sm), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(kpop_ref), np.asarray(kpop_sm), rtol=1e-12)
+
+    def test_shardmap_panel_rejects_ragged_population(self):
+        mesh = make_mesh(("agents",))
+        with pytest.raises(ValueError, match="not divisible"):
+            simulate_capital_path_shardmap(
+                mesh, jnp.zeros((4, 4, 8)), jnp.linspace(0.1, 10, 8),
+                jnp.linspace(30, 50, 4), jnp.zeros(5, jnp.int32),
+                jnp.zeros((5, 9), jnp.int32), jnp.full((9,), 35.0),
+            )
+
+
+class TestDistributed:
+    def test_single_process_is_noop(self, monkeypatch):
+        from aiyagari_tpu.parallel.distributed import initialize_distributed
+
+        # Isolate from ambient pod/CI topology env, which would turn the
+        # no-op under test into a real (hanging) coordinator handshake.
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        ctx = initialize_distributed()
+        assert not ctx.initialized
+        assert ctx.num_processes == 1 and ctx.process_id == 0
+        assert ctx.local_device_count == 8 and ctx.global_device_count == 8
+
+    def test_process_info_snapshot(self):
+        from aiyagari_tpu.parallel.distributed import process_info
+
+        ctx = process_info()
+        assert ctx.num_processes == 1
+        assert ctx.global_device_count == len(jax.devices())
