@@ -24,6 +24,7 @@ from aiyagari_tpu.config import (
     SolverConfig,
     Technology,
 )
+from aiyagari_tpu.diagnostics.errors import ConvergenceError, ConvergenceWarning
 from aiyagari_tpu.dispatch import solve
 from aiyagari_tpu.equilibrium.bisection import (
     EquilibriumResult,
@@ -41,6 +42,8 @@ __version__ = "0.1.0"
 
 __all__ = [
     "solve",
+    "ConvergenceError",
+    "ConvergenceWarning",
     "solve_equilibrium",
     "solve_equilibrium_distribution",
     "solve_household",

@@ -1,0 +1,23 @@
+"""Telemetry, profiling, and structured failure reporting."""
+
+from aiyagari_tpu.diagnostics.errors import (
+    ConvergenceError,
+    ConvergenceWarning,
+    enforce_convergence,
+)
+from aiyagari_tpu.diagnostics.logging import (
+    CollectSink,
+    ConsoleSink,
+    JSONLSink,
+    multiplex,
+)
+
+__all__ = [
+    "ConvergenceError",
+    "ConvergenceWarning",
+    "enforce_convergence",
+    "CollectSink",
+    "ConsoleSink",
+    "JSONLSink",
+    "multiplex",
+]

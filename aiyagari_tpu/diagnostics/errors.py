@@ -1,0 +1,69 @@
+"""Structured non-convergence reporting (SURVEY.md §5.3).
+
+The reference's failure handling is a printed warning after which the script
+continues with whatever it last computed (Aiyagari_EGM.m:112-116,216-220); its
+max-iteration caps (Aiyagari_VFI.m:49, Krusell_Smith_VFI.m:12) guard silently.
+Here the guard carries data: ConvergenceError records where the iteration
+stopped and how far from tolerance it was, so callers (and resumed runs) can
+act on it. The default policy stays reference-faithful ("warn" and return the
+last iterate); "raise" upgrades the guard to a hard failure for CI and
+unattended runs.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["ConvergenceError", "ConvergenceWarning", "enforce_convergence"]
+
+_POLICIES = ("ignore", "warn", "raise")
+
+
+class ConvergenceWarning(UserWarning):
+    """A fixed point hit its iteration cap; the returned result is the last
+    iterate, not a converged one."""
+
+
+class ConvergenceError(RuntimeError):
+    """A fixed point hit its iteration cap under policy='raise'.
+
+    Attributes carry the loop's final state so the failure is diagnosable
+    and resumable without re-running: `context` names the loop, `iterations`
+    how many steps ran, `distance` the last convergence measure against
+    `tol`, and `detail` any loop-specific extras (e.g. the r-bracket or the
+    ALM coefficient step).
+    """
+
+    def __init__(self, context: str, *, iterations: int, distance: float,
+                 tol: float, detail: dict | None = None):
+        self.context = context
+        self.iterations = int(iterations)
+        self.distance = float(distance)
+        self.tol = float(tol)
+        self.detail = dict(detail or {})
+        extra = f" ({', '.join(f'{k}={v}' for k, v in self.detail.items())})" if self.detail else ""
+        super().__init__(
+            f"{context}: no convergence after {self.iterations} iterations; "
+            f"last distance {self.distance:.3e} vs tol {self.tol:.1e}{extra}"
+        )
+
+
+def enforce_convergence(converged: bool, policy: str, context: str, *,
+                        iterations: int, distance: float, tol: float,
+                        detail: dict | None = None) -> None:
+    """Apply a non-convergence policy: no-op when converged or
+    policy='ignore'; emit ConvergenceWarning for 'warn' (the reference's
+    behavior, made typed); raise ConvergenceError for 'raise'."""
+    if policy not in _POLICIES:
+        raise ValueError(f"unknown on_nonconvergence policy {policy!r}; expected one of {_POLICIES}")
+    if converged or policy == "ignore":
+        return
+    if policy == "raise":
+        raise ConvergenceError(context, iterations=iterations, distance=distance,
+                               tol=tol, detail=detail)
+    warnings.warn(
+        str(ConvergenceError(context, iterations=iterations, distance=distance,
+                             tol=tol, detail=detail)),
+        ConvergenceWarning,
+        stacklevel=3,
+    )

@@ -17,6 +17,8 @@ from typing import Optional, Union
 
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.errors import enforce_convergence
+
 from aiyagari_tpu.config import (
     ALMConfig,
     AiyagariConfig,
@@ -44,11 +46,17 @@ def solve(
     equilibrium: Optional[EquilibriumConfig] = None,
     alm: Optional[ALMConfig] = None,
     aggregation: str = "simulation",
+    on_nonconvergence: str = "warn",
 ):
     """Solve a full model to general equilibrium.
 
     Aiyagari family -> interest-rate bisection (EquilibriumResult).
     Krusell-Smith   -> aggregate-law-of-motion fixed point (KSResult).
+
+    `on_nonconvergence` is the outer-loop iteration-cap policy (SURVEY.md
+    §5.3): "warn" (default — emit ConvergenceWarning and return the last
+    iterate, the reference's behavior at Aiyagari_EGM.m:112-116, made typed),
+    "raise" (ConvergenceError carrying the last distance), or "ignore".
 
     The solution method comes from `method` or `solver.method`; passing both
     with different values is an error (never silently overridden). With
@@ -79,6 +87,11 @@ def solve(
         raise ValueError(
             f"unknown aggregation {aggregation!r}; expected 'simulation' or 'distribution'"
         )
+    if on_nonconvergence not in ("ignore", "warn", "raise"):
+        raise ValueError(
+            f"unknown on_nonconvergence {on_nonconvergence!r}; "
+            "expected 'ignore', 'warn', or 'raise'"
+        )
 
     if isinstance(model, AiyagariConfig):
         solver = solver or SolverConfig(method=method)
@@ -89,17 +102,31 @@ def solve(
                 raise ValueError("aggregation='distribution' requires backend='jax'")
             from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
 
-            return solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
-        from aiyagari_tpu.equilibrium.bisection import (
-            solve_equilibrium,
-            solve_equilibrium_distribution,
-        )
-        from aiyagari_tpu.models.aiyagari import AiyagariModel
+            result = solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
+        else:
+            from aiyagari_tpu.equilibrium.bisection import (
+                solve_equilibrium,
+                solve_equilibrium_distribution,
+            )
+            from aiyagari_tpu.models.aiyagari import AiyagariModel
 
-        m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
-        if aggregation == "distribution":
-            return solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
-        return solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
+            m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+            if aggregation == "distribution":
+                result = solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
+            else:
+                result = solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
+        gap = (
+            abs(result.k_supply[-1] - result.k_demand[-1])
+            if result.k_supply else float("inf")
+        )
+        enforce_convergence(
+            result.converged, on_nonconvergence, "Aiyagari GE bisection",
+            # the numpy-backend result has no iterations field; its bisection
+            # history is one entry per outer iteration
+            iterations=getattr(result, "iterations", len(result.r_history)),
+            distance=gap, tol=equilibrium.tol, detail={"r": result.r},
+        )
+        return result
 
     if isinstance(model, KrusellSmithConfig):
         if aggregation != "simulation":
@@ -113,8 +140,14 @@ def solve(
 
         # solver=None lets the KS loop apply its own reference defaults
         # (tol 1e-6, Howard 50/improve-every-5) rather than the generic ones.
-        return solve_krusell_smith(
+        result = solve_krusell_smith(
             model, method=method, solver=solver, alm=alm, backend=backend
         )
+        enforce_convergence(
+            result.converged, on_nonconvergence, "Krusell-Smith ALM fixed point",
+            iterations=result.iterations, distance=result.diff_B, tol=alm.tol,
+            detail={"B": [round(float(b), 6) for b in result.B]},
+        )
+        return result
 
     raise TypeError(f"unknown model config type: {type(model).__name__}")

@@ -60,3 +60,43 @@ class TestGE:
         # Same bisection bracket logic and same economics: r* within one
         # bracket width (simulation noise differs across RNGs).
         assert abs(res.r - eq_result.r) < 0.02
+
+
+@pytest.mark.slow
+class TestNonConvergencePolicy:
+    """SURVEY.md §5.3: iteration caps surface as typed warnings/errors
+    carrying the loop's final state, not silent flags."""
+
+    STARVED = EquilibriumConfig(max_iter=2, tol=1e-12)   # cannot converge
+
+    def test_warn_default_returns_last_iterate(self):
+        from aiyagari_tpu import ConvergenceWarning
+
+        with pytest.warns(ConvergenceWarning, match="GE bisection"):
+            res = solve(SMALL_CFG, method="egm",
+                        sim=SimConfig(periods=600, n_agents=4, discard=100, seed=0),
+                        equilibrium=self.STARVED)
+        assert not res.converged and len(res.r_history) == 2
+
+    def test_raise_carries_final_state(self):
+        from aiyagari_tpu import ConvergenceError
+
+        with pytest.raises(ConvergenceError) as exc:
+            solve(SMALL_CFG, method="egm",
+                  sim=SimConfig(periods=600, n_agents=4, discard=100, seed=0),
+                  equilibrium=self.STARVED, on_nonconvergence="raise")
+        assert exc.value.iterations == 2
+        assert exc.value.tol == 1e-12
+        assert np.isfinite(exc.value.distance)
+        assert "r" in exc.value.detail
+
+    def test_ignore_is_silent(self, recwarn):
+        res = solve(SMALL_CFG, method="egm",
+                    sim=SimConfig(periods=600, n_agents=4, discard=100, seed=0),
+                    equilibrium=self.STARVED, on_nonconvergence="ignore")
+        assert not res.converged
+        assert not [w for w in recwarn if "GE bisection" in str(w.message)]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_nonconvergence"):
+            solve(SMALL_CFG, on_nonconvergence="explode")
