@@ -171,6 +171,8 @@ class SolverConfig:
     golden_iters: int = 48            # fixed golden-section iterations (fminbnd analogue)
     relative_tol: bool = False        # K-S VFI uses a relative sup-norm (:195)
     use_pallas: bool = False          # fused VMEM-tiled Bellman kernel (TPU)
+    progress_every: int = 0           # in-jit telemetry cadence (0 = off;
+                                      # diagnostics.progress host callbacks)
 
 
 @dataclasses.dataclass(frozen=True)

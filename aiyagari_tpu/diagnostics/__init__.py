@@ -11,8 +11,16 @@ from aiyagari_tpu.diagnostics.logging import (
     JSONLSink,
     multiplex,
 )
+from aiyagari_tpu.diagnostics.progress import (
+    capture_progress,
+    device_progress,
+    subscribe,
+)
 
 __all__ = [
+    "capture_progress",
+    "device_progress",
+    "subscribe",
     "ConvergenceError",
     "ConvergenceWarning",
     "enforce_convergence",

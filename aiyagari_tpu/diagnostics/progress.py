@@ -1,0 +1,88 @@
+"""In-jit iteration telemetry: device-resident fixed points report progress
+through host callbacks (SURVEY.md §5.5).
+
+The solver loops live entirely on device (lax.while_loop), so the host
+normally sees nothing until convergence — the opposite extreme of the
+reference, which prints every sweep (Aiyagari_EGM.m:109,
+Krusell_Smith_VFI.m:196-198). This module restores opt-in visibility without
+giving up the device-resident design: solvers call device_progress() every
+`progress_every` iterations, which jax.debug.callback routes to whatever
+sinks are subscribed (the same sink objects as diagnostics.logging). Off by
+default — callbacks serialize host<->device traffic, so benchmarks and
+production runs pay nothing.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from functools import partial
+from typing import Callable
+
+import jax
+
+__all__ = ["subscribe", "capture_progress", "device_progress"]
+
+_SINKS: list[Callable[[dict], None]] = []
+
+
+def subscribe(sink: Callable[[dict], None]) -> Callable[[], None]:
+    """Register a sink for in-jit progress records; returns an unsubscribe
+    function. Records are dicts {"context", "iteration", "distance"}."""
+    _SINKS.append(sink)
+
+    def unsubscribe() -> None:
+        try:
+            _SINKS.remove(sink)
+        except ValueError:
+            pass
+
+    return unsubscribe
+
+
+@contextmanager
+def capture_progress(sink: Callable[[dict], None]):
+    """Scope a sink subscription: records emitted by any jitted solver running
+    inside the with-block are delivered to `sink`."""
+    unsubscribe = subscribe(sink)
+    try:
+        yield sink
+    finally:
+        # debug.callback effects are asynchronous: drain in-flight records
+        # before dropping the subscription, or trailing ones vanish.
+        jax.effects_barrier()
+        unsubscribe()
+
+
+def _deliver(context: str, iteration, distance) -> None:
+    record = {
+        "context": context,
+        "iteration": int(iteration),
+        "distance": float(distance),
+    }
+    for sink in list(_SINKS):
+        sink(record)
+
+
+def device_progress(context: str, iteration, distance, *, every: int) -> None:
+    """Emit one progress record from inside a jitted loop body.
+
+    `every` is static: 0 disables (the call traces to nothing, zero cost);
+    otherwise a record is emitted on iterations where (iteration % every)==0.
+    Callbacks are unordered (jax.debug.callback), so sinks must not assume
+    strict monotone delivery across devices.
+    """
+    if not every:
+        return
+
+    def _emit(args):
+        it, dist = args
+        # context is static Python data: close over it rather than passing it
+        # through the callback's (array-only) argument path.
+        jax.debug.callback(partial(_deliver, context), it, dist)
+
+    jax.lax.cond(
+        iteration % every == 0,
+        _emit,
+        lambda args: None,
+        (iteration, distance),
+    )

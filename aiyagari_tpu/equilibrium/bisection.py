@@ -84,14 +84,14 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 v0, model.a_grid, model.labor_grid, model.s, model.P, r, w,
                 sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
                 tol=solver.tol, max_iter=solver.max_iter, howard_steps=solver.howard_steps,
-                relative_tol=solver.relative_tol,
+                relative_tol=solver.relative_tol, progress_every=solver.progress_every,
             )
         return solve_aiyagari_vfi(
             v0, model.a_grid, model.s, model.P, r, w,
             sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
             max_iter=solver.max_iter, howard_steps=solver.howard_steps,
             block_size=block_size, relative_tol=solver.relative_tol,
-            use_pallas=solver.use_pallas,
+            use_pallas=solver.use_pallas, progress_every=solver.progress_every,
         )
     if solver.method == "egm":
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
@@ -100,11 +100,12 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 C0, model.a_grid, model.s, model.P, r, w, model.amin,
                 sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
                 tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
+                progress_every=solver.progress_every,
             )
         return solve_aiyagari_egm(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
             sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol, max_iter=solver.max_iter,
-            relative_tol=solver.relative_tol,
+            relative_tol=solver.relative_tol, progress_every=solver.progress_every,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 

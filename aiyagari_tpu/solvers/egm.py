@@ -29,11 +29,14 @@ class EGMSolution:
     distance: jax.Array
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-                       tol: float, max_iter: int, relative_tol: bool = False) -> EGMSolution:
+                       tol: float, max_iter: int, relative_tol: bool = False,
+                       progress_every: int = 0) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
-    (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations)."""
+    (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
+    an in-jit telemetry record every that-many sweeps (diagnostics.progress)."""
+    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def cond(carry):
         _, _, dist, it = carry
@@ -44,6 +47,7 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
         C_new, policy_k = egm_step(C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta)
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
         return C_new, policy_k, dist, it + 1
 
     init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
@@ -51,12 +55,14 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol", "progress_every"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                              psi: float, eta: float, tol: float, max_iter: int,
-                             relative_tol: bool = False) -> EGMSolution:
+                             relative_tol: bool = False,
+                             progress_every: int = 0) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def cond(carry):
         return (carry[3] >= tol) & (carry[4] < max_iter)
@@ -68,6 +74,7 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
         )
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
         return C_new, policy_k, policy_l, dist, it + 1
 
     z = jnp.zeros_like(C_init)

@@ -44,18 +44,21 @@ class VFISolution:
     distance: jax.Array       # scalar, final sup-norm
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps", "block_size", "relative_tol", "use_pallas"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps", "block_size", "relative_tol", "use_pallas", "progress_every"))
 def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
                        tol: float, max_iter: int, howard_steps: int = 0,
                        block_size: int = 0, relative_tol: bool = False,
-                       use_pallas: bool = False) -> VFISolution:
+                       use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
     """Iterate the Bellman operator to a sup-norm fixed point.
 
     Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
     sup-norm, tol 1e-5, <=1000 sweeps). howard_steps>0 inserts that many
     policy-evaluation sweeps after each improvement (not used by the reference
-    for Aiyagari, exposed for the scaled-up runs).
+    for Aiyagari, exposed for the scaled-up runs). progress_every>0 emits an
+    in-jit telemetry record every that-many sweeps (diagnostics.progress;
+    0 = off, zero cost).
     """
+    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def eval_sweeps(v, idx):
         if howard_steps <= 0:
@@ -77,6 +80,7 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
                                   block_size=block_size, use_pallas=use_pallas)
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
+        device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
         v_new = eval_sweeps(v_new, idx)
         return v_new, idx, dist, it + 1
 
@@ -187,13 +191,15 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
                        jnp.ones_like(policy_k), it, dist)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "howard_steps", "relative_tol"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))
 def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: float,
                              beta: float, psi: float, eta: float, tol: float,
                              max_iter: int, howard_steps: int = 0,
-                             relative_tol: bool = False) -> VFISolution:
+                             relative_tol: bool = False,
+                             progress_every: int = 0) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
     (Aiyagari_Endogenous_Labor_VFI.m:64-122)."""
+    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def eval_sweeps(v, a_idx, l_idx):
         if howard_steps <= 0:
@@ -218,6 +224,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: f
         )
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
+        device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)
         v_new = eval_sweeps(v_new, a_idx, l_idx)
         return v_new, a_idx, l_idx, dist, it + 1
 

@@ -6,6 +6,7 @@ import subprocess
 import sys
 from pathlib import Path
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -46,6 +47,70 @@ class TestLogging:
         ConsoleSink(prefix="x ")({"it": 1, "d": 0.123456789, "B": [1.0, 2]})
         outp = capsys.readouterr().out
         assert outp.startswith("x it=1") and "0.123457" in outp
+
+
+class TestInJitProgress:
+    """SURVEY.md §5.5: device-resident loops report through host callbacks."""
+
+    def _solve(self, progress_every):
+        from aiyagari_tpu.config import SolverConfig
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+        m = aiyagari_preset(grid_size=50)
+        sol = solve_household(
+            m, 0.04,
+            solver=SolverConfig(method="egm", progress_every=progress_every),
+        )
+        jax.block_until_ready(sol.policy_c)
+        return sol
+
+    def test_records_emitted_at_cadence(self):
+        from aiyagari_tpu.diagnostics import CollectSink, capture_progress
+
+        collect = CollectSink()
+        with capture_progress(collect):
+            sol = self._solve(progress_every=10)
+        iters = int(sol.iterations)
+        assert len(collect.records) == iters // 10
+        assert all(r["context"] == "aiyagari_egm" for r in collect.records)
+        assert all(r["iteration"] % 10 == 0 for r in collect.records)
+        # Distances shrink over the run (contraction visible from telemetry).
+        dists = [r["distance"] for r in sorted(collect.records, key=lambda r: r["iteration"])]
+        assert dists[-1] < dists[0]
+
+    def test_disabled_emits_nothing(self):
+        from aiyagari_tpu.diagnostics import CollectSink, capture_progress
+
+        collect = CollectSink()
+        with capture_progress(collect):
+            self._solve(progress_every=0)
+        assert collect.records == []
+
+    def test_labor_paths_emit_too(self):
+        from aiyagari_tpu.config import SolverConfig
+        from aiyagari_tpu.diagnostics import CollectSink, capture_progress
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+        from aiyagari_tpu.models.aiyagari import aiyagari_labor_preset
+
+        m = aiyagari_labor_preset(grid_size=40)
+        collect = CollectSink()
+        with capture_progress(collect):
+            sol = solve_household(
+                m, 0.04, solver=SolverConfig(method="egm", progress_every=5)
+            )
+            jax.block_until_ready(sol.policy_c)
+        assert collect.records
+        assert all(r["context"] == "aiyagari_egm_labor" for r in collect.records)
+
+    def test_unsubscribed_after_scope(self):
+        from aiyagari_tpu.diagnostics import CollectSink, capture_progress
+
+        collect = CollectSink()
+        with capture_progress(collect):
+            pass
+        self._solve(progress_every=10)
+        assert collect.records == []
 
 
 class TestProfiler:
