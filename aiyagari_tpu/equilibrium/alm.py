@@ -160,6 +160,7 @@ def solve_krusell_smith(
                 tol=solver.tol, max_iter=solver.max_iter,
                 howard_steps=solver.howard_steps, improve_every=solver.improve_every,
                 golden_iters=solver.golden_iters, relative_tol=solver.relative_tol,
+                progress_every=solver.progress_every,
             )
             value = sol.value
         elif solver.method == "egm":
@@ -170,6 +171,7 @@ def solve_krusell_smith(
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=solver.tol, max_iter=solver.max_iter, double_alm=double_alm,
+                progress_every=solver.progress_every,
             )
         else:
             raise ValueError(f"unknown method {solver.method!r}")

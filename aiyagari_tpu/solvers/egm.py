@@ -11,6 +11,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import egm_step, egm_step_labor
 
 __all__ = ["EGMSolution", "solve_aiyagari_egm", "solve_aiyagari_egm_labor"]
@@ -36,7 +37,6 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress)."""
-    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def cond(carry):
         _, _, dist, it = carry
@@ -62,7 +62,6 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
                              progress_every: int = 0) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
-    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def cond(carry):
         return (carry[3] >= tol) & (carry[4] < max_iter)

@@ -22,6 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.interp import masked_pchip_interp
 from aiyagari_tpu.solvers.ks_vfi import KSSolution, _alm_next_K_index
 from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
@@ -29,11 +30,13 @@ from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
 __all__ = ["solve_ks_egm"]
 
 
-@partial(jax.jit, static_argnames=("theta", "beta", "mu", "l_bar", "tol", "max_iter", "double_alm"))
+@partial(jax.jit, static_argnames=("theta", "beta", "mu", "l_bar", "tol", "max_iter",
+                                   "double_alm", "progress_every"))
 def solve_ks_egm(k_opt_init, B, k_grid, K_grid, P, r_table, w_table, eps_by_state,
                  z_by_state, L_by_state, alpha: float, *, theta: float, beta: float,
                  mu: float, l_bar: float, delta: float, k_min: float, k_max: float,
-                 tol: float, max_iter: int, double_alm: bool = False) -> KSSolution:
+                 tol: float, max_iter: int, double_alm: bool = False,
+                 progress_every: int = 0) -> KSSolution:
     """EGM fixed point on the capital policy k_opt [ns, nK, nk] given ALM
     coefficients B. Convergence: absolute sup-norm on k_opt < tol
     (Krusell_Smith_EGM.m:204-206, tol 1e-6, <=10000 sweeps).
@@ -107,6 +110,7 @@ def solve_ks_egm(k_opt_init, B, k_grid, K_grid, P, r_table, w_table, eps_by_stat
         k_opt, _, it = carry
         k_new = sweep(k_opt)
         dist = jnp.max(jnp.abs(k_new - k_opt))
+        device_progress("ks_egm", it + 1, dist, every=progress_every)
         return k_new, dist, it + 1
 
     init = (k_opt_init, jnp.array(jnp.inf, k_opt_init.dtype), jnp.int32(0))

@@ -20,6 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.golden import golden_section_max
 from aiyagari_tpu.ops.interp import pchip_interp, pchip_slopes
 from aiyagari_tpu.utils.utility import crra_utility
@@ -86,12 +87,13 @@ def _expected_value(kp, V_next, slopes, P, k_grid):
 
 @partial(jax.jit, static_argnames=("theta", "beta", "mu", "l_bar", "tol", "max_iter",
                                    "howard_steps", "improve_every", "golden_iters",
-                                   "relative_tol"))
+                                   "relative_tol", "progress_every"))
 def solve_ks_vfi(value_init, k_opt_init, B, k_grid, K_grid, P, r_table, w_table,
                  eps_by_state, *, theta: float, beta: float, mu: float, l_bar: float,
                  delta: float, k_min: float, k_max: float, tol: float, max_iter: int,
                  howard_steps: int = 50, improve_every: int = 5,
-                 golden_iters: int = 48, relative_tol: bool = True) -> KSSolution:
+                 golden_iters: int = 48, relative_tol: bool = True,
+                 progress_every: int = 0) -> KSSolution:
     """Howard-accelerated VFI given ALM coefficients B.
 
     Matches Krusell_Smith_VFI.m:141-204: policy improvement every
@@ -147,6 +149,7 @@ def solve_ks_vfi(value_init, k_opt_init, B, k_grid, K_grid, P, r_table, w_table,
         diff = jnp.abs(value_new - value)
         # Relative sup-norm is the reference's criterion (Krusell_Smith_VFI.m:195).
         dist = jnp.max(diff / (jnp.abs(value) + 1e-10)) if relative_tol else jnp.max(diff)
+        device_progress("ks_vfi", it + 1, dist, every=progress_every)
         return value_new, k_opt, dist, it + 1
 
     init = (value_init, k_opt_init, jnp.array(jnp.inf, value_init.dtype), jnp.int32(0))

@@ -14,6 +14,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.bellman import (
     bellman_step,
     bellman_step_labor,
@@ -58,7 +59,6 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
     in-jit telemetry record every that-many sweeps (diagnostics.progress;
     0 = off, zero cost).
     """
-    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def eval_sweeps(v, idx):
         if howard_steps <= 0:
@@ -199,7 +199,6 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: f
                              progress_every: int = 0) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
     (Aiyagari_Endogenous_Labor_VFI.m:64-122)."""
-    from aiyagari_tpu.diagnostics.progress import device_progress
 
     def eval_sweeps(v, a_idx, l_idx):
         if howard_steps <= 0:

@@ -1,0 +1,61 @@
+"""Shared CLI plumbing for the example scripts.
+
+Every example mirrors one of the six reference scripts end-to-end
+(parameterize -> discretize -> solve -> simulate -> close GE -> report) at the
+reference's scale by default; --quick shrinks grids/horizons for a fast smoke
+run, --outdir writes the full plot/statistics report, --platform forces the
+jax backend (the JAX_PLATFORMS env var alone does not stick in images whose
+TPU plugin registers at interpreter startup).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# Make the repo root importable so the examples run without installation.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def example_args(description: str) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--quick", action="store_true", help="small grids/horizons smoke run")
+    ap.add_argument("--outdir", default=None, help="write the plot/stats report here")
+    ap.add_argument("--platform", choices=["cpu", "tpu"], default=None)
+    ap.add_argument("--progress", type=int, default=0, metavar="N",
+                    help="emit in-jit solver telemetry every N sweeps")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        # Pass the platform through verbatim so --platform tpu errors loudly
+        # if the TPU backend is unavailable instead of silently running CPU.
+        jax.config.update("jax_platforms", args.platform)
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_enable_x64", True)
+    if args.progress:
+        from aiyagari_tpu.diagnostics import ConsoleSink, subscribe
+
+        subscribe(ConsoleSink(prefix="  [solver] "))
+    return args
+
+
+def print_equilibrium(res, label: str) -> None:
+    from aiyagari_tpu.utils.stats import gini, quantile_shares
+
+    print(f"== {label} ==")
+    print(f"r* = {res.r:.6f}   w = {res.w:.6f}   K = {float(res.capital):.4f}   "
+          f"iterations = {len(res.r_history)}  converged = {res.converged}")
+    k = res.series.k if hasattr(res, "series") else res.sim_k
+    g = float(gini(k.reshape(-1)))
+    shares = [round(float(x), 4) for x in quantile_shares(k.reshape(-1))]
+    print(f"wealth gini = {g:.4f}   quintile shares = {shares}")
+
+
+def print_ks(res, label: str) -> None:
+    print(f"== {label} ==")
+    print(f"B = {[round(float(b), 5) for b in res.B]}")
+    print(f"per-regime R^2 = {[round(float(x), 5) for x in res.r2]}   "
+          f"iterations = {res.iterations}  converged = {res.converged}")

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Aiyagari (1994), exogenous labor, endogenous grid method (Carroll 2006).
+
+Framework counterpart of the reference's Aiyagari_EGM.m (EGM operator :74-110,
+simulation :120-149, GE bisection :157-253 — with the stale-wage quirk fixed:
+both r and w are recomputed each bisection step, SURVEY.md §3.6 quirk 1).
+
+Run: python examples/aiyagari_egm.py [--quick] [--outdir out/] [--progress 50]
+"""
+
+import _common
+
+args = _common.example_args(__doc__)
+
+import aiyagari_tpu as at
+
+cfg = at.AiyagariConfig() if not args.quick else at.AiyagariConfig(
+    grid=at.GridSpecConfig(n_points=100)
+)
+sim = at.SimConfig() if not args.quick else at.SimConfig(
+    periods=2000, n_agents=8, discard=200, seed=0
+)
+res = at.solve(
+    cfg, method="egm", sim=sim,
+    solver=at.SolverConfig(method="egm", progress_every=args.progress),
+)
+_common.print_equilibrium(res, "Aiyagari / EGM")
+
+if args.outdir:
+    from aiyagari_tpu.io_utils.report import equilibrium_report
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    summary = equilibrium_report(res, AiyagariModel.from_config(cfg), args.outdir,
+                                 discard=sim.discard)
+    print(f"report written to {args.outdir}: {sorted(summary)}")

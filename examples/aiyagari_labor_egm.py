@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Aiyagari with endogenous labor supply, EGM with the intratemporal FOC.
+
+Framework counterpart of the reference's Aiyagari_Endogenous_Labor_EGM.m
+(closed-form labor FOC l = ((w s u'(c))/psi)^(1/eta) :61-62,86, EGM operator
+:67-107, GE bisection :154-255).
+
+Run: python examples/aiyagari_labor_egm.py [--quick] [--outdir out/]
+"""
+
+import _common
+
+args = _common.example_args(__doc__)
+
+import aiyagari_tpu as at
+
+grid = at.GridSpecConfig(n_points=100) if args.quick else at.GridSpecConfig()
+cfg = at.AiyagariConfig(endogenous_labor=True, grid=grid)
+sim = at.SimConfig() if not args.quick else at.SimConfig(
+    periods=2000, n_agents=8, discard=200, seed=0
+)
+res = at.solve(
+    cfg, method="egm", sim=sim,
+    solver=at.SolverConfig(method="egm", progress_every=args.progress),
+)
+_common.print_equilibrium(res, "Aiyagari endogenous labor / EGM")
+import jax.numpy as jnp
+
+print(f"mean labor supply = {float(jnp.mean(res.series.l)):.4f}")
+
+if args.outdir:
+    from aiyagari_tpu.io_utils.report import equilibrium_report
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    summary = equilibrium_report(res, AiyagariModel.from_config(cfg), args.outdir,
+                                 discard=sim.discard)
+    print(f"report written to {args.outdir}: {sorted(summary)}")

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Aiyagari with endogenous labor supply, VFI (joint labor x asset choice).
+
+Framework counterpart of the reference's Aiyagari_Endogenous_Labor_VFI.m
+(10-point labor grid :62, joint-grid Bellman :64-122, GE bisection :155-256).
+
+Run: python examples/aiyagari_labor_vfi.py [--quick] [--outdir out/]
+"""
+
+import _common
+
+args = _common.example_args(__doc__)
+
+import aiyagari_tpu as at
+
+grid = at.GridSpecConfig(n_points=100) if args.quick else at.GridSpecConfig()
+cfg = at.AiyagariConfig(endogenous_labor=True, grid=grid)
+sim = at.SimConfig() if not args.quick else at.SimConfig(
+    periods=2000, n_agents=8, discard=200, seed=0
+)
+res = at.solve(
+    cfg, method="vfi", sim=sim,
+    solver=at.SolverConfig(method="vfi", progress_every=args.progress),
+)
+_common.print_equilibrium(res, "Aiyagari endogenous labor / VFI")
+import jax.numpy as jnp
+
+print(f"mean labor supply = {float(jnp.mean(res.series.l)):.4f}")
+
+if args.outdir:
+    from aiyagari_tpu.io_utils.report import equilibrium_report
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    summary = equilibrium_report(res, AiyagariModel.from_config(cfg), args.outdir,
+                                 discard=sim.discard)
+    print(f"report written to {args.outdir}: {sorted(summary)}")
