@@ -1,0 +1,81 @@
+"""Precision-discipline tests (SURVEY.md §5.2): the kernels that run in f32 on
+TPU (bf16/f32 is the native regime there) must still converge to the
+reference tolerances and agree with the f64 ground truth.
+
+The suite's conftest enables x64 globally; these tests build f32 models
+explicitly, mirroring what `bench.py` and the dispatch layer do on TPU
+(BackendConfig.dtype="float32"). The precision-sensitive spots called out in
+the survey: CRRA powers at sigma=5 and the EGM marginal-utility inversion
+u'^(-1/sigma) (Aiyagari_EGM.m:69).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import SolverConfig
+from aiyagari_tpu.equilibrium.bisection import solve_household
+from aiyagari_tpu.models.aiyagari import aiyagari_preset
+from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
+
+TOL = 1e-5   # the reference tolerance (Aiyagari_VFI.m:49)
+
+
+@pytest.fixture(scope="module", params=["vfi", "egm"])
+def f32_and_f64(request):
+    method = request.param
+    sols = {}
+    for dtype in (jnp.float32, jnp.float64):
+        m = aiyagari_preset(grid_size=120, dtype=dtype)
+        sols[dtype] = solve_household(
+            m, 0.04, solver=SolverConfig(method=method, tol=TOL, max_iter=1000)
+        )
+    return method, sols
+
+
+class TestF32Convergence:
+    def test_f32_hits_reference_tolerance(self, f32_and_f64):
+        _, sols = f32_and_f64
+        sol = sols[jnp.float32]
+        assert sol.policy_c.dtype == jnp.float32
+        assert float(sol.distance) < TOL
+        assert int(sol.iterations) < 1000
+
+    def test_f32_policy_close_to_f64(self, f32_and_f64):
+        # Policies agree to well under one grid cell; consumption relative
+        # error stays near f32 resolution, not at blowup scale.
+        _, sols = f32_and_f64
+        c32 = np.asarray(sols[jnp.float32].policy_c, np.float64)
+        c64 = np.asarray(sols[jnp.float64].policy_c)
+        rel = np.abs(c32 - c64) / (np.abs(c64) + 1e-12)
+        assert np.max(rel) < 5e-3
+        k32 = np.asarray(sols[jnp.float32].policy_k, np.float64)
+        k64 = np.asarray(sols[jnp.float64].policy_k)
+        assert np.max(np.abs(k32 - k64)) < 0.05 * float(k64.max() - k64.min() + 1)
+
+    def test_f32_value_distance_monotone_family(self, f32_and_f64):
+        # The converged iteration count in f32 is in the same regime as f64
+        # (no precision-stall: f32 should not need materially more sweeps).
+        _, sols = f32_and_f64
+        it32 = int(sols[jnp.float32].iterations)
+        it64 = int(sols[jnp.float64].iterations)
+        assert it32 <= it64 + 50
+
+
+class TestMarginalUtilityInversion:
+    """u' and its inverse at sigma=5 — the survey's precision-sensitive spot."""
+
+    @pytest.mark.parametrize("dtype,rtol", [(jnp.float32, 2e-5), (jnp.float64, 1e-12)])
+    def test_roundtrip_at_sigma5(self, dtype, rtol):
+        c = jnp.asarray(np.geomspace(1e-2, 50.0, 64), dtype)
+        up = crra_marginal(c, 5.0)
+        c_back = crra_marginal_inverse(up, 5.0)
+        np.testing.assert_allclose(np.asarray(c_back), np.asarray(c), rtol=rtol)
+
+    def test_f32_no_overflow_at_small_consumption(self):
+        # c^-5 at c=1e-2 is 1e10 — representable in f32; the inversion must
+        # not round-trip through inf/NaN.
+        c = jnp.asarray([1e-2, 5e-2, 1e-1], jnp.float32)
+        up = crra_marginal(c, 5.0)
+        assert bool(jnp.all(jnp.isfinite(up)))
+        assert bool(jnp.all(jnp.isfinite(crra_marginal_inverse(up, 5.0))))
