@@ -128,6 +128,19 @@ class TestDispatchKS:
             solve(KrusellSmithConfig(k_size=15), method="vfi",
                   solver=SolverConfig(method="egm"))
 
+    def test_nonconvergence_policy_on_alm_loop(self):
+        # SURVEY.md §5.3 for the K-S branch: a starved ALM loop surfaces a
+        # typed error carrying the coefficient-step distance.
+        from aiyagari_tpu import ConvergenceError, solve
+        from aiyagari_tpu.config import ALMConfig as A
+
+        starved = A(T=100, population=200, discard=20, max_iter=1, tol=1e-12)
+        with pytest.raises(ConvergenceError, match="ALM fixed point") as exc:
+            solve(KrusellSmithConfig(k_size=15), method="vfi", alm=starved,
+                  on_nonconvergence="raise")
+        assert exc.value.iterations == 1
+        assert "B" in exc.value.detail
+
     def test_solver_method_respected_without_method_kwarg(self):
         # solver.method alone selects the method (no silent override).
         from aiyagari_tpu import solve
