@@ -64,10 +64,13 @@ def solve(
     family supplies its own reference-faithful solver defaults (e.g. the
     Krusell-Smith tolerances/Howard schedule of Krusell_Smith_VFI.m:12-13).
 
-    `aggregation` selects the Aiyagari capital-supply closure: "simulation"
-    (the reference's Monte-Carlo time average, Aiyagari_VFI.m:94-129) or
-    "distribution" (deterministic Young-histogram stationary distribution,
-    sim/distribution.py — jax backend only).
+    `aggregation` selects how the cross-section is aggregated: "simulation"
+    (the reference's Monte-Carlo household panel — time average for Aiyagari,
+    Aiyagari_VFI.m:94-129; agent panel for Krusell-Smith, Krusell_Smith_VFI.m:
+    222-248) or "distribution" (deterministic Young histogram — stationary
+    distribution for Aiyagari, sim/distribution.py; distribution path along
+    the aggregate shocks for Krusell-Smith, sim/ks_distribution.py — jax
+    backend only).
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -129,19 +132,19 @@ def solve(
         return result
 
     if isinstance(model, KrusellSmithConfig):
-        if aggregation != "simulation":
-            raise ValueError(
-                "aggregation='distribution' is not available for Krusell-Smith "
-                "models: the ALM closure is defined over a simulated aggregate "
-                "path (Krusell_Smith_VFI.m:250-296)"
-            )
+        if aggregation == "distribution" and backend.backend != "jax":
+            raise ValueError("aggregation='distribution' requires backend='jax'")
         alm = alm or ALMConfig()
         from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
 
         # solver=None lets the KS loop apply its own reference defaults
         # (tol 1e-6, Howard 50/improve-every-5) rather than the generic ones.
+        # aggregation="distribution" advances the cross-section as a Young
+        # histogram along the aggregate path (sim/ks_distribution.py) instead
+        # of the reference's Monte-Carlo agent panel.
         result = solve_krusell_smith(
-            model, method=method, solver=solver, alm=alm, backend=backend
+            model, method=method, solver=solver, alm=alm, backend=backend,
+            closure=("histogram" if aggregation == "distribution" else "panel"),
         )
         enforce_convergence(
             result.converged, on_nonconvergence, "Krusell-Smith ALM fixed point",

@@ -21,6 +21,10 @@ import numpy as np
 from aiyagari_tpu.config import ALMConfig, BackendConfig, KrusellSmithConfig, SolverConfig
 from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
 from aiyagari_tpu.ops.regression import alm_regression
+from aiyagari_tpu.sim.ks_distribution import (
+    distribution_capital_path,
+    initial_distribution,
+)
 from aiyagari_tpu.sim.ks_panel import (
     simulate_aggregate_shocks,
     simulate_capital_path,
@@ -43,11 +47,15 @@ class KSResult:
     K_ts: np.ndarray              # [T] simulated aggregate capital path
     z_path: np.ndarray            # [T] aggregate state path
     k_population: np.ndarray      # final cross-section of agent capital
+                                  # (empty under the histogram closure)
     iterations: int
     converged: bool
     diff_B: float
     solve_seconds: float
     per_iteration: list
+    mu: Optional[np.ndarray] = None   # [2, nk] final (employment, capital)
+                                      # histogram under closure="histogram"
+    k_grid: Optional[np.ndarray] = None   # [nk] capital grid mu lives on
 
 
 def _default_ks_solver_config(method: str) -> SolverConfig:
@@ -72,17 +80,27 @@ def solve_krusell_smith(
     on_iteration: Optional[Callable] = None,
     double_alm: bool = False,
     checkpoint_dir: Optional[str] = None,
+    closure: str = "panel",
 ) -> KSResult:
-    """Iterate household solve -> panel simulation -> ALM regression to a fixed
-    point of the forecasting coefficients B (Krusell_Smith_VFI.m:138-296).
+    """Iterate household solve -> cross-section simulation -> ALM regression to
+    a fixed point of the forecasting coefficients B (Krusell_Smith_VFI.m:138-296).
 
     Stops when max|B_new - B| < alm.tol; damped update otherwise. B starts at
     [0, 1, 0, 1] (:99) — a unit-root forecast in each regime.
+
+    `closure` selects how the cross-section is advanced along the aggregate
+    path: "panel" (the reference's alm.population Monte-Carlo households) or
+    "histogram" (deterministic Young-method distribution on the capital grid,
+    sim/ks_distribution.py — exact given the grid, no sampling noise in the
+    regression).
 
     With checkpoint_dir set, (B, value, policy, cross-section, histories) are
     persisted each outer iteration and a restarted call resumes; shocks are
     regenerated deterministically from alm.seed (SURVEY.md §5.3-5.4).
     """
+    if closure not in ("panel", "histogram"):
+        raise ValueError(f"unknown closure {closure!r}; expected 'panel' or 'histogram'")
+    use_histogram = closure == "histogram"
     t0 = time.perf_counter()
     dtype = jnp.float64 if backend.dtype == "float64" else jnp.float32
     model = KrusellSmithModel.from_config(config, dtype)
@@ -94,31 +112,38 @@ def solve_krusell_smith(
     key = jax.random.PRNGKey(alm.seed)
     k_z, k_eps = jax.random.split(key)
     z_path = simulate_aggregate_shocks(model.pz, k_z, T=alm.T)
-    eps_panel = simulate_employment_panel(
-        z_path, model.eps_trans, sh.u_good, sh.u_bad, k_eps, T=alm.T, population=alm.population
-    )
-
-    # Device-mesh placement: with backend.mesh_axes containing "agents", the
-    # employment panel and the capital cross-section are sharded over the mesh
-    # so the per-step policy evaluation data-parallelizes and the K=mean(k)
-    # reduction lowers to a psum over ICI (SURVEY.md §2.4).
-    if backend.mesh_axes:
-        from aiyagari_tpu.parallel.mesh import agents_sharding, make_mesh
-
-        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
-        eps_panel = jax.device_put(eps_panel, agents_sharding(mesh, batch_axis=1))
-        panel_sharding = agents_sharding(mesh, batch_axis=0)
+    panel_sharding = None
+    if use_histogram:
+        eps_panel = None
     else:
-        panel_sharding = None
+        eps_panel = simulate_employment_panel(
+            z_path, model.eps_trans, sh.u_good, sh.u_bad, k_eps, T=alm.T,
+            population=alm.population,
+        )
+        # Device-mesh placement: with backend.mesh_axes containing "agents",
+        # the employment panel and the capital cross-section are sharded over
+        # the mesh so the per-step policy evaluation data-parallelizes and the
+        # K=mean(k) reduction lowers to a psum over ICI (SURVEY.md §2.4).
+        if backend.mesh_axes:
+            from aiyagari_tpu.parallel.mesh import agents_sharding, make_mesh
+
+            mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+            eps_panel = jax.device_put(eps_panel, agents_sharding(mesh, batch_axis=1))
+            panel_sharding = agents_sharding(mesh, batch_axis=0)
 
     ns, nK, nk = model.n_states, config.K_size, config.k_size
     # Initial policy 0.9*k and implied consistent value guess (Krusell_Smith_VFI.m:97-98).
     k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (ns, nK, nk)).astype(dtype)
     value = jnp.log(jnp.maximum(0.1 / 0.9 * k_opt, 1e-12)) / (1.0 - prefs.beta)
-    # Initial cross-section at K_grid[0] (:100).
-    k_population = jnp.full((alm.population,), float(model.K_grid[0]), dtype)
-    if panel_sharding is not None:
-        k_population = jax.device_put(k_population, panel_sharding)
+    # Initial cross-section at K_grid[0] (:100): Monte-Carlo households for the
+    # panel closure, an (employment, capital) histogram for the Young closure.
+    if use_histogram:
+        u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
+        cross = initial_distribution(model.k_grid, model.K_grid, u0, dtype)
+    else:
+        cross = jnp.full((alm.population,), float(model.K_grid[0]), dtype)
+        if panel_sharding is not None:
+            cross = jax.device_put(cross, panel_sharding)
     B = np.array([0.0, 1.0, 0.0, 1.0])
 
     records = []
@@ -127,8 +152,11 @@ def solve_krusell_smith(
     if checkpoint_dir is not None:
         from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
 
+        # Panel closure keeps the pre-closure checkpoint name so existing
+        # checkpoints still resume (their cross-section key is handled below).
+        ckpt_name = f"ks_{solver.method}" if closure == "panel" else f"ks_{solver.method}_histogram"
         mgr = CheckpointManager(
-            checkpoint_dir, f"ks_{solver.method}",
+            checkpoint_dir, ckpt_name,
             fingerprint=config_fingerprint(config, solver, alm),
         )
         resumed = mgr.restore()
@@ -140,9 +168,10 @@ def solve_krusell_smith(
             records = records[:start_it]
             value = jnp.asarray(arrays["value"], dtype)
             k_opt = jnp.asarray(arrays["k_opt"], dtype)
-            k_population = jnp.asarray(arrays["k_population"], dtype)
+            # legacy checkpoints stored the cross-section as "k_population"
+            cross = jnp.asarray(arrays.get("cross", arrays.get("k_population")), dtype)
             if panel_sharding is not None:
-                k_population = jax.device_put(k_population, panel_sharding)
+                cross = jax.device_put(cross, panel_sharding)
 
     converged = False
     diff_B = np.inf
@@ -177,10 +206,25 @@ def solve_krusell_smith(
             raise ValueError(f"unknown method {solver.method!r}")
         k_opt = sol.k_opt
 
-        K_ts, k_population_new = simulate_capital_path(
-            sol.k_opt, model.k_grid, model.K_grid, z_path, eps_panel,
-            k_population, T=alm.T,
-        )
+        if use_histogram:
+            # Warm-starting reuses last iteration's capital distribution, but
+            # the scan's conditional employment chains assume the employment
+            # marginal is u(z_0) at t=0 (the final-period marginal is
+            # u(z_{T-1})) — rescale the rows so the exact-u(z_t) invariant
+            # holds every iteration. Idempotent on the first pass.
+            u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
+            target = jnp.asarray([1.0 - u0, u0], dtype)
+            row_mass = jnp.sum(cross, axis=1, keepdims=True)
+            cross = cross * (target[:, None] / jnp.maximum(row_mass, 1e-300))
+            K_ts, cross_new = distribution_capital_path(
+                sol.k_opt, model.k_grid, model.K_grid, z_path, model.eps_trans,
+                cross, T=alm.T,
+            )
+        else:
+            K_ts, cross_new = simulate_capital_path(
+                sol.k_opt, model.k_grid, model.K_grid, z_path, eps_panel,
+                cross, T=alm.T,
+            )
         B_new, r2_dev = alm_regression(K_ts, z_path, alm.discard)
         B_new = np.asarray(B_new, np.float64)
         r2 = np.asarray(r2_dev, np.float64)
@@ -204,19 +248,20 @@ def solve_krusell_smith(
         if diff_B < alm.tol:
             converged = True
             B = B_new
-            k_population = k_population_new
+            cross = cross_new
             break
         B = alm.damping * B_new + (1.0 - alm.damping) * B
-        # Reference resets the panel to K_grid[0] implicitly by reusing
-        # k_population across B-iterations (:100, :246-247); we do the same.
-        k_population = k_population_new
+        # Reference warm-starts the cross-section across B-iterations by
+        # reusing k_population (:100, :246-247); we do the same (for both
+        # the agent panel and the histogram).
+        cross = cross_new
         if mgr is not None:
             mgr.save(
                 scalars={"iteration": it, "B": B.tolist(), "records": records},
                 arrays={
                     "value": np.asarray(value),
                     "k_opt": np.asarray(k_opt),
-                    "k_population": np.asarray(k_population),
+                    "cross": np.asarray(cross),
                 },
             )
 
@@ -229,10 +274,12 @@ def solve_krusell_smith(
         solution=sol,
         K_ts=K_ts_np,
         z_path=np.asarray(z_path),
-        k_population=np.asarray(k_population),
+        k_population=(np.asarray([]) if use_histogram else np.asarray(cross)),
         iterations=len(records),
         converged=converged,
         diff_B=diff_B,
         solve_seconds=time.perf_counter() - t0,
         per_iteration=records,
+        mu=(np.asarray(cross) if use_histogram else None),
+        k_grid=np.asarray(model.k_grid),
     )

@@ -249,10 +249,21 @@ def krusell_smith_report(result, outdir, discard: int = 100) -> dict:
     plt.close(fig)
 
     # Wealth distribution of the final cross-section (bonus over the
-    # reference: it never plots the K-S wealth distribution).
+    # reference: it never plots the K-S wealth distribution). Under the
+    # histogram closure the cross-section IS a distribution on k_grid.
     kpop = np.asarray(result.k_population)
+    mu = getattr(result, "mu", None)
     fig, ax = plt.subplots(figsize=(7, 5))
-    ax.hist(kpop, bins=60, weights=np.full(kpop.size, 1.0 / kpop.size))
+    if kpop.size:
+        ax.hist(kpop, bins=60, weights=np.full(kpop.size, 1.0 / kpop.size))
+        wealth_gini = float(gini(jnp.asarray(kpop)))
+    else:
+        k_grid = np.asarray(result.k_grid)
+        w = np.asarray(mu).sum(axis=0)
+        ax.bar(k_grid, w, width=np.gradient(k_grid), align="center")
+        from aiyagari_tpu.utils.stats import weighted_gini
+
+        wealth_gini = float(weighted_gini(jnp.asarray(k_grid), jnp.asarray(w)))
     ax.set_title("Cross-sectional wealth distribution (final period)")
     ax.set_xlabel("k")
     fig.savefig(out / "wealth_cross_section.png", dpi=120)
@@ -268,7 +279,7 @@ def krusell_smith_report(result, outdir, discard: int = 100) -> dict:
         "diff_B": result.diff_B,
         "K_mean": float(K_ts[discard:].mean()),
         "alm_path_max_rel_error": float(err.max()),
-        "wealth_gini": float(gini(jnp.asarray(kpop))),
+        "wealth_gini": wealth_gini,
         "solve_seconds": result.solve_seconds,
     }
     (out / "summary.json").write_text(json.dumps(summary, indent=2))

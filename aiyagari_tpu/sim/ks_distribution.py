@@ -1,0 +1,89 @@
+"""Non-stochastic (Young 2010) cross-section dynamics for Krusell-Smith: push
+the histogram over (employment, capital) gridpoints through the policy along
+the aggregate-shock path, instead of simulating 10,000 discrete households.
+
+The reference's panel simulator (Krusell_Smith_VFI.m:70-94,222-248) carries
+Monte-Carlo sampling error into the ALM regression — the regression chases
+noise, which is why damping 0.3 is needed. The histogram form is exact given
+the grid: per period the whole cross-section moves with one policy lookup
+(the distribution lives ON k_grid, so the policy needs no interpolation in
+k at all), a two-point lottery scatter, and a 2x2 employment mixing whose
+conditional matrices (eps_trans) by construction reproduce u(z) each period
+exactly. Deterministic, RNG-free, and O(nk) per period instead of
+O(population).
+
+The reference has no analogue; this closure is selected with
+solve(..., aggregation="distribution") / solve_krusell_smith(closure=
+"histogram").
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.models.krusell_smith import state_index
+from aiyagari_tpu.sim.distribution import distribution_step, young_lottery
+
+__all__ = ["initial_distribution", "distribution_capital_path"]
+
+
+def initial_distribution(k_grid, K_grid, u0, dtype):
+    """Histogram matching the panel simulator's start: everyone at
+    k = K_grid[0] (snapped onto k_grid by the lottery), unemployed with
+    probability u0."""
+    nk = k_grid.shape[0]
+    point = jnp.full((1, 1), K_grid[0], dtype)
+    idx, w_lo = young_lottery(point, k_grid)
+    k_mass = jnp.zeros((nk,), dtype).at[idx[0, 0]].add(w_lo[0, 0])
+    k_mass = k_mass.at[idx[0, 0] + 1].add(1.0 - w_lo[0, 0])
+    return jnp.stack([(1.0 - u0) * k_mass, u0 * k_mass])   # [2, nk], eps 0=employed
+
+
+@partial(jax.jit, static_argnames=("T",))
+def distribution_capital_path(k_opt, k_grid, K_grid, z_path, eps_trans, mu_init, *,
+                              T: int):
+    """Deterministic aggregate-capital path under policy k_opt [ns, nK, nk].
+
+    mu_init [2, nk]: mass over (eps, k) with eps 0=employed (the ks_panel
+    convention); rows sum to the employment shares. Per step t:
+
+      1. policy at the scalar K_t by linear interpolation in K (the same
+         edge-extrapolating rule as simulate_capital_path);
+      2. since mu lives on k_grid, next capital for each (eps, gridpoint) is
+         just the policy row at the joint state (z_t, eps) — K_{t+1} =
+         <mu, k'> exactly;
+      3. Young two-point lottery scatters each row's mass onto k_grid;
+      4. employment mixing with the 2x2 conditional chain selected by
+         (z_t -> z_{t+1}) (eps_trans, as in simulate_employment_panel).
+
+    Returns (K_ts [T], mu_final [2, nk]).
+    """
+    nK = K_grid.shape[0]
+    n_eps = mu_init.shape[0]
+
+    def step(carry, inp):
+        mu, K_t = carry
+        z_t, z_next = inp
+        iK = jnp.clip(jnp.searchsorted(K_grid, K_t, side="right") - 1, 0, nK - 2)
+        tK = (K_t - K_grid[iK]) / (K_grid[iK + 1] - K_grid[iK])
+        pol_at_K = k_opt[:, iK, :] * (1.0 - tK) + k_opt[:, iK + 1, :] * tK   # [ns, nk]
+        # eps row order 0=employed, 1=unemployed -> employed flag 1-eps.
+        s_rows = state_index(z_t, 1 - jnp.arange(n_eps))                     # [2]
+        kp = pol_at_K[s_rows]                                                # [2, nk]
+        K_next = jnp.sum(mu * kp)
+        idx, w_lo = young_lottery(kp, k_grid)
+        # Same lottery-scatter + chain-mixing kernel as the Aiyagari
+        # stationary iteration, with the (z_t -> z_{t+1}) conditional
+        # employment chain in the role of P.
+        mu_next = distribution_step(mu, idx, w_lo, eps_trans[z_t, z_next])
+        return (mu_next, K_next), K_t
+
+    (mu, K_last), K_head = jax.lax.scan(
+        step, (mu_init, jnp.sum(mu_init * k_grid[None, :])),
+        (z_path[:-1], z_path[1:]),
+    )
+    K_ts = jnp.concatenate([K_head, K_last[None]])
+    return K_ts, mu

@@ -229,11 +229,14 @@ class TestDistributionGE:
         g = float(weighted_gini(wealth, mu))
         assert 0.05 < g < 0.95
 
-    def test_dispatch_rejects_ks_distribution(self):
-        from aiyagari_tpu import KrusellSmithConfig, solve
+    def test_dispatch_rejects_numpy_distribution(self):
+        # KS + aggregation="distribution" is now supported (the Young closure,
+        # test_ks.py TestHistogramClosure); the remaining invalid combination
+        # is the numpy backend, which has no histogram path.
+        from aiyagari_tpu import AiyagariConfig, solve
 
-        with pytest.raises(ValueError):
-            solve(KrusellSmithConfig(), aggregation="distribution")
+        with pytest.raises(ValueError, match="backend"):
+            solve(AiyagariConfig(), aggregation="distribution", backend="numpy")
 
     def test_report_from_distribution_result(self, dist_result, cfg, tmp_path):
         from aiyagari_tpu.io_utils.report import equilibrium_report

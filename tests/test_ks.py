@@ -152,6 +152,88 @@ class TestDispatchKS:
         assert res.iterations == 1
 
 
+class TestHistogramClosure:
+    """Deterministic Young-method cross-section for K-S
+    (sim/ks_distribution.py; no reference analogue)."""
+
+    def test_initial_distribution_shares_and_mass(self):
+        from aiyagari_tpu.sim.ks_distribution import initial_distribution
+
+        model = KrusellSmithModel.from_config(SMALL)
+        mu = initial_distribution(model.k_grid, model.K_grid, 0.04, jnp.float64)
+        assert mu.shape == (2, SMALL.k_size)
+        np.testing.assert_allclose(float(mu.sum()), 1.0, rtol=1e-12)
+        np.testing.assert_allclose(float(mu[1].sum()), 0.04, rtol=1e-12)  # unemployed
+        # All capital mass at (the lottery bracket of) K_grid[0].
+        np.testing.assert_allclose(
+            float((mu * model.k_grid[None, :]).sum()), float(model.K_grid[0]), rtol=1e-10
+        )
+
+    def test_path_conserves_mass_and_unemployment(self):
+        from aiyagari_tpu.sim.ks_distribution import (
+            distribution_capital_path,
+            initial_distribution,
+        )
+
+        cfg = SMALL
+        model = KrusellSmithModel.from_config(cfg)
+        T = 120
+        z = simulate_aggregate_shocks(model.pz, jax.random.PRNGKey(3), T=T)
+        k_opt = 0.9 * jnp.broadcast_to(
+            model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size)
+        ).astype(jnp.float64)
+        mu0 = initial_distribution(model.k_grid, model.K_grid,
+                                   cfg.shocks.u_good, jnp.float64)
+        K_ts, mu = distribution_capital_path(
+            k_opt, model.k_grid, model.K_grid, z, model.eps_trans, mu0, T=T
+        )
+        assert K_ts.shape == (T,)
+        np.testing.assert_allclose(float(mu.sum()), 1.0, rtol=1e-10)
+        # The conditional employment chains reproduce u(z_T) exactly given
+        # u(z_0) — the property the duration construction encodes.
+        u_T = cfg.shocks.u_good if int(z[-1]) == 0 else cfg.shocks.u_bad
+        np.testing.assert_allclose(float(mu[1].sum()), u_T, atol=1e-8)
+        assert bool(jnp.all(K_ts > 0)) and bool(jnp.all(jnp.isfinite(K_ts)))
+
+    @pytest.mark.slow
+    def test_alm_fit_beats_panel_and_agrees(self):
+        kw = dict(method="vfi", solver=SOLVER_VFI,
+                  alm=ALMConfig(T=300, population=2000, discard=50, max_iter=6, seed=7))
+        panel = solve_krusell_smith(SMALL, closure="panel", **kw)
+        hist = solve_krusell_smith(SMALL, closure="histogram", **kw)
+        # Same economics: coefficients within a few percent of each other.
+        np.testing.assert_allclose(hist.B, panel.B, atol=0.05)
+        # No sampling noise: near-perfect regression fit.
+        assert float(np.min(hist.r2)) > 0.9999
+        assert float(np.min(hist.r2)) >= float(np.min(panel.r2))
+        assert hist.mu is not None and hist.mu.shape == (2, SMALL.k_size)
+        assert hist.k_population.size == 0
+
+    def test_dispatch_routes_distribution_aggregation(self, tmp_path):
+        from aiyagari_tpu import solve
+        from aiyagari_tpu.io_utils.report import krusell_smith_report
+
+        res = solve(SMALL, method="vfi", solver=SOLVER_VFI,
+                    alm=ALMConfig(T=120, population=100, discard=20, max_iter=1, seed=1),
+                    aggregation="distribution")
+        assert res.mu is not None
+        assert res.r2[0] > 0.99
+        # The report consumes the histogram form (weighted stats, no panel).
+        summary = krusell_smith_report(res, tmp_path, discard=20)
+        assert 0.0 <= summary["wealth_gini"] <= 1.0
+        assert (tmp_path / "wealth_cross_section.png").exists()
+
+    def test_dispatch_rejects_numpy_backend_for_distribution(self):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError, match="backend"):
+            solve(SMALL, aggregation="distribution", backend="numpy")
+
+    def test_unknown_closure_rejected(self):
+        with pytest.raises(ValueError, match="closure"):
+            solve_krusell_smith(SMALL, closure="exact")
+
+
 @pytest.mark.slow
 class TestKSIntegration:
     @pytest.fixture(scope="class")
