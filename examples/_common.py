@@ -25,6 +25,10 @@ def example_args(description: str) -> argparse.Namespace:
     ap.add_argument("--platform", choices=["cpu", "tpu"], default=None)
     ap.add_argument("--progress", type=int, default=0, metavar="N",
                     help="emit in-jit solver telemetry every N sweeps")
+    ap.add_argument("--closure", choices=["panel", "histogram"], default="panel",
+                    help="Krusell-Smith cross-section: Monte-Carlo agent panel "
+                         "(reference-faithful) or deterministic Young histogram "
+                         "(no sampling noise; K-S examples only)")
     args = ap.parse_args()
 
     import jax

@@ -26,8 +26,9 @@ else:
     # cadence threaded through so --progress works here too.
     solver = at.SolverConfig(method="egm", tol=1e-6, max_iter=10_000,
                              progress_every=args.progress)
-res = at.solve(cfg, method="egm", solver=solver, alm=alm)
-_common.print_ks(res, "Krusell-Smith / EGM")
+res = at.solve(cfg, method="egm", solver=solver, alm=alm,
+               aggregation=("distribution" if args.closure == "histogram" else "simulation"))
+_common.print_ks(res, f"Krusell-Smith / EGM ({args.closure} closure)")
 
 if args.outdir:
     from aiyagari_tpu.io_utils.report import krusell_smith_report

@@ -28,8 +28,9 @@ else:
     solver = at.SolverConfig(method="vfi", tol=1e-6, max_iter=10_000,
                              howard_steps=50, improve_every=5, relative_tol=True,
                              progress_every=args.progress)
-res = at.solve(cfg, method="vfi", solver=solver, alm=alm)
-_common.print_ks(res, "Krusell-Smith / Howard VFI")
+res = at.solve(cfg, method="vfi", solver=solver, alm=alm,
+               aggregation=("distribution" if args.closure == "histogram" else "simulation"))
+_common.print_ks(res, f"Krusell-Smith / Howard VFI ({args.closure} closure)")
 
 if args.outdir:
     from aiyagari_tpu.io_utils.report import krusell_smith_report
