@@ -37,6 +37,9 @@ def main(argv=None) -> int:
     ap.add_argument("--population", type=int, default=10_000, help="agent panel size (K-S)")
     ap.add_argument("--T", type=int, default=1100, help="panel length (K-S)")
     ap.add_argument("--alm-iters", type=int, default=100, help="max ALM iterations (K-S)")
+    ap.add_argument("--closure", choices=["panel", "histogram"], default="panel",
+                    help="K-S cross-section: Monte-Carlo agent panel "
+                         "(reference-faithful) or deterministic Young histogram")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default=None, help="enable checkpoint/resume")
     ap.add_argument("--mesh-agents", action="store_true",
@@ -47,7 +50,9 @@ def main(argv=None) -> int:
     if args.platform:
         import jax
 
-        jax.config.update("jax_platforms", "cpu" if args.platform == "cpu" else None)
+        # Verbatim so --platform tpu errors loudly if the TPU backend is
+        # unavailable instead of silently auto-detecting onto CPU.
+        jax.config.update("jax_platforms", args.platform)
     import jax
 
     from aiyagari_tpu.config import (
@@ -116,6 +121,7 @@ def main(argv=None) -> int:
             backend=backend,
             on_iteration=sink,
             checkpoint_dir=args.checkpoint_dir,
+            closure=args.closure,
         )
         summary = krusell_smith_report(res, outdir, discard=min(100, args.T // 4))
 
