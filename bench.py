@@ -208,6 +208,28 @@ def bench_ks_agents(quick: bool) -> dict:
     }
 
 
+def _tpu_reachable(timeout_s: float = 180.0) -> bool:
+    """Probe device initialization in a SUBPROCESS with a hard timeout.
+
+    The remote-TPU transport in this image can hang jax.devices()
+    indefinitely when the tunnel is down; probing in-process would wedge the
+    benchmark itself (and the backend lock, so no CPU fallback would be
+    possible afterward). A subprocess is killable and leaves this process's
+    jax untouched."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "import sys; sys.exit(0 if d else 1)"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return out.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--grid", type=int, default=400)
@@ -217,12 +239,22 @@ def main() -> int:
     ap.add_argument("--platform", choices=["cpu", "tpu"], default=None,
                     help="force a jax platform (the JAX_PLATFORMS env var is "
                          "overridden by this image's TPU plugin, so use this flag)")
+    ap.add_argument("--probe-timeout", type=float, default=180.0,
+                    help="seconds to wait for device init before falling back to CPU")
     args = ap.parse_args()
+
+    if args.platform is None and not _tpu_reachable(args.probe_timeout):
+        # Degrade rather than hang: a CPU measurement (flagged on stderr) is
+        # recordable; a wedged benchmark is not.
+        print("bench: device init unreachable within "
+              f"{args.probe_timeout:.0f}s; falling back to --platform cpu",
+              file=sys.stderr)
+        args.platform = "cpu"
 
     if args.platform:
         import jax
 
-        jax.config.update("jax_platforms", "cpu" if args.platform == "cpu" else None)
+        jax.config.update("jax_platforms", args.platform)
     import jax
 
     # Off-TPU the benchmarks run in f64; enable x64 or jnp.float64 silently
