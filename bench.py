@@ -83,37 +83,53 @@ def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
     }
 
 
-def bench_scale(grid_scale: int, quick: bool) -> dict:
+def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi") -> dict:
     """The BASELINE.json north star: a 1000x-finer asset grid than the
     reference's 400 points at equal wall-clock. Solves the household problem
-    on `grid_scale` points with the O(na) continuous-choice VFI (golden
-    section over a', closed-form power-grid locator) and reports its
-    wall-clock; vs_baseline = numpy-VFI-at-400 seconds / this, so >= 1.0
-    means the 1000x target is met or beaten."""
+    on `grid_scale` points with an O(na)-per-sweep solver — the
+    continuous-choice VFI (golden section over a', closed-form power-grid
+    locator) or EGM — and reports its wall-clock; vs_baseline =
+    numpy-VFI-at-400 seconds / this, so >= 1.0 means the 1000x target is met
+    or beaten."""
     import jax
     import jax.numpy as jnp
 
     from aiyagari_tpu.models.aiyagari import aiyagari_preset
     from aiyagari_tpu.solvers import numpy_backend as nb
+    from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
     from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
     from aiyagari_tpu.utils.firm import wage_from_r
 
     if quick:
         grid_scale = min(grid_scale, 40_000)   # 100x grid: fast smoke run
-    r, tol, max_iter = 0.04, 1e-5, 1000
+    r, tol, max_iter = 0.04, 1e-5, 2000
     platform = jax.default_backend()
     dtype = jnp.float32 if platform == "tpu" else jnp.float64
     model = aiyagari_preset(grid_size=grid_scale, dtype=dtype)
     w = float(wage_from_r(r, model.config.technology.alpha, model.config.technology.delta))
-    v0 = jnp.zeros((model.P.shape[0], grid_scale), dtype)
 
-    def run():
-        sol = solve_aiyagari_vfi_continuous(
-            v0, model.a_grid, model.s, model.P, r, w, model.amin,
-            sigma=model.preferences.sigma, beta=model.preferences.beta,
-            tol=tol, max_iter=max_iter, howard_steps=50, grid_power=2.0,
-        )
-        return sol
+    if scale_solver == "egm":
+        mean_s = float(jnp.mean(model.s))
+        C0 = jnp.broadcast_to(
+            ((1.0 + r) * model.a_grid + w * mean_s)[None, :],
+            (model.P.shape[0], grid_scale),
+        ).astype(dtype)
+
+        def run():
+            return solve_aiyagari_egm(
+                C0, model.a_grid, model.s, model.P, r, w, model.amin,
+                sigma=model.preferences.sigma, beta=model.preferences.beta,
+                tol=tol, max_iter=max_iter,
+            )
+    else:
+        v0 = jnp.zeros((model.P.shape[0], grid_scale), dtype)
+
+        def run():
+            return solve_aiyagari_vfi_continuous(
+                v0, model.a_grid, model.s, model.P, r, w, model.amin,
+                sigma=model.preferences.sigma, beta=model.preferences.beta,
+                tol=tol, max_iter=max_iter, howard_steps=50, grid_power=2.0,
+            )
 
     sol = run()
     float(sol.distance)   # compile+converge warmup, fenced
@@ -134,7 +150,7 @@ def bench_scale(grid_scale: int, quick: bool) -> dict:
     t_np = time.perf_counter() - t0
 
     return {
-        "metric": f"aiyagari_vfi_scale_grid{grid_scale}_wallclock",
+        "metric": f"aiyagari_{scale_solver}_scale_grid{grid_scale}_wallclock",
         "value": round(t_scale, 4),
         "unit": "seconds",
         "vs_baseline": round(t_np / t_scale, 2),
@@ -241,6 +257,8 @@ def main() -> int:
                          "overridden by this image's TPU plugin, so use this flag)")
     ap.add_argument("--probe-timeout", type=float, default=180.0,
                     help="seconds to wait for device init before falling back to CPU")
+    ap.add_argument("--scale-solver", choices=["vfi", "egm"], default="vfi",
+                    help="household solver for --metric scale")
     args = ap.parse_args()
 
     if args.platform is None and not _tpu_reachable(args.probe_timeout):
@@ -265,7 +283,7 @@ def main() -> int:
     if args.metric == "vfi":
         result = bench_aiyagari_vfi(args.grid, args.quick)
     elif args.metric == "scale":
-        result = bench_scale(args.grid_scale, args.quick)
+        result = bench_scale(args.grid_scale, args.quick, args.scale_solver)
     else:
         result = bench_ks_agents(args.quick)
     print(json.dumps(result))

@@ -233,6 +233,16 @@ class TestHistogramClosure:
         with pytest.raises(ValueError, match="closure"):
             solve_krusell_smith(SMALL, closure="exact")
 
+    def test_histogram_closure_with_egm_method(self):
+        # The closure is orthogonal to the household-solver method.
+        res = solve_krusell_smith(
+            SMALL, method="egm", solver=SOLVER_EGM,
+            alm=ALMConfig(T=120, population=100, discard=20, max_iter=1, seed=1),
+            closure="histogram",
+        )
+        assert res.mu is not None
+        assert float(np.min(res.r2)) > 0.999
+
 
 @pytest.mark.slow
 class TestKSIntegration:
