@@ -192,6 +192,19 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
     fig.savefig(out / "quintiles.png", dpi=120)
     plt.close(fig)
 
+    # Off-grid Euler-equation accuracy of the converged policies (Judd's
+    # consumption-equivalent E_EE, log10 scale) at unconstrained midpoints —
+    # an accuracy standard the reference lacks entirely.
+    from aiyagari_tpu.utils.accuracy import euler_equation_errors
+
+    prefs = model.preferences
+    log10e, mask = euler_equation_errors(
+        result.solution.policy_c, result.solution.policy_k,
+        model.a_grid, model.s, model.P, result.r, result.w, model.amin,
+        sigma=prefs.sigma, beta=prefs.beta,
+    )
+    ee = np.asarray(log10e)[np.asarray(mask)]
+
     summary = {
         "r_star": result.r,
         "wage": result.w,
@@ -203,6 +216,8 @@ def equilibrium_report(result, model, outdir, discard: int = 0) -> dict:
         "iterations": result.iterations,
         "gini": ginis,
         "quintile_shares_percent": shares.tolist(),
+        "euler_error_log10_mean": float(ee.mean()) if ee.size else None,
+        "euler_error_log10_max": float(ee.max()) if ee.size else None,
         "solve_seconds": result.solve_seconds,
     }
     (out / "summary.json").write_text(json.dumps(summary, indent=2))

@@ -1,0 +1,49 @@
+"""Solution-accuracy diagnostics: off-grid Euler-equation errors.
+
+The literature's standard check (Judd 1992; Den Haan 2010 for K-S): evaluate
+the converged policies *between* gridpoints and measure how far the
+intertemporal first-order condition u'(c) = beta (1+r) E[u'(c')] is from
+holding, in consumption units, log10 scale. The reference has no accuracy
+metric at all beyond eyeballing plots (SURVEY.md §4); here the residuals are
+a jitted device computation reported alongside the equilibrium statistics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.utils.utility import crra_marginal
+
+__all__ = ["euler_equation_errors"]
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta"))
+def euler_equation_errors(policy_c, policy_k, a_grid, s, P, r, w, amin, *,
+                          sigma: float, beta: float):
+    """Unit-free Euler residuals at asset-grid midpoints.
+
+    Returns (log10_errors [N, na-1], unconstrained_mask [N, na-1]) where the
+    error is |1 - u'^{-1}(beta (1+r) E[u'(c')]) / c| (consumption-equivalent
+    relative error; Judd's E_EE) and the mask marks points where the
+    borrowing constraint is slack (a' > amin), the only points at which the
+    Euler equation must hold with equality.
+    """
+    mid = 0.5 * (a_grid[:-1] + a_grid[1:])                       # [na-1]
+
+    c_mid = jax.vmap(lambda row: linear_interp(a_grid, row, mid))(policy_c)
+    k_mid = jax.vmap(lambda row: linear_interp(a_grid, row, mid))(policy_k)
+
+    # Next-period consumption at a' = k_mid for EVERY income state m: [N, N, na-1].
+    cp = jax.vmap(
+        lambda k_row: jax.vmap(lambda crow: linear_interp(a_grid, crow, k_row))(policy_c)
+    )(k_mid)
+    emu = jnp.einsum("im,imj->ij", P, crra_marginal(cp, sigma))  # [N, na-1]
+    c_implied = (beta * (1.0 + r) * emu) ** (-1.0 / sigma)
+    err = jnp.abs(1.0 - c_implied / jnp.maximum(c_mid, 1e-300))
+    log10_err = jnp.log10(jnp.maximum(err, 1e-16))
+    unconstrained = k_mid > amin + 1e-8
+    return log10_err, unconstrained

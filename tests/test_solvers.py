@@ -146,6 +146,23 @@ class TestBackendEquivalence:
 
 
 class TestEulerResiduals:
+    def test_accuracy_module_agrees_with_manual_residuals(self, model, egm_sol):
+        # The public euler_equation_errors API reports small errors for a
+        # converged EGM solution and flags the constrained region.
+        from aiyagari_tpu.utils.accuracy import euler_equation_errors
+
+        prefs = model.preferences
+        tech = model.config.technology
+        w = float(wage_from_r(R_TEST, tech.alpha, tech.delta))
+        log10e, mask = euler_equation_errors(
+            egm_sol.policy_c, egm_sol.policy_k, model.a_grid, model.s, model.P,
+            R_TEST, w, model.amin, sigma=prefs.sigma, beta=prefs.beta,
+        )
+        vals = np.asarray(log10e)[np.asarray(mask)]
+        assert vals.size > 0
+        assert vals.mean() < -3.0     # consumption-equivalent errors << 0.1%
+        assert np.asarray(mask).sum() < mask.size   # some points constrained
+
     def test_egm_euler_residual_small_offgrid(self, model, egm_sol):
         """At interior (unconstrained) states the Euler equation
         u'(c) = beta(1+r) E[u'(c')] should hold to high accuracy when policies
