@@ -18,6 +18,10 @@ from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.bellman import (
     bellman_step,
     bellman_step_labor,
+    bellman_step_labor_precomputed,
+    bellman_step_precomputed,
+    choice_utility_tensor,
+    labor_choice_utility_tensor,
     howard_eval_step,
     howard_eval_step_labor,
 )
@@ -74,10 +78,23 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
         _, _, dist, it = carry
         return (dist >= tol) & (it < max_iter)
 
+    # Dense path: the masked choice-utility tensor is loop-invariant, so
+    # compute it once here and keep only EV + add + max inside the while_loop
+    # (choice_utility_tensor docstring). Blocked/Pallas paths keep the fused
+    # per-sweep form — at their scales the [N, na, na'] tensor is the thing
+    # that must NOT be materialized.
+    na = v_init.shape[1]
+    dense = block_size <= 0 or block_size >= na
+    U = (choice_utility_tensor(a_grid, s, r, w, sigma=sigma, dtype=v_init.dtype)
+         if dense and not use_pallas else None)
+
     def body(carry):
         v, idx, _, it = carry
-        v_new, idx = bellman_step(v, a_grid, s, P, r, w, sigma=sigma, beta=beta,
-                                  block_size=block_size, use_pallas=use_pallas)
+        if U is not None:
+            v_new, idx = bellman_step_precomputed(v, U, P, beta=beta)
+        else:
+            v_new, idx = bellman_step(v, a_grid, s, P, r, w, sigma=sigma, beta=beta,
+                                      block_size=block_size, use_pallas=use_pallas)
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
         device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
@@ -216,11 +233,27 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: f
     def cond(carry):
         return (carry[3] >= tol) & (carry[4] < max_iter)
 
+    # Hoist the loop-invariant [nl, N, na, na'] joint-choice utility when it
+    # fits comfortably in HBM (reference scale: 10x7x400x400 f64 = 90 MB);
+    # beyond that fall back to the scanned per-labor form. Peak per-sweep
+    # memory is ~3x U4 (q = U4 + EV, plus the transpose copy for the flat
+    # argmax), so the cap budgets U4 itself at 128 MB.
+    N, na = v_init.shape
+    nl = labor_grid.shape[0]
+    U4 = None
+    if nl * N * na * na * jnp.dtype(v_init.dtype).itemsize <= 128 * 1024 ** 2:
+        U4 = labor_choice_utility_tensor(a_grid, labor_grid, s, r, w,
+                                         sigma=sigma, psi=psi, eta=eta,
+                                         dtype=v_init.dtype)
+
     def body(carry):
         v, a_idx, l_idx, _, it = carry
-        v_new, a_idx, l_idx = bellman_step_labor(
-            v, a_grid, labor_grid, s, P, r, w, sigma=sigma, beta=beta, psi=psi, eta=eta
-        )
+        if U4 is not None:
+            v_new, a_idx, l_idx = bellman_step_labor_precomputed(v, U4, P, beta=beta)
+        else:
+            v_new, a_idx, l_idx = bellman_step_labor(
+                v, a_grid, labor_grid, s, P, r, w, sigma=sigma, beta=beta, psi=psi, eta=eta
+            )
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
         device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)

@@ -25,41 +25,65 @@ import numpy as np
 def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
     import jax
     import jax.numpy as jnp
+    from functools import partial
 
-    from aiyagari_tpu.config import SolverConfig
-    from aiyagari_tpu.equilibrium.bisection import solve_household
     from aiyagari_tpu.models.aiyagari import aiyagari_preset
     from aiyagari_tpu.solvers import numpy_backend as nb
+    from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
     from aiyagari_tpu.utils.firm import wage_from_r
 
     r = 0.04
     tol, max_iter = 1e-5, 1000
-    # Howard policy-evaluation sweeps: same fixed point and identical policy
-    # (test_solvers pins VFI/EGM agreement; measured policy_k match to 1e-8),
-    # ~15x fewer Bellman improvement steps to the same tolerance. The NumPy
-    # baseline below stays the plain reference-faithful iteration.
-    solver = SolverConfig(method="vfi", tol=tol, max_iter=max_iter, howard_steps=50)
 
     # On-accelerator dtype: f32 on TPU (native), f64 elsewhere. The f32 path
     # uses the same absolute tolerance; convergence is verified below.
     platform = jax.default_backend()
     dtype = jnp.float32 if platform == "tpu" else jnp.float64
     model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    prefs = model.preferences
+    w = float(wage_from_r(r, model.config.technology.alpha, model.config.technology.delta))
+    v0 = jnp.zeros((model.P.shape[0], grid_size), dtype)
 
-    # Accelerated path: warmup (compile), then timed run from a cold value fn.
-    # Timing fence: a scalar device->host transfer (block_until_ready alone
-    # does not reliably fence on the remote/experimental TPU transport).
-    sol = solve_household(model, r, solver=solver)
-    float(sol.distance)
-    reps = 1 if quick else 3
+    # Amortized timing: the dev/bench TPU here is reached over an experimental
+    # remote transport whose per-call round trip (~100 ms measured) dwarfs the
+    # device time of a reference-scale solve (~3 ms). Chain `reps` full
+    # cold-start solves inside ONE jitted program — each solve's v_init
+    # data-depends on the previous solve's result (v0 + 0*prev, which XLA
+    # cannot fold away: 0*NaN != 0), so all `reps` fixed points execute
+    # sequentially on device — fetch once, and report wall-clock / reps.
+    # Every solve runs from v=0 to the reference's criterion max|dv| < 1e-5
+    # (Aiyagari_VFI.m:49-50,85). Solver config is platform-adaptive — measured
+    # on this image: on TPU the plain dense sweep (reference-faithful operator
+    # sequence, same as the NumPy baseline) is fastest (~3 ms/solve; Howard's
+    # policy-gather sweeps cost more than they save); on CPU 50 Howard
+    # policy-evaluation sweeps per improvement are a 14x win (0.08 s vs
+    # 1.1 s). Both reach the identical fixed point (pinned by test_solvers).
+    howard = 0 if platform == "tpu" else 50
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def chained(v_init, *, reps):
+        def one(carry, _):
+            sol = solve_aiyagari_vfi(
+                v_init + 0.0 * carry, model.a_grid, model.s, model.P, r, w,
+                sigma=prefs.sigma, beta=prefs.beta, tol=tol, max_iter=max_iter,
+                howard_steps=howard)
+            return sol.distance.astype(v_init.dtype), (sol.iterations, sol.distance)
+        carry, (its, dists) = jax.lax.scan(
+            one, jnp.array(0.0, v_init.dtype), None, length=reps)
+        return its[-1], dists[-1]
+
+    reps = (10 if quick else 50) if platform == "tpu" else (2 if quick else 5)
+    out = chained(v0, reps=reps)
+    float(out[1])                     # compile + converge warmup, fenced
     times = []
-    for _ in range(reps):
+    for _ in range(1 if quick else 3):
         t0 = time.perf_counter()
-        sol = solve_household(model, r, solver=solver)
-        float(sol.distance)
+        out = chained(v0, reps=reps)
+        float(out[1])                 # scalar transfer = timing fence
         times.append(time.perf_counter() - t0)
-    t_jax = min(times)
-    iters_jax = int(sol.iterations)
+    t_jax = min(times) / reps
+    iters_jax = int(out[0])
+    assert float(out[1]) < tol, "accelerated path failed to converge"
 
     # Baseline: vectorized NumPy, same scale, f64.
     a = np.asarray(model.a_grid, np.float64)
