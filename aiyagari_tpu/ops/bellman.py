@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from aiyagari_tpu.utils.utility import crra_utility, labor_disutility
 
 __all__ = [
+    "expectation",
     "bellman_step",
     "bellman_step_labor",
     "choice_utility_tensor",
@@ -32,6 +33,15 @@ __all__ = [
 
 def _neg_inf(dtype):
     return jnp.array(-jnp.inf, dtype)
+
+
+def expectation(P, v, beta: float):
+    """EV = beta * P @ v at HIGHEST precision. The TPU default f32 matmul is
+    a single bf16 pass — measured 0.5 absolute error on values O(100), which
+    a Howard-accelerated fixed point amplifies by ~1/(1-beta) and never
+    converges below. These [N,N]x[N,na] matmuls are a negligible share of
+    sweep cost, so the 6-pass f32 form is free insurance."""
+    return beta * jnp.matmul(P, v, precision=jax.lax.Precision.HIGHEST)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "block_size", "use_pallas"))
@@ -51,7 +61,7 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size
     kernel (ops/pallas_bellman.py; interpreted off-TPU).
     """
     N, na = v.shape
-    EV = beta * P @ v                                     # [N, na']
+    EV = expectation(P, v, beta)                          # [N, na']
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
 
     if use_pallas:
@@ -116,7 +126,7 @@ def bellman_step_precomputed(v, U, P, *, beta: float):
     """Bellman sweep given the precomputed choice-utility tensor: one MXU
     matmul (EV) + a broadcast add + a trailing-axis max. Identical fixed point
     to bellman_step (pinned by test_solvers), ~3x less per-sweep compute."""
-    EV = beta * P @ v
+    EV = expectation(P, v, beta)
     q = U + EV[:, None, :]
     return jnp.max(q, axis=-1), jnp.argmax(q, axis=-1).astype(jnp.int32)
 
@@ -143,7 +153,7 @@ def bellman_step_labor_precomputed(v, U4, P, *, beta: float):
     joint-choice tensor: EV matmul + broadcast add + one flattened argmax over
     (l, a'). Same fixed point and tie order as bellman_step_labor."""
     nl, N, na, nap = U4.shape
-    EV = beta * P @ v                                            # [N, na']
+    EV = expectation(P, v, beta)                                 # [N, na']
     q = U4 + EV[None, :, None, :]                                # [nl, N, na, na']
     flat = q.transpose(1, 2, 0, 3).reshape(N, na, nl * nap)      # l-major choice
     best_flat = jnp.argmax(flat, axis=-1).astype(jnp.int32)
@@ -162,7 +172,7 @@ def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma: float, beta:
     one [N, na, na'] block per labor point.
     """
     N, na = v.shape
-    EV = beta * P @ v                                      # [N, na']
+    EV = expectation(P, v, beta)                           # [N, na']
     base = (1.0 + r) * a_grid[None, :]                     # [N=1 broadcast, na]
 
     def per_labor(carry, l_val):
@@ -196,7 +206,7 @@ def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma: float, beta:
 def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma: float, beta: float):
     """Policy-evaluation sweep at a fixed discrete policy (Howard acceleration):
     v <- u(c_pol) + beta * (P @ v) gathered at the policy indices."""
-    EV = beta * P @ v                                      # [N, na']
+    EV = expectation(P, v, beta)                           # [N, na']
     ap = a_grid[policy_idx]                                # [N, na]
     c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - ap
     u = crra_utility(jnp.maximum(c, 1e-300), sigma)
@@ -207,7 +217,7 @@ def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma: float, beta: f
 def howard_eval_step_labor(v, policy_a_idx, policy_l_idx, a_grid, labor_grid, s, P, r, w, *,
                            sigma: float, beta: float, psi: float, eta: float):
     """Howard evaluation sweep for the endogenous-labor discrete policy."""
-    EV = beta * P @ v
+    EV = expectation(P, v, beta)
     ap = a_grid[policy_a_idx]
     lv = labor_grid[policy_l_idx]
     c = (1.0 + r) * a_grid[None, :] + w * lv * s[:, None] - ap

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.utils.utility import (
     crra_marginal,
     crra_marginal_inverse,
@@ -38,14 +39,24 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
       5. clamp at the borrowing limit
       6. consumption from the budget constraint
     """
-    RHS = beta * (1.0 + r) * (P @ crra_marginal(C, sigma))        # [N, na]
+    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)        # [N, na]
     c_next = crra_marginal_inverse(RHS, sigma)                    # [N, na]
     a_hat = (c_next + a_grid[None, :] - w * s[:, None]) / (1.0 + r)
 
-    # a_hat is increasing in a' (c_next is), so linear interp + extrapolation
-    # matches interp1(a_hat, a_grid, a_grid, 'linear', 'extrap') at :95.
+    # a_hat is increasing in a' (c_next is) in exact arithmetic, so linear
+    # interp + extrapolation matches interp1(a_hat, a_grid, a_grid, 'linear',
+    # 'extrap') at :95. In f32 at 100k+-point grids rounding breaks that
+    # monotonicity locally and searchsorted then lands in arbitrary buckets;
+    # the running max restores sorted knots (exact no-op in f64).
+    a_hat = jax.lax.associative_scan(jnp.maximum, a_hat, axis=1)
     policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
-    policy_k = jnp.maximum(policy_k, amin)                        # :98
+    # Clamp to the grid top as well as the borrowing limit: above the last
+    # endogenous knot the reference extrapolates linearly, but over a long
+    # extrapolation range f32 noise in the edge-segment slope feeds back
+    # through the Euler RHS and the iteration never settles (measured at grid
+    # 40k, f32: oscillation O(10)); truncating at amax matches the discrete
+    # VFI solver's choice set.
+    policy_k = jnp.clip(policy_k, amin, a_grid[-1])               # :98
     C_new = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
     return C_new, policy_k
 
@@ -65,15 +76,44 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float, ps
     (:99) rather than amin.
     """
     ws = w * s[:, None]                                            # [N, 1]
-    RHS = beta * (1.0 + r) * (P @ crra_marginal(C, sigma))
+    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)
     c_next = crra_marginal_inverse(RHS, sigma)
     l_endo = labor_foc_inverse(ws * crra_marginal(c_next, sigma), psi, eta)   # :86
     a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)              # :87
 
-    # Interpolate the consumption (not asset) policy onto the exogenous grid (:90).
-    g_c = jax.vmap(lambda ah, cn: linear_interp(ah, cn, a_grid))(a_hat, c_next)
+    # Interpolate the consumption (not asset) policy onto the exogenous grid
+    # (:90). Same f32 monotonicity insurance as egm_step (no-op in f64), and
+    # the same grid-top discipline: queries above the last endogenous knot
+    # take that knot's consumption (nearest) instead of riding the edge
+    # segment's slope — unbounded linear extrapolation of g_c feeds straight
+    # back into the next Euler RHS and oscillates at O(0.1) on f32 fine grids
+    # (measured at 20k points; cf. egm_step's asset-policy variant).
+    a_hat = jax.lax.associative_scan(jnp.maximum, a_hat, axis=1)
+    q = jnp.minimum(a_grid[None, :], a_hat[:, -1:])
+    g_c = jax.vmap(linear_interp)(a_hat, c_next, q)
+
+    # Constrained region: below the first endogenous knot the borrowing
+    # constraint binds (a' = amin), so solve the static intratemporal system
+    #   c = (1+r)a + w s l - amin,   l = ((w s u'(c))/psi)^(1/eta)
+    # by damped fixed point. The reference linearly extrapolates g_c there
+    # instead (correct to first order at 400 points, f64), but on f32 fine
+    # grids the first-segment slope is rounding noise and the extrapolated
+    # consumption oscillates O(0.5) through the Euler RHS — measured at 20k
+    # points, state 0, before this replacement.
+    c_eps = jnp.asarray(1e-6, g_c.dtype)
+    base = (1.0 + r) * a_grid[None, :] - amin
+
+    def _c_iter(c, _):
+        l = labor_foc_inverse(ws * crra_marginal(c, sigma), psi, eta)
+        return 0.5 * c + 0.5 * jnp.maximum(base + ws * l, c_eps), None
+
+    c_con, _ = jax.lax.scan(_c_iter, jnp.maximum(base + ws, c_eps), None, length=24)
+    g_c = jnp.where(a_grid[None, :] < a_hat[:, :1], c_con, g_c)
+
     g_c = jnp.where(a_grid[None, :] < amin, amin, g_c)                        # :91
     policy_l = labor_foc_inverse(ws * crra_marginal(g_c, sigma), psi, eta)    # :95
     policy_k = (1.0 + r) * a_grid[None, :] + ws * policy_l - g_c              # :98
-    policy_k = jnp.maximum(policy_k, 0.0)                                     # :99
+    # Floored at 0 per the reference quirk (:99); capped at the grid top like
+    # every other solver in this framework (ops/egm.egm_step rationale).
+    policy_k = jnp.clip(policy_k, 0.0, a_grid[-1])
     return g_c, policy_k, policy_l

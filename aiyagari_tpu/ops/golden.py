@@ -8,12 +8,13 @@ iteration instead of 1,600 scalar optimizations per improvement step.
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["golden_section_max"]
+__all__ = ["golden_section_max", "unimodal_argmax_index"]
 
 _INVPHI = 0.6180339887498949   # (sqrt(5)-1)/2
 _INVPHI2 = 0.3819660112501051  # (3-sqrt(5))/2
@@ -52,3 +53,64 @@ def golden_section_max(f: Callable, lo: jnp.ndarray, hi: jnp.ndarray, n_iters: i
 
     lo, hi, *_ = jax.lax.fori_loop(0, n_iters, body, (lo, hi, x1, x2, f1, f2))
     return 0.5 * (lo + hi)
+
+
+def unimodal_argmax_index(f: Callable, hi_idx: jnp.ndarray, n_knots: int,
+                          branch: int = 32, lo_idx=None) -> jnp.ndarray:
+    """Batched coarse-to-fine argmax of a unimodal-in-index objective over
+    integer indices [lo_idx, hi_idx] (inclusive, elementwise; lo_idx
+    defaults to 0).
+
+    f maps int32 index arrays of hi_idx's shape to objective values of the
+    same shape (candidate axes are vmapped over it here); it should be
+    unimodal in the index at every point — satisfied by the Bellman choice
+    objective u(coh - a'_j) + EV_j when u is concave and the continuation
+    value is concave in a' (the standard Aiyagari case).
+
+    Each level samples `branch` evenly spaced candidates in the current
+    bracket, keeps the best, and shrinks the bracket to +/- one sample
+    spacing around it — depth log_{(branch-1)/2}(n) levels, O(na log na)
+    work per Bellman sweep instead of the dense search's O(na^2).
+
+    Why value sampling and not bisection on the rising-difference predicate:
+    near the optimum the objective is flat below f32 resolution, and a
+    predicate chain that only ever compares ADJACENT cells random-walks into
+    regions hundreds of ulps below the max (measured: 2.6e-4 value error at
+    grid 400, f32 — fatal for a 1e-5 tolerance). Sampling compares actual
+    objective values across the whole bracket at every level, so like the
+    dense argmax its value error is bounded at the rounding level of single
+    evaluations, in f32 and f64 alike.
+    """
+    if branch < 5:
+        # The bracket shrinks to 2*ceil(span/(branch-1)) per level, which is
+        # non-contractive for branch <= 4 — the final pass would then cover
+        # only `branch` of a still-wide bracket and return garbage.
+        raise ValueError(f"branch must be >= 5, got {branch}")
+    per_level = max(2, (branch - 1) // 2)
+    depth = max(1, int(math.ceil(math.log(max(n_knots, 2)) / math.log(per_level))))
+    ks = jnp.arange(branch, dtype=jnp.int32)
+    fb = jax.vmap(f, in_axes=-1, out_axes=-1)
+
+    floor = jnp.zeros_like(hi_idx) if lo_idx is None else jnp.broadcast_to(
+        lo_idx, hi_idx.shape
+    ).astype(hi_idx.dtype)
+    hi_idx = jnp.maximum(hi_idx, floor)     # degenerate ranges collapse to floor
+    lo = floor
+    hi = hi_idx
+    for _ in range(depth):
+        span = hi - lo                                            # >= 0
+        cand = lo[..., None] + (ks * span[..., None]) // (branch - 1)
+        vals = fb(cand)
+        best = jnp.take_along_axis(
+            cand, jnp.argmax(vals, axis=-1)[..., None], axis=-1
+        )[..., 0]
+        spacing = (span + (branch - 2)) // (branch - 1)           # ceil, >= 0
+        lo = jnp.maximum(best - spacing, floor)
+        hi = jnp.minimum(best + spacing, hi_idx)
+    # Final bracket has width <= 2 spacings of the last level (<= 2 for any
+    # depth chosen above); one last dense pass over it.
+    cand = jnp.minimum(lo[..., None] + ks, hi[..., None])
+    vals = fb(cand)
+    return jnp.take_along_axis(
+        cand, jnp.argmax(vals, axis=-1)[..., None], axis=-1
+    )[..., 0]

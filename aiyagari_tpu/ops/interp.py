@@ -129,12 +129,16 @@ def linear_interp(x: jnp.ndarray, y: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray
     beyond both ends using the edge segments (interp1 'linear','extrap').
 
     x must be sorted ascending, shape [n]; y shape [..., n] broadcasting over
-    leading axes; q any shape.
+    leading axes; q any shape. Zero-width intervals (possible when x is a
+    data-dependent grid whose adjacent knots collide at f32 resolution — the
+    EGM endogenous grid at 100k+ points does this) return the left knot value
+    instead of 0/0 = NaN.
     """
     idx = bucket_index(x, q)
     x0 = x[idx]
     x1 = x[idx + 1]
-    t = (q - x0) / (x1 - x0)
+    dx = x1 - x0
+    t = jnp.where(dx > 0, (q - x0) / jnp.where(dx > 0, dx, 1.0), 0.0)
     y0 = jnp.take(y, idx, axis=-1)
     y1 = jnp.take(y, idx + 1, axis=-1)
     return y0 * (1.0 - t) + y1 * t
@@ -151,7 +155,8 @@ def linear_interp_rows(x: jnp.ndarray, Y: jnp.ndarray, q: jnp.ndarray) -> jnp.nd
     idx = bucket_index(x, q)
     x0 = x[idx]
     x1 = x[idx + 1]
-    t = (q - x0) / (x1 - x0)
+    dx = x1 - x0
+    t = jnp.where(dx > 0, (q - x0) / jnp.where(dx > 0, dx, 1.0), 0.0)
     y0 = jnp.take_along_axis(Y, idx[:, None], axis=1)[:, 0]
     y1 = jnp.take_along_axis(Y, (idx + 1)[:, None], axis=1)[:, 0]
     return y0 * (1.0 - t) + y1 * t

@@ -73,7 +73,8 @@ def distribution_step(mu, idx, w_lo, P):
         .at[rows, idx].add(mu * w_lo)
         .at[rows, idx + 1].add(mu * (1.0 - w_lo))
     )
-    return P.T @ mu_a
+    # HIGHEST precision: the bf16 default would leak mass at ~1e-3
+    return jnp.matmul(P.T, mu_a, precision=jax.lax.Precision.HIGHEST)
 
 
 @partial(jax.jit, static_argnames=("tol", "max_iter"))

@@ -113,7 +113,7 @@ def _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, mean_fn)
     return K_ts, k_population
 
 
-@partial(jax.jit, static_argnames=("T",), donate_argnames=("k_population",))
+@partial(jax.jit, static_argnames=("T",))
 def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, *, T: int):
     """Step the agent panel through T-1 periods under the policy k_opt
     [ns, nK, nk]; returns (K_ts [T], k_population_final).
@@ -121,6 +121,11 @@ def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population
     The agent axis (k_population, eps_panel columns) may be sharded across
     devices; the mean lowers to a psum over ICI (implicitly, via GSPMD — see
     simulate_capital_path_shardmap for the explicit-collective form).
+
+    k_population is NOT donated: callers legitimately reuse the same initial
+    cross-section across runs (e.g. to compare this path against the
+    shard_map variant), and donating a [pop]-sized buffer saves nothing
+    next to the [T, pop] shock panel.
     """
     return _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, jnp.mean)
 

@@ -78,7 +78,7 @@ def _expected_value(kp, V_next, slopes, P, k_grid):
     def per_point(kp_row, V_row, d_row, P_row):
         # kp_row [nk]; V_row/d_row [ns, nk]; P_row [ns]
         vals = jax.vmap(lambda v, d: pchip_interp(k_grid, v, kp_row, d))(V_row, d_row)
-        return P_row @ vals                        # [nk]
+        return jnp.matmul(P_row, vals, precision=jax.lax.Precision.HIGHEST)  # [nk]
 
     return jax.vmap(jax.vmap(per_point, in_axes=(0, 0, 0, None)), in_axes=(0, 0, 0, 0))(
         kp, V_next, slopes, P

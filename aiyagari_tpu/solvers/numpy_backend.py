@@ -82,14 +82,16 @@ def egm_numpy(C, a_grid, s, P, r, w, amin, *, sigma, beta, tol, max_iter):
         a_hat = (c_next + a_grid[None, :] - w * s[:, None]) / (1.0 + r)
         for j in range(len(s)):
             policy_k[j] = np.interp(a_grid, a_hat[j], a_grid)
-            lo, hi = a_hat[j, 0], a_hat[j, -1]
-            below, above = a_grid < lo, a_grid > hi
-            # np.interp clamps; extend linearly like interp1(...,'extrap').
+            lo = a_hat[j, 0]
+            below = a_grid < lo
+            # np.interp clamps; extend the bottom linearly like
+            # interp1(...,'extrap'). Above the last endogenous knot the
+            # policy is truncated at the grid top instead — the discrete
+            # VFI choice set, matching ops/egm.egm_step (where unbounded
+            # extrapolation is an f32 stability hazard at fine grids).
             sl_lo = (a_grid[1] - a_grid[0]) / (a_hat[j, 1] - a_hat[j, 0])
-            sl_hi = (a_grid[-1] - a_grid[-2]) / (a_hat[j, -1] - a_hat[j, -2])
             policy_k[j, below] = a_grid[0] + (a_grid[below] - lo) * sl_lo
-            policy_k[j, above] = a_grid[-1] + (a_grid[above] - hi) * sl_hi
-        policy_k = np.maximum(policy_k, amin)
+        policy_k = np.clip(policy_k, amin, a_grid[-1])
         C_new = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
         dist = np.max(np.abs(C_new - C))
         C = C_new
@@ -131,7 +133,13 @@ def vfi_labor_numpy(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, eta,
 
 
 def egm_labor_numpy(C, a_grid, s, P, r, w, amin, *, sigma, beta, psi, eta, tol, max_iter):
-    """Vectorized NumPy endogenous-labor EGM (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+    """Vectorized NumPy endogenous-labor EGM (Aiyagari_Endogenous_Labor_EGM.m:67-107).
+
+    Keeps the reference's linear extrapolation of g_c below the first
+    endogenous knot (stable in f64 at reference scale); the JAX kernel
+    (ops/egm.egm_step_labor) instead solves the constrained static problem
+    there exactly — the two backends agree on the grid interior only.
+    """
     it = 0
     policy_k = np.zeros_like(C)
     policy_l = np.zeros_like(C)
@@ -143,16 +151,19 @@ def egm_labor_numpy(C, a_grid, s, P, r, w, amin, *, sigma, beta, psi, eta, tol, 
         a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)
         g_c = np.empty_like(C)
         for j in range(len(s)):
+            # np.interp clamps at both ends; extend the bottom linearly like
+            # interp1(...,'extrap'), keep the nearest-value top (matches
+            # ops/egm.egm_step_labor's grid-top discipline).
             g_c[j] = np.interp(a_grid, a_hat[j], c_next[j])
-            lo, hi = a_hat[j, 0], a_hat[j, -1]
-            below, above = a_grid < lo, a_grid > hi
+            lo = a_hat[j, 0]
+            below = a_grid < lo
             sl_lo = (c_next[j, 1] - c_next[j, 0]) / (a_hat[j, 1] - a_hat[j, 0])
-            sl_hi = (c_next[j, -1] - c_next[j, -2]) / (a_hat[j, -1] - a_hat[j, -2])
             g_c[j, below] = c_next[j, 0] + (a_grid[below] - lo) * sl_lo
-            g_c[j, above] = c_next[j, -1] + (a_grid[above] - hi) * sl_hi
         g_c = np.where(a_grid[None, :] < amin, amin, g_c)
         policy_l = (ws * g_c ** (-sigma) / psi) ** (1.0 / eta)
-        policy_k = np.maximum((1.0 + r) * a_grid[None, :] + ws * policy_l - g_c, 0.0)
+        policy_k = np.clip(
+            (1.0 + r) * a_grid[None, :] + ws * policy_l - g_c, 0.0, a_grid[-1]
+        )
         dist = np.max(np.abs(g_c - C))
         C = g_c
         if dist < tol:

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.bellman import (
+    expectation,
     bellman_step,
     bellman_step_labor,
     bellman_step_labor_precomputed,
@@ -120,28 +121,36 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
                                   howard_steps: int = 20, golden_iters: int = 48,
                                   relative_tol: bool = False,
                                   grid_power: float = 0.0) -> VFISolution:
-    """Continuous-choice VFI: golden-section maximization of
-    u(coh - a') + interp(EV, a') over a' in [amin, coh), vmapped over all
-    (state, asset) points — O(na) per sweep instead of the discrete search's
+    """Scalable VFI: coarse-to-fine maximization of u(coh - a'_j) + EV_j over
+    grid *indices* j (ops/golden.unimodal_argmax_index), followed by one
+    continuous golden-section refinement of the converged policy within its
+    bracketing cells — O(na log na) per sweep instead of the dense search's
     O(na^2), so it scales to grids 1000x the reference's 400 points.
 
+    Why index search and not continuous golden section inside the loop: near
+    the top of the grid the objective is extremely flat (u'(c) ~ c^-sigma at
+    c ~ O(100) is below f32 resolution of a value ~O(40)), so a continuous
+    maximizer jitters by whole grid cells from sweep to sweep and the value
+    iteration stalls around 1e-2 — measured on this image at grid 400, f32.
+    Grid candidates, ranked by direct value comparison at every level of the
+    coarse-to-fine search, behave like the dense discrete argmax (value error
+    bounded at evaluation-rounding level), so this path converges to the
+    dense search's fixed point (pinned by TestContinuousVFI) in f32 and f64
+    alike.
+
     This is the same solver family as the Krusell-Smith Howard VFI
-    (solvers/ks_vfi.py, replacing Krusell_Smith_VFI.m:141-204's fminbnd);
-    here applied to the Aiyagari block. EV is interpolated linearly in a'
-    (concavity-safe); Howard evaluation sweeps amortize each improvement.
-    Returns a VFISolution whose policy_idx is the nearest-grid snap of the
-    continuous policy.
+    (solvers/ks_vfi.py, replacing Krusell_Smith_VFI.m:141-204's fminbnd).
+    Howard evaluation sweeps amortize each improvement. golden_iters > 0
+    enables the final in-cell continuous refine of the returned policy
+    (policy_k/policy_c move off-grid; v and policy_idx stay the discrete
+    fixed point); golden_iters = 0 returns the pure grid solution.
     """
-    from aiyagari_tpu.ops.golden import golden_section_max
+    from aiyagari_tpu.ops.golden import golden_section_max, unimodal_argmax_index
     from aiyagari_tpu.ops.interp import bucket_index, power_bucket_index
     from aiyagari_tpu.utils.utility import crra_utility as _u
 
     N, na = v_init.shape
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]          # [N, na]
-    # Choice set [amin, min(coh, amax)]: capped at the top knot so the search
-    # never optimizes against linearly-extrapolated continuation values (the
-    # discrete solver truncates at the grid top the same way).
-    hi_choice = jnp.clip(coh - 1e-10, amin, a_grid[-1])
 
     def locate(q):
         # grid_power > 0 means a_grid is power-spaced: O(1) closed-form
@@ -150,40 +159,64 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
             return power_bucket_index(a_grid, q, a_grid[0], a_grid[-1], grid_power)
         return bucket_index(a_grid, q)
 
-    def interp_weights(ap):
-        idx = locate(ap)                                         # [N, na]
-        x0 = a_grid[idx]
-        t = (ap - x0) / (a_grid[idx + 1] - x0)
-        return idx, t
+    # Dtype- and sigma-aware consumption floor. Two constraints: it must not
+    # underflow to 0.0 (u(0) = -inf), and u(c_floor) = c_floor^(1-sigma)/
+    # (1-sigma) must stay FINITE — an infinite penalty at a state with no
+    # feasible choice (possible when the grid extends below the borrowing
+    # limit) makes v = -inf there and the convergence distance NaN. Pick the
+    # largest floor that bounds the penalty near the dtype max.
+    # (For 1 < sigma < 2 the overflow bound itself underflows past tiny —
+    # e.g. sigma=1.5, f32: 3e-77 -> 0.0 — so floor it at tiny as well.)
+    fin = jnp.finfo(v_init.dtype)
+    if sigma > 1.0:
+        c_floor = max(4.0 * float(fin.max) ** (-1.0 / (sigma - 1.0)),
+                      float(fin.tiny))
+    else:
+        c_floor = float(fin.tiny)
 
-    def ev_at(EV, idx, t):
-        e0 = jnp.take_along_axis(EV, idx, axis=1)
-        e1 = jnp.take_along_axis(EV, idx + 1, axis=1)
-        return e0 * (1.0 - t) + e1 * t
+    # Feasible choice indices [lo_idx, hi_idx]: lo_idx is the smallest j with
+    # a_grid[j] >= amin (the grid may extend below the borrowing limit);
+    # hi_idx the largest j with a_grid[j] < coh (c > 0), so the search never
+    # ranks points inside the clamped-consumption penalty region (where the
+    # objective turns non-unimodal). Computed once per solve.
+    lo_idx = jnp.minimum(jnp.sum(a_grid < amin), na - 1).astype(jnp.int32)
+    loc = locate(coh)                                            # [N, na] in [0, na-2]
+    hi_idx = jnp.where(
+        a_grid[loc + 1] < coh, loc + 1,
+        jnp.where(a_grid[loc] < coh, loc, jnp.maximum(loc - 1, 0)),
+    ).astype(jnp.int32)
+    hi_idx = jnp.maximum(hi_idx, lo_idx)
 
-    # Dtype-aware consumption floor: a literal like 1e-300 underflows to 0.0
-    # in f32 and would turn the infeasibility penalty into u(0) = -inf.
-    c_floor = jnp.finfo(v_init.dtype).tiny
+    def choice_value(EV, j):
+        c = jnp.maximum(coh - a_grid[j], c_floor)
+        return _u(c, sigma) + jnp.take_along_axis(EV, j, axis=1)
 
-    def value_given_ev(EV, ap):
-        idx, t = interp_weights(ap)
-        c = jnp.maximum(coh - ap, c_floor)
-        return _u(c, sigma) + ev_at(EV, idx, t)
+    # Dense re-scan window around the binary-search result: absorbs the small
+    # non-unimodality the discrete upper envelope introduces near kinks (the
+    # search needs unimodal f; Tv on a grid is only concave up to cell-level
+    # envelope error). 17 extra batched evaluations per improvement.
+    _W = 8
 
     def improve(v):
-        EV = beta * P @ v   # hoisted: one expectation matmul per improvement
-        f = lambda ap: value_given_ev(EV, ap)
-        lo = jnp.full_like(coh, amin)
-        return golden_section_max(f, lo, hi_choice, n_iters=golden_iters)
+        EV = expectation(P, v, beta)   # hoisted: one per improvement
+        f = lambda j: choice_value(EV, j)
+        idx0 = unimodal_argmax_index(f, hi_idx, na, lo_idx=lo_idx)
+        offs = jnp.arange(-_W, _W + 1, dtype=jnp.int32)
+        cand = jnp.clip(idx0[:, :, None] + offs, lo_idx, hi_idx[:, :, None])  # [N, na, 2W+1]
+        vals = jax.vmap(f, in_axes=2, out_axes=2)(cand)
+        return jnp.take_along_axis(
+            cand, jnp.argmax(vals, axis=2)[:, :, None], axis=2
+        )[:, :, 0]
 
-    def howard(v, pol):
-        # The policy is fixed across sweeps: locate it once, re-gather EV only.
-        idx, t = interp_weights(pol)
-        u_pol = _u(jnp.maximum(coh - pol, c_floor), sigma)
+    def evaluate(v, idx):
+        # Howard policy evaluation: the policy is fixed across sweeps, at
+        # exact grid points — no interpolation, just an expectation matmul
+        # and a row gather per sweep.
+        u_pol = _u(jnp.maximum(coh - a_grid[idx], c_floor), sigma)
 
         def sweep(v, _):
-            EV = beta * P @ v
-            return u_pol + ev_at(EV, idx, t), None
+            EV = expectation(P, v, beta)
+            return u_pol + jnp.take_along_axis(EV, idx, axis=1), None
 
         v, _ = jax.lax.scan(sweep, v, None, length=max(howard_steps, 1))
         return v
@@ -194,17 +227,40 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
 
     def body(carry):
         v, _, _, it = carry
-        pol = improve(v)
-        v_new = howard(v, pol)
+        idx = improve(v)
+        v_new = evaluate(v, idx)
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
-        return v_new, pol, dist, it + 1
+        return v_new, idx, dist, it + 1
 
-    init = (v_init, jnp.zeros_like(coh), jnp.array(jnp.inf, v_init.dtype), jnp.int32(0))
-    v, policy_k, dist, it = jax.lax.while_loop(cond, body, init)
-    policy_c = coh - policy_k
-    idx = bucket_index(a_grid, policy_k, hi_clip=na - 1)
-    return VFISolution(v, idx.astype(jnp.int32), policy_k, policy_c,
+    init = (v_init, jnp.zeros(coh.shape, jnp.int32),
+            jnp.array(jnp.inf, v_init.dtype), jnp.int32(0))
+    v, idx, dist, it = jax.lax.while_loop(cond, body, init)
+
+    policy_k = a_grid[idx]
+    if golden_iters > 0:
+        # One continuous refinement within the bracketing cells of the
+        # converged discrete policy: the interval is at most two cells wide,
+        # so f32 flatness jitter is bounded by the grid resolution the
+        # discrete solution already has — it can only improve the policy.
+        EV = expectation(P, v, beta)
+
+        def f_cont(ap):
+            j = locate(ap)
+            t = (ap - a_grid[j]) / (a_grid[j + 1] - a_grid[j])
+            e0 = jnp.take_along_axis(EV, j, axis=1)
+            e1 = jnp.take_along_axis(EV, j + 1, axis=1)
+            c = jnp.maximum(coh - ap, c_floor)
+            return _u(c, sigma) + e0 * (1.0 - t) + e1 * t
+
+        lo_r = jnp.maximum(a_grid[jnp.maximum(idx - 1, 0)], amin)
+        hi_r = jnp.maximum(
+            jnp.minimum(a_grid[jnp.minimum(idx + 1, na - 1)], coh), lo_r
+        )
+        policy_k = golden_section_max(f_cont, lo_r, hi_r, n_iters=golden_iters)
+
+    policy_c = jnp.maximum(coh - policy_k, c_floor)
+    return VFISolution(v, idx, policy_k, policy_c,
                        jnp.ones_like(policy_k), it, dist)
 
 

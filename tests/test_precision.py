@@ -79,3 +79,138 @@ class TestMarginalUtilityInversion:
         up = crra_marginal(c, 5.0)
         assert bool(jnp.all(jnp.isfinite(up)))
         assert bool(jnp.all(jnp.isfinite(crra_marginal_inverse(up, 5.0))))
+
+
+class TestFineGridF32:
+    """Regressions for the fine-grid f32 failure modes measured on TPU:
+    (a) the default TPU f32 matmul is a single bf16 pass with ~0.5 absolute
+    error on values O(100) — expectation() pins HIGHEST precision;
+    (b) the EGM endogenous grid loses monotonicity/extrapolates unstably at
+    100k+ points in f32 — egm_step monotonizes knots and truncates the policy
+    at the grid top;
+    (c) continuous golden-section argmax jitters by whole cells on the flat
+    choice objective — the coarse-to-fine index search ranks candidates by
+    direct value comparison instead."""
+
+    def test_index_argmax_matches_brute_force(self):
+        # Concave objective with a per-point feasibility bound, both dtypes.
+        from aiyagari_tpu.ops.golden import unimodal_argmax_index
+
+        n = 700
+        rng = np.random.default_rng(0)
+        peak = rng.uniform(50, 650, size=(5, 40))
+        hi = np.minimum((peak + rng.uniform(0, 300, peak.shape)).astype(np.int32), n - 1)
+        for dtype in (jnp.float32, jnp.float64):
+            peak_j = jnp.asarray(peak, dtype)
+            hi_j = jnp.asarray(hi, jnp.int32)
+
+            def f(j):
+                return -((j.astype(dtype) - peak_j) ** 2)
+
+            got = np.asarray(unimodal_argmax_index(f, hi_j, n))
+            js = np.arange(n)[None, None, :]
+            vals = -((js - peak[..., None]) ** 2)
+            vals[js > hi[..., None]] = -np.inf
+            np.testing.assert_array_equal(got, vals.argmax(-1))
+
+    def test_egm_f32_converges_on_fine_grid(self):
+        # 20k points, f32: requires the monotonized endogenous grid and the
+        # grid-top clamp (unbounded edge extrapolation oscillates at O(10)).
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        n = 20_000
+        m = aiyagari_preset(grid_size=n, dtype=jnp.float32)
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        mean_s = float(jnp.mean(m.s))
+        C0 = jnp.broadcast_to(
+            ((1.04) * m.a_grid + w * mean_s)[None, :], (m.P.shape[0], n)
+        ).astype(jnp.float32)
+        sol = solve_aiyagari_egm(
+            C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+            sigma=m.preferences.sigma, beta=m.preferences.beta,
+            tol=TOL, max_iter=1000,
+        )
+        assert bool(jnp.all(jnp.isfinite(sol.policy_c)))
+        assert float(sol.distance) < TOL
+
+    def test_continuous_vfi_f32_converges_and_matches_dense(self):
+        from aiyagari_tpu.solvers.vfi import (
+            solve_aiyagari_vfi,
+            solve_aiyagari_vfi_continuous,
+        )
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        n = 400
+        m = aiyagari_preset(grid_size=n, dtype=jnp.float32)
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        v0 = jnp.zeros((m.P.shape[0], n), jnp.float32)
+        kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+                  tol=TOL, max_iter=2000)
+        sol = solve_aiyagari_vfi_continuous(
+            v0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+            howard_steps=50, grid_power=2.0, **kw)
+        dense = solve_aiyagari_vfi(v0, m.a_grid, m.s, m.P, 0.04, w,
+                                   **{**kw, "max_iter": 1000})
+        assert float(sol.distance) < TOL
+        assert int(sol.iterations) < 2000
+        # Same fixed point as the dense discrete search, up to f32 tie
+        # flatness: values match closely, policies within a few cells.
+        assert float(jnp.max(jnp.abs(sol.v - dense.v))) < 5e-3
+        assert int(jnp.max(jnp.abs(sol.policy_idx - dense.policy_idx))) <= 16
+
+    def test_expectation_highest_precision(self):
+        # expectation() must not use the bf16-pass matmul: error vs f64 stays
+        # at f32-rounding scale even for adversarial magnitudes.
+        from aiyagari_tpu.ops.bellman import expectation
+
+        rng = np.random.default_rng(1)
+        P = rng.dirichlet(np.ones(7), 7)
+        v = rng.uniform(-300, -30, (7, 512))
+        got = np.asarray(expectation(jnp.asarray(P, jnp.float32),
+                                     jnp.asarray(v, jnp.float32), 0.96))
+        want = 0.96 * P @ v
+        assert np.abs(got - want).max() < 5e-4
+
+    def test_labor_egm_f32_converges_on_fine_grid(self):
+        # Same hazard as test_egm_f32_converges_on_fine_grid but through the
+        # consumption-policy extrapolation of the endogenous-labor variant.
+        from aiyagari_tpu.models.aiyagari import aiyagari_labor_preset
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        n = 20_000
+        m = aiyagari_labor_preset(grid_size=n, dtype=jnp.float32)
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        mean_s = float(jnp.mean(m.s))
+        C0 = jnp.broadcast_to(
+            ((1.04) * m.a_grid + w * mean_s)[None, :], (m.P.shape[0], n)
+        ).astype(jnp.float32)
+        prefs = m.preferences
+        sol = solve_aiyagari_egm_labor(
+            C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+            sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
+            tol=TOL, max_iter=1000,
+        )
+        assert bool(jnp.all(jnp.isfinite(sol.policy_c)))
+        assert float(sol.distance) < TOL
+
+    def test_continuous_vfi_respects_borrowing_limit_above_grid_bottom(self):
+        # A grid extending below the borrowing limit: the continuous solver
+        # must never choose a' < amin (regression: amin was silently unused).
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        m = aiyagari_preset(grid_size=200, dtype=jnp.float64)
+        shift = 2.0
+        a_grid = m.a_grid - shift          # grid bottom now at -2.0
+        amin = 0.0                         # borrowing limit strictly inside
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        v0 = jnp.zeros((m.P.shape[0], 200))
+        sol = solve_aiyagari_vfi_continuous(
+            v0, a_grid, m.s, m.P, 0.04, w, amin,
+            sigma=m.preferences.sigma, beta=m.preferences.beta,
+            tol=TOL, max_iter=2000, howard_steps=50,
+        )
+        assert float(sol.distance) < TOL
+        assert float(jnp.min(sol.policy_k)) >= amin - 1e-12
