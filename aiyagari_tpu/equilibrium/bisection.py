@@ -94,6 +94,26 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             use_pallas=solver.use_pallas, progress_every=solver.progress_every,
         )
     if solver.method == "egm":
+        if (
+            solver.grid_sequencing
+            and warm_start is None
+            and not model.config.endogenous_labor
+            and na > 1600
+            and model.config.grid.power > 0
+        ):
+            # Cold start on a fine grid: coarse-to-fine stages cut the
+            # full-size sweep count ~10x (solve_aiyagari_egm_multiscale
+            # docstring). Warm starts (bisection midpoints after the first)
+            # are already near the fixed point and skip the stages.
+            from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
+
+            return solve_aiyagari_egm_multiscale(
+                model.a_grid, model.s, model.P, r, w, model.amin,
+                sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                max_iter=solver.max_iter, grid_power=model.config.grid.power,
+                relative_tol=solver.relative_tol,
+                progress_every=solver.progress_every,
+            )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
             return solve_aiyagari_egm_labor(

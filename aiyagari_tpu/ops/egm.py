@@ -21,7 +21,7 @@ from aiyagari_tpu.utils.utility import (
     labor_foc_inverse,
 )
 
-__all__ = ["egm_step", "egm_step_labor"]
+__all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta"))
@@ -62,7 +62,28 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
-def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float, psi: float, eta: float):
+def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma: float,
+                                  beta: float, psi: float, eta: float):
+    """Static consumption where the borrowing constraint binds (a' = amin):
+    damped fixed point of c = (1+r)a + w s l - amin with l from the
+    intratemporal FOC. Loop-invariant across EGM sweeps — compute once per
+    solve and pass to egm_step_labor (it depends on prices and the grid, not
+    on the consumption iterate)."""
+    ws = w * s[:, None]
+    c_eps = jnp.asarray(1e-6, a_grid.dtype)
+    base = (1.0 + r) * a_grid[None, :] - amin
+
+    def _c_iter(c, _):
+        l = labor_foc_inverse(ws * crra_marginal(c, sigma), psi, eta)
+        return 0.5 * c + 0.5 * jnp.maximum(base + ws * l, c_eps), None
+
+    c_con, _ = jax.lax.scan(_c_iter, jnp.maximum(base + ws, c_eps), None, length=24)
+    return c_con
+
+
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+                   psi: float, eta: float, c_constrained=None):
     """One EGM policy update with endogenous labor via the closed-form
     intratemporal FOC l = ((w s u'(c))/psi)^(1/eta).
 
@@ -93,22 +114,17 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float, ps
     g_c = jax.vmap(linear_interp)(a_hat, c_next, q)
 
     # Constrained region: below the first endogenous knot the borrowing
-    # constraint binds (a' = amin), so solve the static intratemporal system
-    #   c = (1+r)a + w s l - amin,   l = ((w s u'(c))/psi)^(1/eta)
-    # by damped fixed point. The reference linearly extrapolates g_c there
-    # instead (correct to first order at 400 points, f64), but on f32 fine
-    # grids the first-segment slope is rounding noise and the extrapolated
-    # consumption oscillates O(0.5) through the Euler RHS — measured at 20k
-    # points, state 0, before this replacement.
-    c_eps = jnp.asarray(1e-6, g_c.dtype)
-    base = (1.0 + r) * a_grid[None, :] - amin
-
-    def _c_iter(c, _):
-        l = labor_foc_inverse(ws * crra_marginal(c, sigma), psi, eta)
-        return 0.5 * c + 0.5 * jnp.maximum(base + ws * l, c_eps), None
-
-    c_con, _ = jax.lax.scan(_c_iter, jnp.maximum(base + ws, c_eps), None, length=24)
-    g_c = jnp.where(a_grid[None, :] < a_hat[:, :1], c_con, g_c)
+    # constraint binds (a' = amin); use the exact static solution
+    # (constrained_consumption_labor). The reference linearly extrapolates
+    # g_c there instead (correct to first order at 400 points, f64), but on
+    # f32 fine grids the first-segment slope is rounding noise and the
+    # extrapolated consumption oscillates O(0.5) through the Euler RHS —
+    # measured at 20k points, state 0, before this replacement.
+    if c_constrained is None:
+        c_constrained = constrained_consumption_labor(
+            a_grid, s, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+        )
+    g_c = jnp.where(a_grid[None, :] < a_hat[:, :1], c_constrained, g_c)
 
     g_c = jnp.where(a_grid[None, :] < amin, amin, g_c)                        # :91
     policy_l = labor_foc_inverse(ws * crra_marginal(g_c, sigma), psi, eta)    # :95

@@ -48,7 +48,12 @@ def bucket_index(x: jnp.ndarray, q: jnp.ndarray, hi_clip: int | None = None) -> 
     if n <= _COMPARE_ALL_MAX:
         idx = jnp.sum(x <= q[..., None], axis=-1).astype(jnp.int32) - 1
     else:
-        idx = jnp.searchsorted(x, q, side="right", method="scan_unrolled").astype(jnp.int32) - 1
+        # method='sort' counts by co-sorting knots and queries — one bitonic
+        # sort (~0.4 ms at 40k knots on a v5e) instead of log2(n) SERIAL
+        # gather rounds (~2 ms each, ~33 ms total at 40k: measured with
+        # chained on-device timing; 'scan_unrolled' was the dominant cost of
+        # an entire EGM sweep).
+        idx = jnp.searchsorted(x, q, side="right", method="sort").astype(jnp.int32) - 1
     return jnp.clip(idx, 0, hi)
 
 

@@ -14,7 +14,12 @@ import jax.numpy as jnp
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import egm_step, egm_step_labor
 
-__all__ = ["EGMSolution", "solve_aiyagari_egm", "solve_aiyagari_egm_labor"]
+__all__ = [
+    "EGMSolution",
+    "solve_aiyagari_egm",
+    "solve_aiyagari_egm_labor",
+    "solve_aiyagari_egm_multiscale",
+]
 
 
 @jax.tree_util.register_dataclass
@@ -62,6 +67,13 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
                              progress_every: int = 0) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+    from aiyagari_tpu.ops.egm import constrained_consumption_labor
+
+    # Loop-invariant: the constrained-region static solution depends on
+    # prices and the grid only, not the consumption iterate.
+    c_con = constrained_consumption_labor(
+        a_grid, s, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+    )
 
     def cond(carry):
         return (carry[3] >= tol) & (carry[4] < max_iter)
@@ -69,7 +81,8 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
     def body(carry):
         C, _, _, _, it = carry
         C_new, policy_k, policy_l = egm_step_labor(
-            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta,
+            c_constrained=c_con,
         )
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
@@ -80,3 +93,63 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
     init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
     C, policy_k, policy_l, dist, it = jax.lax.while_loop(cond, body, init)
     return EGMSolution(C, policy_k, policy_l, it, dist)
+
+
+def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
+                                  beta: float, tol: float, max_iter: int,
+                                  grid_power: float = 2.0, coarsest: int = 400,
+                                  refine_factor: int = 10,
+                                  relative_tol: bool = False,
+                                  progress_every: int = 0) -> EGMSolution:
+    """Grid-sequenced EGM: solve on a coarse grid first, prolong the
+    consumption policy to each finer grid, and re-converge there.
+
+    Why: the EGM fixed point contracts at rate beta per sweep regardless of
+    the starting point's distance, so a cold start at an n-point grid costs
+    ~log(d0/tol)/log(1/beta) full-size sweeps (~290 at the shipped
+    calibration). Warm-starting from the previous grid's solution cuts d0
+    from O(1) to the coarse grid's discretization error, so the expensive
+    fine-grid stages run a fraction of the sweeps — the classic multigrid
+    nested iteration, and the intended way to reach the BASELINE.json
+    north-star scale (400k points) on TPU. Identical fixed point to the
+    single-grid solve (same operator, same tolerance on the final grid;
+    pinned by test_solvers).
+
+    a_grid must be power-spaced with exponent `grid_power` (the framework's
+    builders are; utils/grids.power_grid) so intermediate grids can be
+    rebuilt analytically at any resolution. Host-level stage loop; each
+    stage is the jitted solve_aiyagari_egm fixed point.
+    """
+    from aiyagari_tpu.ops.interp import linear_interp
+
+    n_final = int(a_grid.shape[-1])
+    dtype = a_grid.dtype
+    lo, hi = float(a_grid[0]), float(a_grid[-1])
+
+    sizes = [n_final]
+    while sizes[0] > coarsest * refine_factor:
+        sizes.insert(0, max(coarsest, sizes[0] // refine_factor))
+    if sizes[0] > coarsest:
+        sizes.insert(0, coarsest)
+
+    def stage_grid(n):
+        if n == n_final:
+            return a_grid
+        t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
+        return lo + (hi - lo) * t ** grid_power
+
+    mean_s = float(jnp.mean(s))
+    g = stage_grid(sizes[0])
+    C = jnp.broadcast_to(((1.0 + r) * g + w * mean_s)[None, :],
+                         (P.shape[0], sizes[0])).astype(dtype)
+    sol = None
+    for i, n in enumerate(sizes):
+        g = stage_grid(n)
+        if i > 0:
+            C = jax.vmap(lambda c: linear_interp(g_prev, c, g))(sol.policy_c)
+        sol = solve_aiyagari_egm(C, g, s, P, r, w, amin, sigma=sigma, beta=beta,
+                                 tol=tol, max_iter=max_iter,
+                                 relative_tol=relative_tol,
+                                 progress_every=progress_every)
+        g_prev = g
+    return sol
