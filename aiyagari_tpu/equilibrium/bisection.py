@@ -60,11 +60,10 @@ class EquilibriumResult:
 
 
 def _initial_consumption_guess(model: AiyagariModel, r: float, w: float):
-    """EGM warm start: consume cash-on-hand at mean productivity
-    (Aiyagari_EGM.m:64)."""
-    mean_s = jnp.mean(model.s)
-    base = (1.0 + r) * model.a_grid + w * mean_s
-    return jnp.broadcast_to(base[None, :], (model.s.shape[0], model.a_grid.shape[0]))
+    """EGM warm start (Aiyagari_EGM.m:64); delegates to the shared helper."""
+    from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+    return initial_consumption_guess(model.a_grid, model.s, r, w)
 
 
 def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = SolverConfig(),
@@ -126,6 +125,10 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
             sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol, max_iter=solver.max_iter,
             relative_tol=solver.relative_tol, progress_every=solver.progress_every,
+            # Power-spaced model grids take the gather-free inversion fast
+            # path (identical result to the generic route at f64 resolution;
+            # pinned by TestPowerGridInversion).
+            grid_power=model.config.grid.power,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 

@@ -13,7 +13,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.ops.interp import inverse_interp_power_grid, linear_interp
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.utils.utility import (
     crra_marginal,
@@ -24,8 +24,9 @@ from aiyagari_tpu.utils.utility import (
 __all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta"))
-def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
+@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power"))
+def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+             grid_power: float = 0.0):
     """One EGM policy update, exogenous labor.
 
     C [N, na] (consumption policy on the exogenous grid) ->
@@ -38,6 +39,11 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
       4. interpolate a' as a function of a_hat back onto the exogenous grid
       5. clamp at the borrowing limit
       6. consumption from the budget constraint
+
+    grid_power > 0 asserts a_grid is power-spaced with that exponent
+    (utils/grids.power_grid) and routes step 4 through the gather-free
+    scatter+scan inversion (ops/interp.inverse_interp_power_grid) — the TPU
+    fast path for 100k+-point grids. 0.0 uses the generic sort-based route.
     """
     RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)        # [N, na]
     c_next = crra_marginal_inverse(RHS, sigma)                    # [N, na]
@@ -49,7 +55,12 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
     # monotonicity locally and searchsorted then lands in arbitrary buckets;
     # the running max restores sorted knots (exact no-op in f64).
     a_hat = jax.lax.associative_scan(jnp.maximum, a_hat, axis=1)
-    policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
+    if grid_power > 0.0:
+        policy_k = inverse_interp_power_grid(
+            a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1]
+        )
+    else:
+        policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
     # Clamp to the grid top as well as the borrowing limit: above the last
     # endogenous knot the reference extrapolates linearly, but over a long
     # extrapolation range f32 noise in the edge-segment slope feeds back
@@ -61,9 +72,9 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float):
     return C_new, policy_k
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+@partial(jax.jit, static_argnames=("sigma", "psi", "eta"))
 def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma: float,
-                                  beta: float, psi: float, eta: float):
+                                  psi: float, eta: float):
     """Static consumption where the borrowing constraint binds (a' = amin):
     damped fixed point of c = (1+r)a + w s l - amin with l from the
     intratemporal FOC. Loop-invariant across EGM sweeps — compute once per
@@ -122,7 +133,7 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # measured at 20k points, state 0, before this replacement.
     if c_constrained is None:
         c_constrained = constrained_consumption_labor(
-            a_grid, s, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+            a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
         )
     g_c = jnp.where(a_grid[None, :] < a_hat[:, :1], c_constrained, g_c)
 

@@ -12,14 +12,26 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
-from aiyagari_tpu.ops.egm import egm_step, egm_step_labor
+from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
+from aiyagari_tpu.ops.interp import linear_interp
 
 __all__ = [
     "EGMSolution",
+    "initial_consumption_guess",
     "solve_aiyagari_egm",
     "solve_aiyagari_egm_labor",
     "solve_aiyagari_egm_multiscale",
 ]
+
+
+def initial_consumption_guess(a_grid, s, r, w):
+    """EGM warm start: consume cash-on-hand at mean productivity
+    (Aiyagari_EGM.m:64). The single source of truth for the reference's
+    initial guess — used by the bisection loop, the multiscale stages, and
+    the benchmark."""
+    mean_s = jnp.mean(s)
+    base = (1.0 + r) * a_grid + w * mean_s
+    return jnp.broadcast_to(base[None, :], (s.shape[0], a_grid.shape[0]))
 
 
 @jax.tree_util.register_dataclass
@@ -35,13 +47,15 @@ class EGMSolution:
     distance: jax.Array
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                        tol: float, max_iter: int, relative_tol: bool = False,
-                       progress_every: int = 0) -> EGMSolution:
+                       progress_every: int = 0, grid_power: float = 0.0) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
-    an in-jit telemetry record every that-many sweeps (diagnostics.progress)."""
+    an in-jit telemetry record every that-many sweeps (diagnostics.progress).
+    grid_power > 0 enables the gather-free power-grid inversion fast path
+    (ops/egm.egm_step docstring)."""
 
     def cond(carry):
         _, _, dist, it = carry
@@ -49,7 +63,8 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
 
     def body(carry):
         C, _, _, it = carry
-        C_new, policy_k = egm_step(C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta)
+        C_new, policy_k = egm_step(C, a_grid, s, P, r, w, amin, sigma=sigma,
+                                   beta=beta, grid_power=grid_power)
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
         device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
@@ -67,12 +82,10 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
                              progress_every: int = 0) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
-    from aiyagari_tpu.ops.egm import constrained_consumption_labor
-
     # Loop-invariant: the constrained-region static solution depends on
     # prices and the grid only, not the consumption iterate.
     c_con = constrained_consumption_labor(
-        a_grid, s, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta
+        a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
     )
 
     def cond(carry):
@@ -120,8 +133,6 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     rebuilt analytically at any resolution. Host-level stage loop; each
     stage is the jitted solve_aiyagari_egm fixed point.
     """
-    from aiyagari_tpu.ops.interp import linear_interp
-
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
     lo, hi = float(a_grid[0]), float(a_grid[-1])
@@ -138,10 +149,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
         t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
         return lo + (hi - lo) * t ** grid_power
 
-    mean_s = float(jnp.mean(s))
     g = stage_grid(sizes[0])
-    C = jnp.broadcast_to(((1.0 + r) * g + w * mean_s)[None, :],
-                         (P.shape[0], sizes[0])).astype(dtype)
+    C = initial_consumption_guess(g, s, r, w).astype(dtype)
     sol = None
     for i, n in enumerate(sizes):
         g = stage_grid(n)
@@ -150,6 +159,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
         sol = solve_aiyagari_egm(C, g, s, P, r, w, amin, sigma=sigma, beta=beta,
                                  tol=tol, max_iter=max_iter,
                                  relative_tol=relative_tol,
-                                 progress_every=progress_every)
+                                 progress_every=progress_every,
+                                 grid_power=grid_power)
         g_prev = g
     return sol

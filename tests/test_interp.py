@@ -1,5 +1,6 @@
 """Interpolation-kernel tests against SciPy oracles (SURVEY.md §4.1)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -140,3 +141,43 @@ class TestInterp2D:
         got = interp2d_linear(jnp.array(x), jnp.array(ygrid), jnp.array(Z),
                               jnp.array([2.0, -1.0]), jnp.array([3.0, -2.0]))
         np.testing.assert_allclose(got, [2 * 2.0 + 3.0, 2 * -1.0 + -2.0], atol=1e-12)
+
+
+class TestPowerGridInversion:
+    """ops/interp.inverse_interp_power_grid — the gather-free EGM inversion."""
+
+    def test_matches_generic_linear_interp(self):
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid, linear_interp
+
+        rng = np.random.default_rng(0)
+        for (n_k, n_q, power) in [(400, 400, 2.0), (1000, 400, 2.0), (400, 1000, 3.0)]:
+            lo, hi = 0.0, 52.0
+            gk = lo + (hi - lo) * (np.arange(n_k) / (n_k - 1)) ** power
+            x = np.sort((gk + 0.3 * np.sin(gk / 7.0) + 0.8) / 1.04 - 0.5)
+            xq = jnp.asarray(np.tile(x, (3, 1)))
+            got = np.asarray(inverse_interp_power_grid(xq, lo, hi, power, n_q))
+            gq = lo + (hi - lo) * (np.arange(n_q) / (n_q - 1)) ** power
+            want = np.asarray(jax.vmap(
+                lambda xx: linear_interp(jnp.asarray(xx), jnp.asarray(gk), jnp.asarray(gq))
+            )(xq))
+            # Above the last knot the fast path truncates to the top knot-grid
+            # value (the framework's grid-top rule) instead of extrapolating.
+            top = np.tile(gq[None, :] > x[-1], (3, 1))
+            assert np.abs(got - want)[~top].max() < 1e-10
+            assert np.abs(got[top] - gk[-1]).max() < 1e-10 if top.any() else True
+
+    def test_egm_step_fast_path_matches_generic(self):
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+        from aiyagari_tpu.ops.egm import egm_step
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        m = aiyagari_preset(grid_size=1500)
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        mean_s = float(jnp.mean(m.s))
+        C = jnp.broadcast_to(((1.04) * m.a_grid + w * mean_s)[None, :], (7, 1500))
+        kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta)
+        for _ in range(30):
+            C, _ = egm_step(C, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+        _, pg = egm_step(C, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+        _, pf = egm_step(C, m.a_grid, m.s, m.P, 0.04, w, m.amin, grid_power=2.0, **kw)
+        np.testing.assert_allclose(np.asarray(pf), np.asarray(pg), atol=1e-10)
