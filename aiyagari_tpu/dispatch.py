@@ -107,17 +107,21 @@ def solve(
 
             result = solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
         else:
+            from aiyagari_tpu.config import precision_scope
             from aiyagari_tpu.equilibrium.bisection import (
                 solve_equilibrium,
                 solve_equilibrium_distribution,
             )
             from aiyagari_tpu.models.aiyagari import AiyagariModel
 
-            m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
-            if aggregation == "distribution":
-                result = solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
-            else:
-                result = solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
+            # Honor dtype="float64" even when global x64 is off (see
+            # precision_scope — without it the request silently truncates).
+            with precision_scope(backend.dtype):
+                m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+                if aggregation == "distribution":
+                    result = solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
+                else:
+                    result = solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
         gap = (
             abs(result.k_supply[-1] - result.k_demand[-1])
             if result.k_supply else float("inf")

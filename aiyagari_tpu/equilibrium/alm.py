@@ -100,6 +100,33 @@ def solve_krusell_smith(
     """
     if closure not in ("panel", "histogram"):
         raise ValueError(f"unknown closure {closure!r}; expected 'panel' or 'histogram'")
+    # Honor an f64 request even when global x64 is off — without this the
+    # arrays silently truncate to f32, whose sub-cell policy jitter compounds
+    # through the 1,100-period simulation into an ALM limit cycle at
+    # diff_B ~ 5e-2, far above the reference's 1e-6 (precision_scope
+    # docstring; measured on a v5e).
+    from aiyagari_tpu.config import precision_scope
+
+    with precision_scope(backend.dtype):
+        return _solve_krusell_smith_impl(
+            config, method=method, solver=solver, alm=alm, backend=backend,
+            on_iteration=on_iteration, double_alm=double_alm,
+            checkpoint_dir=checkpoint_dir, closure=closure,
+        )
+
+
+def _solve_krusell_smith_impl(
+    config: KrusellSmithConfig,
+    *,
+    method: str,
+    solver: Optional[SolverConfig],
+    alm: ALMConfig,
+    backend: BackendConfig,
+    on_iteration: Optional[Callable],
+    double_alm: bool,
+    checkpoint_dir: Optional[str],
+    closure: str,
+) -> KSResult:
     use_histogram = closure == "histogram"
     t0 = time.perf_counter()
     dtype = jnp.float64 if backend.dtype == "float64" else jnp.float32

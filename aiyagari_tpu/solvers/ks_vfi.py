@@ -97,9 +97,16 @@ def solve_ks_vfi(value_init, k_opt_init, B, k_grid, K_grid, P, r_table, w_table,
     """Howard-accelerated VFI given ALM coefficients B.
 
     Matches Krusell_Smith_VFI.m:141-204: policy improvement every
-    `improve_every` iterations (continuous maximization over k' in
-    [k_min, min(resources, k_max)]), `howard_steps` evaluation sweeps per
-    iteration, relative sup-norm convergence (:195).
+    `improve_every` iterations, `howard_steps` evaluation sweeps per
+    iteration, relative sup-norm convergence (:195). The improvement step
+    replaces the reference's per-point fminbnd over k' in
+    [k_min, min(resources, k_max)] with a dense argmax over the k_grid
+    candidates followed by `golden_iters` golden-section iterations inside
+    the winning cell's brackets — same continuous within-cell semantics,
+    but the candidate ranking is a direct value comparison, which keeps the
+    policy reproducible between ALM iterations in low precision (rationale
+    in improve(); golden_iters <= 0 returns the pure grid policy, which is
+    too coarse for this power-7 grid — K collapses — so keep it > 0).
     """
     ns, nK, nk = value_init.shape
 
@@ -120,11 +127,44 @@ def solve_ks_vfi(value_init, k_opt_init, B, k_grid, K_grid, P, r_table, w_table,
         return crra_utility(c, theta) + beta * EV
 
     def improve(value, k_opt):
+        # Two-phase maximization replacing full-range golden section. Phase
+        # 1: dense argmax over the k_grid candidates — at grid knots the
+        # pchip-interpolated continuation IS the value table, so EV_grid is
+        # one [ns,ns]x[ns,nK,nk] contraction and the whole [ns,nK,nk,nk]
+        # score tensor is ~640 KB. Phase 2: one golden-section refine inside
+        # the winning cell's brackets, where fminbnd's continuous semantics
+        # live. Why not full-range golden (measured, f32, reference scale):
+        # near the optimum the objective is flat below f32 resolution, the
+        # continuous maximizer jitters by whole cells between ALM
+        # iterations, and 1,100 simulation steps amplify that into ~2e-2
+        # noise in the regression coefficients — the ALM fixed point then
+        # never reaches the reference's 1e-6 tolerance. Grid candidates
+        # ranked by direct value comparison bound the jitter at sub-cell
+        # scale (same cure as solve_aiyagari_vfi_continuous).
         V_next, slopes = _gather_next_tables(value, Kp_idx, k_grid)
+        EV_grid = jnp.einsum(
+            "sp,sKpk->sKk", P, V_next, precision=jax.lax.Precision.HIGHEST,
+        )                                                                  # [ns, nK, nk']
+        c_cand = resources[:, :, :, None] - k_grid[None, None, None, :]    # [ns,nK,nk,nk']
+        feas = (c_cand > 0.0) & (k_grid[None, None, None, :] <= k_max)
+        u = crra_utility(jnp.maximum(c_cand, 1e-10), theta)
+        q = jnp.where(feas, u + beta * EV_grid[:, :, None, :],
+                      jnp.array(-jnp.inf, value.dtype))
+        j_star = jnp.argmax(q, axis=-1)                                    # [ns, nK, nk]
+
+        if golden_iters <= 0:
+            # Pure grid policy: knot values are exactly reproducible across
+            # ALM iterations (no within-cell f32 flatness jitter at all).
+            return k_grid[j_star]
+
         f = lambda kp: bellman_at(kp, V_next, slopes)
-        lo = jnp.full_like(resources, k_min)
-        hi = jnp.minimum(resources, k_max)                                # :159
-        return golden_section_max(f, lo, hi, n_iters=golden_iters)
+        lo_r = jnp.maximum(k_grid[jnp.maximum(j_star - 1, 0)], k_min)
+        hi_r = jnp.minimum(
+            jnp.minimum(k_grid[jnp.minimum(j_star + 1, nk - 1)], resources),
+            k_max,
+        )                                                                  # :159
+        hi_r = jnp.maximum(hi_r, lo_r)
+        return golden_section_max(f, lo_r, hi_r, n_iters=golden_iters)
 
     def howard(value, k_opt):
         def sweep(v, _):

@@ -214,3 +214,21 @@ class TestFineGridF32:
         )
         assert float(sol.distance) < TOL
         assert float(jnp.min(sol.policy_k)) >= amin - 1e-12
+
+
+class TestPrecisionScope:
+    def test_f64_honored_without_global_x64(self):
+        # BackendConfig defaults to float64; without the scope a float64
+        # request silently truncates to f32 when global x64 is off — and the
+        # K-S ALM fixed point then limit-cycles at diff_B ~ 5e-2 instead of
+        # converging (measured on a v5e; see config.precision_scope).
+        import jax
+
+        from aiyagari_tpu.config import precision_scope
+
+        with jax.enable_x64(False):
+            assert jnp.zeros(1, jnp.float64).dtype == jnp.float32  # the trap
+            with precision_scope("float64"):
+                assert jnp.zeros(1, jnp.float64).dtype == jnp.float64
+            with precision_scope("float32"):
+                assert jnp.zeros(1, jnp.float64).dtype == jnp.float32
