@@ -73,9 +73,11 @@ def main(argv=None) -> int:
         None if args.quiet else ConsoleSink(prefix=f"[{args.model}/{args.method}] "),
         JSONLSink(f"{outdir}/iterations.jsonl"),
     )
-    # f64 by default on CPU, f32 on TPU; requesting f64 requires enabling
-    # jax x64, otherwise jnp.float64 silently canonicalizes to f32.
-    use_f64 = args.f64 or (jax.default_backend() == "cpu")
+    # Aiyagari family: f64 by default on CPU, f32 on TPU (its solvers hit the
+    # reference tolerances in f32 — test_precision). Krusell-Smith: f64
+    # everywhere — its ALM fixed point limit-cycles in f32 (BENCHMARKS.md);
+    # the solve entry points enable x64 locally via config.precision_scope.
+    use_f64 = args.f64 or (jax.default_backend() == "cpu") or args.model == "ks"
     if use_f64:
         jax.config.update("jax_enable_x64", True)
     backend = BackendConfig(dtype="float64" if use_f64 else "float32")
