@@ -25,7 +25,7 @@ import numpy as np
 from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
 from aiyagari_tpu.models.aiyagari import AiyagariModel
 from aiyagari_tpu.sim.ergodic import PanelSeries, simulate_panel
-from aiyagari_tpu.solvers.egm import solve_aiyagari_egm, solve_aiyagari_egm_labor
+from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor, solve_aiyagari_egm_safe
 from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi, solve_aiyagari_vfi_labor
 from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
 
@@ -121,13 +121,14 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
             )
-        return solve_aiyagari_egm(
+        return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
             sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol, max_iter=solver.max_iter,
             relative_tol=solver.relative_tol, progress_every=solver.progress_every,
-            # Power-spaced model grids take the gather-free inversion fast
-            # path (identical result to the generic route at f64 resolution;
-            # pinned by TestPowerGridInversion).
+            # Power-spaced model grids take the scatter-free windowed
+            # inversion fast path (identical result to the generic route at
+            # f64 resolution, pinned by TestPowerGridInversion; _safe retries
+            # on the generic route if the windows escape).
             grid_power=model.config.grid.power,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")

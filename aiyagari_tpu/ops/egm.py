@@ -41,9 +41,16 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
       6. consumption from the budget constraint
 
     grid_power > 0 asserts a_grid is power-spaced with that exponent
-    (utils/grids.power_grid) and routes step 4 through the gather-free
-    scatter+scan inversion (ops/interp.inverse_interp_power_grid) — the TPU
-    fast path for 100k+-point grids. 0.0 uses the generic sort-based route.
+    (utils/grids.power_grid) and routes step 4 through the windowed
+    compare-reduce inversion (ops/interp.inverse_interp_power_grid) — the
+    TPU fast path for 100k+-point grids. POISONING CONTRACT: on grids above
+    the kernel's dense cutoff that path may return all-NaN when the
+    endogenous grid's local knot density exceeds its static windows; the
+    NaN propagates into C_new, the solver's while_loop exits on a NaN
+    distance, and a host-level caller must retry with grid_power=0.0
+    (solvers/egm.solve_aiyagari_egm_safe does). Jitted callers that cannot
+    host-retry should pass grid_power=0.0, the generic sort-based exact
+    route.
     """
     RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)        # [N, na]
     c_next = crra_marginal_inverse(RHS, sigma)                    # [N, na]
@@ -53,8 +60,11 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # interp + extrapolation matches interp1(a_hat, a_grid, a_grid, 'linear',
     # 'extrap') at :95. In f32 at 100k+-point grids rounding breaks that
     # monotonicity locally and searchsorted then lands in arbitrary buckets;
-    # the running max restores sorted knots (exact no-op in f64).
-    a_hat = jax.lax.associative_scan(jnp.maximum, a_hat, axis=1)
+    # the running max restores sorted knots (exact no-op in f64). lax.cummax,
+    # not the generic associative_scan combinator: the dedicated primitive's
+    # HLO compiles in seconds where the combinator's takes tens of seconds on
+    # this image's remote-compile path at 40k+ points.
+    a_hat = jax.lax.cummax(a_hat, axis=1)
     if grid_power > 0.0:
         policy_k = inverse_interp_power_grid(
             a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1]
@@ -120,7 +130,7 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # segment's slope — unbounded linear extrapolation of g_c feeds straight
     # back into the next Euler RHS and oscillates at O(0.1) on f32 fine grids
     # (measured at 20k points; cf. egm_step's asset-policy variant).
-    a_hat = jax.lax.associative_scan(jnp.maximum, a_hat, axis=1)
+    a_hat = jax.lax.cummax(a_hat, axis=1)
     q = jnp.minimum(a_grid[None, :], a_hat[:, -1:])
     g_c = jax.vmap(linear_interp)(a_hat, c_next, q)
 

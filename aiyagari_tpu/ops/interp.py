@@ -12,6 +12,8 @@ Krusell_Smith_VFI.m:241-244.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -20,6 +22,7 @@ __all__ = [
     "inverse_interp_power_grid",
     "bucket_onehot",
     "power_bucket_index",
+    "prolong_power_grid",
     "linear_interp",
     "linear_interp_rows",
     "state_policy_interp",
@@ -85,6 +88,42 @@ def power_bucket_index(x: jnp.ndarray, q: jnp.ndarray, lo: float, hi: float,
     return idx
 
 
+@partial(jax.jit, static_argnames=("lo", "hi", "power", "n_new"))
+def prolong_power_grid(Y: jnp.ndarray, lo: float, hi: float, power: float,
+                       n_new: int) -> jnp.ndarray:
+    """Linearly re-sample values Y[..., n_prev] tabulated on the power grid
+    g_prev[i] = lo + (hi-lo)*(i/(n_prev-1))^power onto the n_new-point grid
+    with the SAME spacing law. This is the multigrid prolongation
+    (solvers/egm.solve_aiyagari_egm_multiscale): because both grids share the
+    spacing law, a query's bracket index is closed-form — fractional position
+    j*(n_prev-1)/(n_new-1), LINEAR in the query index — so the whole
+    re-sample is one jitted program with a single neighbor gather: no search,
+    no sort, and one host dispatch instead of an eager op-by-op chain (each
+    eager op costs a ~100 ms round trip on this image's remote transport).
+    """
+    n_prev = Y.shape[-1]
+    dtype = Y.dtype
+    span = hi - lo
+    j = jnp.arange(n_new)
+    fi = j.astype(dtype) * ((n_prev - 1) / (n_new - 1))
+    i0 = jnp.clip(jnp.floor(fi).astype(jnp.int32), 0, n_prev - 2)
+
+    def g_prev(i):
+        return lo + span * (i.astype(dtype) / (n_prev - 1)) ** power
+
+    q = lo + span * (j.astype(dtype) / (n_new - 1)) ** power
+    # Two correction rounds absorb f32 rounding of the fractional position
+    # (cf. power_bucket_index).
+    for _ in range(2):
+        i0 = jnp.where((i0 > 0) & (g_prev(i0) > q), i0 - 1, i0)
+        i0 = jnp.where((i0 < n_prev - 2) & (g_prev(i0 + 1) <= q), i0 + 1, i0)
+    g0, g1 = g_prev(i0), g_prev(i0 + 1)
+    t = jnp.clip((q - g0) / (g1 - g0), 0.0, 1.0)
+    y0 = jnp.take(Y, i0, axis=-1)
+    y1 = jnp.take(Y, i0 + 1, axis=-1)
+    return y0 * (1.0 - t) + y1 * t
+
+
 def bucket_onehot(x: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     """One-hot encoding of bucket_index over the n-1 grid intervals,
     [..., n-1] float of the query dtype.
@@ -130,37 +169,64 @@ def state_policy_interp(x: jnp.ndarray, policies: jnp.ndarray, state_idx: jnp.nd
     return y0 + t * (y1 - y0)
 
 
+_INV_DENSE_MAX = 4096   # below this knot count, one fused compare-reduce per row
+_INV_QBLOCK = 512       # queries per block in the windowed route
+_INV_KBLOCK = 512       # knot-block granularity of the gathered windows
+_INV_WBLOCKS = 6        # knot blocks per window (window covers 6x local density)
+
+
 def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float,
                               n_q: int) -> jnp.ndarray:
-    """Interpolate the inverse of a monotone map onto a power-spaced grid,
-    gather-free: given sorted knots x[..., k] = f(g_k) over the grid
+    """Interpolate the inverse of a monotone map onto a power-spaced grid:
+    given sorted knots x[..., k] = f(g_k) over the grid
     g_k = lo + (hi-lo)*(k/(n_k-1))^power, return, for each query point g_j of
     the n_q-point grid with the SAME spacing law, the piecewise-linear inverse
     out[..., j] = g_K + (g_{K+1}-g_K) * (g_j - x_K)/(x_{K+1} - x_K), where
-    K = max{k: x_k <= g_j}.
+    K = max{k: x_k < g_j} (x_K is the last knot strictly below the query and
+    x_{K+1} the first knot at-or-above it, so a query equal to a knot returns
+    that knot's grid value exactly).
 
     This is the EGM hot operation (policy from the endogenous grid,
-    interp1(a_hat, a_grid, a_grid) at Aiyagari_EGM.m:95). The generic route —
-    searchsorted plus four gathers — is gather-bound on TPU (a [7, 400k]
-    take_along_axis measures ~20 ms; a sweep took ~200 ms). Here everything
-    is computed from the closed grid form instead:
-      * each knot's position among the queries comes from inverting the power
-        spacing analytically (elementwise), corrected to exactness with two
-        compare rounds against the analytic grid value a(i);
-      * the bracketing knot values per query come from one scatter-max +
-        forward cummax (x_K) and one scatter-min + backward cummin (x_{K+1})
-        — associative scans, ~0.15 ms at [7, 40k];
-      * the bracketing grid values g_K, g_{K+1} are evaluated analytically
-        from the filled knot index.
+    interp1(a_hat, a_grid, a_grid) at Aiyagari_EGM.m:95). TPU mapping: every
+    route here is built from broadcast-compare + reduce — no scatter (XLA TPU
+    serializes scatters with colliding indices: the previous scatter+cummax
+    formulation measured ~90 ms per sweep at [7, 40k], ~60x the memory-bound
+    cost), no sort, no associative_scan (the generic combinator's HLO takes
+    tens of seconds to compile on this image's remote-compile path), and no
+    large element gathers (a [7, 400k] take_along_axis measures ~20 ms).
+
+      * n_k <= 4096: one fused [n_q, n_k] compare-reduce per row gives the
+        bracket count and both bracketing knot values directly (VPU work on
+        an unmaterialized broadcast).
+      * larger n_k: a two-level windowed variant of the same idea. Queries
+        are tiled into blocks of 512; one [n_blocks, n_k] compare-reduce
+        locates each block's first bracketing knot; each block then gathers a
+        3,072-knot window as 6 contiguous 512-knot slabs (block-granular DMA,
+        not element gathers) and runs the dense compare-reduce against its
+        window only. Exact whenever no query block spans more than the
+        window's worth of knots; blocks that would (knot density > 6x the
+        query density — not reachable from the EGM operator's endogenous
+        grids at the shipped calibrations, whose knot spacing is bounded
+        below by grid spacing/(1+r)) POISON the whole result with NaN. The
+        EGM fixed point then exits on its NaN distance and the host solver
+        retries with the generic exact route (solvers/egm.py
+        solve_aiyagari_egm_safe) — correctness is never traded for speed.
+
     Queries below the first knot extrapolate linearly on the first segment
     (interp1 'linear','extrap'); queries above the last knot return the top
     grid point (the framework's grid-top truncation, see ops/egm.egm_step).
-    Zero-width brackets (f32 knot collisions) return the left knot's grid
-    value, like linear_interp.
+    Duplicated knots (f32 collisions on fine grids): the strict-< bracket
+    makes a query equal to a run of tied knots interpolate to the FIRST tied
+    knot's grid value, where the generic sort-based route returns the last
+    tie's — both are valid inverses of the collided segment (the choices
+    differ by less than the local grid spacing, below the solvers'
+    tolerance); queries strictly inside a zero-width bracket cannot occur.
 
     x: [..., n_k] sorted ascending along the last axis. Returns [..., n_q].
-    Both grids share (lo, hi, power); n_k and n_q may differ (multigrid
-    prolongation uses n_k != n_q; the EGM sweep uses n_k == n_q).
+    Both grids share (lo, hi, power); n_k and n_q may differ (the EGM sweep
+    uses n_k == n_q; the mismatched case is kept because the kernel is the
+    grid-family-generic inverse, pinned by TestPowerGridInversion's
+    n_k != n_q cases).
     """
     n_k = x.shape[-1]
     dtype = x.dtype
@@ -178,36 +244,11 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
 
     neg, pos = jnp.array(-jnp.inf, dtype), jnp.array(jnp.inf, dtype)
     q_vals = g_of(jnp.arange(n_q))
-    ks = jnp.arange(n_k, dtype=jnp.int32)
 
-    def row(xr):
-        # p_k = #{j < n_q: g_j <= x_k}, the first query index strictly above
-        # the knot; the analytic inverse gives it up to float rounding, two
-        # compare rounds against the exact g(i) pin it down. Elementwise —
-        # no searches, no gathers.
-        t = jnp.clip((xr - lo) / span, 0.0, 1.0) ** (1.0 / power)
-        p = jnp.ceil(t * (n_q - 1)).astype(jnp.int32)
-        for _ in range(2):
-            p = jnp.where((p >= 1) & (g_of(jnp.maximum(p - 1, 0)) > xr), p - 1, p)
-            p = jnp.where((p <= n_q - 1) & (g_of(jnp.minimum(p, n_q - 1)) <= xr), p + 1, p)
-        drop = (p < 0) | (p >= n_q)     # knots above every query
-        p_safe = jnp.clip(p, 0, n_q - 1)
-
-        # x_K per query: scatter each knot value to its first covered query
-        # slot (max resolves several knots landing in one slot), forward-fill.
-        # Knots above every query (p == n_q) can never be an x_K — but the
-        # FIRST of them is the last query's upper bracket, so the x1 scatter
-        # keeps an extra slot for them instead of dropping.
-        S = jnp.full((n_q,), neg).at[p_safe].max(jnp.where(drop, neg, xr))
-        K = jnp.full((n_q,), -1, jnp.int32).at[p_safe].max(jnp.where(drop, -1, ks))
-        T = jnp.full((n_q + 1,), pos).at[jnp.clip(p, 0, n_q)].min(xr)
-        x0 = jax.lax.associative_scan(jnp.maximum, S)
-        idx = jax.lax.associative_scan(jnp.maximum, K)
-        # x_{K+1} per query: nearest knot strictly above — backward-min fill,
-        # shifted one slot so a query's own slot (knots <= it) is excluded.
-        revmin = jax.lax.associative_scan(jnp.minimum, T, reverse=True)
-        x1 = revmin[1:]
-
+    def finish(cnt, x0, x1, xr):
+        # Shared tail: cnt = #{k: x_k < g_j} per query, (x0, x1) the
+        # bracketing knot values (±inf where absent).
+        idx = cnt - 1
         below = idx < 0
         idx_c = jnp.clip(idx, 0, n_k - 1)
         y0 = gk_of(idx_c)
@@ -216,7 +257,6 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
         ok = jnp.isfinite(dx) & (dx > 0)
         tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
         out = y0 + tq * (y1 - y0)
-
         # Below the first knot: linear extrapolation on the first segment
         # (interp1 'linear','extrap' bottom semantics).
         sl = (gk_of(jnp.int32(1)) - gk_of(jnp.int32(0))) / jnp.maximum(
@@ -225,9 +265,61 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
         out_below = gk_of(jnp.int32(0)) + (q_vals - xr[0]) * sl
         return jnp.where(below, out_below, out)
 
+    if n_k <= _INV_DENSE_MAX:
+        def dense_row(xr):
+            lt = xr[None, :] < q_vals[:, None]                        # [n_q, n_k]
+            cnt = jnp.sum(lt, axis=1).astype(jnp.int32)
+            x0 = jnp.max(jnp.where(lt, xr[None, :], neg), axis=1)
+            x1 = jnp.min(jnp.where(lt, pos, xr[None, :]), axis=1)
+            return finish(cnt, x0, x1, xr)
+
+        if x.ndim == 1:
+            return dense_row(x)
+        return jax.vmap(dense_row)(x.reshape((-1, n_k))).reshape(x.shape[:-1] + (n_q,))
+
+    S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
+    nkb = -(-n_k // KB)            # >= 8 under the dense gate, so nkb >= M
+    nb = -(-n_q // S)
+    L = M * KB
+
+    def windowed_row(xr):
+        xp = xr if nkb * KB == n_k else jnp.concatenate(
+            [xr, jnp.full((nkb * KB - n_k,), pos)]
+        )
+        xblk = xp.reshape(nkb, KB)
+        # Padded query indices clamp to the last real query: duplicates of an
+        # existing query, so they introduce no new escapes and are sliced off.
+        jq = jnp.minimum(jnp.arange(nb * S), n_q - 1)
+        qs = g_of(jq).reshape(nb, S)
+
+        # Level 1: each block's bracket start from one fused compare-reduce.
+        s_first = jnp.sum(xr[None, :] < qs[:, :1], axis=1).astype(jnp.int32)  # [nb]
+        ab = jnp.minimum(jnp.clip(s_first - 1, 0, n_k - 1) // KB, nkb - M)
+
+        # Level 2: gather each block's window as M contiguous knot slabs and
+        # run the dense compare-reduce against the window only. Knots before
+        # the window are all < the block's first query by construction of ab.
+        seg = xblk[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
+        lt = seg[:, None, :] < qs[:, :, None]                         # [nb, S, L]
+        cnt_w = jnp.sum(lt, axis=-1).astype(jnp.int32)
+        cnt = ab[:, None] * KB + cnt_w
+        x0 = jnp.max(jnp.where(lt, seg[:, None, :], neg), axis=-1)
+        x1 = jnp.min(jnp.where(lt, pos, seg[:, None, :]), axis=-1)
+        # cnt_w == L means every window knot is below the query, so the true
+        # bracket may lie beyond the window — unless the window already ends
+        # at the top of the knot array (top-truncation case, exact).
+        escape = jnp.any((cnt_w == L) & ((ab[:, None] + M) * KB < n_k))
+        out = finish(
+            cnt.reshape(-1)[:n_q], x0.reshape(-1)[:n_q], x1.reshape(-1)[:n_q], xr
+        )
+        return out, escape
+
     if x.ndim == 1:
-        return row(x)
-    return jax.vmap(row)(x.reshape((-1, n_k))).reshape(x.shape[:-1] + (n_q,))
+        out, escape = windowed_row(x)
+        return jnp.where(escape, jnp.nan, out)
+    outs, escapes = jax.vmap(windowed_row)(x.reshape((-1, n_k)))
+    outs = jnp.where(jnp.any(escapes), jnp.nan, outs)
+    return outs.reshape(x.shape[:-1] + (n_q,))
 
 
 def linear_interp(x: jnp.ndarray, y: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:

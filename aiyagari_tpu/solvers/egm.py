@@ -13,25 +13,34 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
-from aiyagari_tpu.ops.interp import linear_interp
+from aiyagari_tpu.ops.interp import _INV_DENSE_MAX, prolong_power_grid
 
 __all__ = [
     "EGMSolution",
     "initial_consumption_guess",
     "solve_aiyagari_egm",
+    "solve_aiyagari_egm_safe",
     "solve_aiyagari_egm_labor",
     "solve_aiyagari_egm_multiscale",
 ]
 
 
+@jax.jit
 def initial_consumption_guess(a_grid, s, r, w):
     """EGM warm start: consume cash-on-hand at mean productivity
     (Aiyagari_EGM.m:64). The single source of truth for the reference's
     initial guess — used by the bisection loop, the multiscale stages, and
-    the benchmark."""
+    the benchmark. Jitted: one host dispatch instead of an eager op chain
+    (~100 ms per op round trip on this image's remote TPU transport)."""
     mean_s = jnp.mean(s)
     base = (1.0 + r) * a_grid + w * mean_s
     return jnp.broadcast_to(base[None, :], (s.shape[0], a_grid.shape[0]))
+
+
+@partial(jax.jit, static_argnames=("n", "lo", "hi", "power", "dtype"))
+def _stage_grid(n: int, lo: float, hi: float, power: float, dtype):
+    t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
+    return lo + (hi - lo) * t ** power
 
 
 @jax.tree_util.register_dataclass
@@ -73,6 +82,35 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
     C, policy_k, dist, it = jax.lax.while_loop(cond, body, init)
     return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist)
+
+
+def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
+                            beta: float, tol: float, max_iter: int,
+                            relative_tol: bool = False, progress_every: int = 0,
+                            grid_power: float = 0.0) -> EGMSolution:
+    """solve_aiyagari_egm plus the host-level escape retry for the windowed
+    fast-path inversion: if the power-grid inversion's query-block windows
+    cannot cover the endogenous grid's local knot density, it poisons the
+    sweep with NaN (ops/interp.inverse_interp_power_grid), the while_loop
+    exits on the NaN distance, and this wrapper re-solves on the generic
+    exact route (grid_power=0). Host-level by design — callers inside jit
+    should use solve_aiyagari_egm directly and accept the documented poisoning
+    contract. The retry only arms on grids above the kernel's dense cutoff:
+    smaller grids take the escape-free dense route, so a NaN there is genuine
+    numerical divergence and re-solving would mask it (and double the cost)."""
+    sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
+                             beta=beta, tol=tol, max_iter=max_iter,
+                             relative_tol=relative_tol,
+                             progress_every=progress_every,
+                             grid_power=grid_power)
+    can_escape = grid_power > 0.0 and a_grid.shape[-1] > _INV_DENSE_MAX
+    if can_escape and bool(jnp.isnan(sol.distance)):
+        sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
+                                 beta=beta, tol=tol, max_iter=max_iter,
+                                 relative_tol=relative_tol,
+                                 progress_every=progress_every,
+                                 grid_power=0.0)
+    return sol
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol", "progress_every"))
@@ -131,7 +169,11 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     a_grid must be power-spaced with exponent `grid_power` (the framework's
     builders are; utils/grids.power_grid) so intermediate grids can be
     rebuilt analytically at any resolution. Host-level stage loop; each
-    stage is the jitted solve_aiyagari_egm fixed point.
+    stage is the jitted solve_aiyagari_egm fixed point, launched without any
+    host synchronization between stages — the windowed fast path's escape
+    NaN (ops/interp.inverse_interp_power_grid) propagates through the
+    remaining stages, so one isnan check at the end decides the generic-route
+    retry for the whole ladder.
     """
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
@@ -146,20 +188,25 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     def stage_grid(n):
         if n == n_final:
             return a_grid
-        t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
-        return lo + (hi - lo) * t ** grid_power
+        return _stage_grid(n, lo, hi, grid_power, dtype)
 
-    g = stage_grid(sizes[0])
-    C = initial_consumption_guess(g, s, r, w).astype(dtype)
-    sol = None
-    for i, n in enumerate(sizes):
-        g = stage_grid(n)
-        if i > 0:
-            C = jax.vmap(lambda c: linear_interp(g_prev, c, g))(sol.policy_c)
-        sol = solve_aiyagari_egm(C, g, s, P, r, w, amin, sigma=sigma, beta=beta,
-                                 tol=tol, max_iter=max_iter,
-                                 relative_tol=relative_tol,
-                                 progress_every=progress_every,
-                                 grid_power=grid_power)
-        g_prev = g
+    def run_ladder(fast: bool) -> EGMSolution:
+        C = initial_consumption_guess(stage_grid(sizes[0]), s, r, w).astype(dtype)
+        sol = None
+        for i, n in enumerate(sizes):
+            if i > 0:
+                C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
+            sol = solve_aiyagari_egm(C, stage_grid(n), s, P, r, w, amin,
+                                     sigma=sigma, beta=beta, tol=tol,
+                                     max_iter=max_iter,
+                                     relative_tol=relative_tol,
+                                     progress_every=progress_every,
+                                     grid_power=grid_power if fast else 0.0)
+        return sol
+
+    sol = run_ladder(fast=True)
+    # Retry only arms when some stage ran the windowed (escape-capable)
+    # route; a NaN on dense-only ladders is genuine divergence.
+    if sizes[-1] > _INV_DENSE_MAX and bool(jnp.isnan(sol.distance)):
+        sol = run_ladder(fast=False)
     return sol

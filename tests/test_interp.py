@@ -166,6 +166,146 @@ class TestPowerGridInversion:
             assert np.abs(got - want)[~top].max() < 1e-10
             assert np.abs(got[top] - gk[-1]).max() < 1e-10 if top.any() else True
 
+    def test_prolong_power_grid_matches_linear_interp(self):
+        # The multigrid prolongation's closed-form bucket must agree with
+        # generic linear interpolation between the two analytic grids.
+        from aiyagari_tpu.ops.interp import linear_interp, prolong_power_grid
+
+        rng = np.random.default_rng(3)
+        for (n_prev, n_new, power) in [(400, 4000, 2.0), (4000, 400, 2.0), (100, 701, 7.0)]:
+            lo, hi = 0.0, 52.0
+            gp = lo + (hi - lo) * (np.arange(n_prev) / (n_prev - 1)) ** power
+            gn = lo + (hi - lo) * (np.arange(n_new) / (n_new - 1)) ** power
+            Y = jnp.asarray(rng.normal(size=(3, n_prev)))
+            got = np.asarray(prolong_power_grid(Y, lo, hi, power, n_new))
+            want = np.asarray(jax.vmap(
+                lambda y: linear_interp(jnp.asarray(gp), y, jnp.asarray(gn))
+            )(Y))
+            np.testing.assert_allclose(got, want, atol=1e-9)
+
+    def test_windowed_route_matches_generic(self):
+        # n_k > 4096 takes the two-level windowed compare-reduce route (the
+        # 40k+-point TPU fast path); same contract as the dense route.
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid, linear_interp
+
+        for (n_k, n_q) in [(6000, 6000), (9000, 5000), (5000, 9000)]:
+            lo, hi, power = 0.0, 52.0, 2.0
+            gk = lo + (hi - lo) * (np.arange(n_k) / (n_k - 1)) ** power
+            x = np.sort((gk + 0.3 * np.sin(gk / 7.0) + 0.8) / 1.04 - 0.5)
+            xq = jnp.asarray(np.tile(x, (3, 1)))
+            got = np.asarray(inverse_interp_power_grid(xq, lo, hi, power, n_q))
+            assert not np.isnan(got).any()
+            gq = lo + (hi - lo) * (np.arange(n_q) / (n_q - 1)) ** power
+            want = np.asarray(jax.vmap(
+                lambda xx: linear_interp(jnp.asarray(xx), jnp.asarray(gk), jnp.asarray(gq))
+            )(xq))
+            top = np.tile(gq[None, :] > x[-1], (3, 1))
+            assert np.abs(got - want)[~top].max() < 1e-10
+            if top.any():
+                assert np.abs(got[top] - gk[-1]).max() < 1e-10
+
+    def test_windowed_escape_poisons_with_nan(self):
+        # >6x local knot density vs the query grid cannot be bracketed by the
+        # static windows; the contract is loud NaN poisoning (the host solver
+        # then retries on the generic route), never a silently wrong value.
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+
+        n = 8192
+        lo, hi, power = 0.0, 52.0, 2.0
+        gq = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        # 5,000 knots crammed inside one query interval mid-grid.
+        cluster = np.linspace(gq[3000], gq[3001], 5000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 5000).astype(int)]
+        x = np.sort(np.concatenate([cluster, rest]))[:n]
+        out = np.asarray(inverse_interp_power_grid(jnp.asarray(x), lo, hi, power, n))
+        assert np.isnan(out).all()
+
+    def test_safe_solver_matches_generic_route(self):
+        # solve_aiyagari_egm_safe on a power grid reaches the same fixed
+        # point as the generic exact route.
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+        from aiyagari_tpu.solvers.egm import (
+            initial_consumption_guess,
+            solve_aiyagari_egm,
+            solve_aiyagari_egm_safe,
+        )
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        m = aiyagari_preset(grid_size=300)
+        w = float(wage_from_r(0.04, m.config.technology.alpha, m.config.technology.delta))
+        C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
+        kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta, tol=1e-6, max_iter=2000)
+        fast = solve_aiyagari_egm_safe(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                                       grid_power=2.0, **kw)
+        slow = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                                  grid_power=0.0, **kw)
+        np.testing.assert_allclose(np.asarray(fast.policy_c), np.asarray(slow.policy_c),
+                                   atol=1e-8)
+
+    def test_safe_solver_retries_generic_route_on_poison(self, monkeypatch):
+        # Wiring of the poison-then-retry cycle: stub the jitted solve so the
+        # fast path returns a poisoned (NaN-distance) solution on a
+        # windowed-regime grid, and check the wrapper re-dispatches the SAME
+        # problem on the generic route and returns its converged answer.
+        import aiyagari_tpu.solvers.egm as egm_mod
+
+        calls = []
+        real = egm_mod.solve_aiyagari_egm
+
+        def stub(C0, a_grid, s, P, r, w, amin, **kw):
+            calls.append(kw["grid_power"])
+            sol = real(C0, a_grid, s, P, r, w, amin, **kw)
+            if kw["grid_power"] > 0.0:
+                return egm_mod.EGMSolution(
+                    jnp.full_like(sol.policy_c, jnp.nan), sol.policy_k,
+                    sol.policy_l, sol.iterations,
+                    jnp.array(jnp.nan, sol.distance.dtype))
+            return sol
+
+        monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
+        n = 5000   # above the windowed cutoff, so the retry is armed
+        a_grid = jnp.asarray(52.0 * (np.arange(n) / (n - 1)) ** 2.0)
+        s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
+        C0 = egm_mod.initial_consumption_guess(a_grid, s, 0.04, 1.2)
+        sol = egm_mod.solve_aiyagari_egm_safe(
+            C0, a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
+            tol=1e-5, max_iter=1000, grid_power=2.0)
+        assert calls == [2.0, 0.0]
+        assert float(sol.distance) < 1e-5
+        assert not np.isnan(np.asarray(sol.policy_c)).any()
+
+    def test_multiscale_retries_whole_ladder_on_poison(self, monkeypatch):
+        # Same wiring check for the stage ladder: a poisoned fast ladder must
+        # be re-run end-to-end on the generic route.
+        import aiyagari_tpu.solvers.egm as egm_mod
+
+        calls = []
+        real = egm_mod.solve_aiyagari_egm
+
+        def stub(C0, a_grid, s, P, r, w, amin, **kw):
+            calls.append((int(a_grid.shape[-1]), kw["grid_power"]))
+            sol = real(C0, a_grid, s, P, r, w, amin, **kw)
+            if kw["grid_power"] > 0.0 and a_grid.shape[-1] > 4096:
+                return egm_mod.EGMSolution(
+                    jnp.full_like(sol.policy_c, jnp.nan), sol.policy_k,
+                    sol.policy_l, sol.iterations,
+                    jnp.array(jnp.nan, sol.distance.dtype))
+            return sol
+
+        monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
+        n = 5000
+        a_grid = jnp.asarray(52.0 * (np.arange(n) / (n - 1)) ** 2.0)
+        s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
+        sol = egm_mod.solve_aiyagari_egm_multiscale(
+            a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
+            tol=1e-5, max_iter=1000, grid_power=2.0, coarsest=400,
+            refine_factor=10)
+        # Fast ladder [400, 500, 5000] then generic ladder, same stages.
+        assert calls == [(400, 2.0), (500, 2.0), (5000, 2.0),
+                         (400, 0.0), (500, 0.0), (5000, 0.0)]
+        assert float(sol.distance) < 1e-5
+        assert not np.isnan(np.asarray(sol.policy_c)).any()
+
     def test_egm_step_fast_path_matches_generic(self):
         from aiyagari_tpu.models.aiyagari import aiyagari_preset
         from aiyagari_tpu.ops.egm import egm_step
