@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "INVERSE_DENSE_CUTOFF",
     "bucket_index",
     "inverse_interp_power_grid",
     "bucket_onehot",
@@ -169,7 +170,11 @@ def state_policy_interp(x: jnp.ndarray, policies: jnp.ndarray, state_idx: jnp.nd
     return y0 + t * (y1 - y0)
 
 
-_INV_DENSE_MAX = 4096   # below this knot count, one fused compare-reduce per row
+# Public: grids at or below this knot count take the escape-free dense route
+# of inverse_interp_power_grid; larger grids take the windowed route, which
+# can poison with NaN (see its docstring). Host-level retry wrappers use this
+# to decide whether a NaN can be a window escape at all (solvers/egm.py).
+INVERSE_DENSE_CUTOFF = 4096
 _INV_QBLOCK = 512       # queries per block in the windowed route
 _INV_KBLOCK = 512       # knot-block granularity of the gathered windows
 _INV_WBLOCKS = 6        # knot blocks per window (window covers 6x local density)
@@ -265,7 +270,7 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
         out_below = gk_of(jnp.int32(0)) + (q_vals - xr[0]) * sl
         return jnp.where(below, out_below, out)
 
-    if n_k <= _INV_DENSE_MAX:
+    if n_k <= INVERSE_DENSE_CUTOFF:
         def dense_row(xr):
             lt = xr[None, :] < q_vals[:, None]                        # [n_q, n_k]
             cnt = jnp.sum(lt, axis=1).astype(jnp.int32)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
-from aiyagari_tpu.ops.interp import _INV_DENSE_MAX, prolong_power_grid
+from aiyagari_tpu.ops.interp import INVERSE_DENSE_CUTOFF, prolong_power_grid
 
 __all__ = [
     "EGMSolution",
@@ -35,12 +35,6 @@ def initial_consumption_guess(a_grid, s, r, w):
     mean_s = jnp.mean(s)
     base = (1.0 + r) * a_grid + w * mean_s
     return jnp.broadcast_to(base[None, :], (s.shape[0], a_grid.shape[0]))
-
-
-@partial(jax.jit, static_argnames=("n", "lo", "hi", "power", "dtype"))
-def _stage_grid(n: int, lo: float, hi: float, power: float, dtype):
-    t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
-    return lo + (hi - lo) * t ** power
 
 
 @jax.tree_util.register_dataclass
@@ -103,7 +97,7 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              relative_tol=relative_tol,
                              progress_every=progress_every,
                              grid_power=grid_power)
-    can_escape = grid_power > 0.0 and a_grid.shape[-1] > _INV_DENSE_MAX
+    can_escape = grid_power > 0.0 and a_grid.shape[-1] > INVERSE_DENSE_CUTOFF
     if can_escape and bool(jnp.isnan(sol.distance)):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
@@ -175,28 +169,25 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     remaining stages, so one isnan check at the end decides the generic-route
     retry for the whole ladder.
     """
+    from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
+
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
     lo, hi = float(a_grid[0]), float(a_grid[-1])
+    sizes = stage_sizes(n_final, coarsest, refine_factor)
 
-    sizes = [n_final]
-    while sizes[0] > coarsest * refine_factor:
-        sizes.insert(0, max(coarsest, sizes[0] // refine_factor))
-    if sizes[0] > coarsest:
-        sizes.insert(0, coarsest)
-
-    def stage_grid(n):
+    def _grid(n):
         if n == n_final:
             return a_grid
-        return _stage_grid(n, lo, hi, grid_power, dtype)
+        return stage_grid(n, lo, hi, grid_power, dtype)
 
     def run_ladder(fast: bool) -> EGMSolution:
-        C = initial_consumption_guess(stage_grid(sizes[0]), s, r, w).astype(dtype)
+        C = initial_consumption_guess(_grid(sizes[0]), s, r, w).astype(dtype)
         sol = None
         for i, n in enumerate(sizes):
             if i > 0:
                 C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
-            sol = solve_aiyagari_egm(C, stage_grid(n), s, P, r, w, amin,
+            sol = solve_aiyagari_egm(C, _grid(n), s, P, r, w, amin,
                                      sigma=sigma, beta=beta, tol=tol,
                                      max_iter=max_iter,
                                      relative_tol=relative_tol,
@@ -207,6 +198,6 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     sol = run_ladder(fast=True)
     # Retry only arms when some stage ran the windowed (escape-capable)
     # route; a NaN on dense-only ladders is genuine divergence.
-    if sizes[-1] > _INV_DENSE_MAX and bool(jnp.isnan(sol.distance)):
+    if sizes[-1] > INVERSE_DENSE_CUTOFF and bool(jnp.isnan(sol.distance)):
         sol = run_ladder(fast=False)
     return sol

@@ -32,6 +32,7 @@ __all__ = [
     "solve_aiyagari_vfi",
     "solve_aiyagari_vfi_labor",
     "solve_aiyagari_vfi_continuous",
+    "solve_aiyagari_vfi_multiscale",
 ]
 
 
@@ -262,6 +263,54 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     policy_c = jnp.maximum(coh - policy_k, c_floor)
     return VFISolution(v, idx, policy_k, policy_c,
                        jnp.ones_like(policy_k), it, dist)
+
+
+def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
+                                  beta: float, tol: float, max_iter: int,
+                                  grid_power: float,
+                                  howard_steps: int = 20, golden_iters: int = 48,
+                                  coarsest: int = 400,
+                                  refine_factor: int = 10,
+                                  relative_tol: bool = False) -> VFISolution:
+    """Grid-sequenced continuous VFI: solve coarse, prolong the VALUE function
+    to each finer power grid (ops/interp.prolong_power_grid — closed-form
+    bucket, one dispatch per stage), and re-converge there.
+
+    Same nested-iteration rationale as solve_aiyagari_egm_multiscale: a cold
+    fine-grid start pays ~log(d0/tol)/log(1/beta) improvement rounds, each a
+    coarse-to-fine index search whose take_along_axis gathers are the TPU
+    bottleneck; warm-starting from the coarse value cuts d0 to the coarse
+    grid's discretization error, so the expensive fine stages run a handful
+    of rounds. Identical fixed point to the single-grid
+    solve_aiyagari_vfi_continuous (same operator and tolerance on the final
+    grid; pinned by test_solvers.TestMultiscaleVFI).
+
+    grid_power is REQUIRED (no default) and must be a_grid's actual spacing
+    exponent: both the stage-grid construction and the closed-form locators
+    trust it, and a mismatch converges to a silently wrong policy rather
+    than erroring.
+    """
+    from aiyagari_tpu.ops.interp import prolong_power_grid
+    from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
+
+    n_final = int(a_grid.shape[-1])
+    dtype = a_grid.dtype
+    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    sizes = stage_sizes(n_final, coarsest, refine_factor)
+
+    sol = None
+    for i, n in enumerate(sizes):
+        g = a_grid if n == n_final else stage_grid(n, lo, hi, grid_power, dtype)
+        v = (jnp.zeros((s.shape[0], n), dtype) if i == 0
+             else prolong_power_grid(sol.v, lo, hi, grid_power, n))
+        sol = solve_aiyagari_vfi_continuous(
+            v, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+            max_iter=max_iter, howard_steps=howard_steps,
+            # In-cell continuous refinement only matters on the final grid.
+            golden_iters=golden_iters if n == n_final else 0,
+            relative_tol=relative_tol, grid_power=grid_power,
+        )
+    return sol
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))

@@ -7,12 +7,18 @@ power-7 100-point Krusell-Smith individual grid plus 4-point aggregate grid
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from aiyagari_tpu.config import AiyagariConfig, KrusellSmithConfig
 
 __all__ = [
     "power_grid",
+    "stage_grid",
+    "stage_sizes",
     "aiyagari_asset_bounds",
     "aiyagari_asset_grid",
     "ks_k_grid",
@@ -23,6 +29,28 @@ __all__ = [
 def power_grid(lo: float, hi: float, n: int, power: float) -> np.ndarray:
     """lo + (hi-lo) * linspace(0,1,n)^power — denser near lo for power>1."""
     return lo + (hi - lo) * np.linspace(0.0, 1.0, n) ** power
+
+
+@partial(jax.jit, static_argnames=("n", "lo", "hi", "power", "dtype"))
+def stage_grid(n: int, lo: float, hi: float, power: float, dtype):
+    """power_grid's spacing law built on device in one jitted dispatch — the
+    stage-grid builder shared by the EGM and VFI multigrid ladders."""
+    t = jnp.linspace(0.0, 1.0, n, dtype=dtype)
+    return lo + (hi - lo) * t ** power
+
+
+def stage_sizes(n_final: int, coarsest: int, refine_factor: int) -> list[int]:
+    """Coarse-to-fine grid sizes for multigrid nested iteration, ending at
+    n_final: [coarsest, ..., n_final//refine_factor**2, n_final//refine_factor,
+    n_final]. The single source of the stage ladder shared by the EGM and
+    VFI grid-sequenced solvers (solvers/egm.solve_aiyagari_egm_multiscale,
+    solvers/vfi.solve_aiyagari_vfi_multiscale)."""
+    sizes = [n_final]
+    while sizes[0] > coarsest * refine_factor:
+        sizes.insert(0, max(coarsest, sizes[0] // refine_factor))
+    if sizes[0] > coarsest:
+        sizes.insert(0, coarsest)
+    return sizes
 
 
 def aiyagari_asset_bounds(cfg: AiyagariConfig, s_min: float | None = None) -> tuple[float, float]:

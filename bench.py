@@ -120,7 +120,6 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi") -> dict
 
     from aiyagari_tpu.models.aiyagari import aiyagari_preset
     from aiyagari_tpu.solvers import numpy_backend as nb
-    from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
     from aiyagari_tpu.utils.firm import wage_from_r
 
     if quick:
@@ -144,13 +143,14 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi") -> dict
                 grid_power=model.config.grid.power,
             )
     else:
-        v0 = jnp.zeros((model.P.shape[0], grid_scale), dtype)
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_multiscale
 
         def run():
-            return solve_aiyagari_vfi_continuous(
-                v0, model.a_grid, model.s, model.P, r, w, model.amin,
+            return solve_aiyagari_vfi_multiscale(
+                model.a_grid, model.s, model.P, r, w, model.amin,
                 sigma=model.preferences.sigma, beta=model.preferences.beta,
-                tol=tol, max_iter=max_iter, howard_steps=50, grid_power=2.0,
+                tol=tol, max_iter=max_iter, howard_steps=50,
+                grid_power=model.config.grid.power,
             )
 
     sol = run()
