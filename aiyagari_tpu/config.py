@@ -221,6 +221,13 @@ class ALMConfig:
     population: int = 10_000
     discard: int = 100
     seed: int = 0
+    # Outer-loop update rule for the forecasting coefficients: "damped" is
+    # the reference's B <- damping*B_new + (1-damping)*B; "anderson" is
+    # safeguarded Anderson mixing over the last `anderson_depth` iterates —
+    # same fixed point, typically ~3x fewer (solver + simulate + regress)
+    # rounds (equilibrium/alm.py).
+    acceleration: str = "damped"
+    anderson_depth: int = 3
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,48 @@
+"""Persistent XLA compilation cache.
+
+This image's TPU is reached through a remote-compile transport where first
+compiles of the solver fixed points cost tens of seconds to minutes (the K-S
+Howard VFI at f64 measured ~80 s; BENCHMARKS.md). JAX's persistent
+compilation cache removes that cost for every process after the first —
+measured 13.0 s -> 1.4 s on a representative kernel across fresh processes.
+
+The framework enables it from its executables (bench.py, the CLI, the
+examples, the driver entry points) rather than at package import, so
+importing aiyagari_tpu as a library never mutates global JAX config behind
+the caller's back.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compilation_cache"]
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at `cache_dir` and return the
+    directory used (None if the running JAX lacks the feature).
+
+    Resolution order: explicit argument, $AIYAGARI_TPU_COMPILE_CACHE, then
+    ~/.cache/aiyagari_tpu/xla. Setting $AIYAGARI_TPU_COMPILE_CACHE to the
+    empty string disables the cache entirely.
+    """
+    import jax
+
+    env = os.environ.get("AIYAGARI_TPU_COMPILE_CACHE")
+    if cache_dir is None:
+        if env == "":
+            return None
+        cache_dir = env or os.path.join(
+            os.path.expanduser("~"), ".cache", "aiyagari_tpu", "xla"
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every program: the workload is dominated by a handful of
+        # solver fixed points whose artifacts are small next to their
+        # compile times, so size/time thresholds only cost cache hits.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:   # older jax without the persistent cache
+        return None
+    return cache_dir

@@ -293,6 +293,14 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     from aiyagari_tpu.ops.interp import prolong_power_grid
     from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
 
+    if grid_power <= 0.0:
+        # 0.0 is solve_aiyagari_vfi_continuous's "not power-spaced" sentinel;
+        # here it would collapse every stage grid to a point (t**0 == 1) and
+        # poison the prolongation with 0/0 — fail loudly instead.
+        raise ValueError(
+            "solve_aiyagari_vfi_multiscale requires a power-spaced grid: pass "
+            f"its actual spacing exponent as grid_power, got {grid_power}"
+        )
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
     lo, hi = float(a_grid[0]), float(a_grid[-1])

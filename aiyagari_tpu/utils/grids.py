@@ -45,6 +45,9 @@ def stage_sizes(n_final: int, coarsest: int, refine_factor: int) -> list[int]:
     n_final]. The single source of the stage ladder shared by the EGM and
     VFI grid-sequenced solvers (solvers/egm.solve_aiyagari_egm_multiscale,
     solvers/vfi.solve_aiyagari_vfi_multiscale)."""
+    if refine_factor < 2:
+        # refine_factor=1 would re-insert the same size forever.
+        raise ValueError(f"refine_factor must be >= 2, got {refine_factor}")
     sizes = [n_final]
     while sizes[0] > coarsest * refine_factor:
         sizes.insert(0, max(coarsest, sizes[0] // refine_factor))

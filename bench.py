@@ -343,6 +343,10 @@ def main() -> int:
 
     import os
 
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     if args.probe_timeout is None:
         args.probe_timeout = 3600.0 if (args.metric == "scale" and not args.quick) else 900.0
 

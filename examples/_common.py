@@ -33,6 +33,9 @@ def example_args(description: str) -> argparse.Namespace:
 
     import jax
 
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     if args.platform:
         # Pass the platform through verbatim so --platform tpu errors loudly
         # if the TPU backend is unavailable instead of silently running CPU.

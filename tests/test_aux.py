@@ -281,6 +281,35 @@ class TestExamples:
 
 
 @pytest.mark.slow
+class TestCompileCache:
+    def test_enable_sets_and_env_disables(self, tmp_path, monkeypatch):
+        import jax
+
+        from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+        old = {
+            name: getattr(jax.config, name)
+            for name in (
+                "jax_compilation_cache_dir",
+                "jax_persistent_cache_min_entry_size_bytes",
+                "jax_persistent_cache_min_compile_time_secs",
+            )
+        }
+        try:
+            d = enable_compilation_cache(str(tmp_path / "xla"))
+            assert d == str(tmp_path / "xla")
+            assert jax.config.jax_compilation_cache_dir == d
+            # Empty env var is the documented opt-out.
+            monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", "")
+            assert enable_compilation_cache() is None
+            # Env var wins over the default location.
+            monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", str(tmp_path / "env"))
+            assert enable_compilation_cache() == str(tmp_path / "env")
+        finally:
+            for name, val in old.items():
+                jax.config.update(name, val)
+
+
 class TestCLI:
     def test_cli_aiyagari_end_to_end(self, tmp_path):
         out = subprocess.run(

@@ -302,3 +302,34 @@ class TestALMConvergence:
         B = [float(b) for b in res.B]
         assert 0.8 < B[1] < 1.0 and 0.8 < B[3] < 1.0
         assert res.solution.k_opt.dtype == jnp.float64
+
+    @pytest.mark.slow
+    def test_anderson_acceleration_matches_damped_with_fewer_rounds(self):
+        """alm.acceleration='anderson' must reach the same fixed point as the
+        reference's damped update — each outer round is a full household
+        solve + simulation + regression, so fewer rounds is the whole
+        point — and never more rounds than damping at this scale."""
+        from aiyagari_tpu import solve as _solve
+
+        kw = dict(method="vfi")
+        alm_kw = dict(T=300, population=1000, discard=50, max_iter=100, seed=0)
+        damped = _solve(KrusellSmithConfig(k_size=40),
+                        alm=ALMConfig(**alm_kw), **kw)
+        anderson = _solve(KrusellSmithConfig(k_size=40),
+                          alm=ALMConfig(acceleration="anderson", **alm_kw), **kw)
+        assert anderson.converged
+        assert anderson.diff_B < 1e-6
+        np.testing.assert_allclose(anderson.B, damped.B, atol=1e-4)
+        assert anderson.iterations <= damped.iterations
+        # The acceleration must actually accelerate at this representative
+        # scale, not merely not hurt.
+        assert anderson.iterations <= int(0.7 * damped.iterations)
+
+    def test_unknown_acceleration_rejected(self):
+        from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
+
+        with pytest.raises(ValueError, match="acceleration"):
+            solve_krusell_smith(
+                KrusellSmithConfig(k_size=10),
+                alm=ALMConfig(T=50, population=50, acceleration="nesterov"),
+            )
