@@ -173,11 +173,13 @@ class SolverConfig:
     use_pallas: bool = False          # fused VMEM-tiled Bellman kernel (TPU)
     progress_every: int = 0           # in-jit telemetry cadence (0 = off;
                                       # diagnostics.progress host callbacks)
-    grid_sequencing: bool = False     # EGM only: cold solves on fine grids
+    grid_sequencing: bool = True      # EGM only: cold solves on fine grids
                                       # (>1600 pts) run coarse-to-fine stages
                                       # (solvers/egm.solve_aiyagari_egm_multiscale)
                                       # — same fixed point, ~10x fewer
-                                      # full-size sweeps
+                                      # full-size sweeps; False forces the
+                                      # single-grid reference trajectory at
+                                      # any size
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,199 @@
+"""Property-based tests (hypothesis) for the numeric kernels.
+
+These complement the example-based suites with randomized adversarial inputs
+against independent oracles (NumPy/SciPy) and invariants (SURVEY.md §4.1-4.2).
+Shapes are drawn from small fixed sets so jit compiles a bounded number of
+programs; hypothesis varies the VALUES.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+from hypothesis.extra.numpy import arrays
+
+from aiyagari_tpu.ops.interp import (
+    bucket_index,
+    inverse_interp_power_grid,
+    linear_interp,
+    pchip_interp,
+    power_bucket_index,
+    prolong_power_grid,
+)
+from aiyagari_tpu.utils.markov import rouwenhorst, stationary_distribution, tauchen
+from aiyagari_tpu.utils.stats import gini, lorenz_curve
+
+SET = settings(max_examples=25, deadline=None,
+               suppress_health_check=[HealthCheck.too_slow])
+
+finite = dict(allow_nan=False, allow_infinity=False)
+
+
+def _monotone_knots(raw, span=50.0):
+    """Sorted knot vector spanning ~[0, span] from raw uniforms; interior
+    duplicate values survive (cumsum of non-negative gaps, some zero) —
+    exactly the f32 collision case the kernels must handle."""
+    gaps = np.abs(raw)
+    total = gaps.sum()
+    if total <= 0:
+        return np.linspace(0.0, span, raw.shape[0])
+    return np.cumsum(gaps) / total * span
+
+
+class TestInversePowerGridProperties:
+    @SET
+    @given(
+        raw=arrays(np.float64, (400,), elements=st.floats(0.0, 1.0, **finite)),
+        power=st.sampled_from([1.0, 2.0, 3.0, 7.0]),
+        shift=st.floats(-5.0, 5.0, **finite),
+    )
+    def test_dense_route_matches_linear_interp_oracle(self, raw, power, shift):
+        n_k = n_q = 400      # dense route (<= cutoff)
+        lo, hi = 0.0, 52.0
+        x = np.sort(_monotone_knots(raw) + shift)
+        gk = lo + (hi - lo) * (np.arange(n_k) / (n_k - 1)) ** power
+        gq = lo + (hi - lo) * (np.arange(n_q) / (n_q - 1)) ** power
+        got = np.asarray(inverse_interp_power_grid(jnp.asarray(x), lo, hi, power, n_q))
+        want = np.asarray(linear_interp(jnp.asarray(x), jnp.asarray(gk), jnp.asarray(gq)))
+        # Compare on the interior; below the first knots the two routes use
+        # different (both valid) degenerate-edge conventions when the first
+        # knots collide, and above the last knot the kernel truncates to the
+        # grid top by contract.
+        interior = (gq > x[1]) & (gq <= x[-1])
+        assert np.all(np.abs(got[interior] - want[interior]) < 1e-8)
+        top = gq > x[-1]
+        if top.any():
+            assert np.all(np.abs(got[top] - gk[-1]) < 1e-8)
+
+    @SET
+    @given(
+        raw=arrays(np.float64, (6000,), elements=st.floats(0.0, 1.0, **finite)),
+        power=st.sampled_from([2.0, 7.0]),
+    )
+    def test_windowed_route_exact_or_loudly_poisoned(self, raw, power):
+        n = 6000             # windowed route (> cutoff)
+        lo, hi = 0.0, 52.0
+        x = _monotone_knots(raw)
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        gq = gk
+        got = np.asarray(inverse_interp_power_grid(jnp.asarray(x), lo, hi, power, n))
+        if np.isnan(got).any():
+            # The escape contract: poisoning is all-or-nothing, never a
+            # silently wrong value.
+            assert np.isnan(got).all()
+            return
+        want = np.asarray(linear_interp(jnp.asarray(x), jnp.asarray(gk), jnp.asarray(gq)))
+        interior = (gq > x[1]) & (gq <= x[-1])
+        assert np.all(np.abs(got[interior] - want[interior]) < 1e-8)
+
+    @SET
+    @given(
+        y=arrays(np.float64, (3, 300), elements=st.floats(-100.0, 100.0, **finite)),
+        power=st.sampled_from([1.0, 2.0, 7.0]),
+        n_new=st.sampled_from([150, 300, 1200]),
+    )
+    def test_prolong_matches_linear_interp_oracle(self, y, power, n_new):
+        lo, hi = 0.0, 52.0
+        n_prev = y.shape[-1]
+        gp = lo + (hi - lo) * (np.arange(n_prev) / (n_prev - 1)) ** power
+        gn = lo + (hi - lo) * (np.arange(n_new) / (n_new - 1)) ** power
+        got = np.asarray(prolong_power_grid(jnp.asarray(y), lo, hi, power, n_new))
+        want = np.asarray(jax.vmap(
+            lambda r: linear_interp(jnp.asarray(gp), r, jnp.asarray(gn))
+        )(jnp.asarray(y)))
+        np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+class TestLocatorProperties:
+    @SET
+    @given(q=arrays(np.float64, (200,), elements=st.floats(-10.0, 60.0, **finite)))
+    def test_bucket_index_matches_searchsorted(self, q):
+        x = np.sort(np.unique(np.linspace(0.0, 52.0, 80)))
+        got = np.asarray(bucket_index(jnp.asarray(x), jnp.asarray(q)))
+        want = np.clip(np.searchsorted(x, q, side="right") - 1, 0, len(x) - 2)
+        np.testing.assert_array_equal(got, want)
+
+    @SET
+    @given(
+        q=arrays(np.float64, (200,), elements=st.floats(0.0, 52.0, **finite)),
+        power=st.sampled_from([2.0, 7.0]),
+    )
+    def test_power_bucket_index_brackets_queries(self, q, power):
+        n = 5000
+        lo, hi = 0.0, 52.0
+        x = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        idx = np.asarray(power_bucket_index(jnp.asarray(x), jnp.asarray(q), lo, hi, power))
+        assert np.all((idx >= 0) & (idx <= n - 2))
+        inside = (q >= x[0]) & (q < x[-1])
+        assert np.all(x[idx[inside]] <= q[inside])
+        assert np.all(q[inside] < x[idx[inside] + 1])
+
+
+class TestPchipProperties:
+    @SET
+    @given(
+        gaps=arrays(np.float64, (40,), elements=st.floats(0.05, 2.0, **finite)),
+        vals=arrays(np.float64, (40,), elements=st.floats(0.0, 1.0, **finite)),
+    )
+    def test_monotone_data_gives_monotone_interpolant(self, gaps, vals):
+        # Shape preservation is pchip's defining property (Fritsch-Carlson).
+        x = np.cumsum(gaps)
+        y = np.cumsum(np.abs(vals))
+        q = np.linspace(x[0], x[-1], 400)
+        out = np.asarray(pchip_interp(jnp.asarray(x), jnp.asarray(y), jnp.asarray(q)))
+        assert np.all(np.diff(out) >= -1e-9)
+        assert out.min() >= y[0] - 1e-9 and out.max() <= y[-1] + 1e-9
+
+
+class TestStatsProperties:
+    @SET
+    @given(
+        w=arrays(np.float64, (500,), elements=st.floats(0.0, 1e4, **finite)),
+        scale=st.floats(0.1, 100.0, **finite),
+    )
+    def test_gini_bounds_and_scale_invariance(self, w, scale):
+        if w.sum() <= 0:
+            return
+        g1 = float(gini(jnp.asarray(w)))
+        g2 = float(gini(jnp.asarray(w * scale)))
+        assert -1e-9 <= g1 <= 1.0
+        assert abs(g1 - g2) < 1e-8
+        # Permutation invariance.
+        g3 = float(gini(jnp.asarray(np.sort(w)[::-1].copy())))
+        assert abs(g1 - g3) < 1e-8
+
+    @SET
+    @given(w=arrays(np.float64, (300,), elements=st.floats(0.0, 1e4, **finite)))
+    def test_lorenz_curve_is_convex_and_below_diagonal(self, w):
+        if w.sum() <= 0:
+            return
+        pop, wealth = lorenz_curve(jnp.asarray(w))
+        pop, wealth = np.asarray(pop), np.asarray(wealth)
+        assert np.all(wealth <= pop + 1e-9)
+        assert np.all(np.diff(wealth) >= -1e-12)
+        # Convexity: increments are non-decreasing (shares sorted ascending).
+        inc = np.diff(wealth)
+        assert np.all(np.diff(inc) >= -1e-9)
+
+
+class TestMarkovProperties:
+    @SET
+    @given(
+        rho=st.floats(0.0, 0.98, **finite),
+        sigma_e=st.floats(0.01, 1.0, **finite),
+        n=st.sampled_from([3, 7, 11]),
+    )
+    def test_discretizers_yield_stochastic_matrices_with_fixed_point(self, rho, sigma_e, n):
+        from aiyagari_tpu.config import IncomeProcess
+
+        proc = IncomeProcess(rho=rho, sigma_e=sigma_e, n_states=n)
+        for build in (tauchen, rouwenhorst):
+            grid, P = build(proc)
+            P = np.asarray(P)
+            assert P.shape == (n, n)
+            assert np.all(P >= -1e-12)
+            np.testing.assert_allclose(P.sum(axis=1), 1.0, atol=1e-9)
+            pi = np.asarray(stationary_distribution(jnp.asarray(P)))
+            np.testing.assert_allclose(pi @ P, pi, atol=1e-8)
+            assert np.all(np.asarray(grid)[:-1] <= np.asarray(grid)[1:])
