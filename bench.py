@@ -93,11 +93,15 @@ def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
     w = wage_from_r(r, model.config.technology.alpha, model.config.technology.delta)
     # Always run the baseline to convergence: at 400 points it is sub-second,
     # so quick mode never needs an extrapolated (and therefore shifting) count.
-    t0 = time.perf_counter()
-    *_, iters_np = nb.vfi_numpy(np.zeros((len(s), len(a))), a, s, P, r, w,
-                                sigma=prefs.sigma, beta=prefs.beta, tol=tol,
-                                max_iter=max_iter)
-    t_np = time.perf_counter() - t0
+    # Best-of-3: the CPU baseline jitters ~2x under background load, which
+    # otherwise swings vs_baseline run to run for a fixed accelerator time.
+    t_np = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        *_, iters_np = nb.vfi_numpy(np.zeros((len(s), len(a))), a, s, P, r, w,
+                                    sigma=prefs.sigma, beta=prefs.beta, tol=tol,
+                                    max_iter=max_iter)
+        t_np = min(t_np, time.perf_counter() - t0)
 
     return {
         "metric": f"aiyagari_vfi_wallclock_grid{grid_size}",
@@ -168,11 +172,14 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi") -> dict
     a = np.asarray(base.a_grid, np.float64)
     s = np.asarray(base.s, np.float64)
     P = np.asarray(base.P, np.float64)
-    t0 = time.perf_counter()
-    *_, iters_np = nb.vfi_numpy(np.zeros((len(s), len(a))), a, s, P, r, w,
-                                sigma=base.preferences.sigma, beta=base.preferences.beta,
-                                tol=tol, max_iter=max_iter)
-    t_np = time.perf_counter() - t0
+    # Best-of-3 for the same jitter-robustness reason as the vfi metric.
+    t_np = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        *_, iters_np = nb.vfi_numpy(np.zeros((len(s), len(a))), a, s, P, r, w,
+                                    sigma=base.preferences.sigma, beta=base.preferences.beta,
+                                    tol=tol, max_iter=max_iter)
+        t_np = min(t_np, time.perf_counter() - t0)
 
     return {
         "metric": f"aiyagari_{scale_solver}_scale_grid{grid_scale}_wallclock",
