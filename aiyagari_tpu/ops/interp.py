@@ -105,21 +105,57 @@ def prolong_power_grid(Y: jnp.ndarray, lo: float, hi: float, power: float,
     n_prev = Y.shape[-1]
     dtype = Y.dtype
     span = hi - lo
+    np1, nn1 = n_prev - 1, n_new - 1
     j = jnp.arange(n_new)
-    fi = j.astype(dtype) * ((n_prev - 1) / (n_new - 1))
-    i0 = jnp.clip(jnp.floor(fi).astype(jnp.int32), 0, n_prev - 2)
+    fi = j.astype(dtype) * (np1 / nn1)
+    i0e = jnp.floor(fi).astype(jnp.int32)
 
-    def g_prev(i):
-        return lo + span * (i.astype(dtype) / (n_prev - 1)) ** power
+    if power == 2.0 and np1 * 4096 + (n_new // 4096 + 1) * nn1 < 2**31:
+        # Cancellation-free bracket and weight for the quadratic spacing law.
+        # The naive t = (q - g0)/(g1 - g0) subtracts near-equal O(hi) values:
+        # in f32 its rounding noise is a few percent of a cell near the grid
+        # top, which injects ~4e-5 absolute consumption error into every
+        # multigrid warm start (the fine-stage sweep count itself is set by
+        # the f32 ulp-noise band of the sup-norm criterion — BENCHMARKS.md).
+        # Algebraically
+        #   t = (tj^2 - ti0^2)/(ti1^2 - ti0^2)
+        #     = num * (tj + ti0) / (nn1 * (ti0 + ti1)),
+        # with tj = j/nn1, ti = i/np1, and num = (j*np1) mod nn1, the exact
+        # integer remainder — evaluated in int32 by splitting j = jh*4096+jl;
+        # the entry guard bounds the SUM jh*m1 + jl*np1 (the actual int32
+        # quantity below, jh*m1 < (n_new//4096+1)*nn1 and jl*np1 < 4096*np1),
+        # not just each factor. The exact floor i0 is
+        # recovered from the f32 position estimate plus the exact fractional
+        # part (the estimate's error, ~6e-8*j, is far below 1/2). Every
+        # factor is well-conditioned, so t carries only f32 eps relative
+        # error and the warm start only true discretization error.
+        jh, jl = j // 4096, j % 4096
+        m1 = (np1 * 4096) % nn1
+        mm = (jh * m1 + jl * np1) % nn1
+        frac_true = mm.astype(dtype) / nn1
+        k = jnp.round(fi - i0e.astype(dtype) - frac_true).astype(jnp.int32)
+        i0 = jnp.clip(i0e + k, 0, n_prev - 2)
+        tj = j.astype(dtype) / nn1
+        ti0 = i0.astype(dtype) / np1
+        ti1 = (i0 + 1).astype(dtype) / np1
+        t = mm.astype(dtype) * (tj + ti0) / (nn1 * (ti0 + ti1))
+        # The one clipped bracket is the last query (floor == np1, mm == 0):
+        # its weight is exactly 1 on the (n_prev-2, n_prev-1) cell.
+        t = jnp.where(j == nn1, 1.0, jnp.clip(t, 0.0, 1.0))
+    else:
+        i0 = jnp.clip(i0e, 0, n_prev - 2)
 
-    q = lo + span * (j.astype(dtype) / (n_new - 1)) ** power
-    # Two correction rounds absorb f32 rounding of the fractional position
-    # (cf. power_bucket_index).
-    for _ in range(2):
-        i0 = jnp.where((i0 > 0) & (g_prev(i0) > q), i0 - 1, i0)
-        i0 = jnp.where((i0 < n_prev - 2) & (g_prev(i0 + 1) <= q), i0 + 1, i0)
-    g0, g1 = g_prev(i0), g_prev(i0 + 1)
-    t = jnp.clip((q - g0) / (g1 - g0), 0.0, 1.0)
+        def g_prev(i):
+            return lo + span * (i.astype(dtype) / np1) ** power
+
+        q = lo + span * (j.astype(dtype) / nn1) ** power
+        # Two correction rounds absorb f32 rounding of the fractional
+        # position (cf. power_bucket_index).
+        for _ in range(2):
+            i0 = jnp.where((i0 > 0) & (g_prev(i0) > q), i0 - 1, i0)
+            i0 = jnp.where((i0 < n_prev - 2) & (g_prev(i0 + 1) <= q), i0 + 1, i0)
+        g0, g1 = g_prev(i0), g_prev(i0 + 1)
+        t = jnp.clip((q - g0) / (g1 - g0), 0.0, 1.0)
     y0 = jnp.take(Y, i0, axis=-1)
     y1 = jnp.take(Y, i0 + 1, axis=-1)
     return y0 * (1.0 - t) + y1 * t

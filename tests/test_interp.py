@@ -183,6 +183,22 @@ class TestPowerGridInversion:
             )(Y))
             np.testing.assert_allclose(got, want, atol=1e-9)
 
+    def test_prolong_overflow_guard_sizes_stay_correct(self):
+        # Sizes where jh*m1 + jl*np1 would wrap int32 (n_prev=524288,
+        # n_new=1000001): the entry guard must route these off the exact-
+        # remainder fast path; results still match the oracle.
+        from aiyagari_tpu.ops.interp import linear_interp, prolong_power_grid
+
+        rng = np.random.default_rng(7)
+        n_prev, n_new, power = 524_288, 1_000_001, 2.0
+        lo, hi = 0.0, 52.0
+        gp = lo + (hi - lo) * (np.arange(n_prev) / (n_prev - 1)) ** power
+        gn = lo + (hi - lo) * (np.arange(n_new) / (n_new - 1)) ** power
+        Y = jnp.asarray(rng.normal(size=(1, n_prev)))
+        got = np.asarray(prolong_power_grid(Y, lo, hi, power, n_new))
+        want = np.asarray(linear_interp(jnp.asarray(gp), Y[0], jnp.asarray(gn)))
+        np.testing.assert_allclose(got[0], want, atol=1e-7)
+
     def test_windowed_route_matches_generic(self):
         # n_k > 4096 takes the two-level windowed compare-reduce route (the
         # 40k+-point TPU fast path); same contract as the dense route.
