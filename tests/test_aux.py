@@ -265,22 +265,6 @@ class TestReports:
         assert summary["alm_path_max_rel_error"] < 0.2
 
 
-@pytest.mark.slow
-class TestExamples:
-    """The examples/ scripts (one per reference script) stay runnable."""
-
-    def test_aiyagari_egm_example_quick(self):
-        root = Path(__file__).resolve().parents[1]
-        out = subprocess.run(
-            [sys.executable, str(root / "examples" / "aiyagari_egm.py"),
-             "--quick", "--platform", "cpu"],
-            capture_output=True, text=True, timeout=500,
-        )
-        assert out.returncode == 0, out.stderr[-2000:]
-        assert "Aiyagari / EGM" in out.stdout and "wealth gini" in out.stdout
-
-
-@pytest.mark.slow
 class TestCompileCache:
     def test_enable_sets_and_env_disables(self, tmp_path, monkeypatch):
         import jax
@@ -310,6 +294,7 @@ class TestCompileCache:
                 jax.config.update(name, val)
 
 
+@pytest.mark.slow
 class TestCLI:
     def test_cli_aiyagari_end_to_end(self, tmp_path):
         out = subprocess.run(

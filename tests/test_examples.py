@@ -1,0 +1,79 @@
+"""Smoke tests for all six runnable example scripts (the reference-script
+twins; docs/USAGE.md migration table): they run, converge sanely at --quick
+scale, and print the expected summaries.
+
+Subprocesses get a SANITIZED environment (the suite's conftest forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8 and JAX_ENABLE_X64, which
+users running `python examples/foo.py` do not have) so these pin the actual
+single-device user configuration. Slow-marked: ~0.5-2 min each on CPU
+(fast once the persistent compile cache is warm).
+"""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _user_env() -> dict:
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("XLA_", "JAX_")):
+            del env[k]
+    return env
+
+
+def _run_example(name: str, *extra: str) -> str:
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / name), "--quick", "--platform", "cpu", *extra],
+        capture_output=True, text=True, timeout=540, cwd=REPO, env=_user_env(),
+    )
+    assert out.returncode == 0, f"{name} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def _check_aiyagari(stdout: str, labor: bool) -> None:
+    m = re.search(r"r\* = (-?\d+\.\d+)", stdout)
+    assert m, stdout
+    # Quick mode starves the bisection; r* must still be inside the bracket.
+    assert -0.05 < float(m.group(1)) < 0.05
+    g = re.search(r"wealth gini = (\d+\.\d+)", stdout)
+    assert g and 0.1 < float(g.group(1)) < 0.7
+    if labor:
+        l = re.search(r"mean labor supply = (\d+\.\d+)", stdout)
+        assert l and 0.2 < float(l.group(1)) < 1.2
+
+
+def _check_ks(stdout: str) -> None:
+    m = re.search(r"per-regime R\^2 = \[(\d+\.\d+), (\d+\.\d+)\]", stdout)
+    assert m, stdout
+    assert float(m.group(1)) > 0.9 and float(m.group(2)) > 0.9
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,labor", [
+    ("aiyagari_vfi.py", False),
+    ("aiyagari_egm.py", False),
+    ("aiyagari_labor_vfi.py", True),
+    ("aiyagari_labor_egm.py", True),
+])
+def test_aiyagari_examples_smoke(name, labor):
+    _check_aiyagari(_run_example(name), labor)
+
+
+@pytest.mark.slow
+def test_krusell_smith_vfi_example_smoke(tmp_path):
+    stdout = _run_example("krusell_smith_vfi.py", "--outdir", str(tmp_path))
+    _check_ks(stdout)
+    # The report surface: figures + summary.json written.
+    assert (tmp_path / "summary.json").exists()
+
+
+@pytest.mark.slow
+def test_krusell_smith_egm_example_smoke():
+    _check_ks(_run_example("krusell_smith_egm.py", "--closure", "histogram"))
