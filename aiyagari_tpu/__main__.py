@@ -30,6 +30,11 @@ def main(argv=None) -> int:
                     help="force a jax platform (JAX_PLATFORMS env is overridden "
                          "by this image's TPU plugin; use this flag)")
     ap.add_argument("--f64", action="store_true", help="force float64")
+    ap.add_argument("--dtype", choices=["float32", "float64", "mixed"], default=None,
+                    help="dtype policy (overrides --f64): 'mixed' (K-S only) "
+                         "runs the household fixed point in native f32 and the "
+                         "cross-section/regression in f64 — the TPU-native "
+                         "path to the reference's 1e-6 ALM tolerance")
     ap.add_argument("--grid", type=int, default=400, help="asset grid points (Aiyagari)")
     ap.add_argument("--periods", type=int, default=10_000, help="simulation length (Aiyagari)")
     ap.add_argument("--agents", type=int, default=1, help="simulated households (Aiyagari)")
@@ -86,9 +91,12 @@ def main(argv=None) -> int:
     # everywhere — its ALM fixed point limit-cycles in f32 (BENCHMARKS.md);
     # the solve entry points enable x64 locally via config.precision_scope.
     use_f64 = args.f64 or (jax.default_backend() == "cpu") or args.model == "ks"
-    if use_f64:
+    dtype = args.dtype or ("float64" if use_f64 else "float32")
+    if dtype == "mixed" and args.model != "ks":
+        ap.error("--dtype mixed applies to the Krusell-Smith outer loop only")
+    if dtype in ("float64", "mixed"):
         jax.config.update("jax_enable_x64", True)
-    backend = BackendConfig(dtype="float64" if use_f64 else "float32")
+    backend = BackendConfig(dtype=dtype)
 
     if args.model in ("aiyagari", "aiyagari-labor"):
         import jax.numpy as jnp

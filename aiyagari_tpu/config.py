@@ -241,17 +241,25 @@ class BackendConfig:
 
     dtype policy: the default is float64 and it is HONORED on every backend
     (the solve entry points wrap work in precision_scope, enabling x64
-    locally if needed) — the Krusell-Smith ALM fixed point requires f64 to
-    reach its 1e-6 reference tolerance (precision_scope docstring). On TPU,
-    f64 runs in extended-precision emulation; pass dtype="float32" for
-    native-speed solves where f32 accuracy suffices (the Aiyagari-family
-    solvers converge to their reference tolerances in f32 — pinned by
-    test_precision — and bench.py selects f32 on TPU explicitly, as does
-    the CLI).
+    locally if needed) — the Krusell-Smith ALM fixed point requires f64
+    somewhere to reach its 1e-6 reference tolerance (precision_scope
+    docstring). On TPU, f64 runs in extended-precision emulation; pass
+    dtype="float32" for native-speed solves where f32 accuracy suffices
+    (the Aiyagari-family solvers converge to their reference tolerances in
+    f32 — pinned by test_precision — and bench.py selects f32 on TPU
+    explicitly, as does the CLI).
+
+    dtype="mixed" (Krusell-Smith outer loop only) runs the household fixed
+    point — the per-iteration compute bulk — in native f32 and only the
+    cross-section advance + ALM regression in f64: the f32 ALM blocker is
+    noise COMPOUNDING over the 1,100-period simulation into the regression
+    coefficients, not the policy solve itself (the household fixed point
+    converges in f32, test_precision). Equilibrium/alm.py casts the f32
+    policy into the f64 simulation each outer round.
     """
 
     backend: str = "jax"              # {"jax", "numpy"}
-    dtype: str = "float64"            # {"float32", "float64"} — see policy above
+    dtype: str = "float64"            # {"float32", "float64", "mixed"} — see policy above
     mesh_axes: Tuple[str, ...] = ()
     mesh_shape: Tuple[int, ...] = ()
 
@@ -274,7 +282,8 @@ def precision_scope(dtype: str):
     """
     import jax
 
-    if dtype == "float64" and not jax.config.jax_enable_x64:
+    # "mixed" needs x64 available for its f64 simulation/regression half.
+    if dtype in ("float64", "mixed") and not jax.config.jax_enable_x64:
         return jax.enable_x64()
     import contextlib
 

@@ -1,0 +1,164 @@
+"""Roofline cost models and absolute-utilization accounting for the hot
+kernels (SURVEY.md §6: the reference publishes no performance numbers —
+README.md:1-3 — so self-measured %-of-peak is the only absolute yardstick).
+
+Each cost model counts, per sweep/step, three things XLA cannot reduce:
+
+  * mxu_flops — matmul FLOPs (2*m*n*k per [m,k]x[k,n] contraction),
+  * vpu_ops   — elementwise/compare/reduce operations (the VPU work a
+                compare-reduce kernel is made of),
+  * hbm_bytes — the unavoidable HBM traffic, assuming perfect fusion of
+                elementwise chains (operands read once, results written
+                once, broadcasts never materialized unless noted).
+
+These are *model* counts — analytic lower bounds on the work the algorithm
+specifies, not instruction counts from the compiled HLO. Utilization
+(work / time / peak) computed from them is therefore conservative: real
+programs pad, re-materialize, and round up to tile sizes, so the hardware is
+busier than the reported fraction. That direction of error is the useful one
+for "is 20.8 ms per sweep good?" questions.
+
+Chip peaks: this image's accelerator is a TPU v5 lite (v5e) core. Public
+peaks (jax-ml.github.io/scaling-book, Google Cloud docs): 197 TFLOP/s bf16
+matmul, 819 GB/s HBM bandwidth. The VPU peak is NOT published; the estimate
+below assumes 4 ALUs x (8x128) lanes x ~1.67 GHz ≈ 6.8e12 f32 op/s and is
+marked as such. MFU is quoted against the bf16 matmul peak — the chip's
+headline number — which makes MFU for VPU-dominated kernels small by
+construction; vpu_frac is the honest utilization figure for those.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = [
+    "ChipPeaks",
+    "KernelCost",
+    "CHIP_PEAKS",
+    "vfi_sweep_cost",
+    "egm_sweep_cost",
+    "panel_step_cost",
+    "utilization",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPeaks:
+    matmul_flops: float   # headline matmul peak (bf16 for TPU), FLOP/s
+    vpu_ops: float        # vector-unit elementwise peak, op/s (estimate)
+    hbm_bytes: float      # HBM bandwidth, B/s
+
+
+CHIP_PEAKS = {
+    # TPU v5e: 197 TFLOP/s bf16, 819 GB/s HBM (public); VPU estimated (see
+    # module docstring).
+    "tpu": ChipPeaks(matmul_flops=1.97e14, vpu_ops=6.8e12, hbm_bytes=8.19e11),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCost:
+    mxu_flops: float
+    vpu_ops: float
+    hbm_bytes: float
+
+    def __add__(self, other: "KernelCost") -> "KernelCost":
+        return KernelCost(self.mxu_flops + other.mxu_flops,
+                          self.vpu_ops + other.vpu_ops,
+                          self.hbm_bytes + other.hbm_bytes)
+
+    def __mul__(self, k: float) -> "KernelCost":
+        return KernelCost(self.mxu_flops * k, self.vpu_ops * k, self.hbm_bytes * k)
+
+    __rmul__ = __mul__
+
+
+def vfi_sweep_cost(N: int, na: int, itemsize: int = 4) -> KernelCost:
+    """One dense precomputed-U Bellman sweep (ops/bellman.py
+    bellman_step_precomputed): EV = beta * P @ v ([N,N]x[N,na] matmul), then
+    q = U + EV broadcast and a trailing-axis max+argmax over the [N, na, na']
+    tensor. The U tensor read is the dominant HBM term — it is na x the size
+    of every other operand and cannot be fused away (it is a solve-wide
+    constant living in HBM at fine grids)."""
+    mxu = 2.0 * N * N * na
+    # add + max-compare + argmax-compare per (i, j, j') cell.
+    vpu = 3.0 * N * na * na
+    bytes_ = itemsize * (N * na * na      # U read
+                         + 4.0 * N * na)  # v read, EV write/read, v_new+idx write
+    return KernelCost(mxu, vpu, bytes_)
+
+
+def egm_sweep_cost(N: int, na: int, itemsize: int = 4,
+                   windowed: bool | None = None,
+                   qblock: int = 512, wblocks: int = 6) -> KernelCost:
+    """One EGM sweep (ops/egm.egm_step): the Euler-RHS expectation matmul,
+    ~15 elementwise ops per (state, grid) cell (u', u'^-1, endogenous grid,
+    budget), a cummax, and the grid inversion. Inversion route per
+    ops/interp.inverse_interp_power_grid:
+
+      * dense (na <= INVERSE_DENSE_CUTOFF): 3 ops per [n_q, n_k] cell per row
+        (compare + two masked reduces);
+      * windowed: level-1 block locate (na/qblock rows x na knot compares)
+        plus 3 ops per [n_q, window] cell, window = wblocks*qblock knots.
+
+    HBM model: ~10 [N, na] arrays touched (iterate, RHS, endogenous grid,
+    policies in/out) plus the windowed route's gathered knot slabs
+    (wblocks/qblock-granular DMA: na * wblocks elements per row)."""
+    from aiyagari_tpu.ops.interp import INVERSE_DENSE_CUTOFF
+
+    if windowed is None:
+        windowed = na > INVERSE_DENSE_CUTOFF
+    mxu = 2.0 * N * N * na
+    vpu = 15.0 * N * na + 2.0 * N * na     # elementwise + cummax
+    bytes_ = itemsize * 10.0 * N * na
+    if windowed:
+        L = float(qblock * wblocks)
+        nb = -(-na // qblock)
+        vpu += N * (nb * float(na)         # level-1 locate
+                    + 3.0 * L * na)        # windowed compare-reduce
+        bytes_ += itemsize * N * (L * nb)  # window slab gathers
+    else:
+        vpu += 3.0 * N * float(na) * na
+    return KernelCost(mxu, vpu, bytes_)
+
+
+def panel_step_cost(population: int, ns: int = 4, nk: int = 100,
+                    itemsize: int = 4) -> KernelCost:
+    """One Krusell-Smith panel step (sim/ks_panel._panel_scan +
+    ops/interp.state_policy_interp): per agent, a [1,ns]x[ns,nk] one-hot row
+    pick (MXU), an nk-wide bucket one-hot + segment contraction (VPU), and
+    the mean reduction. HBM model assumes the [B, nk] one-hot and row-pick
+    intermediates materialize once each (they are matmul operands, not
+    fusable elementwise temporaries)."""
+    mxu = 2.0 * population * ns * nk       # ohS @ policies
+    vpu = population * (ns + 7.0 * nk)     # one-hot build + 4 contractions + interp
+    bytes_ = itemsize * population * (3.0 * nk + 8.0)   # ohS/sel/Y + k in/out
+    return KernelCost(mxu, vpu, bytes_)
+
+
+def utilization(seconds: float, cost: KernelCost | None, platform: str = "tpu") -> dict:
+    """Absolute utilization of `cost` executed in `seconds` on `platform`.
+
+    Returns {"mfu", "vpu_frac", "membw_frac", "bound"} — mfu counts ALL
+    model operations (MXU FLOPs + VPU ops) against the chip's headline
+    matmul peak (the conventional MFU denominator; conservative for
+    VPU-heavy kernels), vpu_frac counts VPU ops against the estimated VPU
+    peak, membw_frac counts model bytes against HBM bandwidth. "bound" names
+    the largest fraction — the resource the kernel is closest to saturating
+    under this model. Unknown platforms (CPU fallback runs) return None
+    fields so a JSON record never carries a made-up denominator; so does a
+    None cost (kernels without an analytic model)."""
+    peaks = CHIP_PEAKS.get(platform)
+    if peaks is None or cost is None or seconds <= 0:
+        return {"mfu": None, "vpu_frac": None, "membw_frac": None, "bound": None}
+    mfu = (cost.mxu_flops + cost.vpu_ops) / (seconds * peaks.matmul_flops)
+    vpu_frac = cost.vpu_ops / (seconds * peaks.vpu_ops)
+    membw_frac = cost.hbm_bytes / (seconds * peaks.hbm_bytes)
+    fracs = {"mxu": cost.mxu_flops / (seconds * peaks.matmul_flops),
+             "vpu": vpu_frac, "hbm": membw_frac}
+    return {
+        "mfu": round(mfu, 4),
+        "vpu_frac": round(vpu_frac, 4),
+        "membw_frac": round(membw_frac, 4),
+        "bound": max(fracs, key=fracs.get),
+    }

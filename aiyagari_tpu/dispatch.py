@@ -97,6 +97,11 @@ def solve(
         )
 
     if isinstance(model, AiyagariConfig):
+        if backend.dtype == "mixed":
+            raise ValueError(
+                "dtype='mixed' applies to the Krusell-Smith outer loop only; "
+                "Aiyagari solves converge natively in f32 (test_precision)"
+            )
         solver = solver or SolverConfig(method=method)
         sim = sim or SimConfig()
         equilibrium = equilibrium or EquilibriumConfig()

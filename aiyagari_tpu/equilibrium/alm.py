@@ -131,6 +131,11 @@ def solve_krusell_smith(
         raise ValueError(
             f"unknown alm.acceleration {alm.acceleration!r}; expected 'damped' or 'anderson'"
         )
+    if backend.dtype not in ("float32", "float64", "mixed"):
+        raise ValueError(
+            f"unknown backend.dtype {backend.dtype!r}; expected 'float32', "
+            "'float64', or 'mixed'"
+        )
     # Honor an f64 request even when global x64 is off — without this the
     # arrays silently truncate to f32, whose sub-cell policy jitter compounds
     # through the 1,100-period simulation into an ALM limit cycle at
@@ -160,8 +165,25 @@ def _solve_krusell_smith_impl(
 ) -> KSResult:
     use_histogram = closure == "histogram"
     t0 = time.perf_counter()
-    dtype = jnp.float64 if backend.dtype == "float64" else jnp.float32
-    model = KrusellSmithModel.from_config(config, dtype)
+    # Mixed-precision design (BackendConfig.dtype docstring): under "mixed"
+    # the outer loop is two-phase iterative refinement. Phase 1 runs the
+    # household fixed point on f32 DOWNCASTS of the f64 tables (TPU-native
+    # speed for the compute bulk) with the cross-section advance + regression
+    # in f64, and iterates until the f32 policy noise floor — diff_B stalls
+    # at O(1e-3): the Bellman objective is flat below f32 resolution near
+    # its maximizer, so the policy jitters sub-cell between outer rounds.
+    # Phase 2 switches the household solve to the f64 master tables,
+    # warm-started from the f32 value/policy (so its inner fixed points run
+    # a handful of sweeps), and polishes to the reference's 1e-6. Both
+    # phases share one master model, so the final fixed point is exactly the
+    # plain-f64 pipeline's.
+    mixed = backend.dtype == "mixed"
+    master_dtype = jnp.float32 if backend.dtype == "float32" else jnp.float64
+    model = KrusellSmithModel.from_config(config, master_dtype)
+    house = model.astype(jnp.float32) if mixed else model
+    sim_dtype = master_dtype
+    dtype = house.dtype                  # household-phase dtype (may switch)
+    k_grid_sim, K_grid_sim, eps_trans_sim = model.k_grid, model.K_grid, model.eps_trans
     solver = solver or _default_ks_solver_config(method)
     prefs = config.preferences
     tech = config.technology
@@ -175,7 +197,7 @@ def _solve_krusell_smith_impl(
         eps_panel = None
     else:
         eps_panel = simulate_employment_panel(
-            z_path, model.eps_trans, sh.u_good, sh.u_bad, k_eps, T=alm.T,
+            z_path, eps_trans_sim, sh.u_good, sh.u_bad, k_eps, T=alm.T,
             population=alm.population,
         )
         # Device-mesh placement: with backend.mesh_axes containing "agents",
@@ -197,9 +219,9 @@ def _solve_krusell_smith_impl(
     # panel closure, an (employment, capital) histogram for the Young closure.
     if use_histogram:
         u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
-        cross = initial_distribution(model.k_grid, model.K_grid, u0, dtype)
+        cross = initial_distribution(k_grid_sim, K_grid_sim, u0, sim_dtype)
     else:
-        cross = jnp.full((alm.population,), float(model.K_grid[0]), dtype)
+        cross = jnp.full((alm.population,), float(model.K_grid[0]), sim_dtype)
         if panel_sharding is not None:
             cross = jax.device_put(cross, panel_sharding)
     B = np.array([0.0, 1.0, 0.0, 1.0])
@@ -207,6 +229,12 @@ def _solve_krusell_smith_impl(
     records = []
     start_it = 0
     mgr = None
+    B_hist: list = []
+    G_hist: list = []
+    # Mixed-phase switch state (part of the iterate trajectory, like the
+    # Anderson history — checkpointed and restored with it).
+    best_f32 = np.inf   # best diff_B seen in the mixed f32 phase
+    f32_stall = 0       # consecutive rounds without meaningful f32 progress
     if checkpoint_dir is not None:
         from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
 
@@ -224,26 +252,36 @@ def _solve_krusell_smith_impl(
             records = sc["records"]
             start_it = min(sc["iteration"] + 1, alm.max_iter - 1)
             records = records[:start_it]
+            # Mixed runs resume into the phase they checkpointed in (a resume
+            # mid-polish must not drop back to f32 and re-stall).
+            if mixed and sc.get("house_phase") == "float64":
+                house, dtype = model, model.dtype
             value = jnp.asarray(arrays["value"], dtype)
             k_opt = jnp.asarray(arrays["k_opt"], dtype)
             # legacy checkpoints stored the cross-section as "k_population"
-            cross = jnp.asarray(arrays.get("cross", arrays.get("k_population")), dtype)
+            cross = jnp.asarray(arrays.get("cross", arrays.get("k_population")), sim_dtype)
             if panel_sharding is not None:
                 cross = jax.device_put(cross, panel_sharding)
+            # Anderson mixing history (short: depth+1 entries) — persisted so
+            # a resume continues extrapolating from the pre-crash trajectory
+            # instead of silently re-warming with damped steps. Absent in
+            # legacy checkpoints (-> empty, the cold-start behavior).
+            B_hist = [np.asarray(b, np.float64) for b in sc.get("B_hist", [])]
+            G_hist = [np.asarray(g, np.float64) for g in sc.get("G_hist", [])]
+            best_f32 = float(sc.get("best_f32", np.inf))
+            f32_stall = int(sc.get("f32_stall", 0))
 
     converged = False
     diff_B = np.inf
     r2 = np.zeros(2)
     sol = None
-    B_hist: list = []
-    G_hist: list = []
     for it in range(start_it, alm.max_iter):
         it_t0 = time.perf_counter()
         B_dev = jnp.asarray(B, dtype)
         if solver.method == "vfi":
             sol = solve_ks_vfi(
-                value, k_opt, B_dev, model.k_grid, model.K_grid, model.P,
-                model.r_table, model.w_table, model.eps_by_state,
+                value, k_opt, B_dev, house.k_grid, house.K_grid, house.P,
+                house.r_table, house.w_table, house.eps_by_state,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=solver.tol, max_iter=solver.max_iter,
@@ -254,9 +292,9 @@ def _solve_krusell_smith_impl(
             value = sol.value
         elif solver.method == "egm":
             sol = solve_ks_egm(
-                k_opt, B_dev, model.k_grid, model.K_grid, model.P,
-                model.r_table, model.w_table, model.eps_by_state,
-                model.z_by_state, model.L_by_state, tech.alpha,
+                k_opt, B_dev, house.k_grid, house.K_grid, house.P,
+                house.r_table, house.w_table, house.eps_by_state,
+                house.z_by_state, house.L_by_state, tech.alpha,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=solver.tol, max_iter=solver.max_iter, double_alm=double_alm,
@@ -266,6 +304,10 @@ def _solve_krusell_smith_impl(
             raise ValueError(f"unknown method {solver.method!r}")
         k_opt = sol.k_opt
 
+        # The policy enters the simulation in sim_dtype (a no-op cast except
+        # under "mixed", where the f32 household policy feeds the f64
+        # cross-section advance — BackendConfig.dtype docstring).
+        k_opt_sim = sol.k_opt.astype(sim_dtype)
         if use_histogram:
             # Warm-starting reuses last iteration's capital distribution, but
             # the scan's conditional employment chains assume the employment
@@ -273,16 +315,16 @@ def _solve_krusell_smith_impl(
             # u(z_{T-1})) — rescale the rows so the exact-u(z_t) invariant
             # holds every iteration. Idempotent on the first pass.
             u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
-            target = jnp.asarray([1.0 - u0, u0], dtype)
+            target = jnp.asarray([1.0 - u0, u0], sim_dtype)
             row_mass = jnp.sum(cross, axis=1, keepdims=True)
             cross = cross * (target[:, None] / jnp.maximum(row_mass, 1e-300))
             K_ts, cross_new = distribution_capital_path(
-                sol.k_opt, model.k_grid, model.K_grid, z_path, model.eps_trans,
+                k_opt_sim, k_grid_sim, K_grid_sim, z_path, eps_trans_sim,
                 cross, T=alm.T,
             )
         else:
             K_ts, cross_new = simulate_capital_path(
-                sol.k_opt, model.k_grid, model.K_grid, z_path, eps_panel,
+                k_opt_sim, k_grid_sim, K_grid_sim, z_path, eps_panel,
                 cross, T=alm.T,
             )
         B_new, r2_dev = alm_regression(K_ts, z_path, alm.discard)
@@ -300,6 +342,7 @@ def _solve_krusell_smith_impl(
             "solver_distance": float(sol.distance),
             "K_mean": float(np.mean(np.asarray(K_ts)[alm.discard:])),
             "seconds": time.perf_counter() - it_t0,
+            "house_dtype": str(np.dtype(dtype)),
         }
         records.append(rec)
         if on_iteration is not None:
@@ -310,6 +353,20 @@ def _solve_krusell_smith_impl(
             B = B_new
             cross = cross_new
             break
+        if mixed and np.dtype(dtype) == np.float32:
+            # Phase-switch rule: the f32 phase ends when diff_B stops making
+            # real progress (two consecutive rounds within 10% of the best so
+            # far — the f32 policy noise floor, O(1e-3), is flat while the
+            # contraction phase shrinks ~(1-damping) per round) or when it is
+            # already within 50x of tol (f64 finishes that gap in a couple of
+            # warm-started rounds either way).
+            stalled = diff_B >= 0.9 * best_f32
+            best_f32 = min(best_f32, diff_B)
+            f32_stall = f32_stall + 1 if stalled else 0
+            if f32_stall >= 2 or diff_B < 50.0 * alm.tol:
+                house, dtype = model, model.dtype
+                value = value.astype(dtype)
+                k_opt = k_opt.astype(dtype)
         if alm.acceleration == "anderson":
             B_hist.append(B.copy())
             G_hist.append(B_new.copy())
@@ -323,7 +380,11 @@ def _solve_krusell_smith_impl(
         cross = cross_new
         if mgr is not None:
             mgr.save(
-                scalars={"iteration": it, "B": B.tolist(), "records": records},
+                scalars={"iteration": it, "B": B.tolist(), "records": records,
+                         "B_hist": [b.tolist() for b in B_hist],
+                         "G_hist": [g.tolist() for g in G_hist],
+                         "house_phase": str(np.dtype(dtype)),
+                         "best_f32": float(best_f32), "f32_stall": f32_stall},
                 arrays={
                     "value": np.asarray(value),
                     "k_opt": np.asarray(k_opt),

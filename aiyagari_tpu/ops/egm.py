@@ -24,13 +24,15 @@ from aiyagari_tpu.utils.utility import (
 __all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power", "with_escape"))
 def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-             grid_power: float = 0.0):
+             grid_power: float = 0.0, with_escape: bool = False):
     """One EGM policy update, exogenous labor.
 
     C [N, na] (consumption policy on the exogenous grid) ->
-    (C_new [N, na], policy_k [N, na]).
+    (C_new [N, na], policy_k [N, na]); with_escape=True appends the windowed
+    inversion's scalar escape flag (always False off the fast path), which
+    host retry wrappers use to tell a window escape from genuine divergence.
 
     Steps mirror Aiyagari_EGM.m:74-110:
       1. RHS[i,:] = beta*(1+r) * sum_m P[i,m] u'(C[m,:])   (one matmul)
@@ -65,9 +67,11 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # HLO compiles in seconds where the combinator's takes tens of seconds on
     # this image's remote-compile path at 40k+ points.
     a_hat = jax.lax.cummax(a_hat, axis=1)
+    escaped = jnp.array(False)
     if grid_power > 0.0:
-        policy_k = inverse_interp_power_grid(
-            a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1]
+        policy_k, escaped = inverse_interp_power_grid(
+            a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1],
+            with_escape=True,
         )
     else:
         policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
@@ -79,6 +83,8 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # VFI solver's choice set.
     policy_k = jnp.clip(policy_k, amin, a_grid[-1])               # :98
     C_new = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
+    if with_escape:
+        return C_new, policy_k, escaped
     return C_new, policy_k
 
 

@@ -217,7 +217,7 @@ _INV_WBLOCKS = 6        # knot blocks per window (window covers 6x local density
 
 
 def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float,
-                              n_q: int) -> jnp.ndarray:
+                              n_q: int, *, with_escape: bool = False):
     """Interpolate the inverse of a monotone map onto a power-spaced grid:
     given sorted knots x[..., k] = f(g_k) over the grid
     g_k = lo + (hi-lo)*(k/(n_k-1))^power, return, for each query point g_j of
@@ -263,7 +263,12 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
     differ by less than the local grid spacing, below the solvers'
     tolerance); queries strictly inside a zero-width bracket cannot occur.
 
-    x: [..., n_k] sorted ascending along the last axis. Returns [..., n_q].
+    x: [..., n_k] sorted ascending along the last axis. Returns [..., n_q];
+    with_escape=True returns (out, escaped) where escaped is a scalar bool
+    array that is True iff the windowed route actually escaped (always False
+    on the dense route) — this is how host-level retry wrappers distinguish a
+    window escape from genuine numerical divergence, which also NaNs
+    (solvers/egm.solve_aiyagari_egm_safe).
     Both grids share (lo, hi, power); n_k and n_q may differ (the EGM sweep
     uses n_k == n_q; the mismatched case is kept because the kernel is the
     grid-family-generic inverse, pinned by TestPowerGridInversion's
@@ -315,8 +320,10 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
             return finish(cnt, x0, x1, xr)
 
         if x.ndim == 1:
-            return dense_row(x)
-        return jax.vmap(dense_row)(x.reshape((-1, n_k))).reshape(x.shape[:-1] + (n_q,))
+            out = dense_row(x)
+        else:
+            out = jax.vmap(dense_row)(x.reshape((-1, n_k))).reshape(x.shape[:-1] + (n_q,))
+        return (out, jnp.array(False)) if with_escape else out
 
     S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
     nkb = -(-n_k // KB)            # >= 8 under the dense gate, so nkb >= M
@@ -357,10 +364,12 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
 
     if x.ndim == 1:
         out, escape = windowed_row(x)
-        return jnp.where(escape, jnp.nan, out)
+        out = jnp.where(escape, jnp.nan, out)
+        return (out, escape) if with_escape else out
     outs, escapes = jax.vmap(windowed_row)(x.reshape((-1, n_k)))
-    outs = jnp.where(jnp.any(escapes), jnp.nan, outs)
-    return outs.reshape(x.shape[:-1] + (n_q,))
+    escape = jnp.any(escapes)
+    outs = jnp.where(escape, jnp.nan, outs).reshape(x.shape[:-1] + (n_q,))
+    return (outs, escape) if with_escape else outs
 
 
 def linear_interp(x: jnp.ndarray, y: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:

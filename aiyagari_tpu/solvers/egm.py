@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
-from aiyagari_tpu.ops.interp import INVERSE_DENSE_CUTOFF, prolong_power_grid
+from aiyagari_tpu.ops.interp import prolong_power_grid
 
 __all__ = [
     "EGMSolution",
@@ -41,13 +41,18 @@ def initial_consumption_guess(a_grid, s, r, w):
 @dataclasses.dataclass(frozen=True)
 class EGMSolution:
     """Converged policies on the exogenous grid. policy_l is all-ones for
-    exogenous-labor models."""
+    exogenous-labor models. `escaped` is True iff some sweep's windowed
+    fast-path inversion escaped its static windows (the NaN-poisoning
+    contract, ops/interp.inverse_interp_power_grid) — a NaN distance with
+    escaped=False is genuine numerical divergence, and retry wrappers must
+    not mask it by re-solving."""
 
     policy_c: jax.Array       # [N, na]
     policy_k: jax.Array       # [N, na]
     policy_l: jax.Array       # [N, na]
     iterations: jax.Array
     distance: jax.Array
+    escaped: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(False))
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power"))
@@ -61,21 +66,24 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     (ops/egm.egm_step docstring)."""
 
     def cond(carry):
-        _, _, dist, it = carry
+        _, _, dist, it, _ = carry
         return (dist >= tol) & (it < max_iter)
 
     def body(carry):
-        C, _, _, it = carry
-        C_new, policy_k = egm_step(C, a_grid, s, P, r, w, amin, sigma=sigma,
-                                   beta=beta, grid_power=grid_power)
+        C, _, _, it, esc = carry
+        C_new, policy_k, esc_new = egm_step(C, a_grid, s, P, r, w, amin,
+                                            sigma=sigma, beta=beta,
+                                            grid_power=grid_power,
+                                            with_escape=True)
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
         device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
-        return C_new, policy_k, dist, it + 1
+        return C_new, policy_k, dist, it + 1, esc | esc_new
 
-    init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
-    C, policy_k, dist, it = jax.lax.while_loop(cond, body, init)
-    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist)
+    init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype),
+            jnp.int32(0), jnp.array(False))
+    C, policy_k, dist, it, esc = jax.lax.while_loop(cond, body, init)
+    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc)
 
 
 def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
@@ -85,20 +93,20 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
-    sweep with NaN (ops/interp.inverse_interp_power_grid), the while_loop
-    exits on the NaN distance, and this wrapper re-solves on the generic
-    exact route (grid_power=0). Host-level by design — callers inside jit
-    should use solve_aiyagari_egm directly and accept the documented poisoning
-    contract. The retry only arms on grids above the kernel's dense cutoff:
-    smaller grids take the escape-free dense route, so a NaN there is genuine
-    numerical divergence and re-solving would mask it (and double the cost)."""
+    sweep with NaN and raises the solution's `escaped` flag
+    (ops/interp.inverse_interp_power_grid), the while_loop exits on the NaN
+    distance, and this wrapper re-solves on the generic exact route
+    (grid_power=0). Host-level by design — callers inside jit should use
+    solve_aiyagari_egm directly and accept the documented poisoning contract.
+    The retry arms on the `escaped` flag, not on NaN itself: genuine
+    numerical divergence also yields a NaN distance (on any grid size), and
+    re-solving there would double the cost only to return the same NaN."""
     sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                              beta=beta, tol=tol, max_iter=max_iter,
                              relative_tol=relative_tol,
                              progress_every=progress_every,
                              grid_power=grid_power)
-    can_escape = grid_power > 0.0 and a_grid.shape[-1] > INVERSE_DENSE_CUTOFF
-    if can_escape and bool(jnp.isnan(sol.distance)):
+    if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
                                  relative_tol=relative_tol,
@@ -137,7 +145,7 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
     z = jnp.zeros_like(C_init)
     init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
     C, policy_k, policy_l, dist, it = jax.lax.while_loop(cond, body, init)
-    return EGMSolution(C, policy_k, policy_l, it, dist)
+    return EGMSolution(C, policy_k, policy_l, it, dist, jnp.array(False))
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -166,11 +174,21 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     stage is the jitted solve_aiyagari_egm fixed point, launched without any
     host synchronization between stages — the windowed fast path's escape
     NaN (ops/interp.inverse_interp_power_grid) propagates through the
-    remaining stages, so one isnan check at the end decides the generic-route
-    retry for the whole ladder.
+    remaining stages, and the per-stage `escaped` flags are OR-ed on device,
+    so one host read at the end decides the generic-route retry for the
+    whole ladder.
     """
     from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
 
+    if grid_power <= 0.0:
+        # 0.0 is solve_aiyagari_egm's "not power-spaced" sentinel; here it
+        # would collapse every stage grid to the top point (t**0 == 1) and
+        # poison the prolongation with 0/0 — fail loudly instead, like
+        # solve_aiyagari_vfi_multiscale.
+        raise ValueError(
+            "solve_aiyagari_egm_multiscale requires a power-spaced grid: pass "
+            f"its actual spacing exponent as grid_power, got {grid_power}"
+        )
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
     lo, hi = float(a_grid[0]), float(a_grid[-1])
@@ -184,6 +202,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     def run_ladder(fast: bool) -> EGMSolution:
         C = initial_consumption_guess(_grid(sizes[0]), s, r, w).astype(dtype)
         sol = None
+        esc = jnp.array(False)
         for i, n in enumerate(sizes):
             if i > 0:
                 C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
@@ -193,11 +212,12 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                      relative_tol=relative_tol,
                                      progress_every=progress_every,
                                      grid_power=grid_power if fast else 0.0)
-        return sol
+            esc = esc | sol.escaped
+        return dataclasses.replace(sol, escaped=esc)
 
     sol = run_ladder(fast=True)
-    # Retry only arms when some stage ran the windowed (escape-capable)
-    # route; a NaN on dense-only ladders is genuine divergence.
-    if sizes[-1] > INVERSE_DENSE_CUTOFF and bool(jnp.isnan(sol.distance)):
+    # Retry only arms when some stage's windowed route actually escaped; a
+    # NaN distance with escaped=False is genuine divergence and surfaces.
+    if bool(sol.escaped):
         sol = run_ladder(fast=False)
     return sol

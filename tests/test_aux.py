@@ -234,6 +234,34 @@ class TestCheckpoint:
         resumed = solve_krusell_smith(cfg, alm=alm, checkpoint_dir=tmp_path, **kw)
         np.testing.assert_allclose(resumed.B, full.B, atol=1e-10)
 
+    def test_ks_resume_preserves_anderson_history(self, tmp_path):
+        # The Anderson mixing history is part of the outer-loop state: a
+        # resume must continue extrapolating from the pre-crash trajectory,
+        # i.e. reproduce the uninterrupted run's iterates exactly. Interrupt
+        # AFTER iteration 1 so the saved history is non-empty (depth >= 1)
+        # and the post-resume step actually uses it.
+        cfg = KrusellSmithConfig(k_size=15)
+        alm = ALMConfig(T=120, population=300, discard=30, max_iter=4, seed=2,
+                        acceleration="anderson")
+        kw = dict(method="vfi",
+                  solver=SolverConfig(method="vfi", tol=1e-4, max_iter=50, howard_steps=10))
+        full = solve_krusell_smith(cfg, alm=alm, **kw)
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_krusell_smith(cfg, alm=alm, on_iteration=interrupt,
+                                checkpoint_dir=tmp_path, **kw)
+        resumed = solve_krusell_smith(cfg, alm=alm, checkpoint_dir=tmp_path, **kw)
+        np.testing.assert_allclose(resumed.B, full.B, atol=1e-10)
+        for r_full, r_res in zip(full.per_iteration[2:], resumed.per_iteration[2:]):
+            np.testing.assert_allclose(r_res["B"], r_full["B"], atol=1e-10)
+
 
 class TestReports:
     def test_equilibrium_report(self, tmp_path):

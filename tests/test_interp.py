@@ -260,9 +260,9 @@ class TestPowerGridInversion:
 
     def test_safe_solver_retries_generic_route_on_poison(self, monkeypatch):
         # Wiring of the poison-then-retry cycle: stub the jitted solve so the
-        # fast path returns a poisoned (NaN-distance) solution on a
-        # windowed-regime grid, and check the wrapper re-dispatches the SAME
-        # problem on the generic route and returns its converged answer.
+        # fast path returns a poisoned (NaN-distance, escaped=True) solution
+        # on a windowed-regime grid, and check the wrapper re-dispatches the
+        # SAME problem on the generic route and returns its converged answer.
         import aiyagari_tpu.solvers.egm as egm_mod
 
         calls = []
@@ -275,7 +275,8 @@ class TestPowerGridInversion:
                 return egm_mod.EGMSolution(
                     jnp.full_like(sol.policy_c, jnp.nan), sol.policy_k,
                     sol.policy_l, sol.iterations,
-                    jnp.array(jnp.nan, sol.distance.dtype))
+                    jnp.array(jnp.nan, sol.distance.dtype),
+                    jnp.array(True))
             return sol
 
         monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
@@ -305,7 +306,8 @@ class TestPowerGridInversion:
                 return egm_mod.EGMSolution(
                     jnp.full_like(sol.policy_c, jnp.nan), sol.policy_k,
                     sol.policy_l, sol.iterations,
-                    jnp.array(jnp.nan, sol.distance.dtype))
+                    jnp.array(jnp.nan, sol.distance.dtype),
+                    jnp.array(True))
             return sol
 
         monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
@@ -321,6 +323,63 @@ class TestPowerGridInversion:
                          (400, 0.0), (500, 0.0), (5000, 0.0)]
         assert float(sol.distance) < 1e-5
         assert not np.isnan(np.asarray(sol.policy_c)).any()
+
+    def test_safe_solver_does_not_retry_on_genuine_divergence(self, monkeypatch):
+        # A NaN distance WITHOUT the escape flag is genuine numerical
+        # divergence: the wrapper must surface it (one dispatch, NaN result),
+        # not mask it behind a doubled-cost generic re-solve.
+        import aiyagari_tpu.solvers.egm as egm_mod
+
+        calls = []
+        real = egm_mod.solve_aiyagari_egm
+
+        def stub(C0, a_grid, s, P, r, w, amin, **kw):
+            calls.append(kw["grid_power"])
+            sol = real(C0, a_grid, s, P, r, w, amin, **kw)
+            return egm_mod.EGMSolution(
+                jnp.full_like(sol.policy_c, jnp.nan), sol.policy_k,
+                sol.policy_l, sol.iterations,
+                jnp.array(jnp.nan, sol.distance.dtype),
+                jnp.array(False))
+
+        monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
+        n = 5000   # windowed regime, where the old isnan heuristic would retry
+        a_grid = jnp.asarray(52.0 * (np.arange(n) / (n - 1)) ** 2.0)
+        s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
+        C0 = egm_mod.initial_consumption_guess(a_grid, s, 0.04, 1.2)
+        sol = egm_mod.solve_aiyagari_egm_safe(
+            C0, a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
+            tol=1e-5, max_iter=1000, grid_power=2.0)
+        assert calls == [2.0]
+        assert np.isnan(float(sol.distance))
+
+    def test_multiscale_egm_rejects_non_power_grid(self):
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
+
+        a_grid = jnp.linspace(0.0, 52.0, 800)
+        s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
+        with pytest.raises(ValueError, match="power-spaced"):
+            solve_aiyagari_egm_multiscale(
+                a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
+                tol=1e-5, max_iter=1000, grid_power=0.0)
+
+    def test_windowed_escape_flag_reported(self):
+        # with_escape=True surfaces the escape bit alongside the NaN poison.
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+
+        n = 8192
+        lo, hi, power = 0.0, 52.0, 2.0
+        gq = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        cluster = np.linspace(gq[3000], gq[3001], 5000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 5000).astype(int)]
+        x = np.sort(np.concatenate([cluster, rest]))[:n]
+        out, esc = inverse_interp_power_grid(jnp.asarray(x), lo, hi, power, n,
+                                             with_escape=True)
+        assert bool(esc) and np.isnan(np.asarray(out)).all()
+        # Benign knots: flag stays down.
+        out2, esc2 = inverse_interp_power_grid(jnp.asarray(gq * 0.97), lo, hi,
+                                               power, n, with_escape=True)
+        assert not bool(esc2) and not np.isnan(np.asarray(out2)).any()
 
     def test_egm_step_fast_path_matches_generic(self):
         from aiyagari_tpu.models.aiyagari import aiyagari_preset

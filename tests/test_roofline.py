@@ -1,0 +1,64 @@
+"""Roofline cost-model accounting (diagnostics/roofline.py, SURVEY.md §6)."""
+
+import pytest
+
+from aiyagari_tpu.diagnostics.roofline import (
+    CHIP_PEAKS,
+    KernelCost,
+    egm_sweep_cost,
+    panel_step_cost,
+    utilization,
+    vfi_sweep_cost,
+)
+
+
+class TestKernelCosts:
+    def test_vfi_sweep_counts(self):
+        c = vfi_sweep_cost(7, 400, 4)
+        assert c.mxu_flops == 2 * 7 * 7 * 400
+        assert c.vpu_ops == 3 * 7 * 400 * 400
+        assert c.hbm_bytes == 4 * (7 * 400 * 400 + 4 * 7 * 400)
+
+    def test_egm_routes_split_at_dense_cutoff(self):
+        dense = egm_sweep_cost(7, 4096, 4)
+        windowed = egm_sweep_cost(7, 4097, 4)
+        # Dense route is quadratic in na; windowed is linear with a 3*L
+        # constant — at the cutoff boundary dense is the bigger count.
+        assert dense.vpu_ops > windowed.vpu_ops * 0.25
+        assert egm_sweep_cost(7, 400_000, 4).vpu_ops < egm_sweep_cost(
+            7, 400_000, 4, windowed=False).vpu_ops
+
+    def test_windowed_scaling_is_near_linear(self):
+        # Dominant 3*L*na term is linear in na; the level-1 block locate
+        # (na^2/qblock) adds a sub-10% superlinear correction at these sizes.
+        a = egm_sweep_cost(7, 100_000, 4)
+        b = egm_sweep_cost(7, 400_000, 4)
+        assert b.vpu_ops == pytest.approx(4 * a.vpu_ops, rel=0.10)
+
+    def test_cost_algebra(self):
+        c = panel_step_cost(10_000)
+        s = 3 * c + c
+        assert s.mxu_flops == 4 * c.mxu_flops
+        assert s.hbm_bytes == 4 * c.hbm_bytes
+
+
+class TestUtilization:
+    def test_fractions_against_documented_peaks(self):
+        cost = KernelCost(mxu_flops=0.985e12, vpu_ops=6.8e10, hbm_bytes=8.19e6)
+        u = utilization(0.01, cost, "tpu")
+        peaks = CHIP_PEAKS["tpu"]
+        assert u["mfu"] == pytest.approx(
+            (cost.mxu_flops + cost.vpu_ops) / (0.01 * peaks.matmul_flops), abs=1e-3)
+        assert u["vpu_frac"] == pytest.approx(1.0, abs=1e-3)   # 6.8e10 in 10 ms = VPU peak
+        assert u["membw_frac"] == pytest.approx(0.001, abs=1e-4)
+        assert u["bound"] == "vpu"
+
+    def test_unknown_platform_yields_nulls(self):
+        u = utilization(1.0, vfi_sweep_cost(7, 400), "cpu")
+        assert u == {"mfu": None, "vpu_frac": None, "membw_frac": None, "bound": None}
+
+    def test_bound_picks_the_saturated_resource(self):
+        hbm_heavy = KernelCost(mxu_flops=1.0, vpu_ops=1.0, hbm_bytes=8.19e11)
+        assert utilization(1.0, hbm_heavy, "tpu")["bound"] == "hbm"
+        mxu_heavy = KernelCost(mxu_flops=1.97e14, vpu_ops=1.0, hbm_bytes=1.0)
+        assert utilization(1.0, mxu_heavy, "tpu")["bound"] == "mxu"
