@@ -32,8 +32,8 @@ def main(argv=None) -> int:
     ap.add_argument("--f64", action="store_true", help="force float64")
     ap.add_argument("--dtype", choices=["float32", "float64", "mixed"], default=None,
                     help="dtype policy (overrides --f64): 'mixed' (K-S only) "
-                         "runs the household fixed point in native f32 and the "
-                         "cross-section/regression in f64 — the TPU-native "
+                         "runs the household solve + regression in f64 and the "
+                         "cross-section scan in native f32 — the TPU-native "
                          "path to the reference's 1e-6 ALM tolerance")
     ap.add_argument("--grid", type=int, default=400, help="asset grid points (Aiyagari)")
     ap.add_argument("--periods", type=int, default=10_000, help="simulation length (Aiyagari)")
@@ -55,11 +55,6 @@ def main(argv=None) -> int:
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
-    # After argparse so --help and flag errors stay instant (no jax import).
-    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
-
-    enable_compilation_cache()
-
     if args.platform:
         import jax
 
@@ -67,6 +62,13 @@ def main(argv=None) -> int:
         # unavailable instead of silently auto-detecting onto CPU.
         jax.config.update("jax_platforms", args.platform)
     import jax
+
+    # After the platform choice (the cache dir is keyed by it — a CPU-forced
+    # run must not share AOT artifacts with TPU-attached runs), and after
+    # argparse so --help stays instant.
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from aiyagari_tpu.config import (
         ALMConfig,

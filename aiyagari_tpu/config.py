@@ -249,13 +249,16 @@ class BackendConfig:
     f32 — pinned by test_precision — and bench.py selects f32 on TPU
     explicitly, as does the CLI).
 
-    dtype="mixed" (Krusell-Smith outer loop only) runs the household fixed
-    point — the per-iteration compute bulk — in native f32 and only the
-    cross-section advance + ALM regression in f64: the f32 ALM blocker is
-    noise COMPOUNDING over the 1,100-period simulation into the regression
-    coefficients, not the policy solve itself (the household fixed point
-    converges in f32, test_precision). Equilibrium/alm.py casts the f32
-    policy into the f64 simulation each outer round.
+    dtype="mixed" (Krusell-Smith outer loop only) assigns each component
+    the cheapest dtype that preserves the 1e-6 ALM tolerance, from v5e
+    measurements (equilibrium/alm.py design note): the household solve and
+    the regression run in f64 — the solve is op-latency-bound at the
+    reference scale, so f64 there costs nothing, and it is where the f32
+    noise (sub-cell policy jitter) actually originates — while the
+    1,100-step cross-section scan, 18x slower in emulated f64, runs in
+    native f32 (its rounding is a fixed O(eps) bias in a deterministic
+    map, not compounding noise). A stall detector falls back to the f64
+    simulation if the bias floor ever exceeds tol.
     """
 
     backend: str = "jax"              # {"jax", "numpy"}

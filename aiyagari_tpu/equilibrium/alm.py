@@ -165,25 +165,35 @@ def _solve_krusell_smith_impl(
 ) -> KSResult:
     use_histogram = closure == "histogram"
     t0 = time.perf_counter()
-    # Mixed-precision design (BackendConfig.dtype docstring): under "mixed"
-    # the outer loop is two-phase iterative refinement. Phase 1 runs the
-    # household fixed point on f32 DOWNCASTS of the f64 tables (TPU-native
-    # speed for the compute bulk) with the cross-section advance + regression
-    # in f64, and iterates until the f32 policy noise floor — diff_B stalls
-    # at O(1e-3): the Bellman objective is flat below f32 resolution near
-    # its maximizer, so the policy jitters sub-cell between outer rounds.
-    # Phase 2 switches the household solve to the f64 master tables,
-    # warm-started from the f32 value/policy (so its inner fixed points run
-    # a handful of sweeps), and polishes to the reference's 1e-6. Both
-    # phases share one master model, so the final fixed point is exactly the
-    # plain-f64 pipeline's.
+    # Mixed-precision design (BackendConfig.dtype docstring). Measured on the
+    # v5e at the reference scale: the household fixed point costs the SAME in
+    # f32 and f64 (~0.09 s warm — it is op-latency-bound at [4,4,100], not
+    # FLOP-bound), while the 1,100-step cross-section scan is 18x slower in
+    # emulated f64 (1.68 s vs 0.094 s; 120x at k_size=1000). And the f32 ALM
+    # blocker is household-side: sub-cell policy jitter (full-f32 limit
+    # cycles at diff_B ~ 5e-2; f32-house+f64-sim floors at ~2e-3), whereas
+    # the simulation is a DETERMINISTIC map of (policy, shocks) whose f32
+    # rounding is a fixed O(eps) bias, not compounding noise. So "mixed"
+    # puts f64 where it is free and needed (household solve, regression) and
+    # f32 where it pays (the simulation scan): phase 1 advances the
+    # cross-section in f32; if diff_B ever stalls above tol (the f32-sim
+    # bias floor — not observed at the shipped scales), phase 2 switches the
+    # simulation to f64 and polishes. The regression always runs in f64 on
+    # the (cast) simulated path.
     mixed = backend.dtype == "mixed"
     master_dtype = jnp.float32 if backend.dtype == "float32" else jnp.float64
     model = KrusellSmithModel.from_config(config, master_dtype)
-    house = model.astype(jnp.float32) if mixed else model
-    sim_dtype = master_dtype
-    dtype = house.dtype                  # household-phase dtype (may switch)
-    k_grid_sim, K_grid_sim, eps_trans_sim = model.k_grid, model.K_grid, model.eps_trans
+    dtype = model.dtype                  # household solve always in master dtype
+    sim_dtype = jnp.float32 if mixed else master_dtype   # may switch to f64
+
+    def sim_tables():
+        # Casts of the master tables at the CURRENT simulation dtype (cast,
+        # not rebuilt: the policy is tabulated on the master knots, and a
+        # rebuild would shift them by rounding).
+        return (model.k_grid.astype(sim_dtype), model.K_grid.astype(sim_dtype),
+                model.eps_trans.astype(sim_dtype))
+
+    k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
     solver = solver or _default_ks_solver_config(method)
     prefs = config.preferences
     tech = config.technology
@@ -196,8 +206,13 @@ def _solve_krusell_smith_impl(
     if use_histogram:
         eps_panel = None
     else:
+        # Drawn with the MASTER-dtype probabilities: the uniform stream (and
+        # so the realized panel) must be identical across dtype policies —
+        # under "mixed" an f32 draw would be a different Monte-Carlo sample,
+        # shifting B by sampling error O(1e-2), dwarfing any arithmetic
+        # difference. One-time cost; the panel itself is int32.
         eps_panel = simulate_employment_panel(
-            z_path, eps_trans_sim, sh.u_good, sh.u_bad, k_eps, T=alm.T,
+            z_path, model.eps_trans, sh.u_good, sh.u_bad, k_eps, T=alm.T,
             population=alm.population,
         )
         # Device-mesh placement: with backend.mesh_axes containing "agents",
@@ -253,9 +268,10 @@ def _solve_krusell_smith_impl(
             start_it = min(sc["iteration"] + 1, alm.max_iter - 1)
             records = records[:start_it]
             # Mixed runs resume into the phase they checkpointed in (a resume
-            # mid-polish must not drop back to f32 and re-stall).
-            if mixed and sc.get("house_phase") == "float64":
-                house, dtype = model, model.dtype
+            # mid-polish must not drop back to the f32 sim and re-stall).
+            if mixed and sc.get("sim_phase") == "float64":
+                sim_dtype = jnp.float64
+                k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
             value = jnp.asarray(arrays["value"], dtype)
             k_opt = jnp.asarray(arrays["k_opt"], dtype)
             # legacy checkpoints stored the cross-section as "k_population"
@@ -280,8 +296,8 @@ def _solve_krusell_smith_impl(
         B_dev = jnp.asarray(B, dtype)
         if solver.method == "vfi":
             sol = solve_ks_vfi(
-                value, k_opt, B_dev, house.k_grid, house.K_grid, house.P,
-                house.r_table, house.w_table, house.eps_by_state,
+                value, k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                model.r_table, model.w_table, model.eps_by_state,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=solver.tol, max_iter=solver.max_iter,
@@ -292,9 +308,9 @@ def _solve_krusell_smith_impl(
             value = sol.value
         elif solver.method == "egm":
             sol = solve_ks_egm(
-                k_opt, B_dev, house.k_grid, house.K_grid, house.P,
-                house.r_table, house.w_table, house.eps_by_state,
-                house.z_by_state, house.L_by_state, tech.alpha,
+                k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                model.r_table, model.w_table, model.eps_by_state,
+                model.z_by_state, model.L_by_state, tech.alpha,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=solver.tol, max_iter=solver.max_iter, double_alm=double_alm,
@@ -304,10 +320,11 @@ def _solve_krusell_smith_impl(
             raise ValueError(f"unknown method {solver.method!r}")
         k_opt = sol.k_opt
 
-        # The policy enters the simulation in sim_dtype (a no-op cast except
-        # under "mixed", where the f32 household policy feeds the f64
-        # cross-section advance — BackendConfig.dtype docstring).
+        # The policy and cross-section enter the simulation in sim_dtype
+        # (no-op casts except under "mixed", where the f64 household policy
+        # feeds the f32 cross-section scan — see the design note above).
         k_opt_sim = sol.k_opt.astype(sim_dtype)
+        cross = cross.astype(sim_dtype)
         if use_histogram:
             # Warm-starting reuses last iteration's capital distribution, but
             # the scan's conditional employment chains assume the employment
@@ -327,7 +344,10 @@ def _solve_krusell_smith_impl(
                 k_opt_sim, k_grid_sim, K_grid_sim, z_path, eps_panel,
                 cross, T=alm.T,
             )
-        B_new, r2_dev = alm_regression(K_ts, z_path, alm.discard)
+        # Regression always in f64: the closed-form normal-equation sums over
+        # ~1,000 log-K terms lose ~3 digits in f32, directly polluting B_new
+        # at the 1e-6 tolerance; casting the [T] path costs nothing.
+        B_new, r2_dev = alm_regression(K_ts.astype(jnp.float64), z_path, alm.discard)
         B_new = np.asarray(B_new, np.float64)
         r2 = np.asarray(r2_dev, np.float64)
         diff_B = float(np.max(np.abs(B_new - B)))
@@ -343,6 +363,7 @@ def _solve_krusell_smith_impl(
             "K_mean": float(np.mean(np.asarray(K_ts)[alm.discard:])),
             "seconds": time.perf_counter() - it_t0,
             "house_dtype": str(np.dtype(dtype)),
+            "sim_dtype": str(np.dtype(sim_dtype)),
         }
         records.append(rec)
         if on_iteration is not None:
@@ -353,20 +374,22 @@ def _solve_krusell_smith_impl(
             B = B_new
             cross = cross_new
             break
-        if mixed and np.dtype(dtype) == np.float32:
-            # Phase-switch rule: the f32 phase ends when diff_B stops making
-            # real progress (two consecutive rounds within 10% of the best so
-            # far — the f32 policy noise floor, O(1e-3), is flat while the
-            # contraction phase shrinks ~(1-damping) per round) or when it is
-            # already within 50x of tol (f64 finishes that gap in a couple of
-            # warm-started rounds either way).
-            stalled = diff_B >= 0.9 * best_f32
-            best_f32 = min(best_f32, diff_B)
-            f32_stall = f32_stall + 1 if stalled else 0
-            if f32_stall >= 2 or diff_B < 50.0 * alm.tol:
-                house, dtype = model, model.dtype
-                value = value.astype(dtype)
-                k_opt = k_opt.astype(dtype)
+        if mixed and np.dtype(sim_dtype) == np.float32:
+            # Fallback phase switch: if the f32-sim fixed point ever stalls
+            # above tol (two consecutive rounds within 10% of the best diff
+            # so far, past the initial transient), finish with the f64
+            # simulation. Not expected at the shipped scales — the f32 sim's
+            # rounding is a fixed O(eps) bias, below the 1e-6 tolerance —
+            # but a user scale where the bias floor bites must converge, not
+            # limit-cycle. The diff_B < 1e-2 gate keeps Anderson's early
+            # non-monotone rounds from triggering a spurious switch.
+            if diff_B < 1e-2:
+                stalled = diff_B >= 0.9 * best_f32
+                f32_stall = f32_stall + 1 if stalled else 0
+                best_f32 = min(best_f32, diff_B)
+                if f32_stall >= 2:
+                    sim_dtype = jnp.float64
+                    k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
         if alm.acceleration == "anderson":
             B_hist.append(B.copy())
             G_hist.append(B_new.copy())
@@ -383,7 +406,7 @@ def _solve_krusell_smith_impl(
                 scalars={"iteration": it, "B": B.tolist(), "records": records,
                          "B_hist": [b.tolist() for b in B_hist],
                          "G_hist": [g.tolist() for g in G_hist],
-                         "house_phase": str(np.dtype(dtype)),
+                         "sim_phase": str(np.dtype(sim_dtype)),
                          "best_f32": float(best_f32), "f32_stall": f32_stall},
                 arrays={
                     "value": np.asarray(value),

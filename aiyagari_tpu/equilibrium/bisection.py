@@ -96,14 +96,26 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
         if (
             solver.grid_sequencing
             and warm_start is None
-            and not model.config.endogenous_labor
             and na > 1600
             and model.config.grid.power > 0
         ):
             # Cold start on a fine grid: coarse-to-fine stages cut the
             # full-size sweep count ~10x (solve_aiyagari_egm_multiscale
             # docstring). Warm starts (bisection midpoints after the first)
-            # are already near the fixed point and skip the stages.
+            # are already near the fixed point and skip the stages. Both
+            # labor families take a ladder — the labor one prolongs C and
+            # re-derives (l, k) per sweep (solve_aiyagari_egm_labor_multiscale).
+            if model.config.endogenous_labor:
+                from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor_multiscale
+
+                return solve_aiyagari_egm_labor_multiscale(
+                    model.a_grid, model.s, model.P, r, w, model.amin,
+                    sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi,
+                    eta=prefs.eta, tol=solver.tol, max_iter=solver.max_iter,
+                    grid_power=model.config.grid.power,
+                    relative_tol=solver.relative_tol,
+                    progress_every=solver.progress_every,
+                )
             from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
             return solve_aiyagari_egm_multiscale(
@@ -115,11 +127,14 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
-            return solve_aiyagari_egm_labor(
+            from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor_safe
+
+            return solve_aiyagari_egm_labor_safe(
                 C0, model.a_grid, model.s, model.P, r, w, model.amin,
                 sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
                 tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
+                grid_power=model.config.grid.power,
             )
         return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,

@@ -33,8 +33,15 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     if cache_dir is None:
         if env == "":
             return None
+        # Keyed by the requested platform set: a TPU-attached process also
+        # compiles XLA:CPU executables with different machine-feature flags
+        # (+prefer-no-scatter/-gather) than a pure-CPU process, and loading
+        # the other's AOT artifacts triggers feature-mismatch warnings with
+        # a documented SIGILL risk.
+        platforms = jax.config.jax_platforms or "auto"
         cache_dir = env or os.path.join(
-            os.path.expanduser("~"), ".cache", "aiyagari_tpu", "xla"
+            os.path.expanduser("~"), ".cache", "aiyagari_tpu",
+            f"xla-{platforms.replace(',', '-')}"
         )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -92,17 +92,6 @@ class KrusellSmithModel:
             eps_trans=as_dtype(eps_trans),
         )
 
-    def astype(self, dtype) -> "KrusellSmithModel":
-        """The same economy with every discretized table cast to `dtype`.
-        Used by the mixed-precision outer loop (equilibrium/alm.py): the f32
-        phase solves on downcasts of the SAME f64 tables, so the f64 polish
-        phase converges to exactly the plain-f64 pipeline's fixed point."""
-        cast = {
-            f.name: getattr(self, f.name).astype(dtype)
-            for f in dataclasses.fields(self) if f.name != "config"
-        }
-        return dataclasses.replace(self, **cast)
-
     @property
     def dtype(self):
         return self.k_grid.dtype

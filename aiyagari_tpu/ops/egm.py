@@ -13,7 +13,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from aiyagari_tpu.ops.interp import inverse_interp_power_grid, linear_interp
+from aiyagari_tpu.ops.interp import (
+    INVERSE_DENSE_CUTOFF,
+    interp_monotone_power_grid,
+    inverse_interp_power_grid,
+    linear_interp,
+)
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.utils.utility import (
     crra_marginal,
@@ -24,9 +29,10 @@ from aiyagari_tpu.utils.utility import (
 __all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power", "with_escape"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power", "with_escape", "use_pallas"))
 def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-             grid_power: float = 0.0, with_escape: bool = False):
+             grid_power: float = 0.0, with_escape: bool = False,
+             use_pallas: bool = False):
     """One EGM policy update, exogenous labor.
 
     C [N, na] (consumption policy on the exogenous grid) ->
@@ -68,7 +74,17 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # this image's remote-compile path at 40k+ points.
     a_hat = jax.lax.cummax(a_hat, axis=1)
     escaped = jnp.array(False)
-    if grid_power > 0.0:
+    if grid_power > 0.0 and use_pallas and a_grid.shape[-1] > INVERSE_DENSE_CUTOFF:
+        # Fused TPU kernel over the same window tiling (chunk-skipping,
+        # ops/pallas_inverse.py); interpreted off-TPU so the routing stays
+        # testable everywhere.
+        from aiyagari_tpu.ops.pallas_inverse import inverse_interp_power_grid_pallas
+
+        policy_k, escaped = inverse_interp_power_grid_pallas(
+            a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1],
+            interpret=(jax.default_backend() != "tpu"),
+        )
+    elif grid_power > 0.0:
         policy_k, escaped = inverse_interp_power_grid(
             a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1],
             with_escape=True,
@@ -108,13 +124,16 @@ def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma: float,
     return c_con
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "grid_power", "with_escape"))
 def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-                   psi: float, eta: float, c_constrained=None):
+                   psi: float, eta: float, c_constrained=None,
+                   grid_power: float = 0.0, with_escape: bool = False):
     """One EGM policy update with endogenous labor via the closed-form
     intratemporal FOC l = ((w s u'(c))/psi)^(1/eta).
 
-    C [N, na] -> (C_new, policy_k, policy_l).
+    C [N, na] -> (C_new, policy_k, policy_l); with_escape=True appends the
+    windowed interpolation's scalar escape flag (always False off the fast
+    path).
 
     Mirrors Aiyagari_Endogenous_Labor_EGM.m:67-107, including its two
     documented sequencing choices (kept because they are no-ops at the
@@ -122,6 +141,13 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     the borrowing constraint is imposed on the interpolated *consumption*
     policy where a_grid < amin (:91), and the asset policy is floored at 0
     (:99) rather than amin.
+
+    grid_power > 0 asserts a_grid is power-spaced with that exponent and
+    routes the consumption re-interpolation through the windowed
+    compare-reduce value interpolation (ops/interp.
+    interp_monotone_power_grid) — the same TPU fast path (and NaN-poisoning
+    escape contract) as the exogenous family's grid inversion, generalized
+    to tabulated values using the consumption policy's monotonicity in a'.
     """
     ws = w * s[:, None]                                            # [N, 1]
     RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)
@@ -130,15 +156,25 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)              # :87
 
     # Interpolate the consumption (not asset) policy onto the exogenous grid
-    # (:90). Same f32 monotonicity insurance as egm_step (no-op in f64), and
-    # the same grid-top discipline: queries above the last endogenous knot
-    # take that knot's consumption (nearest) instead of riding the edge
-    # segment's slope — unbounded linear extrapolation of g_c feeds straight
-    # back into the next Euler RHS and oscillates at O(0.1) on f32 fine grids
-    # (measured at 20k points; cf. egm_step's asset-policy variant).
+    # (:90). Same f32 monotonicity insurance as egm_step (no-op in f64) on
+    # BOTH arrays — the windowed value kernel's bracketing max/min trick
+    # needs c_next non-decreasing too — and the same grid-top discipline:
+    # queries above the last endogenous knot take that knot's consumption
+    # (nearest) instead of riding the edge segment's slope — unbounded
+    # linear extrapolation of g_c feeds straight back into the next Euler
+    # RHS and oscillates at O(0.1) on f32 fine grids (measured at 20k
+    # points; cf. egm_step's asset-policy variant).
     a_hat = jax.lax.cummax(a_hat, axis=1)
-    q = jnp.minimum(a_grid[None, :], a_hat[:, -1:])
-    g_c = jax.vmap(linear_interp)(a_hat, c_next, q)
+    c_next = jax.lax.cummax(c_next, axis=1)
+    escaped = jnp.array(False)
+    if grid_power > 0.0:
+        g_c, escaped = interp_monotone_power_grid(
+            a_hat, c_next, a_grid[0], a_grid[-1], grid_power,
+            a_grid.shape[-1], with_escape=True,
+        )
+    else:
+        q = jnp.minimum(a_grid[None, :], a_hat[:, -1:])
+        g_c = jax.vmap(linear_interp)(a_hat, c_next, q)
 
     # Constrained region: below the first endogenous knot the borrowing
     # constraint binds (a' = amin); use the exact static solution
@@ -159,4 +195,6 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     # Floored at 0 per the reference quirk (:99); capped at the grid top like
     # every other solver in this framework (ops/egm.egm_step rationale).
     policy_k = jnp.clip(policy_k, 0.0, a_grid[-1])
+    if with_escape:
+        return g_c, policy_k, policy_l, escaped
     return g_c, policy_k, policy_l

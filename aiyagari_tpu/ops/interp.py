@@ -216,6 +216,40 @@ _INV_KBLOCK = 512       # knot-block granularity of the gathered windows
 _INV_WBLOCKS = 6        # knot blocks per window (window covers 6x local density)
 
 
+def _finish_inverse(cnt, x0, x1, xr, *, lo, hi, power, n_q, n_k):
+    """Shared tail of the power-grid inversion: bracket data -> interpolated
+    inverse. cnt = #{k: x_k < g_j} per query, (x0, x1) the bracketing knot
+    values (±inf where absent), xr the full knot row (for the below-range
+    extrapolation slope). Used by both the XLA routes here and the fused
+    Pallas kernel (ops/pallas_inverse.py), so the two cannot drift."""
+    dtype = xr.dtype
+    span = hi - lo
+
+    def g_of(i):
+        return lo + span * (i.astype(dtype) / (n_q - 1)) ** power
+
+    def gk_of(i):
+        return lo + span * (i.astype(dtype) / (n_k - 1)) ** power
+
+    q_vals = g_of(jnp.arange(n_q))
+    idx = cnt - 1
+    below = idx < 0
+    idx_c = jnp.clip(idx, 0, n_k - 1)
+    y0 = gk_of(idx_c)
+    y1 = gk_of(jnp.minimum(idx_c + 1, n_k - 1))
+    dx = x1 - x0
+    ok = jnp.isfinite(dx) & (dx > 0)
+    tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
+    out = y0 + tq * (y1 - y0)
+    # Below the first knot: linear extrapolation on the first segment
+    # (interp1 'linear','extrap' bottom semantics).
+    sl = (gk_of(jnp.int32(1)) - gk_of(jnp.int32(0))) / jnp.maximum(
+        xr[1] - xr[0], jnp.finfo(dtype).tiny
+    )
+    out_below = gk_of(jnp.int32(0)) + (q_vals - xr[0]) * sl
+    return jnp.where(below, out_below, out)
+
+
 def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float,
                               n_q: int, *, with_escape: bool = False):
     """Interpolate the inverse of a monotone map onto a power-spaced grid:
@@ -292,24 +326,8 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
     q_vals = g_of(jnp.arange(n_q))
 
     def finish(cnt, x0, x1, xr):
-        # Shared tail: cnt = #{k: x_k < g_j} per query, (x0, x1) the
-        # bracketing knot values (±inf where absent).
-        idx = cnt - 1
-        below = idx < 0
-        idx_c = jnp.clip(idx, 0, n_k - 1)
-        y0 = gk_of(idx_c)
-        y1 = gk_of(jnp.minimum(idx_c + 1, n_k - 1))
-        dx = x1 - x0
-        ok = jnp.isfinite(dx) & (dx > 0)
-        tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
-        out = y0 + tq * (y1 - y0)
-        # Below the first knot: linear extrapolation on the first segment
-        # (interp1 'linear','extrap' bottom semantics).
-        sl = (gk_of(jnp.int32(1)) - gk_of(jnp.int32(0))) / jnp.maximum(
-            xr[1] - xr[0], jnp.finfo(dtype).tiny
-        )
-        out_below = gk_of(jnp.int32(0)) + (q_vals - xr[0]) * sl
-        return jnp.where(below, out_below, out)
+        return _finish_inverse(cnt, x0, x1, xr, lo=lo, hi=hi, power=power,
+                               n_q=n_q, n_k=n_k)
 
     if n_k <= INVERSE_DENSE_CUTOFF:
         def dense_row(xr):
@@ -367,6 +385,126 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
         out = jnp.where(escape, jnp.nan, out)
         return (out, escape) if with_escape else out
     outs, escapes = jax.vmap(windowed_row)(x.reshape((-1, n_k)))
+    escape = jnp.any(escapes)
+    outs = jnp.where(escape, jnp.nan, outs).reshape(x.shape[:-1] + (n_q,))
+    return (outs, escape) if with_escape else outs
+
+
+def interp_monotone_power_grid(x: jnp.ndarray, y: jnp.ndarray, lo: float,
+                               hi: float, power: float, n_q: int, *,
+                               with_escape: bool = False):
+    """Windowed compare-reduce interpolation of a MONOTONE tabulated function
+    onto a power-spaced query grid: given sorted knots x[..., k] with
+    non-decreasing values y[..., k], return y interpolated at the n_q-point
+    power grid g_j = lo + (hi-lo)*(j/(n_q-1))^power.
+
+    This is the endogenous-labor EGM hot operation (consumption policy from
+    the endogenous grid, interp1(a_hat, c_next, a_grid) at
+    Aiyagari_Endogenous_Labor_EGM.m:90) in the same gather/sort/scatter-free
+    form as inverse_interp_power_grid — that kernel is the special case
+    y_k = analytic grid values, where the bracketing VALUES can be
+    reconstructed from the count alone. Here y is data, but because it is
+    monotone the bracketing values come from the SAME masked max/min
+    reductions that locate the bracketing knots: y0 = max{y_k : x_k < q} and
+    y1 = min{y_k : x_k >= q} are exactly the bracket's endpoint values.
+    Monotonicity is the caller's contract (the EGM consumption iterate is
+    increasing in a' in exact arithmetic; callers cummax both arrays to
+    absorb f32 rounding, cf. ops/egm.egm_step).
+
+    Semantics at the edges: queries above the last knot return the last
+    value (nearest — the labor EGM's grid-top discipline, ops/egm.
+    egm_step_labor); queries below the first knot extrapolate linearly on
+    the first segment (callers overwrite that region with the exact
+    constrained solution anyway). Escape contract and window geometry are
+    identical to inverse_interp_power_grid (NaN poisoning + escaped flag).
+    """
+    n_k = x.shape[-1]
+    dtype = x.dtype
+    span = hi - lo
+    neg, pos = jnp.array(-jnp.inf, dtype), jnp.array(jnp.inf, dtype)
+
+    def g_of(i):
+        return lo + span * (i.astype(dtype) / (n_q - 1)) ** power
+
+    q_vals = g_of(jnp.arange(n_q))
+
+    def finish(x0, x1, y0, y1, xr, yr):
+        have_lo = jnp.isfinite(x0)          # some knot strictly below q
+        have_hi = jnp.isfinite(x1)          # some knot at-or-above q
+        dx = x1 - x0
+        ok = have_lo & have_hi & (dx > 0)
+        tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
+        out = jnp.where(have_lo, y0, yr[0]) + tq * (y1 - jnp.where(have_lo, y0, yr[0]))
+        # Above the top knot: nearest (last) value.
+        out = jnp.where(have_lo & ~have_hi, y0, out)
+        # Below the first knot: linear extrapolation on the first segment.
+        sl = (yr[1] - yr[0]) / jnp.maximum(xr[1] - xr[0], jnp.finfo(dtype).tiny)
+        out_below = yr[0] + (q_vals - xr[0]) * sl
+        return jnp.where(~have_lo, out_below, out)
+
+    if n_k <= INVERSE_DENSE_CUTOFF:
+        def dense_row(xr, yr):
+            lt = xr[None, :] < q_vals[:, None]                        # [n_q, n_k]
+            x0 = jnp.max(jnp.where(lt, xr[None, :], neg), axis=1)
+            x1 = jnp.min(jnp.where(lt, pos, xr[None, :]), axis=1)
+            y0 = jnp.max(jnp.where(lt, yr[None, :], neg), axis=1)
+            y1 = jnp.min(jnp.where(lt, pos, yr[None, :]), axis=1)
+            return finish(x0, x1, y0, y1, xr, yr)
+
+        if x.ndim == 1:
+            out = dense_row(x, y)
+        else:
+            out = jax.vmap(dense_row)(
+                x.reshape((-1, n_k)), y.reshape((-1, n_k))
+            ).reshape(x.shape[:-1] + (n_q,))
+        return (out, jnp.array(False)) if with_escape else out
+
+    S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
+    nkb = -(-n_k // KB)
+    nb = -(-n_q // S)
+    L = M * KB
+
+    def windowed_row(xr, yr):
+        if nkb * KB == n_k:
+            xp, yp = xr, yr
+        else:
+            pad = nkb * KB - n_k
+            xp = jnp.concatenate([xr, jnp.full((pad,), pos)])
+            yp = jnp.concatenate([yr, jnp.full((pad,), pos)])
+        xblk = xp.reshape(nkb, KB)
+        yblk = yp.reshape(nkb, KB)
+        jq = jnp.minimum(jnp.arange(nb * S), n_q - 1)
+        qs = g_of(jq).reshape(nb, S)
+
+        s_first = jnp.sum(xr[None, :] < qs[:, :1], axis=1).astype(jnp.int32)
+        ab = jnp.minimum(jnp.clip(s_first - 1, 0, n_k - 1) // KB, nkb - M)
+
+        segx = xblk[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
+        segy = yblk[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
+        lt = segx[:, None, :] < qs[:, :, None]                        # [nb, S, L]
+        cnt_w = jnp.sum(lt, axis=-1).astype(jnp.int32)
+        x0 = jnp.max(jnp.where(lt, segx[:, None, :], neg), axis=-1)
+        x1 = jnp.min(jnp.where(lt, pos, segx[:, None, :]), axis=-1)
+        y0 = jnp.max(jnp.where(lt, segy[:, None, :], neg), axis=-1)
+        y1 = jnp.min(jnp.where(lt, pos, segy[:, None, :]), axis=-1)
+        # Window-local x0 is the true bracket only if the window did not
+        # saturate; same rule as the inverse kernel. The y0 from knots
+        # BEFORE the window would be <= the window's y0 by monotonicity, so
+        # the window max is exact whenever the x bracket is.
+        escape = jnp.any((cnt_w == L) & ((ab[:, None] + M) * KB < n_k))
+        out = finish(
+            x0.reshape(-1)[:n_q], x1.reshape(-1)[:n_q],
+            y0.reshape(-1)[:n_q], y1.reshape(-1)[:n_q], xr, yr,
+        )
+        return out, escape
+
+    if x.ndim == 1:
+        out, escape = windowed_row(x, y)
+        out = jnp.where(escape, jnp.nan, out)
+        return (out, escape) if with_escape else out
+    outs, escapes = jax.vmap(windowed_row)(
+        x.reshape((-1, n_k)), y.reshape((-1, n_k))
+    )
     escape = jnp.any(escapes)
     outs = jnp.where(escape, jnp.nan, outs).reshape(x.shape[:-1] + (n_q,))
     return (outs, escape) if with_escape else outs

@@ -21,7 +21,9 @@ __all__ = [
     "solve_aiyagari_egm",
     "solve_aiyagari_egm_safe",
     "solve_aiyagari_egm_labor",
+    "solve_aiyagari_egm_labor_safe",
     "solve_aiyagari_egm_multiscale",
+    "solve_aiyagari_egm_labor_multiscale",
 ]
 
 
@@ -53,43 +55,76 @@ class EGMSolution:
     iterations: jax.Array
     distance: jax.Array
     escaped: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(False))
+    # The tolerance the stopping rule actually applied: == tol unless the
+    # ulp-noise floor was engaged (solve_aiyagari_egm noise_floor_ulp).
+    # Convergence checks should compare distance against THIS, not tol.
+    tol_effective: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(0.0))
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                        tol: float, max_iter: int, relative_tol: bool = False,
-                       progress_every: int = 0, grid_power: float = 0.0) -> EGMSolution:
+                       progress_every: int = 0, grid_power: float = 0.0,
+                       noise_floor_ulp: float = 0.0,
+                       use_pallas: bool = False) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
     grid_power > 0 enables the gather-free power-grid inversion fast path
-    (ops/egm.egm_step docstring)."""
+    (ops/egm.egm_step docstring).
+
+    noise_floor_ulp > 0 widens the absolute stopping tolerance to
+    max(tol, noise_floor_ulp * eps(dtype) * max|C|) — the sweep operator's
+    own rounding floor. Why: on fine grids in f32 the iterate reaches its
+    fixed point in a handful of warm-started sweeps and then WANDERS in the
+    ulp-noise band of the sup-norm (each sweep re-rounds 2.8M values at
+    ~eps * |C|; tol 1e-5 is ~1.3 ulp at max|C| ~ 100), so the strict
+    criterion burns ~30 extra full-size sweeps at 400k points waiting for
+    the max over millions of points to randomly dip under tol
+    (BENCHMARKS.md round-1 stage timings). A distance at the floor carries
+    the same solution quality — the discretization error at those grids is
+    orders of magnitude below it. No-op in f64 at any sane setting
+    (eps ~ 2e-16) and at the reference's 400-point scale (the strict tol is
+    reached before the band matters). The applied tolerance is returned as
+    EGMSolution.tol_effective; convergence checks must use it."""
+
+    tol_c = jnp.asarray(tol, C_init.dtype)
+    floor_k = float(noise_floor_ulp) * float(jnp.finfo(C_init.dtype).eps)
 
     def cond(carry):
-        _, _, dist, it, _ = carry
-        return (dist >= tol) & (it < max_iter)
+        _, _, dist, it, _, tol_eff = carry
+        return (dist >= tol_eff) & (it < max_iter)
 
     def body(carry):
-        C, _, _, it, esc = carry
+        C, _, _, it, esc, _ = carry
         C_new, policy_k, esc_new = egm_step(C, a_grid, s, P, r, w, amin,
                                             sigma=sigma, beta=beta,
                                             grid_power=grid_power,
-                                            with_escape=True)
+                                            with_escape=True,
+                                            use_pallas=use_pallas)
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        if noise_floor_ulp > 0.0 and not relative_tol:
+            tol_eff = jnp.maximum(tol_c, floor_k * jnp.max(jnp.abs(C_new)))
+        else:
+            # The relative criterion is already scale-free; the band argument
+            # does not apply, so the floor is ignored there.
+            tol_eff = tol_c
         device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
-        return C_new, policy_k, dist, it + 1, esc | esc_new
+        return C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff
 
     init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype),
-            jnp.int32(0), jnp.array(False))
-    C, policy_k, dist, it, esc = jax.lax.while_loop(cond, body, init)
-    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc)
+            jnp.int32(0), jnp.array(False), tol_c)
+    C, policy_k, dist, it, esc, tol_eff = jax.lax.while_loop(cond, body, init)
+    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff)
 
 
 def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             beta: float, tol: float, max_iter: int,
                             relative_tol: bool = False, progress_every: int = 0,
-                            grid_power: float = 0.0) -> EGMSolution:
+                            grid_power: float = 0.0,
+                            noise_floor_ulp: float = 0.0,
+                            use_pallas: bool = False) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
@@ -105,47 +140,90 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              beta=beta, tol=tol, max_iter=max_iter,
                              relative_tol=relative_tol,
                              progress_every=progress_every,
-                             grid_power=grid_power)
+                             grid_power=grid_power,
+                             noise_floor_ulp=noise_floor_ulp,
+                             use_pallas=use_pallas)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
                                  relative_tol=relative_tol,
                                  progress_every=progress_every,
-                                 grid_power=0.0)
+                                 grid_power=0.0,
+                                 noise_floor_ulp=noise_floor_ulp)
     return sol
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol", "progress_every"))
+@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                              psi: float, eta: float, tol: float, max_iter: int,
                              relative_tol: bool = False,
-                             progress_every: int = 0) -> EGMSolution:
+                             progress_every: int = 0,
+                             grid_power: float = 0.0,
+                             noise_floor_ulp: float = 0.0) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
-    (Aiyagari_Endogenous_Labor_EGM.m:67-107)."""
+    (Aiyagari_Endogenous_Labor_EGM.m:67-107). grid_power > 0 routes the
+    consumption re-interpolation through the windowed value-interpolation
+    fast path; noise_floor_ulp is the f32 stopping-rule floor — both exactly
+    as in solve_aiyagari_egm (see its docstring)."""
     # Loop-invariant: the constrained-region static solution depends on
     # prices and the grid only, not the consumption iterate.
     c_con = constrained_consumption_labor(
         a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
     )
+    tol_c = jnp.asarray(tol, C_init.dtype)
+    floor_k = float(noise_floor_ulp) * float(jnp.finfo(C_init.dtype).eps)
 
     def cond(carry):
-        return (carry[3] >= tol) & (carry[4] < max_iter)
+        return (carry[3] >= carry[6]) & (carry[4] < max_iter)
 
     def body(carry):
-        C, _, _, _, it = carry
-        C_new, policy_k, policy_l = egm_step_labor(
+        C, _, _, _, it, esc, _ = carry
+        C_new, policy_k, policy_l, esc_new = egm_step_labor(
             C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta,
-            c_constrained=c_con,
+            c_constrained=c_con, grid_power=grid_power, with_escape=True,
         )
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+        if noise_floor_ulp > 0.0 and not relative_tol:
+            tol_eff = jnp.maximum(tol_c, floor_k * jnp.max(jnp.abs(C_new)))
+        else:
+            tol_eff = tol_c
         device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
-        return C_new, policy_k, policy_l, dist, it + 1
+        return C_new, policy_k, policy_l, dist, it + 1, esc | esc_new, tol_eff
 
     z = jnp.zeros_like(C_init)
-    init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0))
-    C, policy_k, policy_l, dist, it = jax.lax.while_loop(cond, body, init)
-    return EGMSolution(C, policy_k, policy_l, it, dist, jnp.array(False))
+    init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0),
+            jnp.array(False), tol_c)
+    C, policy_k, policy_l, dist, it, esc, tol_eff = jax.lax.while_loop(cond, body, init)
+    return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff)
+
+
+def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
+                                  sigma: float, beta: float, psi: float,
+                                  eta: float, tol: float, max_iter: int,
+                                  relative_tol: bool = False,
+                                  progress_every: int = 0,
+                                  grid_power: float = 0.0,
+                                  noise_floor_ulp: float = 0.0) -> EGMSolution:
+    """Host-level escape retry for the labor family (the exact analogue of
+    solve_aiyagari_egm_safe: re-solve on the generic route only when the
+    windowed fast path actually escaped)."""
+    sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
+                                   sigma=sigma, beta=beta, psi=psi, eta=eta,
+                                   tol=tol, max_iter=max_iter,
+                                   relative_tol=relative_tol,
+                                   progress_every=progress_every,
+                                   grid_power=grid_power,
+                                   noise_floor_ulp=noise_floor_ulp)
+    if grid_power > 0.0 and bool(sol.escaped):
+        sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
+                                       sigma=sigma, beta=beta, psi=psi, eta=eta,
+                                       tol=tol, max_iter=max_iter,
+                                       relative_tol=relative_tol,
+                                       progress_every=progress_every,
+                                       grid_power=0.0,
+                                       noise_floor_ulp=noise_floor_ulp)
+    return sol
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -153,7 +231,9 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   grid_power: float = 2.0, coarsest: int = 400,
                                   refine_factor: int = 10,
                                   relative_tol: bool = False,
-                                  progress_every: int = 0) -> EGMSolution:
+                                  progress_every: int = 0,
+                                  noise_floor_ulp: float = 0.0,
+                                  use_pallas: bool = False) -> EGMSolution:
     """Grid-sequenced EGM: solve on a coarse grid first, prolong the
     consumption policy to each finer grid, and re-converge there.
 
@@ -211,13 +291,72 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                      max_iter=max_iter,
                                      relative_tol=relative_tol,
                                      progress_every=progress_every,
-                                     grid_power=grid_power if fast else 0.0)
+                                     grid_power=grid_power if fast else 0.0,
+                                     noise_floor_ulp=noise_floor_ulp,
+                                     use_pallas=use_pallas)
             esc = esc | sol.escaped
         return dataclasses.replace(sol, escaped=esc)
 
     sol = run_ladder(fast=True)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
+    if bool(sol.escaped):
+        sol = run_ladder(fast=False)
+    return sol
+
+
+def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
+                                        sigma: float, beta: float, psi: float,
+                                        eta: float, tol: float, max_iter: int,
+                                        grid_power: float = 2.0,
+                                        coarsest: int = 400,
+                                        refine_factor: int = 10,
+                                        relative_tol: bool = False,
+                                        progress_every: int = 0,
+                                        noise_floor_ulp: float = 0.0) -> EGMSolution:
+    """Grid-sequenced EGM for the endogenous-labor family — the same nested
+    iteration as solve_aiyagari_egm_multiscale (see its docstring for the
+    rationale and escape handling). Only the consumption policy C is
+    prolonged across stages: the labor and asset policies are closed-form
+    functions of C within each sweep (the intratemporal FOC and the budget
+    constraint, ops/egm.egm_step_labor), so (C, l) move jointly without a
+    separate labor prolongation. Reference operator:
+    Aiyagari_Endogenous_Labor_EGM.m:67-107."""
+    from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
+
+    if grid_power <= 0.0:
+        raise ValueError(
+            "solve_aiyagari_egm_labor_multiscale requires a power-spaced "
+            f"grid: pass its actual spacing exponent as grid_power, got {grid_power}"
+        )
+    n_final = int(a_grid.shape[-1])
+    dtype = a_grid.dtype
+    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    sizes = stage_sizes(n_final, coarsest, refine_factor)
+
+    def _grid(n):
+        if n == n_final:
+            return a_grid
+        return stage_grid(n, lo, hi, grid_power, dtype)
+
+    def run_ladder(fast: bool) -> EGMSolution:
+        C = initial_consumption_guess(_grid(sizes[0]), s, r, w).astype(dtype)
+        sol = None
+        esc = jnp.array(False)
+        for i, n in enumerate(sizes):
+            if i > 0:
+                C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
+            sol = solve_aiyagari_egm_labor(C, _grid(n), s, P, r, w, amin,
+                                           sigma=sigma, beta=beta, psi=psi,
+                                           eta=eta, tol=tol, max_iter=max_iter,
+                                           relative_tol=relative_tol,
+                                           progress_every=progress_every,
+                                           grid_power=grid_power if fast else 0.0,
+                                           noise_floor_ulp=noise_floor_ulp)
+            esc = esc | sol.escaped
+        return dataclasses.replace(sol, escaped=esc)
+
+    sol = run_ladder(fast=True)
     if bool(sol.escaped):
         sol = run_ladder(fast=False)
     return sol

@@ -33,13 +33,15 @@ def example_args(description: str) -> argparse.Namespace:
 
     import jax
 
-    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
-
-    enable_compilation_cache()
     if args.platform:
         # Pass the platform through verbatim so --platform tpu errors loudly
         # if the TPU backend is unavailable instead of silently running CPU.
         jax.config.update("jax_platforms", args.platform)
+    # After the platform choice: the cache dir is keyed by it
+    # (io_utils/compile_cache.py).
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     if jax.default_backend() != "tpu":
         jax.config.update("jax_enable_x64", True)
     if args.progress:

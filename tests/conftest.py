@@ -20,6 +20,19 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache: the suite's wall-clock is dominated by CPU
+# compiles of the solver fixed points (this box has ONE core), and the test
+# programs are identical run to run — the cache cuts repeat-suite time ~2x
+# (io_utils/compile_cache.py; set AIYAGARI_TPU_COMPILE_CACHE="" to disable,
+# e.g. when bisecting a suspected stale-cache miscompile).
+from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache  # noqa: E402
+
+# Dedicated directory: the default dir also holds artifacts from TPU-session
+# processes whose XLA:CPU flags differ — loading those here triggers
+# machine-feature-mismatch warnings (and a documented SIGILL risk).
+enable_compilation_cache(os.path.join(os.path.expanduser("~"),
+                                      ".cache", "aiyagari_tpu", "xla-tests"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,109 @@
+"""Fused Pallas windowed inversion vs the XLA route (ops/pallas_inverse.py).
+
+Interpret-mode on CPU: the kernel's chunk-skip contributions are exact (not
+approximated), so the two routes must agree exactly, escapes included."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+from aiyagari_tpu.ops.pallas_inverse import inverse_interp_power_grid_pallas
+
+
+def _grid(n, lo, hi, power):
+    return lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+
+
+class TestPallasWindowedInverse:
+    @pytest.mark.parametrize("n_k,n_q", [(8192, 8192), (6000, 5000)])
+    def test_matches_xla_route(self, n_k, n_q):
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = _grid(n_k, lo, hi, power)
+        # A smooth monotone distortion of the grid — the EGM endogenous-grid
+        # shape (knot density within the windows' 6x budget).
+        x = np.sort((gk + 0.3 * np.sin(gk / 7.0) + 0.8) / 1.04 - 0.5)
+        xq = jnp.asarray(np.stack([x, x * 1.01 + 0.05]))
+        want, esc_want = inverse_interp_power_grid(xq, lo, hi, power, n_q,
+                                                   with_escape=True)
+        got, esc = inverse_interp_power_grid_pallas(xq, lo, hi, power, n_q,
+                                                    interpret=True)
+        assert bool(esc) == bool(esc_want) == False  # noqa: E712
+        # The bracket data (cnt/x0/x1) is exact in both routes; the only
+        # difference is 1-ulp FMA/ordering in the shared finish tail under
+        # different fusion contexts. A genuine bracket error would be O(grid
+        # step ~ 1e-2), far above this tolerance.
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-9)
+
+    def test_escape_on_oversaturated_window(self):
+        # The kernel's 16,384-knot double panels escape only when a query
+        # block's bracket span exceeds them: 20,000 knots crammed inside one
+        # query interval at 64k total.
+        n = 65_536
+        lo, hi, power = 0.0, 52.0, 2.0
+        gq = _grid(n, lo, hi, power)
+        cluster = np.linspace(gq[9000], gq[9001], 20_000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 20_000).astype(int)]
+        x = jnp.asarray(np.sort(np.concatenate([cluster, rest]))[:n])
+        out, esc = inverse_interp_power_grid_pallas(x, lo, hi, power, n,
+                                                    interpret=True)
+        assert bool(esc)
+        assert np.isnan(np.asarray(out)).all()
+
+    def test_nonzero_panel_offsets_match_xla(self):
+        # 24k knots: programs past the first panel use pan0 > 0 — the regime
+        # an earlier hand-rolled-DMA kernel silently miscompiled in (module
+        # docstring). Pins the data-dependent index_map path.
+        n = 24_576
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = _grid(n, lo, hi, power)
+        x = np.sort((gk + 0.3 * np.sin(gk / 7.0) + 0.8) / 1.04 - 0.5)
+        want, esc_w = inverse_interp_power_grid(jnp.asarray(x), lo, hi, power,
+                                                n, with_escape=True)
+        got, esc = inverse_interp_power_grid_pallas(jnp.asarray(x), lo, hi,
+                                                    power, n, interpret=True)
+        assert not bool(esc) and not bool(esc_w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-9)
+
+    def test_wider_windows_solve_where_xla_escapes(self):
+        # At 8k knots the kernel's window IS the whole array, so the
+        # XLA-escaping clustered case is solved exactly instead (a strict
+        # improvement; the escape contract is per-route, conservative).
+        from aiyagari_tpu.ops.interp import linear_interp
+
+        n = 8192
+        lo, hi, power = 0.0, 52.0, 2.0
+        gq = _grid(n, lo, hi, power)
+        cluster = np.linspace(gq[3000], gq[3001], 5000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 5000).astype(int)]
+        x = np.sort(np.concatenate([cluster, rest]))[:n]
+        xla_out, xla_esc = inverse_interp_power_grid(jnp.asarray(x), lo, hi,
+                                                     power, n, with_escape=True)
+        assert bool(xla_esc)   # the 6-slab XLA windows saturate here
+        out, esc = inverse_interp_power_grid_pallas(jnp.asarray(x), lo, hi,
+                                                    power, n, interpret=True)
+        assert not bool(esc)
+        want = np.asarray(linear_interp(jnp.asarray(x), jnp.asarray(gq),
+                                        jnp.asarray(gq)))
+        # Exclude the cluster interval itself: inside a near-collided
+        # segment the strict-< bracket and the generic route pick different
+        # (equally valid) inverses, differing by less than the local query
+        # spacing (ops/interp.inverse_interp_power_grid docstring).
+        skip = (gq > x[-1]) | ((gq >= gq[3000]) & (gq <= gq[3001]))
+        np.testing.assert_allclose(np.asarray(out)[~skip], want[~skip], atol=1e-9)
+
+    def test_top_truncation_no_escape(self):
+        # Knots end well below the top queries: the last window ends at the
+        # top of the knot array, so cnt == L there is truncation, not escape.
+        n_k = n_q = 8192
+        lo, hi, power = 0.0, 52.0, 2.0
+        x = jnp.asarray(_grid(n_k, lo, hi, power) * 0.6)
+        want, esc_w = inverse_interp_power_grid(x, lo, hi, power, n_q,
+                                                with_escape=True)
+        got, esc = inverse_interp_power_grid_pallas(x, lo, hi, power, n_q,
+                                                    interpret=True)
+        assert not bool(esc) and not bool(esc_w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-9)
