@@ -25,7 +25,7 @@ import numpy as np
 from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
 from aiyagari_tpu.models.aiyagari import AiyagariModel
 from aiyagari_tpu.sim.ergodic import PanelSeries, simulate_panel
-from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor, solve_aiyagari_egm_safe
+from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_safe
 from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi, solve_aiyagari_vfi_labor
 from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
 

@@ -30,18 +30,24 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     import jax
 
     env = os.environ.get("AIYAGARI_TPU_COMPILE_CACHE")
+    if env == "":
+        # The kill switch wins over everything, including explicit dirs —
+        # it exists for bisecting suspected stale-cache miscompiles, where a
+        # silently-still-enabled cache would invalidate the bisection.
+        return None
     if cache_dir is None:
-        if env == "":
-            return None
-        # Keyed by the requested platform set: a TPU-attached process also
-        # compiles XLA:CPU executables with different machine-feature flags
-        # (+prefer-no-scatter/-gather) than a pure-CPU process, and loading
-        # the other's AOT artifacts triggers feature-mismatch warnings with
-        # a documented SIGILL risk.
-        platforms = jax.config.jax_platforms or "auto"
+        # Keyed by the RESOLVED backend (this initializes it — the call
+        # sites all touch devices immediately afterwards anyway): a
+        # TPU-attached process also compiles XLA:CPU executables with
+        # different machine-feature flags (+prefer-no-scatter/-gather) than
+        # a pure-CPU process, and loading the other's AOT artifacts
+        # triggers feature-mismatch warnings with a documented SIGILL risk.
+        # The requested-platform string would NOT do: it is unset ("auto")
+        # both for a TPU-attached default run and for a CPU fallback run
+        # when the TPU tunnel is down.
+        backend = jax.default_backend()
         cache_dir = env or os.path.join(
-            os.path.expanduser("~"), ".cache", "aiyagari_tpu",
-            f"xla-{platforms.replace(',', '-')}"
+            os.path.expanduser("~"), ".cache", "aiyagari_tpu", f"xla-{backend}"
         )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -309,49 +309,68 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
     n_k != n_q cases).
     """
     n_k = x.shape[-1]
+    cnt, x0, x1, _, _, escaped = _bracket_power_grid(x, None, lo, hi, power, n_q)
+    out = jax.vmap(
+        lambda c, a0, a1, row: _finish_inverse(c, a0, a1, row, lo=lo, hi=hi,
+                                               power=power, n_q=n_q, n_k=n_k)
+    )(cnt, x0, x1, x.reshape((-1, n_k)))
+    out = jnp.where(escaped, jnp.nan, out).reshape(x.shape[:-1] + (n_q,))
+    return (out, escaped) if with_escape else out
+
+
+def _bracket_power_grid(x, y, lo, hi, power, n_q):
+    """Shared bracket machinery of the power-grid interpolation kernels:
+    for every query g_j of the n_q-point power grid, the count of knots
+    strictly below it and the bracketing knot values (±inf where absent) —
+    and, when a value row `y` is supplied (interp_monotone_power_grid), the
+    bracketing VALUES from the same masked reductions (exact because y is
+    monotone). One implementation for the dense and two-level windowed
+    routes, so the window geometry and escape rule cannot drift between the
+    inverse and monotone-value kernels.
+
+    x [..., n_k] sorted; y None or same shape. Returns
+    (cnt [R, n_q] i32, x0, x1, y0, y1, escaped) with rows flattened to R;
+    y0/y1 are None when y is None; escaped is the scalar window-escape flag
+    (always False on the dense route).
+    """
+    n_k = x.shape[-1]
     dtype = x.dtype
     span = hi - lo
+    neg, pos = jnp.array(-jnp.inf, dtype), jnp.array(jnp.inf, dtype)
+    with_y = y is not None
 
     def g_of(i):
-        # Analytic grid value at (float or int) index i of the QUERY grid.
-        t = i.astype(dtype) / (n_q - 1)
-        return lo + span * t ** power
+        return lo + span * (i.astype(dtype) / (n_q - 1)) ** power
 
-    def gk_of(i):
-        # Analytic grid value at index i of the KNOT grid (n_k points).
-        tk = i.astype(dtype) / (n_k - 1)
-        return lo + span * tk ** power
-
-    neg, pos = jnp.array(-jnp.inf, dtype), jnp.array(jnp.inf, dtype)
     q_vals = g_of(jnp.arange(n_q))
-
-    def finish(cnt, x0, x1, xr):
-        return _finish_inverse(cnt, x0, x1, xr, lo=lo, hi=hi, power=power,
-                               n_q=n_q, n_k=n_k)
+    xr_all = x.reshape((-1, n_k))
+    # A dummy second operand keeps one vmap signature for both cases.
+    yr_all = y.reshape((-1, n_k)) if with_y else xr_all
 
     if n_k <= INVERSE_DENSE_CUTOFF:
-        def dense_row(xr):
+        def dense_row(xr, yr):
             lt = xr[None, :] < q_vals[:, None]                        # [n_q, n_k]
             cnt = jnp.sum(lt, axis=1).astype(jnp.int32)
             x0 = jnp.max(jnp.where(lt, xr[None, :], neg), axis=1)
             x1 = jnp.min(jnp.where(lt, pos, xr[None, :]), axis=1)
-            return finish(cnt, x0, x1, xr)
+            if not with_y:
+                return cnt, x0, x1, x0, x1
+            y0 = jnp.max(jnp.where(lt, yr[None, :], neg), axis=1)
+            y1 = jnp.min(jnp.where(lt, pos, yr[None, :]), axis=1)
+            return cnt, x0, x1, y0, y1
 
-        if x.ndim == 1:
-            out = dense_row(x)
-        else:
-            out = jax.vmap(dense_row)(x.reshape((-1, n_k))).reshape(x.shape[:-1] + (n_q,))
-        return (out, jnp.array(False)) if with_escape else out
+        cnt, x0, x1, y0, y1 = jax.vmap(dense_row)(xr_all, yr_all)
+        return cnt, x0, x1, (y0 if with_y else None), (y1 if with_y else None), \
+            jnp.array(False)
 
     S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
     nkb = -(-n_k // KB)            # >= 8 under the dense gate, so nkb >= M
     nb = -(-n_q // S)
     L = M * KB
 
-    def windowed_row(xr):
-        xp = xr if nkb * KB == n_k else jnp.concatenate(
-            [xr, jnp.full((nkb * KB - n_k,), pos)]
-        )
+    def windowed_row(xr, yr):
+        pad = nkb * KB - n_k
+        xp = xr if pad == 0 else jnp.concatenate([xr, jnp.full((pad,), pos)])
         xblk = xp.reshape(nkb, KB)
         # Padded query indices clamp to the last real query: duplicates of an
         # existing query, so they introduce no new escapes and are sliced off.
@@ -375,19 +394,24 @@ def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float
         # bracket may lie beyond the window — unless the window already ends
         # at the top of the knot array (top-truncation case, exact).
         escape = jnp.any((cnt_w == L) & ((ab[:, None] + M) * KB < n_k))
-        out = finish(
-            cnt.reshape(-1)[:n_q], x0.reshape(-1)[:n_q], x1.reshape(-1)[:n_q], xr
-        )
-        return out, escape
 
-    if x.ndim == 1:
-        out, escape = windowed_row(x)
-        out = jnp.where(escape, jnp.nan, out)
-        return (out, escape) if with_escape else out
-    outs, escapes = jax.vmap(windowed_row)(x.reshape((-1, n_k)))
-    escape = jnp.any(escapes)
-    outs = jnp.where(escape, jnp.nan, outs).reshape(x.shape[:-1] + (n_q,))
-    return (outs, escape) if with_escape else outs
+        def cut(a):
+            return a.reshape(-1)[:n_q]
+
+        if not with_y:
+            return cut(cnt), cut(x0), cut(x1), cut(x0), cut(x1), escape
+        yp = yr if pad == 0 else jnp.concatenate([yr, jnp.full((pad,), pos)])
+        segy = yp.reshape(nkb, KB)[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
+        # The y brackets from knots BEFORE the window would be <= the
+        # window's by monotonicity, so the window reductions are exact
+        # whenever the x bracket is (same saturation rule).
+        y0 = jnp.max(jnp.where(lt, segy[:, None, :], neg), axis=-1)
+        y1 = jnp.min(jnp.where(lt, pos, segy[:, None, :]), axis=-1)
+        return cut(cnt), cut(x0), cut(x1), cut(y0), cut(y1), escape
+
+    cnt, x0, x1, y0, y1, escapes = jax.vmap(windowed_row)(xr_all, yr_all)
+    return cnt, x0, x1, (y0 if with_y else None), (y1 if with_y else None), \
+        jnp.any(escapes)
 
 
 def interp_monotone_power_grid(x: jnp.ndarray, y: jnp.ndarray, lo: float,
@@ -421,12 +445,7 @@ def interp_monotone_power_grid(x: jnp.ndarray, y: jnp.ndarray, lo: float,
     n_k = x.shape[-1]
     dtype = x.dtype
     span = hi - lo
-    neg, pos = jnp.array(-jnp.inf, dtype), jnp.array(jnp.inf, dtype)
-
-    def g_of(i):
-        return lo + span * (i.astype(dtype) / (n_q - 1)) ** power
-
-    q_vals = g_of(jnp.arange(n_q))
+    q_vals = lo + span * (jnp.arange(n_q).astype(dtype) / (n_q - 1)) ** power
 
     def finish(x0, x1, y0, y1, xr, yr):
         have_lo = jnp.isfinite(x0)          # some knot strictly below q
@@ -442,72 +461,11 @@ def interp_monotone_power_grid(x: jnp.ndarray, y: jnp.ndarray, lo: float,
         out_below = yr[0] + (q_vals - xr[0]) * sl
         return jnp.where(~have_lo, out_below, out)
 
-    if n_k <= INVERSE_DENSE_CUTOFF:
-        def dense_row(xr, yr):
-            lt = xr[None, :] < q_vals[:, None]                        # [n_q, n_k]
-            x0 = jnp.max(jnp.where(lt, xr[None, :], neg), axis=1)
-            x1 = jnp.min(jnp.where(lt, pos, xr[None, :]), axis=1)
-            y0 = jnp.max(jnp.where(lt, yr[None, :], neg), axis=1)
-            y1 = jnp.min(jnp.where(lt, pos, yr[None, :]), axis=1)
-            return finish(x0, x1, y0, y1, xr, yr)
-
-        if x.ndim == 1:
-            out = dense_row(x, y)
-        else:
-            out = jax.vmap(dense_row)(
-                x.reshape((-1, n_k)), y.reshape((-1, n_k))
-            ).reshape(x.shape[:-1] + (n_q,))
-        return (out, jnp.array(False)) if with_escape else out
-
-    S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
-    nkb = -(-n_k // KB)
-    nb = -(-n_q // S)
-    L = M * KB
-
-    def windowed_row(xr, yr):
-        if nkb * KB == n_k:
-            xp, yp = xr, yr
-        else:
-            pad = nkb * KB - n_k
-            xp = jnp.concatenate([xr, jnp.full((pad,), pos)])
-            yp = jnp.concatenate([yr, jnp.full((pad,), pos)])
-        xblk = xp.reshape(nkb, KB)
-        yblk = yp.reshape(nkb, KB)
-        jq = jnp.minimum(jnp.arange(nb * S), n_q - 1)
-        qs = g_of(jq).reshape(nb, S)
-
-        s_first = jnp.sum(xr[None, :] < qs[:, :1], axis=1).astype(jnp.int32)
-        ab = jnp.minimum(jnp.clip(s_first - 1, 0, n_k - 1) // KB, nkb - M)
-
-        segx = xblk[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
-        segy = yblk[ab[:, None] + jnp.arange(M)[None, :]].reshape(nb, L)
-        lt = segx[:, None, :] < qs[:, :, None]                        # [nb, S, L]
-        cnt_w = jnp.sum(lt, axis=-1).astype(jnp.int32)
-        x0 = jnp.max(jnp.where(lt, segx[:, None, :], neg), axis=-1)
-        x1 = jnp.min(jnp.where(lt, pos, segx[:, None, :]), axis=-1)
-        y0 = jnp.max(jnp.where(lt, segy[:, None, :], neg), axis=-1)
-        y1 = jnp.min(jnp.where(lt, pos, segy[:, None, :]), axis=-1)
-        # Window-local x0 is the true bracket only if the window did not
-        # saturate; same rule as the inverse kernel. The y0 from knots
-        # BEFORE the window would be <= the window's y0 by monotonicity, so
-        # the window max is exact whenever the x bracket is.
-        escape = jnp.any((cnt_w == L) & ((ab[:, None] + M) * KB < n_k))
-        out = finish(
-            x0.reshape(-1)[:n_q], x1.reshape(-1)[:n_q],
-            y0.reshape(-1)[:n_q], y1.reshape(-1)[:n_q], xr, yr,
-        )
-        return out, escape
-
-    if x.ndim == 1:
-        out, escape = windowed_row(x, y)
-        out = jnp.where(escape, jnp.nan, out)
-        return (out, escape) if with_escape else out
-    outs, escapes = jax.vmap(windowed_row)(
-        x.reshape((-1, n_k)), y.reshape((-1, n_k))
-    )
-    escape = jnp.any(escapes)
-    outs = jnp.where(escape, jnp.nan, outs).reshape(x.shape[:-1] + (n_q,))
-    return (outs, escape) if with_escape else outs
+    _, x0, x1, y0, y1, escaped = _bracket_power_grid(x, y, lo, hi, power, n_q)
+    out = jax.vmap(finish)(x0, x1, y0, y1, x.reshape((-1, n_k)),
+                           y.reshape((-1, n_k)))
+    out = jnp.where(escaped, jnp.nan, out).reshape(x.shape[:-1] + (n_q,))
+    return (out, escaped) if with_escape else out
 
 
 def linear_interp(x: jnp.ndarray, y: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:

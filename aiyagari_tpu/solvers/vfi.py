@@ -197,9 +197,16 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     # search needs unimodal f; Tv on a grid is only concave up to cell-level
     # envelope error). 17 extra batched evaluations per improvement.
     _W = 8
+    # Local-improvement half-window: between improvement rounds (and after a
+    # multigrid prolongation) the discrete policy drifts a few cells, so a
+    # windowed argmax around the previous policy needs (2*_LW+1) objective
+    # evaluations instead of the global coarse-to-fine search's ~160 — each
+    # evaluation is a [N, na] EV element gather, the measured per-round
+    # bottleneck of this solver at fine grids (BENCHMARKS.md round 1:
+    # ~0.9 s/round at [7, 40k], gather-bound).
+    _LW = 24
 
-    def improve(v):
-        EV = expectation(P, v, beta)   # hoisted: one per improvement
+    def improve_global(EV):
         f = lambda j: choice_value(EV, j)
         idx0 = unimodal_argmax_index(f, hi_idx, na, lo_idx=lo_idx)
         offs = jnp.arange(-_W, _W + 1, dtype=jnp.int32)
@@ -208,6 +215,27 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         return jnp.take_along_axis(
             cand, jnp.argmax(vals, axis=2)[:, :, None], axis=2
         )[:, :, 0]
+
+    def improve(v, idx_prev):
+        EV = expectation(P, v, beta)   # hoisted: one per improvement
+        f = lambda j: choice_value(EV, j)
+        offs = jnp.arange(-_LW, _LW + 1, dtype=jnp.int32)
+        cand = jnp.clip(idx_prev[:, :, None] + offs, lo_idx, hi_idx[:, :, None])
+        vals = jax.vmap(f, in_axes=2, out_axes=2)(cand)
+        best = jnp.take_along_axis(
+            cand, jnp.argmax(vals, axis=2)[:, :, None], axis=2
+        )[:, :, 0]
+        # A maximizer pinned to a window edge that is not a true bound means
+        # the drift exceeded the window — fall back to the global search for
+        # this round. The all-zeros initial policy hits this on round one, so
+        # cold starts transparently take the global path.
+        at_lo = (best == cand[:, :, 0]) & (cand[:, :, 0] > lo_idx)
+        at_hi = (best == cand[:, :, -1]) & (cand[:, :, -1] < hi_idx)
+        return jax.lax.cond(
+            jnp.any(at_lo | at_hi),
+            lambda: improve_global(EV),
+            lambda: best,
+        )
 
     def evaluate(v, idx):
         # Howard policy evaluation: the policy is fixed across sweeps, at
@@ -223,20 +251,57 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         return v
 
     def cond(carry):
-        _, _, dist, it = carry
-        return (dist >= tol) & (it < max_iter)
+        _, _, _, dist, it, same = carry
+        return (dist >= tol) & (it < max_iter) & jnp.logical_not(same)
 
     def body(carry):
-        v, _, _, it = carry
-        idx = improve(v)
+        v, idx_prev, idx_prev2, _, it, _ = carry
+        idx = improve(v, idx_prev)
         v_new = evaluate(v, idx)
         diff = jnp.abs(v_new - v)
         dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
-        return v_new, idx, dist, it + 1
+        # Policy-stability termination (the Howard policy-iteration stop):
+        # improvement returning the policy unchanged — or returning the
+        # policy of two rounds ago, an exact period-2 cycle — means further
+        # rounds only trade f32 flatness wobble: near the grid top the
+        # choice objective is flat below value resolution, so the discrete
+        # argmax can oscillate between equal-value cells forever while the
+        # value sup-norm criterion wanders in the rounding band (cf. the
+        # EGM noise_floor_ulp rationale). Both tests are DISCRETE and
+        # drift-proof: a genuinely converging policy moves monotonically
+        # and never revisits an earlier iterate, so neither fires early
+        # (pinned by TestContinuousVFI value-dominance in f64). Round one
+        # cannot fire (the all-zeros init is never an improvement image).
+        same = (jnp.all(idx == idx_prev) | jnp.all(idx == idx_prev2)) & (it > 0)
+        return v_new, idx, idx_prev, dist, it + 1, same
 
-    init = (v_init, jnp.zeros(coh.shape, jnp.int32),
-            jnp.array(jnp.inf, v_init.dtype), jnp.int32(0))
-    v, idx, dist, it = jax.lax.while_loop(cond, body, init)
+    z_idx = jnp.zeros(coh.shape, jnp.int32)
+    init = (v_init, z_idx, z_idx,
+            jnp.array(jnp.inf, v_init.dtype), jnp.int32(0), jnp.array(False))
+    v, idx, _, dist, it, same = jax.lax.while_loop(cond, body, init)
+
+    # Policy-repeat exits still owe v a polish: with the policy fixed, each
+    # evaluate() burst contracts the value residual by ~beta^howard_steps,
+    # so iterating pure evaluation to the SAME value criterion delivers the
+    # tolerance the value-based stop would have — without re-running the
+    # gather-heavy improvement rounds (the whole point of the early exit).
+    def _pol_cond(c):
+        _, d, k = c
+        return (d >= tol) & (k < jnp.int32(50))
+
+    def _pol_body(c):
+        vv, _, k = c
+        v2 = evaluate(vv, idx)
+        diff = jnp.abs(v2 - vv)
+        d = jnp.max(diff / (jnp.abs(vv) + 1e-10)) if relative_tol else jnp.max(diff)
+        return v2, d, k + 1
+
+    v, dist, _ = jax.lax.cond(
+        same,
+        lambda c: jax.lax.while_loop(_pol_cond, _pol_body, c),
+        lambda c: c,
+        (v, dist, jnp.int32(0)),
+    )
 
     policy_k = a_grid[idx]
     if golden_iters > 0:

@@ -181,10 +181,15 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
 
     sol = run()
     float(sol.distance)   # compile+converge warmup, fenced
-    t0 = time.perf_counter()
-    sol = run()
-    dist = float(sol.distance)
-    t_scale = time.perf_counter() - t0
+    # Best-of-3 like the CPU denominator: the noise-floor stop makes the
+    # solve short enough (~0.5 s at 400k) that per-run device/transport
+    # jitter is a visible fraction of it.
+    t_scale = np.inf
+    for _ in range(1 if quick else 3):
+        t0 = time.perf_counter()
+        sol = run()
+        dist = float(sol.distance)
+        t_scale = min(t_scale, time.perf_counter() - t0)
     # A non-converged (or NaN) solve must fail loudly, not be recorded as a
     # fast time: NaN >= tol is False, so the fixed point exits immediately.
     # The acceptance bound is the stopping rule the solver actually applied:

@@ -37,14 +37,14 @@ class TestPallasWindowedInverse:
                                    rtol=0, atol=1e-9)
 
     def test_escape_on_oversaturated_window(self):
-        # The kernel's 16,384-knot double panels escape only when a query
-        # block's bracket span exceeds them: 20,000 knots crammed inside one
-        # query interval at 64k total.
-        n = 65_536
+        # The kernel's 16,384-knot panels escape only when a query block's
+        # bracket span exceeds them: 18,000 knots crammed inside one query
+        # interval (smallest total size that leaves room for the cluster).
+        n = 36_864
         lo, hi, power = 0.0, 52.0, 2.0
         gq = _grid(n, lo, hi, power)
-        cluster = np.linspace(gq[9000], gq[9001], 20_000, endpoint=False)
-        rest = gq[np.linspace(0, n - 1, n - 20_000).astype(int)]
+        cluster = np.linspace(gq[9000], gq[9001], 18_000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 18_000).astype(int)]
         x = jnp.asarray(np.sort(np.concatenate([cluster, rest]))[:n])
         out, esc = inverse_interp_power_grid_pallas(x, lo, hi, power, n,
                                                     interpret=True)
