@@ -36,6 +36,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # silently-still-enabled cache would invalidate the bisection.
         return None
     if cache_dir is None:
+        cache_dir = env
+    if cache_dir is None:
         # Keyed by the RESOLVED backend (this initializes it — the call
         # sites all touch devices immediately afterwards anyway): a
         # TPU-attached process also compiles XLA:CPU executables with
@@ -44,9 +46,11 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # triggers feature-mismatch warnings with a documented SIGILL risk.
         # The requested-platform string would NOT do: it is unset ("auto")
         # both for a TPU-attached default run and for a CPU fallback run
-        # when the TPU tunnel is down.
+        # when the TPU tunnel is down. Resolved only on this default path —
+        # an env- or argument-supplied dir must not force backend init (and
+        # platform pinning) as a side effect.
         backend = jax.default_backend()
-        cache_dir = env or os.path.join(
+        cache_dir = os.path.join(
             os.path.expanduser("~"), ".cache", "aiyagari_tpu", f"xla-{backend}"
         )
     try:

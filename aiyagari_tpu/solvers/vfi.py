@@ -226,11 +226,17 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
             cand, jnp.argmax(vals, axis=2)[:, :, None], axis=2
         )[:, :, 0]
         # A maximizer pinned to a window edge that is not a true bound means
-        # the drift exceeded the window — fall back to the global search for
-        # this round. The all-zeros initial policy hits this on round one, so
-        # cold starts transparently take the global path.
-        at_lo = (best == cand[:, :, 0]) & (cand[:, :, 0] > lo_idx)
-        at_hi = (best == cand[:, :, -1]) & (cand[:, :, -1] < hi_idx)
+        # the drift may exceed the window — fall back to the global search
+        # for this round. "Pinned" requires the edge to STRICTLY beat its
+        # inward neighbor: in the f32 flat-top regime whole windows tie
+        # exactly and argmax's first-max rule lands on the edge offset, which
+        # would otherwise escalate every flat round to the global search.
+        # The all-zeros initial policy hits the lo edge with a strict
+        # gradient on round one, so cold starts transparently go global.
+        at_lo = ((best == cand[:, :, 0]) & (cand[:, :, 0] > lo_idx)
+                 & (vals[:, :, 0] > vals[:, :, 1]))
+        at_hi = ((best == cand[:, :, -1]) & (cand[:, :, -1] < hi_idx)
+                 & (vals[:, :, -1] > vals[:, :, -2]))
         return jax.lax.cond(
             jnp.any(at_lo | at_hi),
             lambda: improve_global(EV),
@@ -269,10 +275,14 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         # value sup-norm criterion wanders in the rounding band (cf. the
         # EGM noise_floor_ulp rationale). Both tests are DISCRETE and
         # drift-proof: a genuinely converging policy moves monotonically
-        # and never revisits an earlier iterate, so neither fires early
-        # (pinned by TestContinuousVFI value-dominance in f64). Round one
-        # cannot fire (the all-zeros init is never an improvement image).
-        same = (jnp.all(idx == idx_prev) | jnp.all(idx == idx_prev2)) & (it > 0)
+        # and never revisits an earlier ITERATE, so neither fires early
+        # (pinned by TestContinuousVFI value-dominance in f64). The repeat
+        # test arms after round one and the cycle test after round two —
+        # before those, idx_prev/idx_prev2 still hold the all-zeros INIT
+        # sentinel, a corner policy a transient iterate could legitimately
+        # equal without it being a revisit.
+        same = (jnp.all(idx == idx_prev) & (it > 0)) | (
+            jnp.all(idx == idx_prev2) & (it > 1))
         return v_new, idx, idx_prev, dist, it + 1, same
 
     z_idx = jnp.zeros(coh.shape, jnp.int32)

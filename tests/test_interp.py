@@ -262,6 +262,7 @@ class TestPowerGridInversion:
         np.testing.assert_allclose(np.asarray(fast.policy_c), np.asarray(slow.policy_c),
                                    atol=1e-8)
 
+    @pytest.mark.slow
     def test_safe_solver_retries_generic_route_on_poison(self, monkeypatch):
         # Wiring of the poison-then-retry cycle: stub the jitted solve so the
         # fast path returns a poisoned (NaN-distance, escaped=True) solution
@@ -284,17 +285,18 @@ class TestPowerGridInversion:
             return sol
 
         monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
-        n = 5000   # above the windowed cutoff, so the retry is armed
+        n = 4608   # above the windowed cutoff, so the retry is armed
         a_grid = jnp.asarray(52.0 * (np.arange(n) / (n - 1)) ** 2.0)
         s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
         C0 = egm_mod.initial_consumption_guess(a_grid, s, 0.04, 1.2)
         sol = egm_mod.solve_aiyagari_egm_safe(
             C0, a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
-            tol=1e-5, max_iter=1000, grid_power=2.0)
+            tol=1e-4, max_iter=1000, grid_power=2.0)
         assert calls == [2.0, 0.0]
-        assert float(sol.distance) < 1e-5
+        assert float(sol.distance) < 1e-4
         assert not np.isnan(np.asarray(sol.policy_c)).any()
 
+    @pytest.mark.slow
     def test_multiscale_retries_whole_ladder_on_poison(self, monkeypatch):
         # Same wiring check for the stage ladder: a poisoned fast ladder must
         # be re-run end-to-end on the generic route.
@@ -328,6 +330,7 @@ class TestPowerGridInversion:
         assert float(sol.distance) < 1e-5
         assert not np.isnan(np.asarray(sol.policy_c)).any()
 
+    @pytest.mark.slow
     def test_safe_solver_does_not_retry_on_genuine_divergence(self, monkeypatch):
         # A NaN distance WITHOUT the escape flag is genuine numerical
         # divergence: the wrapper must surface it (one dispatch, NaN result),
@@ -347,13 +350,13 @@ class TestPowerGridInversion:
                 jnp.array(False))
 
         monkeypatch.setattr(egm_mod, "solve_aiyagari_egm", stub)
-        n = 5000   # windowed regime, where the old isnan heuristic would retry
+        n = 4608   # windowed regime, where the old isnan heuristic would retry
         a_grid = jnp.asarray(52.0 * (np.arange(n) / (n - 1)) ** 2.0)
         s = jnp.asarray([0.8, 1.2]); P = jnp.asarray([[0.9, 0.1], [0.1, 0.9]])
         C0 = egm_mod.initial_consumption_guess(a_grid, s, 0.04, 1.2)
         sol = egm_mod.solve_aiyagari_egm_safe(
             C0, a_grid, s, P, 0.04, 1.2, 0.0, sigma=2.0, beta=0.95,
-            tol=1e-5, max_iter=1000, grid_power=2.0)
+            tol=1e-4, max_iter=1000, grid_power=2.0)
         assert calls == [2.0]
         assert np.isnan(float(sol.distance))
 

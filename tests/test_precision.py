@@ -172,6 +172,7 @@ class TestFineGridF32:
         want = 0.96 * P @ v
         assert np.abs(got - want).max() < 5e-4
 
+    @pytest.mark.slow
     def test_labor_egm_f32_converges_on_fine_grid(self):
         # Same hazard as test_egm_f32_converges_on_fine_grid but through the
         # consumption-policy extrapolation of the endogenous-labor variant.

@@ -9,6 +9,7 @@ programs; hypothesis varies the VALUES.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 from hypothesis.extra.numpy import arrays
@@ -27,7 +28,12 @@ from aiyagari_tpu.utils.stats import gini, lorenz_curve
 SET = settings(max_examples=25, deadline=None,
                suppress_health_check=[HealthCheck.too_slow])
 
-finite = dict(allow_nan=False, allow_infinity=False)
+# Subnormals excluded: weights at O(1e-311) make cumsum/total carry ~1e-12
+# RELATIVE rounding (the subnormal ulp is a fixed 5e-324 absolute), busting
+# the 1e-9 share-identity tolerances — found by hypothesis in the Lorenz
+# convexity property. Normal-range tiny values (>= ~2.2e-308) keep the usual
+# 1e-16 relative ulp and stay in scope.
+finite = dict(allow_nan=False, allow_infinity=False, allow_subnormal=False)
 
 
 def _monotone_knots(raw, span=50.0):
@@ -71,6 +77,7 @@ class TestInversePowerGridProperties:
         raw=arrays(np.float64, (6000,), elements=st.floats(0.0, 1.0, **finite)),
         power=st.sampled_from([2.0, 7.0]),
     )
+    @pytest.mark.slow
     def test_windowed_route_exact_or_loudly_poisoned(self, raw, power):
         n = 6000             # windowed route (> cutoff)
         lo, hi = 0.0, 52.0
