@@ -173,6 +173,49 @@ class TestFineGridF32:
         assert np.abs(got - want).max() < 5e-4
 
     @pytest.mark.slow
+    def test_noise_floor_rule_semantics(self):
+        """noise_floor_ulp widens the stopping tolerance to the f32 rounding
+        band (tol_effective > tol, fewer sweeps, near-identical policy) and
+        is an exact no-op in f64, where the floor is ~1e-13 (BENCHMARKS.md
+        round-2 yardstick pins the 400k quality claim on hardware; this
+        pins the rule's mechanics at test scale)."""
+        from aiyagari_tpu.solvers.egm import initial_consumption_guess, solve_aiyagari_egm
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        n = 1200
+        for dtype in (jnp.float32, jnp.float64):
+            m = aiyagari_preset(grid_size=n, dtype=dtype)
+            w = float(wage_from_r(0.04, m.config.technology.alpha,
+                                  m.config.technology.delta))
+            C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w).astype(dtype)
+            kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+                      max_iter=2000, grid_power=2.0)
+            # A tolerance just below the f32 floor at this calibration
+            # (max|C| ~ 10.2 -> floor_24 = 24*eps*maxC ~ 2.9e-5 in f32,
+            # ~5.4e-14 in f64), so the rule engages in f32 only.
+            strict = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w,
+                                        m.amin, tol=2e-5, **kw)
+            floored = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w,
+                                         m.amin, tol=2e-5,
+                                         noise_floor_ulp=24.0, **kw)
+            if dtype == jnp.float32:
+                assert float(floored.tol_effective) > 2e-5
+                assert int(floored.iterations) <= int(strict.iterations)
+                # Same noise cone: both iterates sit within their own
+                # stopping distance of the fixed point, so the gap is
+                # bounded by the SUM of the two tolerances amplified by the
+                # fixed-point sensitivity 1/(1-beta).
+                bound = (float(floored.tol_effective) + 2e-5) / (1 - m.preferences.beta)
+                assert float(jnp.max(jnp.abs(
+                    floored.policy_c - strict.policy_c))) < bound
+            else:
+                # f64: floor ~ 5.4e-14 << tol -> identical stopping rule.
+                assert float(floored.tol_effective) == pytest.approx(2e-5)
+                assert int(floored.iterations) == int(strict.iterations)
+                np.testing.assert_array_equal(np.asarray(floored.policy_c),
+                                              np.asarray(strict.policy_c))
+
+    @pytest.mark.slow
     def test_labor_egm_f32_converges_on_fine_grid(self):
         # Same hazard as test_egm_f32_converges_on_fine_grid but through the
         # consumption-policy extrapolation of the endogenous-labor variant.
