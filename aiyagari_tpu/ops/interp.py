@@ -216,12 +216,16 @@ _INV_KBLOCK = 512       # knot-block granularity of the gathered windows
 _INV_WBLOCKS = 6        # knot blocks per window (window covers 6x local density)
 
 
-def _finish_inverse(cnt, x0, x1, xr, *, lo, hi, power, n_q, n_k):
+def _finish_inverse(cnt, x0, x1, xr, *, lo, hi, power, n_q, n_k, q_vals=None):
     """Shared tail of the power-grid inversion: bracket data -> interpolated
     inverse. cnt = #{k: x_k < g_j} per query, (x0, x1) the bracketing knot
-    values (±inf where absent), xr the full knot row (for the below-range
-    extrapolation slope). Used by both the XLA routes here and the fused
-    Pallas kernel (ops/pallas_inverse.py), so the two cannot drift."""
+    values (±inf where absent), xr the knot row — only its first two knots
+    are read (the below-range extrapolation slope), so callers holding a
+    shard may pass just those. q_vals overrides the query values for
+    callers evaluating a SLICE of the query grid (the halo-sharded route);
+    default is the full analytic n_q-point grid. Used by the XLA routes
+    here, the fused Pallas kernel (ops/pallas_inverse.py), and the
+    halo-exchange sharded route (parallel/halo.py), so they cannot drift."""
     dtype = xr.dtype
     span = hi - lo
 
@@ -231,7 +235,8 @@ def _finish_inverse(cnt, x0, x1, xr, *, lo, hi, power, n_q, n_k):
     def gk_of(i):
         return lo + span * (i.astype(dtype) / (n_k - 1)) ** power
 
-    q_vals = g_of(jnp.arange(n_q))
+    if q_vals is None:
+        q_vals = g_of(jnp.arange(n_q))
     idx = cnt - 1
     below = idx < 0
     idx_c = jnp.clip(idx, 0, n_k - 1)

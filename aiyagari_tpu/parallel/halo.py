@@ -1,0 +1,135 @@
+"""Halo-exchange sharded power-grid inversion: the grid-axis distribution of
+the EGM hot operation where the KNOT ARRAY STAYS RESIDENT per device.
+
+Under plain GSPMD, sharding the knot array along the grid axis does not
+distribute it: the windowed inversion's data-dependent slab gathers defeat
+the compiler's locality analysis and the full row is re-materialized per
+device (measured; docs/DESIGN.md §4, tests/test_sim_sharding.py). This
+module is the explicit-collective alternative (SURVEY.md §2.4(1)): under
+`jax.shard_map`, each device owns one contiguous shard of the knots and of
+the query grid, exchanges a fixed-width HALO of boundary knots with its
+neighbors over two `lax.ppermute` rounds (ICI neighbor traffic, no
+all-gather), and brackets its own queries against [left halo | local shard
+| right halo] only.
+
+Why a bounded halo suffices — and exactly: the knots and the query grid
+share the power-spacing law and the EGM endogenous grid's knot density is
+bounded (the single-device windowed route's 6x envelope), so a query's
+bracketing knots lie within a fixed distance of its own shard. Device
+edges use SENTINEL halos that make the arithmetic exact rather than
+special-cased: device 0 fills its left halo with -inf — every sentinel
+counts as "a knot below the query", so the global count base
+(shard_start - halo) + (halo sentinels) telescopes to the true count, and
+a query below all real knots yields count 0 and x0 = -inf, the exact
+"absent bracket" encoding the finish step already handles. The last
+device fills its right halo with +inf (never below a query, never a
+bracket). Queries whose bracket would lie beyond the halo ESCAPE with the
+same NaN-poisoning contract as the single-device windowed route.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aiyagari_tpu.ops.interp import _finish_inverse
+
+__all__ = ["inverse_interp_power_grid_halo"]
+
+
+def inverse_interp_power_grid_halo(mesh, x, lo: float, hi: float, power: float,
+                                   n_q: int, *, axis: str = "grid",
+                                   halo: int = 3072):
+    """Distributed inverse interpolation onto the n_q-point power grid.
+
+    x [..., n_k] sorted knots, sharded (or shardable) along the last axis
+    over mesh[axis]; the axis size must divide n_k and n_q. Returns
+    (out [..., n_q] sharded along the last axis, escaped scalar bool).
+    Semantics match ops/interp.inverse_interp_power_grid (strict-< brackets,
+    below-range extrapolation, top truncation, NaN poisoning on escape).
+    """
+    D = mesh.shape[axis]
+    n_k = x.shape[-1]
+    if n_k % D or n_q % D:
+        raise ValueError(
+            f"mesh axis size {D} must divide n_k={n_k} and n_q={n_q}")
+    if halo >= n_k // D:
+        raise ValueError(f"halo={halo} must be smaller than the shard {n_k // D}")
+    lead = x.shape[:-1]
+    xr = x.reshape((-1, n_k))
+    run = _halo_fn(mesh, axis, n_k, n_q, float(lo), float(hi), float(power),
+                   int(halo), jnp.dtype(x.dtype).name)
+    out, escaped = run(xr)
+    return out.reshape(lead + (n_q,)), escaped > 0
+
+
+@lru_cache(maxsize=None)
+def _halo_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
+             power: float, halo: int, dtype_name: str):
+    """Build (and cache per static signature, so per-sweep callers hit jit's
+    trace cache instead of re-tracing the shard_map program — the pattern of
+    sim/ks_panel._shardmap_panel_fn) the halo-exchange bracket program."""
+    D = mesh.shape[axis]
+    nq_loc = n_q // D
+    dtype = jnp.dtype(dtype_name)
+    span = hi - lo
+
+    def local(xl):
+        # xl: [R, n_k/D] — this device's contiguous knot shard.
+        dev = jax.lax.axis_index(axis)
+        neg = jnp.array(-jnp.inf, dtype)
+        pos = jnp.array(jnp.inf, dtype)
+
+        # Neighbor halos over ICI: each device sends its tail right and its
+        # head left; edge devices receive the circular wrap and overwrite it
+        # with the exact sentinels (module docstring).
+        fwd = [(i, (i + 1) % D) for i in range(D)]
+        bwd = [(i, (i - 1) % D) for i in range(D)]
+        left = jax.lax.ppermute(xl[:, -halo:], axis, fwd)    # left nbr's tail
+        right = jax.lax.ppermute(xl[:, :halo], axis, bwd)    # right nbr's head
+        left = jnp.where(dev == 0, neg, left)
+        right = jnp.where(dev == D - 1, pos, right)
+        ext = jnp.concatenate([left, xl, right], axis=-1)    # [R, shard+2*halo]
+
+        # This device's slice of the analytic query grid.
+        j = dev * nq_loc + jnp.arange(nq_loc)
+        q = lo + span * (j.astype(dtype) / (n_q - 1)) ** power
+
+        lt = ext[:, None, :] < q[None, :, None]              # [R, nq_loc, ext]
+        cnt_ext = jnp.sum(lt, axis=-1).astype(jnp.int32)
+        x0 = jnp.max(jnp.where(lt, ext[:, None, :], neg), axis=-1)
+        x1 = jnp.min(jnp.where(lt, pos, ext[:, None, :]), axis=-1)
+        # Global count: shard start minus the halo the sentinel/neighbor
+        # knots occupy — exact by the sentinel construction.
+        base = dev * (n_k // D) - halo
+        cnt = base + cnt_ext
+
+        # Escape: a bracket touching the ext edges may continue beyond the
+        # halo. Left: every ext knot >= q (cnt_ext == 0) on a device with
+        # real knots to its left. Right: every ext knot < q with real knots
+        # to the right.
+        esc_l = jnp.any((cnt_ext == 0) & (dev > 0))
+        esc_r = jnp.any((cnt_ext == ext.shape[-1]) & (dev < D - 1))
+        escaped = jax.lax.pmax((esc_l | esc_r).astype(jnp.int32), axis)
+
+        # The finish step needs the FIRST knot pair of the whole array for
+        # the below-range extrapolation slope: all-gather the tiny per-shard
+        # heads and take device 0's (ppermute cannot broadcast one source).
+        head2 = jax.lax.all_gather(xl[:, :2], axis)[0]
+        out = jax.vmap(
+            lambda c, a0, a1, h2: _finish_inverse(
+                c, a0, a1, h2, lo=lo, hi=hi, power=power, n_q=n_q, n_k=n_k,
+                q_vals=q,
+            )
+        )(cnt, x0, x1, head2)
+        out = jnp.where(escaped > 0, jnp.nan, out)
+        return out, escaped
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P(None, axis),
+        out_specs=(P(None, axis), P()),
+    ))

@@ -232,6 +232,90 @@ class TestGridSharding:
                                       np.asarray(ref.policy_idx))
 
 
+class TestHaloShardedInversion:
+    """parallel/halo.py: the EGM inversion with the knot array genuinely
+    DISTRIBUTED — per-device shards + ppermute neighbor halos, never a full
+    re-materialization (the thing GSPMD cannot do for this op; DESIGN.md §4)."""
+
+    def _knots(self, n, distort=True):
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        if distort:
+            x = np.sort((gk + 0.3 * np.sin(gk / 7.0) + 0.8) / 1.04 - 0.5)
+        else:
+            x = gk * 0.97
+        return jnp.asarray(x), lo, hi, power
+
+    def test_matches_unsharded_route(self):
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+        from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        n = 40_960   # 5,120-knot shards + 2,048-knot halos on 8 devices
+        # (the shifted second row's bracket lag measures ~1,170 knots at the
+        # sqrt-dense bottom of the power grid, past a 1,024 halo — which the
+        # escape test below exercises on purpose).
+        x, lo, hi, power = self._knots(n)
+        xq = jnp.stack([x, x * 1.01 + 0.05])
+        mesh = make_mesh(("grid",))
+        got, esc = inverse_interp_power_grid_halo(mesh, xq, lo, hi, power, n,
+                                                  halo=2048)
+        want, esc_w = inverse_interp_power_grid(xq, lo, hi, power, n,
+                                                with_escape=True)
+        assert not bool(esc) and not bool(esc_w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-9)
+
+    def test_below_and_above_range_edges(self):
+        # Knots shifted up (first queries below all knots) and truncated
+        # (last queries above): the sentinel halos must reproduce the
+        # unsharded below-extrapolation and top-truncation exactly.
+        from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+        from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        n = 16_384
+        x, lo, hi, power = self._knots(n, distort=False)
+        x = x + 0.5          # queries below the first knot exist
+        mesh = make_mesh(("grid",))
+        # The +0.5 shift lags brackets by up to ~760 knots at the power
+        # grid's dense bottom; 1,024 covers it.
+        got, esc = inverse_interp_power_grid_halo(mesh, x, lo, hi, power, n,
+                                                  halo=1024)
+        want = inverse_interp_power_grid(x, lo, hi, power, n)
+        assert not bool(esc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-9)
+
+    def test_escape_on_cross_shard_cluster(self):
+        # A knot cluster wider than the halo inside one query interval: the
+        # sharded route must escape (NaN + flag), never return silently
+        # wrong brackets.
+        from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        n = 16_384
+        lo, hi, power = 0.0, 52.0, 2.0
+        gq = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        cluster = np.linspace(gq[2000], gq[2001], 4000, endpoint=False)
+        rest = gq[np.linspace(0, n - 1, n - 4000).astype(int)]
+        x = jnp.asarray(np.sort(np.concatenate([cluster, rest]))[:n])
+        mesh = make_mesh(("grid",))
+        out, esc = inverse_interp_power_grid_halo(mesh, x, lo, hi, power, n,
+                                                  halo=512)
+        assert bool(esc)
+        assert np.isnan(np.asarray(out)).all()
+
+    def test_rejects_ragged_shapes(self):
+        from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(("grid",))
+        with pytest.raises(ValueError, match="divide"):
+            inverse_interp_power_grid_halo(mesh, jnp.zeros(1001), 0.0, 1.0,
+                                           2.0, 1001, halo=8)
+
+
 class TestDistributed:
     def test_single_process_is_noop(self, monkeypatch):
         from aiyagari_tpu.parallel.distributed import initialize_distributed
