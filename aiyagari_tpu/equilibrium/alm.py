@@ -250,6 +250,7 @@ def _solve_krusell_smith_impl(
     # Anderson history — checkpointed and restored with it).
     best_f32 = np.inf   # best diff_B seen in the mixed f32 phase
     f32_stall = 0       # consecutive rounds without meaningful f32 progress
+    f32_in_band = False  # diff_B has entered the near-convergence band
     if checkpoint_dir is not None:
         from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
 
@@ -286,6 +287,7 @@ def _solve_krusell_smith_impl(
             G_hist = [np.asarray(g, np.float64) for g in sc.get("G_hist", [])]
             best_f32 = float(sc.get("best_f32", np.inf))
             f32_stall = int(sc.get("f32_stall", 0))
+            f32_in_band = bool(sc.get("f32_in_band", False))
 
     converged = False
     diff_B = np.inf
@@ -376,20 +378,33 @@ def _solve_krusell_smith_impl(
             break
         if mixed and np.dtype(sim_dtype) == np.float32:
             # Fallback phase switch: if the f32-sim fixed point ever stalls
-            # above tol (two consecutive rounds within 10% of the best diff
-            # so far, past the initial transient), finish with the f64
-            # simulation. Not expected at the shipped scales — the f32 sim's
-            # rounding is a fixed O(eps) bias, below the 1e-6 tolerance —
-            # but a user scale where the bias floor bites must converge, not
-            # limit-cycle. The diff_B < 1e-2 gate keeps Anderson's early
-            # non-monotone rounds from triggering a spurious switch.
-            if diff_B < 1e-2:
+            # above tol (consecutive rounds within 10% of the best diff so
+            # far), finish with the f64 simulation. Not expected at the
+            # shipped scales — the f32 sim's rounding is a fixed O(eps)
+            # bias, below the 1e-6 tolerance — but a user scale where the
+            # bias floor bites must converge, not limit-cycle. Two
+            # thresholds: 2 stalled rounds once diff_B < 1e-2 (the normal
+            # near-convergence band), 6 above it — a scale where the f32
+            # bias floor itself exceeds 1e-2 must still trigger the switch,
+            # and the higher count absorbs Anderson's early non-monotone
+            # rounds that the 1e-2 gate used to filter (ADVICE round 2).
+            if diff_B < 1e-2 and not f32_in_band:
+                # First crossing into the band re-anchors the tracker: an
+                # early Anderson dip must not carry an above-band stall
+                # count (or a transiently low best) into the band, where
+                # the stricter 2-round trigger applies.
+                f32_in_band, f32_stall, best_f32 = True, 0, diff_B
+            else:
                 stalled = diff_B >= 0.9 * best_f32
                 f32_stall = f32_stall + 1 if stalled else 0
                 best_f32 = min(best_f32, diff_B)
-                if f32_stall >= 2:
-                    sim_dtype = jnp.float64
-                    k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
+            # Trigger threshold follows the CURRENT round's band, not the
+            # latch: an Anderson overshoot back above 1e-2 after a dip is
+            # normal non-monotone progress and gets the loose 6-count, the
+            # same filter those rounds had before any dip.
+            if f32_stall >= (2 if diff_B < 1e-2 else 6):
+                sim_dtype = jnp.float64
+                k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
         if alm.acceleration == "anderson":
             B_hist.append(B.copy())
             G_hist.append(B_new.copy())
@@ -407,7 +422,8 @@ def _solve_krusell_smith_impl(
                          "B_hist": [b.tolist() for b in B_hist],
                          "G_hist": [g.tolist() for g in G_hist],
                          "sim_phase": str(np.dtype(sim_dtype)),
-                         "best_f32": float(best_f32), "f32_stall": f32_stall},
+                         "best_f32": float(best_f32), "f32_stall": f32_stall,
+                         "f32_in_band": f32_in_band},
                 arrays={
                     "value": np.asarray(value),
                     "k_opt": np.asarray(k_opt),

@@ -37,22 +37,25 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         return None
     if cache_dir is None:
         cache_dir = env
+    # Every path is keyed by the RESOLVED backend (this initializes it — the
+    # call sites all touch devices immediately afterwards anyway): a
+    # TPU-attached process also compiles XLA:CPU executables with different
+    # machine-feature flags (+prefer-no-scatter/-gather) than a pure-CPU
+    # process, and loading the other's AOT artifacts triggers
+    # feature-mismatch warnings with a documented SIGILL risk. Explicit and
+    # env-supplied dirs get the same "-{backend}" suffix — an unsuffixed
+    # shared dir would reintroduce exactly that collision the moment a
+    # TPU-attached and a CPU-forced process point at it (ADVICE round 2).
+    # The requested-platform string would NOT do: it is unset ("auto") both
+    # for a TPU-attached default run and for a CPU fallback run when the
+    # TPU tunnel is down.
+    backend = jax.default_backend()
     if cache_dir is None:
-        # Keyed by the RESOLVED backend (this initializes it — the call
-        # sites all touch devices immediately afterwards anyway): a
-        # TPU-attached process also compiles XLA:CPU executables with
-        # different machine-feature flags (+prefer-no-scatter/-gather) than
-        # a pure-CPU process, and loading the other's AOT artifacts
-        # triggers feature-mismatch warnings with a documented SIGILL risk.
-        # The requested-platform string would NOT do: it is unset ("auto")
-        # both for a TPU-attached default run and for a CPU fallback run
-        # when the TPU tunnel is down. Resolved only on this default path —
-        # an env- or argument-supplied dir must not force backend init (and
-        # platform pinning) as a side effect.
-        backend = jax.default_backend()
         cache_dir = os.path.join(
             os.path.expanduser("~"), ".cache", "aiyagari_tpu", f"xla-{backend}"
         )
+    else:
+        cache_dir = f"{cache_dir.rstrip(os.sep)}-{backend}"
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # Cache every program: the workload is dominated by a handful of

@@ -5,6 +5,7 @@ from aiyagari_tpu.parallel.distributed import (
     initialize_distributed,
     process_info,
 )
+from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
 from aiyagari_tpu.parallel.mesh import (
     agents_sharding,
     force_host_device_count,
@@ -12,6 +13,10 @@ from aiyagari_tpu.parallel.mesh import (
     make_mesh,
     replicated,
     shard_panel,
+)
+from aiyagari_tpu.parallel.ring import (
+    inverse_interp_power_grid_ring,
+    ring_buffer_size,
 )
 
 __all__ = [
@@ -21,7 +26,10 @@ __all__ = [
     "agents_sharding",
     "force_host_device_count",
     "grid_sharding",
+    "inverse_interp_power_grid_halo",
+    "inverse_interp_power_grid_ring",
     "make_mesh",
     "replicated",
+    "ring_buffer_size",
     "shard_panel",
 ]

@@ -25,11 +25,14 @@ a query below all real knots yields count 0 and x0 = -inf, the exact
 device fills its right halo with +inf (never below a query, never a
 bracket). Queries whose bracket would lie beyond the halo ESCAPE with the
 same NaN-poisoning contract as the single-device windowed route.
+
+The shard-local body is exposed as `halo_bracket_local` so larger
+shard_map programs (the distributed EGM sweep, solvers/egm_sharded.py)
+can run it inline per sweep instead of crossing a shard_map boundary per
+iteration.
 """
 
 from __future__ import annotations
-
-from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +40,96 @@ from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.interp import _finish_inverse
 
-__all__ = ["inverse_interp_power_grid_halo"]
+__all__ = ["inverse_interp_power_grid_halo", "halo_bracket_local"]
+
+# Bounded program caches keyed on mesh VALUE (device ids + axis layout), not
+# the Mesh object: equal-valued meshes rebuilt per call site hit the same
+# entry, and old meshes' closures/executables are evicted instead of retained
+# for the process lifetime.
+_PROGRAM_CACHE_MAX = 32
+
+
+def mesh_fingerprint(mesh, axis: str):
+    """Hashable value identity of (mesh, axis) for program caches. Device
+    ids alone would collide across backends (CPU and TPU devices are both
+    numbered from 0 in one process), handing a CPU call an executable
+    compiled for the equal-shaped TPU mesh — so the platform is part of
+    the key."""
+    return (
+        tuple((d.platform, int(d.id)) for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        axis,
+    )
+
+
+def cached_program(cache: dict, key, build):
+    """FIFO-bounded build-once cache for jitted shard_map programs."""
+    prog = cache.get(key)
+    if prog is None:
+        if len(cache) >= _PROGRAM_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        prog = cache[key] = build()
+    return prog
+
+
+def halo_bracket_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
+                       lo: float, hi: float, power: float, halo: int):
+    """Shard-local body of the halo-exchange inversion — call from INSIDE a
+    shard_map over `axis`.
+
+    xl [R, n_k/D] is this device's contiguous sorted-knot shard, q [n_q/D]
+    its slice of the analytic power query grid. Returns (out [R, n_q/D],
+    escaped int32 scalar) where `out` is already NaN-poisoned and `escaped`
+    pmax'd across the axis. Semantics match ops/interp.
+    inverse_interp_power_grid (strict-< brackets, below-range extrapolation,
+    top truncation, NaN poisoning on escape).
+    """
+    dev = jax.lax.axis_index(axis)
+    dtype = xl.dtype
+    neg = jnp.array(-jnp.inf, dtype)
+    pos = jnp.array(jnp.inf, dtype)
+
+    # Neighbor halos over ICI: each device sends its tail right and its
+    # head left; edge devices receive the circular wrap and overwrite it
+    # with the exact sentinels (module docstring).
+    fwd = [(i, (i + 1) % D) for i in range(D)]
+    bwd = [(i, (i - 1) % D) for i in range(D)]
+    left = jax.lax.ppermute(xl[:, -halo:], axis, fwd)    # left nbr's tail
+    right = jax.lax.ppermute(xl[:, :halo], axis, bwd)    # right nbr's head
+    left = jnp.where(dev == 0, neg, left)
+    right = jnp.where(dev == D - 1, pos, right)
+    ext = jnp.concatenate([left, xl, right], axis=-1)    # [R, shard+2*halo]
+
+    lt = ext[:, None, :] < q[None, :, None]              # [R, nq_loc, ext]
+    cnt_ext = jnp.sum(lt, axis=-1).astype(jnp.int32)
+    x0 = jnp.max(jnp.where(lt, ext[:, None, :], neg), axis=-1)
+    x1 = jnp.min(jnp.where(lt, pos, ext[:, None, :]), axis=-1)
+    # Global count: shard start minus the halo the sentinel/neighbor
+    # knots occupy — exact by the sentinel construction.
+    base = dev * (n_k // D) - halo
+    cnt = base + cnt_ext
+
+    # Escape: a bracket touching the ext edges may continue beyond the
+    # halo. Left: every ext knot >= q (cnt_ext == 0) on a device with
+    # real knots to its left. Right: every ext knot < q with real knots
+    # to the right.
+    esc_l = jnp.any((cnt_ext == 0) & (dev > 0))
+    esc_r = jnp.any((cnt_ext == ext.shape[-1]) & (dev < D - 1))
+    escaped = jax.lax.pmax((esc_l | esc_r).astype(jnp.int32), axis)
+
+    # The finish step needs the FIRST knot pair of the whole array for
+    # the below-range extrapolation slope: all-gather the tiny per-shard
+    # heads and take device 0's (ppermute cannot broadcast one source).
+    head2 = jax.lax.all_gather(xl[:, :2], axis)[0]
+    out = jax.vmap(
+        lambda c, a0, a1, h2: _finish_inverse(
+            c, a0, a1, h2, lo=lo, hi=hi, power=power, n_q=n_q, n_k=n_k,
+            q_vals=q,
+        )
+    )(cnt, x0, x1, head2)
+    out = jnp.where(escaped > 0, jnp.nan, out)
+    return out, escaped
 
 
 def inverse_interp_power_grid_halo(mesh, x, lo: float, hi: float, power: float,
@@ -66,7 +158,9 @@ def inverse_interp_power_grid_halo(mesh, x, lo: float, hi: float, power: float,
     return out.reshape(lead + (n_q,)), escaped > 0
 
 
-@lru_cache(maxsize=None)
+_HALO_PROGRAMS: dict = {}
+
+
 def _halo_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
              power: float, halo: int, dtype_name: str):
     """Build (and cache per static signature, so per-sweep callers hit jit's
@@ -77,59 +171,21 @@ def _halo_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
 
-    def local(xl):
-        # xl: [R, n_k/D] — this device's contiguous knot shard.
-        dev = jax.lax.axis_index(axis)
-        neg = jnp.array(-jnp.inf, dtype)
-        pos = jnp.array(jnp.inf, dtype)
+    def build():
+        def local(xl):
+            dev = jax.lax.axis_index(axis)
+            j = dev * nq_loc + jnp.arange(nq_loc)
+            q = lo + span * (j.astype(dtype) / (n_q - 1)) ** power
+            return halo_bracket_local(xl, q, axis=axis, D=D, n_k=n_k,
+                                      n_q=n_q, lo=lo, hi=hi, power=power,
+                                      halo=halo)
 
-        # Neighbor halos over ICI: each device sends its tail right and its
-        # head left; edge devices receive the circular wrap and overwrite it
-        # with the exact sentinels (module docstring).
-        fwd = [(i, (i + 1) % D) for i in range(D)]
-        bwd = [(i, (i - 1) % D) for i in range(D)]
-        left = jax.lax.ppermute(xl[:, -halo:], axis, fwd)    # left nbr's tail
-        right = jax.lax.ppermute(xl[:, :halo], axis, bwd)    # right nbr's head
-        left = jnp.where(dev == 0, neg, left)
-        right = jnp.where(dev == D - 1, pos, right)
-        ext = jnp.concatenate([left, xl, right], axis=-1)    # [R, shard+2*halo]
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=(P(None, axis), P()),
+        ))
 
-        # This device's slice of the analytic query grid.
-        j = dev * nq_loc + jnp.arange(nq_loc)
-        q = lo + span * (j.astype(dtype) / (n_q - 1)) ** power
-
-        lt = ext[:, None, :] < q[None, :, None]              # [R, nq_loc, ext]
-        cnt_ext = jnp.sum(lt, axis=-1).astype(jnp.int32)
-        x0 = jnp.max(jnp.where(lt, ext[:, None, :], neg), axis=-1)
-        x1 = jnp.min(jnp.where(lt, pos, ext[:, None, :]), axis=-1)
-        # Global count: shard start minus the halo the sentinel/neighbor
-        # knots occupy — exact by the sentinel construction.
-        base = dev * (n_k // D) - halo
-        cnt = base + cnt_ext
-
-        # Escape: a bracket touching the ext edges may continue beyond the
-        # halo. Left: every ext knot >= q (cnt_ext == 0) on a device with
-        # real knots to its left. Right: every ext knot < q with real knots
-        # to the right.
-        esc_l = jnp.any((cnt_ext == 0) & (dev > 0))
-        esc_r = jnp.any((cnt_ext == ext.shape[-1]) & (dev < D - 1))
-        escaped = jax.lax.pmax((esc_l | esc_r).astype(jnp.int32), axis)
-
-        # The finish step needs the FIRST knot pair of the whole array for
-        # the below-range extrapolation slope: all-gather the tiny per-shard
-        # heads and take device 0's (ppermute cannot broadcast one source).
-        head2 = jax.lax.all_gather(xl[:, :2], axis)[0]
-        out = jax.vmap(
-            lambda c, a0, a1, h2: _finish_inverse(
-                c, a0, a1, h2, lo=lo, hi=hi, power=power, n_q=n_q, n_k=n_k,
-                q_vals=q,
-            )
-        )(cnt, x0, x1, head2)
-        out = jnp.where(escaped > 0, jnp.nan, out)
-        return out, escaped
-
-    return jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=P(None, axis),
-        out_specs=(P(None, axis), P()),
-    ))
+    key = mesh_fingerprint(mesh, axis) + (n_k, n_q, lo, hi, power, halo,
+                                          dtype_name)
+    return cached_program(_HALO_PROGRAMS, key, build)

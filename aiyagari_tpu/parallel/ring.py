@@ -1,0 +1,234 @@
+"""Ring-redistribution sharded power-grid inversion: the grid-axis
+distribution of the EGM hot operation for knot arrays whose brackets lie
+ARBITRARILY far from their query's shard — the regime the one-hop halo
+exchange (parallel/halo.py) cannot cover.
+
+Why the halo variant is not enough for the EGM solver: the endogenous grid
+a_hat is the INVERSE savings policy, and at the dense bottom of the power
+grid a household's one-period jump w·s − c maps to an index displacement
+that is a constant FRACTION of the grid (measured: bracket lag up to
+0.33·n at the shipped Aiyagari calibration, every grid size — the policy
+jump in value space is O(1) and the power grid's index density scales with
+n). A neighbor halo is bounded by the shard size n/D, so for D ≥ 4 no
+legal halo covers the lag. The halo kernel remains correct and shipped for
+narrow-lag inversions; this module is the general mechanism.
+
+Design — value-space knot redistribution over a ring, O(n/D) memory:
+
+  1. Every device computes, for ALL devices' first queries (analytic, so
+     no communication), the count of its own shard's knots strictly below
+     each; one psum yields the exact global bracket start c_e per device.
+  2. Each device assembles the contiguous global knot slab
+     [c_dev − pad, c_dev − pad + B) that covers its queries' brackets: the
+     shards rotate around the ring (D−1 `lax.ppermute` rounds) and each
+     visiting shard is aligned into the local buffer with one roll + mask
+     (no gathers). Positions outside [0, n_k) take ±inf SENTINELS, making
+     the global count telescope exactly (cnt = s_start + buffer count) —
+     the same trick as the halo kernel's edge sentinels.
+  3. The device then runs the standard two-level windowed compare-reduce
+     (ops/interp._bracket_power_grid's geometry: 512-query blocks,
+     6×512-knot windows) against its LOCAL buffer only, and finishes with
+     the shared _finish_inverse tail, so the sharded and unsharded routes
+     cannot drift.
+
+Per-device memory is B = capacity·(n/D) (+ window margin); the measured
+slab requirement of the EGM endogenous grids is 1.11·(n/D) (worst device
+over sweeps and states at the shipped calibration, both 8k and 40k grids —
+the knot count landing in one query shard's value range is bounded by the
+endogenous grid's density ratio, not by the bracket LAG, which only sets
+where the slab starts). Default capacity 2.0 ≈ 80% headroom. A buffer
+overflow — bracket beyond the slab — ESCAPES with the same
+NaN-poisoning contract as the windowed route, and host wrappers fall back
+to the unsharded solver. Total ring traffic per sweep is one full rotation
+of the knot array (the same volume an all-gather would move) — the win is
+not bandwidth, it is that no device ever MATERIALIZES more than B knots,
+which is what makes grids that overflow one device's memory solvable at
+all (SURVEY.md §2.4(1), Aiyagari_EGM.m:95).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aiyagari_tpu.ops.interp import (
+    _INV_KBLOCK,
+    _INV_QBLOCK,
+    _INV_WBLOCKS,
+    _finish_inverse,
+)
+from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
+
+__all__ = ["inverse_interp_power_grid_ring", "ring_inverse_local",
+           "ring_buffer_size"]
+
+
+def ring_buffer_size(n_k: int, D: int, capacity: float) -> int:
+    """Static per-device knot-buffer length: capacity·shard plus one window
+    of slack, rounded up to the knot-block granularity. The floor (one shard
+    or one window, whichever is larger) is what the merge roll and the
+    window clamp require; capacities below ~1 degenerate to it and exist
+    only to exercise the escape contract."""
+    L = n_k // D
+    KB, M = _INV_KBLOCK, _INV_WBLOCKS
+    B = int(capacity * L) + M * KB
+    return max(-(-B // KB) * KB, -(-L // KB) * KB, M * KB)
+
+
+def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
+                       lo: float, hi: float, power: float,
+                       capacity: float = 2.0, pad: int = 8):
+    """Shard-local body of the ring-redistribution inversion — call from
+    INSIDE a shard_map over `axis`.
+
+    xl [R, n_k/D] is this device's contiguous sorted-knot shard (global
+    order: device d owns indices [d·L, (d+1)·L)), q [n_q/D] its slice of
+    the analytic power query grid. Returns (out [R, n_q/D], escaped int32
+    scalar pmax'd across the axis), `out` already NaN-poisoned on escape.
+    Semantics match ops/interp.inverse_interp_power_grid exactly (strict-<
+    brackets, below-range extrapolation, top truncation).
+    """
+    R, L = xl.shape
+    nq_loc = q.shape[-1]
+    dtype = xl.dtype
+    span = hi - lo
+    dev = jax.lax.axis_index(axis)
+    neg = jnp.array(-jnp.inf, dtype)
+    pos = jnp.array(jnp.inf, dtype)
+    B = ring_buffer_size(n_k, D, capacity)
+    S, KB, M = _INV_QBLOCK, _INV_KBLOCK, _INV_WBLOCKS
+    Lw = M * KB
+    nkb_buf = B // KB
+    nb = -(-nq_loc // S)
+
+    # 1. Exact global bracket starts: every device's first query is analytic,
+    # so each device counts its own knots below ALL of them and one psum
+    # telescopes the global counts. Strict < matches the bracket convention.
+    e = jnp.arange(D)
+    q_first_all = lo + span * ((e * (n_q // D)).astype(dtype) / (n_q - 1)) ** power
+    cnt_part = jnp.sum(xl[:, None, :] < q_first_all[None, :, None],
+                       axis=-1).astype(jnp.int32)                   # [R, D]
+    c_all = jax.lax.psum(cnt_part, axis)                            # [R, D]
+    s_start = c_all[:, dev] - pad                                   # [R]
+
+    # 2. Assemble the buffer: rotate the shards around the ring; align each
+    # visiting shard into the buffer with one roll + mask per row.
+    g0 = s_start[:, None] + jnp.arange(B)[None, :]                  # [R, B]
+    buf = jnp.where(g0 < 0, neg, pos)
+    perm = [(i, (i - 1) % D) for i in range(D)]
+    bpos = jnp.arange(B)
+
+    def merge_row(bufr, vr, off):
+        padded = jnp.concatenate([vr, jnp.full((B - L,), pos)])
+        rolled = jnp.roll(padded, off)
+        m = (bpos >= off) & (bpos < off + L)
+        return jnp.where(m, rolled, bufr)
+
+    visit = xl
+    for t in range(D):
+        f = (dev + t) % D                       # visiting shard's global id
+        off = f * L - s_start                   # [R] buffer offset
+        buf = jax.vmap(merge_row)(buf, visit, off)
+        if t < D - 1:
+            visit = jax.lax.ppermute(visit, axis, perm)
+
+    # 3. Two-level windowed bracket against the local buffer (the geometry
+    # of ops/interp._bracket_power_grid's windowed route, buffer-offset).
+    jq = jnp.minimum(jnp.arange(nb * S), nq_loc - 1)    # clamp query padding
+    qs = q[jq].reshape(nb, S)
+
+    def bracket_row(bufr, s0):
+        s_first = jnp.sum(bufr[None, :] < qs[:, :1], axis=1).astype(jnp.int32)
+        ab = jnp.minimum(jnp.clip(s_first - 1, 0, B - 1) // KB, nkb_buf - M)
+        seg = bufr.reshape(nkb_buf, KB)[ab[:, None] + jnp.arange(M)[None, :]]
+        seg = seg.reshape(nb, Lw)
+        lt = seg[:, None, :] < qs[:, :, None]                     # [nb, S, Lw]
+        cnt_w = jnp.sum(lt, axis=-1).astype(jnp.int32)
+        cnt = s0 + ab[:, None] * KB + cnt_w                       # global
+        x0 = jnp.max(jnp.where(lt, seg[:, None, :], neg), axis=-1)
+        x1 = jnp.min(jnp.where(lt, pos, seg[:, None, :]), axis=-1)
+        # Saturated window whose global end is short of the knot top: the
+        # bracket may continue beyond it (density overflow within the
+        # buffer, or the buffer itself too small) — one uniform escape rule,
+        # the buffer-offset form of the unsharded windowed route's.
+        esc = jnp.any((cnt_w == Lw) & (s0 + (ab[:, None] + M) * KB < n_k))
+
+        def cut(a):
+            return a.reshape(-1)[:nq_loc]
+
+        return cut(cnt), cut(x0), cut(x1), esc
+
+    cnt, x0, x1, esc_rows = jax.vmap(bracket_row)(buf, s_start)
+    escaped = jax.lax.pmax(jnp.any(esc_rows).astype(jnp.int32), axis)
+
+    # 4. Shared finish (below-range slope needs the global first knot pair:
+    # all-gather the tiny per-shard heads, take device 0's).
+    head2 = jax.lax.all_gather(xl[:, :2], axis)[0]
+    out = jax.vmap(
+        lambda c, a0, a1, h2: _finish_inverse(
+            c, a0, a1, h2, lo=lo, hi=hi, power=power, n_q=n_q, n_k=n_k,
+            q_vals=q,
+        )
+    )(cnt, x0, x1, head2)
+    out = jnp.where(escaped > 0, jnp.nan, out)
+    return out, escaped
+
+
+_RING_PROGRAMS: dict = {}
+
+
+def inverse_interp_power_grid_ring(mesh, x, lo: float, hi: float,
+                                   power: float, n_q: int, *,
+                                   axis: str = "grid",
+                                   capacity: float = 2.0, pad: int = 8):
+    """Distributed inverse interpolation onto the n_q-point power grid with
+    ring-redistributed knots (module docstring). x [..., n_k] sorted knots,
+    sharded (or shardable) along the last axis over mesh[axis]; the axis
+    size must divide n_k and n_q. Returns (out [..., n_q] sharded along the
+    last axis, escaped scalar bool). Semantics match
+    ops/interp.inverse_interp_power_grid.
+    """
+    D = mesh.shape[axis]
+    n_k = x.shape[-1]
+    if n_k % D or n_q % D:
+        raise ValueError(
+            f"mesh axis size {D} must divide n_k={n_k} and n_q={n_q}")
+    if pad < 1:
+        # pad >= 1 keeps each device's first query's LOWER bracketing knot
+        # (global index c-1) inside the slab; pad=0 would silently degrade
+        # that query to its lower grid value with escaped=False.
+        raise ValueError(f"pad must be >= 1, got {pad}")
+    lead = x.shape[:-1]
+    xr = x.reshape((-1, n_k))
+    run = _ring_fn(mesh, axis, n_k, n_q, float(lo), float(hi), float(power),
+                   float(capacity), int(pad), jnp.dtype(x.dtype).name)
+    out, escaped = run(xr)
+    return out.reshape(lead + (n_q,)), escaped > 0
+
+
+def _ring_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
+             power: float, capacity: float, pad: int, dtype_name: str):
+    D = mesh.shape[axis]
+    nq_loc = n_q // D
+    dtype = jnp.dtype(dtype_name)
+    span = hi - lo
+
+    def build():
+        def local(xl):
+            dev = jax.lax.axis_index(axis)
+            j = dev * nq_loc + jnp.arange(nq_loc)
+            q = lo + span * (j.astype(dtype) / (n_q - 1)) ** power
+            return ring_inverse_local(xl, q, axis=axis, D=D, n_k=n_k,
+                                      n_q=n_q, lo=lo, hi=hi, power=power,
+                                      capacity=capacity, pad=pad)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=(P(None, axis), P()),
+        ))
+
+    key = mesh_fingerprint(mesh, axis) + (n_k, n_q, lo, hi, power, capacity,
+                                          pad, dtype_name)
+    return cached_program(_RING_PROGRAMS, key, build)

@@ -1,0 +1,179 @@
+"""Distributed EGM solver: the full consumption-policy fixed point under one
+`jax.shard_map` program with the grid axis sharded across the mesh and the
+endogenous-grid knot array RESIDENT per device.
+
+This composes the ring-redistribution inversion (parallel/ring.py) with the
+EGM sweep (ops/egm.egm_step, the operator of Aiyagari_EGM.m:74-110) into
+the blueprint's actual multi-chip capability (SURVEY.md §2.4(1)): each
+device holds a [N, na/D] shard of the consumption iterate and its slice of
+the asset grid, and a sweep costs only
+
+  * the tiny [N,N]x[N, na/D] Euler matmul and the elementwise inversion
+    arithmetic, all local;
+  * one ring rotation of the knot shards (D-1 `lax.ppermute` rounds, ICI
+    neighbor traffic) assembling each device's O(na/D) bracket slab — the
+    one-hop halo variant (parallel/halo.py) cannot serve this op: the
+    endogenous grid's bracket lag is a constant fraction of the grid
+    (measured 0.33·na), beyond any legal halo (ring.py module docstring);
+  * O(D)-sized collectives: the psum'd bracket starts, an all_gather of
+    per-shard cummax tails (the cross-device prefix of the monotonicity
+    repair), an all_gather of per-shard head pairs (the below-range
+    extrapolation slope), and the pmax'd sup-norm/escape reductions.
+
+No device ever MATERIALIZES more than capacity·na/D knots (+ one window) — the
+memory-scaling property GSPMD cannot deliver for this op (its
+data-dependent slab gathers force the full knot row to be re-gathered per
+device; measured and pinned in tests/test_sim_sharding.TestGridSharding).
+tests/test_egm_sharded.py asserts both trajectory equality with the
+single-device solver and, on the compiled HLO, that no collective carries
+a full-grid-sized operand.
+
+The while_loop runs INSIDE shard_map: the convergence distance is pmax'd
+so every device sees the identical replicated carry and the devices
+iterate in lockstep — one program launch per solve, not one per sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aiyagari_tpu.ops.bellman import expectation
+from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
+from aiyagari_tpu.parallel.ring import ring_inverse_local
+from aiyagari_tpu.solvers.egm import EGMSolution
+from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
+
+__all__ = ["solve_aiyagari_egm_sharded"]
+
+_EGM_PROGRAMS: dict = {}
+
+
+def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
+                               sigma: float, beta: float, tol: float,
+                               max_iter: int, grid_power: float,
+                               noise_floor_ulp: float = 0.0,
+                               capacity: float = 2.0, pad: int = 8,
+                               axis: str = "grid") -> EGMSolution:
+    """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
+    knots resident per device (module docstring).
+
+    Same stopping rule, escape contract, and trajectory as the single-device
+    windowed fast path (solvers/egm.solve_aiyagari_egm with grid_power>0):
+    the bracket counts and knot selections are exact integer/select
+    arithmetic and the max-reductions are associative, so the only
+    divergence from the unsharded solve is the Euler matmul's reassociation
+    under the shard shape — measured <= 2e-14 absolute per sweep in f64
+    (pinned at 1e-12 by tests/test_egm_sharded.py). a_grid must be power-spaced with
+    exponent `grid_power` (utils/grids.power_grid). Host-level entry — not
+    callable inside jit (the mesh/program cache is host state).
+
+    capacity sizes the per-device knot slab (parallel/ring.ring_buffer_size;
+    the measured EGM slab requirement is 1.11 shards — default 2.0 is ~80%
+    headroom). On escape (bracket beyond the
+    slab, or knot density beyond the windowed route's 6x envelope) the
+    solution is NaN-poisoned with `escaped=True`; callers fall back exactly
+    as for the single-device windowed route (solve_aiyagari_egm_safe's
+    contract) — the generic route has no sharded variant, so the fallback
+    is the unsharded solver.
+    """
+    if grid_power <= 0.0:
+        raise ValueError(
+            "solve_aiyagari_egm_sharded requires a power-spaced grid: pass "
+            f"its actual spacing exponent as grid_power, got {grid_power}")
+    D = int(mesh.shape[axis])
+    N, na = C_init.shape
+    if na % D:
+        raise ValueError(f"mesh axis size {D} must divide the grid {na}")
+    if pad < 1:
+        raise ValueError(f"pad must be >= 1, got {pad}")  # ring.py rationale
+    dtype = C_init.dtype
+    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    run = _egm_program(mesh, axis, N, na, lo, hi, float(grid_power),
+                       float(capacity), int(pad), float(sigma), float(beta),
+                       float(tol), int(max_iter), float(noise_floor_ulp),
+                       jnp.dtype(dtype).name)
+    C, policy_k, dist, it, esc, tol_eff = run(
+        C_init, a_grid, s, P_mat,
+        jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
+    )
+    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff)
+
+
+def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
+                 power: float, capacity: float, pad: int, sigma: float,
+                 beta: float, tol: float, max_iter: int,
+                 noise_floor_ulp: float, dtype_name: str):
+    D = int(mesh.shape[axis])
+    na_loc = na // D
+    dtype = jnp.dtype(dtype_name)
+    span = hi - lo
+    tol_c = jnp.asarray(tol, dtype)
+    floor_k = float(noise_floor_ulp) * float(jnp.finfo(dtype).eps)
+    neg = jnp.array(-jnp.inf, dtype)
+
+    def build():
+        def local(C0, a_loc, s, Pm, r, w, amin):
+            dev = jax.lax.axis_index(axis)
+            # This device's slice of the analytic query grid — the same
+            # expression as _finish_inverse's g_of, so the sharded and
+            # unsharded routes interpolate onto bitwise-identical queries.
+            j = dev * na_loc + jnp.arange(na_loc)
+            q = lo + span * (j.astype(dtype) / (na - 1)) ** power
+
+            def sweep(C):
+                # ops/egm.egm_step steps 1-6 on the local shard; see its
+                # docstring for the operator and the cummax/clip rationale.
+                RHS = (1.0 + r) * expectation(Pm, crra_marginal(C, sigma), beta)
+                c_next = crra_marginal_inverse(RHS, sigma)
+                a_hat = (c_next + a_loc[None, :] - w * s[:, None]) / (1.0 + r)
+                # Global cummax = local cummax + cross-device prefix of the
+                # shard tails (max is associative: bitwise-equal to the
+                # unsharded lax.cummax over the full row).
+                a_hat = jax.lax.cummax(a_hat, axis=1)
+                tails = jax.lax.all_gather(a_hat[:, -1], axis)       # [D, N]
+                mask = (jnp.arange(D) < dev)[:, None]
+                pref = jnp.max(jnp.where(mask, tails, neg), axis=0)  # [N]
+                a_hat = jnp.maximum(a_hat, pref[:, None])
+                out, esc = ring_inverse_local(
+                    a_hat, q, axis=axis, D=D, n_k=na, n_q=na,
+                    lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
+                )
+                policy_k = jnp.clip(out, amin, hi)
+                C_new = (1.0 + r) * a_loc[None, :] + w * s[:, None] - policy_k
+                return C_new, policy_k, esc
+
+            def cond(carry):
+                _, _, dist, it, _, tol_eff = carry
+                return (dist >= tol_eff) & (it < max_iter)
+
+            def body(carry):
+                C, _, _, it, esc, _ = carry
+                C_new, policy_k, esc_new = sweep(C)
+                dist = jax.lax.pmax(jnp.max(jnp.abs(C_new - C)), axis)
+                if noise_floor_ulp > 0.0:
+                    # The f32 ulp-noise stopping floor of
+                    # solve_aiyagari_egm; sup-norm of the iterate pmax'd so
+                    # the effective tolerance is the global one.
+                    tol_eff = jnp.maximum(
+                        tol_c,
+                        floor_k * jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis))
+                else:
+                    tol_eff = tol_c
+                return C_new, policy_k, dist, it + 1, esc | (esc_new > 0), tol_eff
+
+            init = (C0, jnp.zeros_like(C0), jnp.array(jnp.inf, dtype),
+                    jnp.int32(0), jnp.array(False), tol_c)
+            return jax.lax.while_loop(cond, body, init)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
+            out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P()),
+        ))
+
+    key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
+                                          pad, sigma, beta, tol, max_iter,
+                                          noise_floor_ulp, dtype_name)
+    return cached_program(_EGM_PROGRAMS, key, build)

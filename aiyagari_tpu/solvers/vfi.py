@@ -280,9 +280,16 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         # test arms after round one and the cycle test after round two —
         # before those, idx_prev/idx_prev2 still hold the all-zeros INIT
         # sentinel, a corner policy a transient iterate could legitimately
-        # equal without it being a revisit.
-        same = (jnp.all(idx == idx_prev) & (it > 0)) | (
-            jnp.all(idx == idx_prev2) & (it > 1))
+        # equal without it being a revisit. A proximity gate (dist within
+        # 1e3x tol) guards the one theoretical hole: modified policy
+        # iteration with finite evaluation sweeps is not monotone, so a
+        # policy 2-cycle far from the fixed point would otherwise
+        # terminate — and the post-exit polish only re-evaluates, never
+        # re-improves, so the suboptimal member would be returned without
+        # any convergence signal (ADVICE round 2).
+        near = dist < 1e3 * tol
+        same = near & ((jnp.all(idx == idx_prev) & (it > 0)) | (
+            jnp.all(idx == idx_prev2) & (it > 1)))
         return v_new, idx, idx_prev, dist, it + 1, same
 
     z_idx = jnp.zeros(coh.shape, jnp.int32)

@@ -27,9 +27,9 @@ jax.config.update("jax_enable_x64", True)
 # e.g. when bisecting a suspected stale-cache miscompile).
 from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache  # noqa: E402
 
-# Dedicated directory: the default dir also holds artifacts from TPU-session
-# processes whose XLA:CPU flags differ — loading those here triggers
-# machine-feature-mismatch warnings (and a documented SIGILL risk).
+# Dedicated directory (backend-suffixed by enable_compilation_cache, so the
+# suite's XLA:CPU artifacts never collide with TPU-session processes whose
+# XLA:CPU machine-feature flags differ — the documented SIGILL hazard).
 enable_compilation_cache(os.path.join(os.path.expanduser("~"),
                                       ".cache", "aiyagari_tpu", "xla-tests"))
 

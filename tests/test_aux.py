@@ -308,15 +308,20 @@ class TestCompileCache:
             )
         }
         try:
+            # Explicit and env dirs are backend-suffixed too: an
+            # unsuffixed shared dir lets a TPU-attached process's XLA:CPU
+            # AOT artifacts (+prefer-no-scatter/-gather machine features)
+            # collide with a pure-CPU process's — the documented SIGILL
+            # hazard (ADVICE round 2).
             d = enable_compilation_cache(str(tmp_path / "xla"))
-            assert d == str(tmp_path / "xla")
+            assert d == str(tmp_path / "xla") + "-cpu"
             assert jax.config.jax_compilation_cache_dir == d
             # Empty env var is the documented opt-out.
             monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", "")
             assert enable_compilation_cache() is None
             # Env var wins over the default location.
             monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", str(tmp_path / "env"))
-            assert enable_compilation_cache() == str(tmp_path / "env")
+            assert enable_compilation_cache() == str(tmp_path / "env") + "-cpu"
         finally:
             for name, val in old.items():
                 jax.config.update(name, val)

@@ -1,0 +1,254 @@
+"""End-to-end distributed EGM (SURVEY.md §2.4(1)): the ring-redistribution
+inversion (parallel/ring.py) composed into the full sharded fixed point
+(solvers/egm_sharded.py), on the 8-virtual-device CPU mesh.
+
+What these tests pin, in order of importance:
+  1. the sharded solve's TRAJECTORY matches the unsharded windowed solver
+     (iterate-by-iterate; sharding correctness is per-sweep, so bounded
+     sweeps pin it as hard as full convergence does);
+  2. a full CONVERGED solve agrees, stopping rule included;
+  3. the compiled program never materializes a full-grid-sized array per
+     device — no collective or temporary carries the whole knot row (the
+     memory-scaling property GSPMD cannot deliver for this op, measured in
+     test_sim_sharding.TestGridSharding);
+  4. the escape contract: an undersized knot slab NaN-poisons and raises
+     the flag, never returns silently wrong brackets.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.models.aiyagari import aiyagari_preset
+from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+from aiyagari_tpu.parallel.mesh import make_mesh
+from aiyagari_tpu.parallel.ring import (
+    inverse_interp_power_grid_ring,
+    ring_buffer_size,
+)
+from aiyagari_tpu.solvers.egm import initial_consumption_guess, solve_aiyagari_egm
+from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+from aiyagari_tpu.utils.firm import wage_from_r
+
+
+def _egm_problem(n):
+    m = aiyagari_preset(grid_size=n)
+    w = float(wage_from_r(0.04, m.config.technology.alpha,
+                          m.config.technology.delta))
+    C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
+    kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+              tol=1e-6, max_iter=2000, grid_power=float(m.config.grid.power))
+    return m, w, C0, kw
+
+
+class TestRingInversion:
+    """The standalone ring kernel vs the single-device exact route."""
+
+    def _lagged_knots(self, n, shift):
+        # A value-space shift whose index lag at the power grid's dense
+        # bottom is a large FRACTION of the grid — the regime that defeats
+        # any one-hop halo (halo < shard; parallel/ring.py docstring) and
+        # that the real EGM endogenous grids live in (measured 0.33*n).
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        x = np.sort((gk + shift + 0.3 * np.sin(gk / 7.0)) / 1.04)
+        return jnp.asarray(x), lo, hi, power
+
+    def test_matches_unsharded_route_large_lag(self):
+        n = 16_384
+        x, lo, hi, power = self._lagged_knots(n, shift=-3.0)
+        xq = jnp.stack([x, x * 1.01 + 0.05])
+        mesh = make_mesh(("grid",))
+        got, esc = inverse_interp_power_grid_ring(mesh, xq, lo, hi, power, n)
+        want, esc_w = inverse_interp_power_grid(xq, lo, hi, power, n,
+                                                with_escape=True)
+        assert not bool(esc) and not bool(esc_w)
+        # The bracket integers are identical; the float tail differs only by
+        # XLA's per-program FMA contraction of the shared finish arithmetic
+        # (measured 3e-14 at f64).
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-12)
+
+    def test_below_and_above_range_edges(self):
+        # Knots shifted up (first queries below all knots) and compressed
+        # (last queries above): sentinel positions must reproduce the
+        # unsharded below-extrapolation and top-truncation exactly.
+        n = 8_192
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        x = jnp.asarray(gk * 0.9 + 0.5)
+        mesh = make_mesh(("grid",))
+        got, esc = inverse_interp_power_grid_ring(mesh, x, lo, hi, power, n)
+        want = inverse_interp_power_grid(x, lo, hi, power, n)
+        assert not bool(esc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-12)
+
+    def test_escape_on_undersized_buffer(self):
+        # All knots crowded into the top shard's value range: the receiving
+        # device's slab overflows any capacity<D buffer — must escape (NaN +
+        # flag), never return silently wrong brackets.
+        n = 8_192
+        lo, hi, power = 0.0, 52.0, 2.0
+        x = jnp.asarray(np.linspace(0.97 * hi, 0.99 * hi, n))
+        mesh = make_mesh(("grid",))
+        out, esc = inverse_interp_power_grid_ring(mesh, x, lo, hi, power, n,
+                                                  capacity=1.5)
+        assert bool(esc)
+        assert np.isnan(np.asarray(out)).all()
+
+    def test_rejects_ragged_shapes(self):
+        mesh = make_mesh(("grid",))
+        with pytest.raises(ValueError, match="divide"):
+            inverse_interp_power_grid_ring(mesh, jnp.zeros(1001), 0.0, 1.0,
+                                           2.0, 1001)
+
+    def test_buffer_size_is_static_and_bounded(self):
+        # The memory claim: B = capacity*shard + one window of slack — O(n/D)
+        # with the measured model constant, NOT the full row.
+        n = 409_600
+        B8 = ring_buffer_size(n, 8, 4.0)
+        assert B8 % 512 == 0
+        assert B8 == 4 * (n // 8) + 6 * 512
+        assert B8 < n
+        # The constant is per-DEVICE: at larger meshes the slab keeps
+        # shrinking while GSPMD's re-materialized row would not.
+        assert ring_buffer_size(n, 64, 4.0) <= n // 16 + 6 * 512
+
+
+class TestShardedEGMSolver:
+    def test_trajectory_matches_unsharded(self):
+        # Bounded-sweep trajectory equality at 8,192 points (windowed
+        # regime; per-sweep agreement pins the composition as hard as full
+        # convergence, cf. TestGridSharding's rationale).
+        n = 8_192
+        m, w, C0, kw = _egm_problem(n)
+        kw.update(tol=1e-30, max_iter=6)
+        ref = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
+                                         w, m.amin, **kw)
+        assert int(sol.iterations) == int(ref.iterations) == 6
+        assert not bool(sol.escaped)
+        # Only the Euler matmul's shard-shape reassociation separates the
+        # two (the bracket/cummax arithmetic is exact; solver docstring).
+        np.testing.assert_allclose(np.asarray(sol.policy_c),
+                                   np.asarray(ref.policy_c), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(sol.policy_k),
+                                   np.asarray(ref.policy_k), atol=1e-12)
+
+    @pytest.mark.slow
+    def test_trajectory_matches_unsharded_at_scale(self):
+        # The 100k+-point composition the blueprint demands (VERDICT round 2
+        # #1): 102,400 points, 12,800-knot shards, 3 sweeps on the 8-device
+        # mesh vs the single-device windowed solver.
+        n = 102_400
+        m, w, C0, kw = _egm_problem(n)
+        kw.update(tol=1e-30, max_iter=3)
+        ref = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
+                                         w, m.amin, **kw)
+        assert int(sol.iterations) == 3 and not bool(sol.escaped)
+        np.testing.assert_allclose(np.asarray(sol.policy_c),
+                                   np.asarray(ref.policy_c), atol=1e-11)
+
+    @pytest.mark.slow
+    def test_converged_solve_matches_unsharded(self):
+        # Full fixed point, stopping rule included, from the multiscale warm
+        # start (a cold 8k fixed point is ~300 sweeps; the warm start cuts
+        # it to a handful without changing the fixed point).
+        from aiyagari_tpu.ops.interp import prolong_power_grid
+
+        n = 8_192
+        m, w, C0, kw = _egm_problem(n)
+        coarse = aiyagari_preset(grid_size=512)
+        Cc = initial_consumption_guess(coarse.a_grid, coarse.s, 0.04, w)
+        kwc = dict(kw, grid_power=float(coarse.config.grid.power))
+        sol_c = solve_aiyagari_egm(Cc, coarse.a_grid, coarse.s, coarse.P,
+                                   0.04, w, coarse.amin, **kwc)
+        C_warm = prolong_power_grid(sol_c.policy_c, float(m.a_grid[0]),
+                                    float(m.a_grid[-1]), kw["grid_power"], n)
+        ref = solve_aiyagari_egm(C_warm, m.a_grid, m.s, m.P, 0.04, w,
+                                 m.amin, **kw)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C_warm, m.a_grid, m.s, m.P,
+                                         0.04, w, m.amin, **kw)
+        assert not bool(sol.escaped)
+        assert float(sol.distance) < float(sol.tol_effective)
+        assert int(sol.iterations) == int(ref.iterations)
+        np.testing.assert_allclose(np.asarray(sol.policy_c),
+                                   np.asarray(ref.policy_c), atol=1e-10)
+
+    def test_no_full_grid_crosses_devices(self):
+        # The knots-resident assertion (VERDICT round 2 #1): in the compiled
+        # SPMD module of the sharded solve, NO collective moves or rebuilds
+        # anything full-grid-sized. The ring rotation's collective-permutes
+        # carry exactly one [N, na/D] shard; every all-gather/all-reduce is
+        # O(D)-sized (cummax tails, head pairs, bracket starts, sup-norms).
+        # This is precisely what GSPMD could not do for this op — it
+        # re-gathered the whole knot row per device
+        # (test_sim_sharding.TestGridSharding).
+        n = 16_384
+        m, w, C0, kw = _egm_problem(n)
+        kw.update(tol=1e-30, max_iter=2)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
+                                         w, m.amin, **kw)
+        assert int(sol.iterations) == 2
+        from aiyagari_tpu.solvers.egm_sharded import _EGM_PROGRAMS
+
+        (prog,) = [p for k, p in _EGM_PROGRAMS.items() if n in k]
+        C0_j = jnp.asarray(C0)
+        hlo = prog.lower(
+            C0_j, m.a_grid, m.s, m.P,
+            jnp.asarray(0.04, C0_j.dtype), jnp.asarray(w, C0_j.dtype),
+            jnp.asarray(m.amin, C0_j.dtype),
+        ).compile().as_text()
+        shard_elems = 7 * (n // 8)
+        seen = []
+        for ln in hlo.splitlines():
+            mm = re.search(r"= \w+\[([0-9,]*)\][^ ]* (all-gather|all-reduce|"
+                           r"collective-permute)", ln)
+            if mm:
+                dims = [int(d) for d in mm.group(1).split(",") if d]
+                seen.append((mm.group(2), dims))
+        assert seen, "no collectives found — parsing broke or program changed"
+        for op, dims in seen:
+            elems = int(np.prod(dims)) if dims else 1
+            if op == "collective-permute":
+                assert elems <= shard_elems, (op, dims)
+            else:
+                assert elems <= 1024, (op, dims)
+            assert elems < 7 * n, (op, dims)
+
+    @pytest.mark.slow
+    def test_escape_contract_on_undersized_slab(self):
+        # Undersized slab: capacity=0.0 degenerates the buffer to its floor
+        # of exactly one shard (B = L), below the measured 1.11L slab
+        # requirement of the real EGM endogenous grids — the solver must
+        # raise the flag and NaN-poison, never return silently wrong
+        # brackets. max_iter leaves room for the worst-requirement sweep.
+        n = 40_960
+        m, w, C0, kw = _egm_problem(n)
+        kw.update(tol=1e-30, max_iter=12)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
+                                         w, m.amin, capacity=0.0, **kw)
+        assert bool(sol.escaped)
+        assert np.isnan(np.asarray(sol.policy_c)).all()
+
+    def test_rejects_bad_arguments(self):
+        m, w, C0, kw = _egm_problem(1002)
+        mesh = make_mesh(("grid",))
+        with pytest.raises(ValueError, match="divide"):
+            solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
+                                       w, m.amin, **kw)
+        m2, w2, C02, kw2 = _egm_problem(1024)
+        kw2["grid_power"] = 0.0
+        with pytest.raises(ValueError, match="power-spaced"):
+            solve_aiyagari_egm_sharded(mesh, C02, m2.a_grid, m2.s, m2.P,
+                                       0.04, w2, m2.amin, **kw2)

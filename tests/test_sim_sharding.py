@@ -335,3 +335,68 @@ class TestDistributed:
         ctx = process_info()
         assert ctx.num_processes == 1
         assert ctx.global_device_count == len(jax.devices())
+
+    @pytest.mark.slow
+    def test_two_process_cluster_cross_process_psum(self):
+        # The REAL multi-process path (SURVEY.md §5.8; VERDICT round 2 #5):
+        # two fresh processes, a localhost coordinator, one CPU device each
+        # — initialize_distributed must complete the gRPC handshake, report
+        # num_processes==2, and a jitted sum over a process-spanning sharded
+        # array must all-reduce ACROSS the processes. This is exactly the
+        # topology a TPU pod launcher creates (one process per host), minus
+        # the hardware.
+        import os
+        import socket
+        import subprocess
+        import sys as _sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        worker = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from aiyagari_tpu.parallel.distributed import initialize_distributed
+
+ctx = initialize_distributed(coordinator_address="127.0.0.1:%d",
+                             num_processes=2, process_id=int(sys.argv[1]))
+assert ctx.initialized and ctx.num_processes == 2, ctx
+assert ctx.global_device_count == 2 and ctx.local_device_count == 1, ctx
+mesh = jax.make_mesh((2,), ("p",))
+sh = NamedSharding(mesh, P("p"))
+x = jax.make_array_from_callback(
+    (2,), sh, lambda idx: np.asarray([float(jax.process_index() + 1)]))
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == 3.0, float(total)   # 1 (proc 0) + 2 (proc 1)
+print("WORKER_OK", ctx.process_id, float(total))
+""" % port
+
+        env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+            [os.getcwd()] + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID", "XLA_FLAGS", "JAX_PLATFORMS"):
+            env.pop(var, None)
+        procs = [subprocess.Popen([_sys.executable, "-c", worker, str(pid)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True, env=env)
+                 for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("two-process cluster hung (coordinator handshake)")
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed rc={rc}\n{out}\n{err}"
+            assert "WORKER_OK" in out, (out, err)
