@@ -39,6 +39,49 @@ def initial_consumption_guess(a_grid, s, r, w):
     return jnp.broadcast_to(base[None, :], (s.shape[0], a_grid.shape[0]))
 
 
+@jax.jit
+def _grid_bounds(a):
+    return a[0], a[-1]
+
+
+_GRID_BOUNDS_CACHE: dict = {}
+
+
+def _cached_grid_bounds(a_grid):
+    """(lo, hi) of a grid array as host floats, fetched ONCE per array.
+
+    Why this exists: on this image's remote TPU transport every host read
+    is a ~100 ms round trip, and the multiscale entry points need lo/hi as
+    STATIC values (stage grids and prolongation are compile-time
+    parameterized). Eager `float(a_grid[0])` + `float(a_grid[-1])` cost two
+    dispatches and two sequential fetches per call — measured ~45% of the
+    entire 400k north-star solve. One jitted pair extraction + one batched
+    `jax.device_get` costs a single round trip, and the id-keyed cache
+    (holding the array alive, so ids cannot be reused) makes repeat solves
+    on the same grid — the bench loop, every bisection iteration — free."""
+    key = id(a_grid)
+    hit = _GRID_BOUNDS_CACHE.get(key)
+    if hit is not None and hit[0] is a_grid:
+        return hit[1], hit[2]
+    lo, hi = (float(v) for v in jax.device_get(_grid_bounds(a_grid)))
+    if len(_GRID_BOUNDS_CACHE) >= 8:
+        _GRID_BOUNDS_CACHE.pop(next(iter(_GRID_BOUNDS_CACHE)))
+    _GRID_BOUNDS_CACHE[key] = (a_grid, lo, hi)
+    return lo, hi
+
+
+def _fetch_scalars(sol: "EGMSolution") -> "EGMSolution":
+    """Replace the solution's scalar fields with host values in ONE batched
+    transfer (jax.device_get pipelines the gets — measured ~1 round trip
+    for 4 scalars vs 4 sequential ~100 ms float() fetches on the axon
+    transport). The escape-retry decision and the callers' convergence
+    checks (bool(escaped), float(distance)) then cost nothing."""
+    esc, dist, it, tol_eff = jax.device_get(
+        (sol.escaped, sol.distance, sol.iterations, sol.tol_effective))
+    return dataclasses.replace(sol, escaped=esc, distance=dist,
+                               iterations=it, tol_effective=tol_eff)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class EGMSolution:
@@ -226,6 +269,76 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
     return sol
 
 
+def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
+                 grid_power: float, solve_stage) -> EGMSolution:
+    """Host-level stage loop shared by the generic-route retry and the
+    labor-family ladders: initial guess on the coarsest grid, per-stage
+    solve via `solve_stage(C, grid)`, analytic prolongation between stages
+    (final stage on the CALLER's grid array, bitwise), per-stage escape
+    flags OR-ed on device, and one batched scalar fetch at the end. One
+    body, so the ladder protocol cannot drift between its host users (the
+    fast path is the separately-traced _egm_ladder_fused)."""
+    from aiyagari_tpu.utils.grids import stage_grid
+
+    dtype = a_grid.dtype
+    C = initial_consumption_guess(
+        stage_grid(sizes[0], lo, hi, grid_power, dtype), s, r, w).astype(dtype)
+    sol = None
+    esc = jnp.array(False)
+    for i, n in enumerate(sizes):
+        g = a_grid if i == len(sizes) - 1 else stage_grid(n, lo, hi,
+                                                          grid_power, dtype)
+        if i > 0:
+            C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
+        sol = solve_stage(C, g)
+        esc = esc | sol.escaped
+    return _fetch_scalars(dataclasses.replace(sol, escaped=esc))
+
+
+@partial(jax.jit, static_argnames=("sizes", "lo", "hi", "sigma", "beta",
+                                   "tol", "max_iter", "relative_tol",
+                                   "progress_every", "grid_power",
+                                   "noise_floor_ulp", "use_pallas"))
+def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
+                      hi: float, sigma: float, beta: float, tol: float,
+                      max_iter: int, relative_tol: bool, progress_every: int,
+                      grid_power: float, noise_floor_ulp: float,
+                      use_pallas: bool) -> EGMSolution:
+    """The whole fast-path stage ladder traced as ONE device program:
+    stage solve -> prolong -> next stage, unrolled over the static `sizes`
+    tuple. Why one program: each separately-jitted stage costs a ~100 ms
+    dispatch round trip on this image's remote TPU transport plus a fetch
+    fence, and the ladder has 4 stages — at the 400k north-star scale that
+    overhead was ~45% of the measured 0.54 s wall (round-3 stage timing;
+    BENCHMARKS.md). Inside one jit the stages chain on device with no host
+    involvement, and XLA owns all intermediate buffers."""
+    from aiyagari_tpu.utils.grids import stage_grid
+
+    dtype = a_grid.dtype
+    C = initial_consumption_guess(
+        stage_grid(sizes[0], lo, hi, grid_power, dtype), s, r, w).astype(dtype)
+    sol = None
+    esc = jnp.array(False)
+    for i, n in enumerate(sizes):
+        # The final stage uses the CALLER's grid array (bitwise — the
+        # analytic rebuild could differ from the model builder's by an ulp);
+        # intermediate grids are rebuilt analytically on device.
+        g = a_grid if i == len(sizes) - 1 else stage_grid(n, lo, hi,
+                                                          grid_power, dtype)
+        if i > 0:
+            C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
+        sol = solve_aiyagari_egm(C, g, s, P, r, w, amin,
+                                 sigma=sigma, beta=beta, tol=tol,
+                                 max_iter=max_iter,
+                                 relative_tol=relative_tol,
+                                 progress_every=progress_every,
+                                 grid_power=grid_power,
+                                 noise_floor_ulp=noise_floor_ulp,
+                                 use_pallas=use_pallas)
+        esc = esc | sol.escaped
+    return dataclasses.replace(sol, escaped=esc)
+
+
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   beta: float, tol: float, max_iter: int,
                                   grid_power: float = 2.0, coarsest: int = 400,
@@ -250,13 +363,13 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
 
     a_grid must be power-spaced with exponent `grid_power` (the framework's
     builders are; utils/grids.power_grid) so intermediate grids can be
-    rebuilt analytically at any resolution. Host-level stage loop; each
-    stage is the jitted solve_aiyagari_egm fixed point, launched without any
-    host synchronization between stages — the windowed fast path's escape
-    NaN (ops/interp.inverse_interp_power_grid) propagates through the
-    remaining stages, and the per-stage `escaped` flags are OR-ed on device,
-    so one host read at the end decides the generic-route retry for the
-    whole ladder.
+    rebuilt analytically at any resolution. The fast-path ladder is ONE
+    jitted device program (_egm_ladder_fused) — no host dispatch between
+    stages; the windowed fast path's escape NaN (ops/interp.
+    inverse_interp_power_grid) propagates through the remaining stages, the
+    per-stage `escaped` flags are OR-ed on device, and one host read at the
+    end decides the generic-route retry for the whole ladder (which runs as
+    a host-level stage loop — the rare path keeps no fused program).
     """
     from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
 
@@ -271,37 +384,28 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
         )
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
-    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    lo, hi = _cached_grid_bounds(a_grid)
     sizes = stage_sizes(n_final, coarsest, refine_factor)
 
-    def _grid(n):
-        if n == n_final:
-            return a_grid
-        return stage_grid(n, lo, hi, grid_power, dtype)
-
-    def run_ladder(fast: bool) -> EGMSolution:
-        C = initial_consumption_guess(_grid(sizes[0]), s, r, w).astype(dtype)
-        sol = None
-        esc = jnp.array(False)
-        for i, n in enumerate(sizes):
-            if i > 0:
-                C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
-            sol = solve_aiyagari_egm(C, _grid(n), s, P, r, w, amin,
-                                     sigma=sigma, beta=beta, tol=tol,
-                                     max_iter=max_iter,
-                                     relative_tol=relative_tol,
-                                     progress_every=progress_every,
-                                     grid_power=grid_power if fast else 0.0,
-                                     noise_floor_ulp=noise_floor_ulp,
-                                     use_pallas=use_pallas)
-            esc = esc | sol.escaped
-        return dataclasses.replace(sol, escaped=esc)
-
-    sol = run_ladder(fast=True)
+    sol = _egm_ladder_fused(a_grid, s, P, r, w, amin, sizes=tuple(sizes),
+                            lo=lo, hi=hi, sigma=sigma, beta=beta, tol=tol,
+                            max_iter=max_iter, relative_tol=relative_tol,
+                            progress_every=progress_every,
+                            grid_power=grid_power,
+                            noise_floor_ulp=noise_floor_ulp,
+                            use_pallas=use_pallas)
+    sol = _fetch_scalars(sol)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
     if bool(sol.escaped):
-        sol = run_ladder(fast=False)
+        sol = _host_ladder(
+            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
+            grid_power=grid_power,
+            solve_stage=lambda C, g: solve_aiyagari_egm(
+                C, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+                max_iter=max_iter, relative_tol=relative_tol,
+                progress_every=progress_every, grid_power=0.0,
+                noise_floor_ulp=noise_floor_ulp))
     return sol
 
 
@@ -330,31 +434,19 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
             f"grid: pass its actual spacing exponent as grid_power, got {grid_power}"
         )
     n_final = int(a_grid.shape[-1])
-    dtype = a_grid.dtype
-    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    lo, hi = _cached_grid_bounds(a_grid)
     sizes = stage_sizes(n_final, coarsest, refine_factor)
 
-    def _grid(n):
-        if n == n_final:
-            return a_grid
-        return stage_grid(n, lo, hi, grid_power, dtype)
-
     def run_ladder(fast: bool) -> EGMSolution:
-        C = initial_consumption_guess(_grid(sizes[0]), s, r, w).astype(dtype)
-        sol = None
-        esc = jnp.array(False)
-        for i, n in enumerate(sizes):
-            if i > 0:
-                C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
-            sol = solve_aiyagari_egm_labor(C, _grid(n), s, P, r, w, amin,
-                                           sigma=sigma, beta=beta, psi=psi,
-                                           eta=eta, tol=tol, max_iter=max_iter,
-                                           relative_tol=relative_tol,
-                                           progress_every=progress_every,
-                                           grid_power=grid_power if fast else 0.0,
-                                           noise_floor_ulp=noise_floor_ulp)
-            esc = esc | sol.escaped
-        return dataclasses.replace(sol, escaped=esc)
+        return _host_ladder(
+            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
+            grid_power=grid_power,
+            solve_stage=lambda C, g: solve_aiyagari_egm_labor(
+                C, g, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi,
+                eta=eta, tol=tol, max_iter=max_iter,
+                relative_tol=relative_tol, progress_every=progress_every,
+                grid_power=grid_power if fast else 0.0,
+                noise_floor_ulp=noise_floor_ulp))
 
     sol = run_ladder(fast=True)
     if bool(sol.escaped):

@@ -42,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
 from aiyagari_tpu.parallel.ring import ring_inverse_local
-from aiyagari_tpu.solvers.egm import EGMSolution
+from aiyagari_tpu.solvers.egm import EGMSolution, _cached_grid_bounds, _fetch_scalars
 from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
 
 __all__ = ["solve_aiyagari_egm_sharded"]
@@ -89,7 +89,7 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
     if pad < 1:
         raise ValueError(f"pad must be >= 1, got {pad}")  # ring.py rationale
     dtype = C_init.dtype
-    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    lo, hi = _cached_grid_bounds(a_grid)
     run = _egm_program(mesh, axis, N, na, lo, hi, float(grid_power),
                        float(capacity), int(pad), float(sigma), float(beta),
                        float(tol), int(max_iter), float(noise_floor_ulp),
@@ -98,7 +98,8 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
-    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff)
+    return _fetch_scalars(
+        EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff))
 
 
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
