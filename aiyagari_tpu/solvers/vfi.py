@@ -116,12 +116,14 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
-                                   "golden_iters", "relative_tol", "grid_power"))
+                                   "golden_iters", "relative_tol", "grid_power",
+                                   "slab"))
 def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: float,
                                   beta: float, tol: float, max_iter: int,
                                   howard_steps: int = 20, golden_iters: int = 48,
                                   relative_tol: bool = False,
-                                  grid_power: float = 0.0) -> VFISolution:
+                                  grid_power: float = 0.0,
+                                  slab: bool | None = None) -> VFISolution:
     """Scalable VFI: coarse-to-fine maximization of u(coh - a'_j) + EV_j over
     grid *indices* j (ops/golden.unimodal_argmax_index), followed by one
     continuous golden-section refinement of the converged policy within its
@@ -145,6 +147,12 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     enables the final in-cell continuous refine of the returned policy
     (policy_k/policy_c move off-grid; v and policy_idx stay the discrete
     fixed point); golden_iters = 0 returns the pure grid solution.
+
+    slab=None auto-selects the monotone-policy SLAB improvement/evaluation
+    above 4,096 points (block-DMA dense argmax + one-hot Howard
+    contraction — no EV element gathers; BENCHMARKS.md round 3); True or
+    False forces a route (TestContinuousVFI pins slab == local-window at
+    5,120 points).
     """
     from aiyagari_tpu.ops.golden import golden_section_max, unimodal_argmax_index
     from aiyagari_tpu.ops.interp import bucket_index, power_bucket_index
@@ -216,8 +224,10 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
             cand, jnp.argmax(vals, axis=2)[:, :, None], axis=2
         )[:, :, 0]
 
-    def improve(v, idx_prev):
-        EV = expectation(P, v, beta)   # hoisted: one per improvement
+    def improve_local_window(EV, idx_prev):
+        # Small-grid route: per-point +/-_LW candidate window around the
+        # previous policy (49 EV element-gathers per point — cheap at these
+        # sizes). Returns (best, escalate).
         f = lambda j: choice_value(EV, j)
         offs = jnp.arange(-_LW, _LW + 1, dtype=jnp.int32)
         cand = jnp.clip(idx_prev[:, :, None] + offs, lo_idx, hi_idx[:, :, None])
@@ -237,8 +247,136 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
                  & (vals[:, :, 0] > vals[:, :, 1]))
         at_hi = ((best == cand[:, :, -1]) & (cand[:, :, -1] < hi_idx)
                  & (vals[:, :, -1] > vals[:, :, -2]))
+        return best, jnp.any(at_lo | at_hi)
+
+    # Monotone-policy slab argmax (SURVEY.md §7.3's divide-and-conquer,
+    # in its TPU-batched form): the optimal index is nondecreasing in
+    # assets, so a block of _SQ consecutive queries has ALL its candidates
+    # inside one contiguous EV slab around the block's previous-policy
+    # span (span <= density ratio * _SQ + drift; the slab's >=_KB cells of
+    # margin each side covers 10x the old +/-_LW drift bound). The slab is
+    # fetched as _MW KB-granular contiguous blocks — block DMA, the
+    # windowed-EGM pattern — and ALL slab positions are evaluated densely:
+    # more FLOPs than the 49-candidate window, but pure VPU broadcast
+    # work instead of 49 EV element-gathers per point, which were the
+    # measured per-round bottleneck at fine grids (~0.45 s/round at
+    # [7, 40k]; BENCHMARKS.md round 1).
+    _SQ, _KB, _MW = 256, 256, 6
+    _SLAB = _KB * _MW
+    nkb = -(-na // _KB)
+    use_slab = (na > 4096 if slab is None else bool(slab)) and nkb >= _MW
+
+    _CB = 16              # query blocks per chunk of the slab evaluation
+    nb_s = -(-na // _SQ)
+    nT = -(-nb_s // _CB)
+    nbp = nT * _CB
+    padk_s = nkb * _KB - na
+
+    def _slab_geometry(idx_anchor):
+        """KB-granular slab starts per query block from an anchoring policy:
+        ab[n, b] positions block b's _SLAB-cell candidate slab one knot
+        block below the block's first anchor index (clamped). Shared by the
+        improvement argmax and the Howard evaluation contraction."""
+        idxp_pad = jnp.pad(idx_anchor, ((0, 0), (0, nbp * _SQ - na)),
+                           mode="edge")
+        # Clamp the anchor into the feasible index range: an anchor below
+        # lo_idx (the all-zeros init on a grid extending below the
+        # borrowing limit) would position a slab with NO feasible cell —
+        # all--inf values whose tie-argmin silently returns an infeasible
+        # index with no escalation (the clip-based local window could
+        # never do that). Anchored at lo_idx, the slab always contains a
+        # feasible position.
+        first = jnp.clip(idxp_pad[:, :: _SQ], lo_idx, na - 1)    # [N, nbp]
+        ab = jnp.clip((first - _KB) // _KB, 0, nkb - _MW)        # [N, nbp]
+        return idxp_pad, ab
+
+    def _slab_fetch(Xp, ab_chunk):
+        """[N, _CB, _SLAB] slab values: _MW contiguous _KB-blocks per query
+        block via a row-granular take_along_axis — block DMA, the
+        windowed-EGM gather pattern (a vmapped dynamic_slice here lowered
+        to a ~1.4 ms/block serial form under lax.map; measured)."""
+        blk = ab_chunk[:, :, None] + jnp.arange(_MW)[None, None, :]
+        cb = ab_chunk.shape[1]
+        rows = jnp.take_along_axis(
+            Xp.reshape(N, nkb, _KB),
+            blk.reshape(N, cb * _MW)[:, :, None], axis=1)
+        return rows.reshape(N, cb, _SLAB)
+
+    def _slab_avals(jglob):
+        if grid_power > 0.0:
+            # Analytic slab of grid values — no gather at all.
+            return a_grid[0] + (a_grid[-1] - a_grid[0]) * (
+                jglob.astype(v_init.dtype) / (na - 1)) ** grid_power
+        a_pad = jnp.concatenate(
+            [a_grid, jnp.full((padk_s,), jnp.inf, a_grid.dtype)])
+        return a_pad[jglob]
+
+    def improve_slab(EV, idx_prev):
+        # lax.map over chunks of _CB blocks: the full [N, nb, _SQ, _SLAB]
+        # candidate tensor is ~30 GB at 40k points (it has multiple
+        # consumers — max, tie-argmin, edge comparisons — so XLA
+        # materializes it and the compile OOMs); per chunk it is ~176 MB.
+        neg_inf = jnp.array(-jnp.inf, v_init.dtype)
+        EVp = jnp.concatenate(
+            [EV, jnp.full((N, padk_s), neg_inf, EV.dtype)], axis=1)
+        joff = jnp.arange(_SLAB, dtype=jnp.int32)
+        idxp_pad, ab_all = _slab_geometry(idx_prev)
+        cohp = jnp.pad(coh, ((0, 0), (0, nbp * _SQ - na)), mode="edge")
+        hip = jnp.pad(hi_idx, ((0, 0), (0, nbp * _SQ - na)), mode="edge")
+
+        def chunk(t):
+            q0 = t * _CB * _SQ
+            ab = jax.lax.dynamic_slice_in_dim(ab_all, t * _CB, _CB, axis=1)
+            seg = _slab_fetch(EVp, ab)                           # [N,_CB,_SLAB]
+            jglob = ab[:, :, None] * _KB + joff[None, None, :]
+            a_vals = _slab_avals(jglob)
+            cut = lambda x: jax.lax.dynamic_slice_in_dim(
+                x, q0, _CB * _SQ, axis=1).reshape(N, _CB, _SQ)
+            cohb, hib, idxp_b = cut(cohp), cut(hip), cut(idxp_pad)
+            c = jnp.maximum(cohb[..., None] - a_vals[:, :, None, :], c_floor)
+            vals = _u(c, sigma) + seg[:, :, None, :]     # [N,_CB,_SQ,_SLAB]
+            feas = (jglob[:, :, None, :] >= lo_idx) & \
+                   (jglob[:, :, None, :] <= hib[..., None]) & \
+                   (jglob[:, :, None, :] < na)
+            vals = jnp.where(feas, vals, neg_inf)
+            # Argmax with ties broken TOWARD the previous policy, not
+            # first-max: in the f32 flat-top regime whole slab stretches
+            # tie exactly, and a leftmost-tie rule would slide the policy
+            # to the slab edge every round — the policy-repeat stop then
+            # never fires and the loop burns max_iter rounds (and a
+            # multi-minute single-kernel execution wedges this image's TPU
+            # worker). Preferring the tied candidate closest to idx_prev
+            # makes the policy STATIONARY once the value ties stabilize.
+            vmax = jnp.max(vals, axis=3, keepdims=True)
+            far = jnp.int32(2 ** 30)
+            dist_j = jnp.abs(jglob[:, :, None, :] - idxp_b[..., None])
+            jloc = jnp.argmin(
+                jnp.where(vals >= vmax, dist_j, far),
+                axis=3).astype(jnp.int32)                        # [N,_CB,_SQ]
+            best = ab[..., None] * _KB + jloc
+            # Same edge-pin escalation contract as the local window: a
+            # strict maximizer at a slab edge that is not a true bound
+            # means the drift exceeded the slab. (The slab always contains
+            # the block's previous policy and a feasible index, so ties at
+            # an all--inf edge cannot fire the STRICT comparison.)
+            e0 = vals[..., 0] > vals[..., 1]
+            e1 = vals[..., -1] > vals[..., -2]
+            at_lo = (jloc == 0) & (jglob[:, :, :1] > lo_idx) & e0
+            at_hi = (jloc == _SLAB - 1) & (jglob[:, :, -1:] < hib) & e1
+            return best, jnp.any(at_lo | at_hi)
+
+        best_c, esc_c = jax.lax.map(chunk, jnp.arange(nT))  # [nT, N, _CB, _SQ]
+        best = jnp.moveaxis(best_c, 0, 1).reshape(N, nbp * _SQ)[:, :na]
+        return best, jnp.any(esc_c)
+
+    def improve(v, idx_prev):
+        EV = expectation(P, v, beta)   # hoisted: one per improvement
+        if use_slab:
+            best, escalate = improve_slab(EV, idx_prev)
+        else:
+            best, escalate = improve_local_window(EV, idx_prev)
         return jax.lax.cond(
-            jnp.any(at_lo | at_hi),
+            escalate,
             lambda: improve_global(EV),
             lambda: best,
         )
@@ -246,15 +384,61 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     def evaluate(v, idx):
         # Howard policy evaluation: the policy is fixed across sweeps, at
         # exact grid points — no interpolation, just an expectation matmul
-        # and a row gather per sweep.
+        # and the policy-indexed EV read per sweep.
         u_pol = _u(jnp.maximum(coh - a_grid[idx], c_floor), sigma)
 
-        def sweep(v, _):
+        def sweep_gather(v, _):
             EV = expectation(P, v, beta)
             return u_pol + jnp.take_along_axis(EV, idx, axis=1), None
 
-        v, _ = jax.lax.scan(sweep, v, None, length=max(howard_steps, 1))
-        return v
+        def run_gather(v):
+            v, _ = jax.lax.scan(sweep_gather, v, None,
+                                length=max(howard_steps, 1))
+            return v
+
+        if not use_slab:
+            return run_gather(v)
+
+        # Fine-grid route: the per-sweep take_along_axis is an [N, na]
+        # ELEMENT gather — the measured bottleneck of the evaluation burst
+        # (~2 ms at [7, 40k], x howard_steps x rounds). Replace it with the
+        # slab one-hot contraction: fetch each query block's EV slab as
+        # contiguous knot blocks (block DMA) and reduce
+        # sum(where(jglob == idx, seg, 0)) — bitwise equal to the gather
+        # (adding exact zeros), but pure VPU broadcast work. The slab is
+        # re-anchored at THIS policy, whose own block span always satisfies
+        # the slab's lower bound (slab start <= block-first index <= idx);
+        # only an upper-bound violation (a >1,024-cell policy jump inside
+        # one 256-query block) is possible — checked once per round, with
+        # the gather route as the lax.cond fallback so correctness never
+        # depends on the span assumption.
+        idxp_pad, ab_all = _slab_geometry(idx)
+        idxb = idxp_pad.reshape(N, nbp, _SQ)
+        joff = jnp.arange(_SLAB, dtype=jnp.int32)
+        jglob = ab_all[:, :, None] * _KB + joff[None, None, :]  # [N,nbp,_SLAB]
+        # BOTH bounds: within-block monotonicity of idx is not guaranteed
+        # (improve_global on an f32 tie plateau can jump non-monotonically),
+        # so an index below its block's slab start is as reachable as one
+        # above its end — either would make the contraction silently drop
+        # the continuation value.
+        slab_start = ab_all[:, :, None] * _KB
+        in_slab = jnp.all((idxb >= slab_start) & (idxb < slab_start + _SLAB))
+
+        def sweep_slab(v, _):
+            EV = expectation(P, v, beta)
+            EVp = jnp.concatenate(
+                [EV, jnp.zeros((N, padk_s), EV.dtype)], axis=1)
+            seg = _slab_fetch(EVp, ab_all)                      # [N,nbp,_SLAB]
+            g = jnp.sum(jnp.where(jglob[:, :, None, :] == idxb[..., None],
+                                  seg[:, :, None, :], 0.0), axis=3)
+            return u_pol + g.reshape(N, nbp * _SQ)[:, :na], None
+
+        def run_slab(v):
+            v, _ = jax.lax.scan(sweep_slab, v, None,
+                                length=max(howard_steps, 1))
+            return v
+
+        return jax.lax.cond(in_slab, run_slab, run_gather, v)
 
     def cond(carry):
         _, _, _, dist, it, same = carry

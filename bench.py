@@ -290,10 +290,13 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
         from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_multiscale
 
         def run():
+            # howard_steps=25: with the slab improvement/evaluation the
+            # per-round balance shifted — measured 2.88 s at hs=25 vs
+            # 3.06 s at hs=50 at [7, 40k] (BENCHMARKS.md round 3).
             return solve_aiyagari_vfi_multiscale(
                 model.a_grid, model.s, model.P, r, w, model.amin,
                 sigma=model.preferences.sigma, beta=model.preferences.beta,
-                tol=tol, max_iter=max_iter, howard_steps=50,
+                tol=tol, max_iter=max_iter, howard_steps=25,
                 grid_power=model.config.grid.power,
             )
 

@@ -119,6 +119,40 @@ class TestContinuousVFI:
         want = bucket_index(model.a_grid, q)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.slow
+    def test_slab_route_matches_local_window(self):
+        """The monotone-policy slab improvement + one-hot Howard contraction
+        (the fine-grid route, BENCHMARKS.md round 3) against the
+        local-window gather route on the same 5,120-point problem — the
+        slab paths are otherwise dead below the 4,096-point auto gate, so
+        this is the pin for the 'bitwise equal to the gather' claim and
+        the tie-to-previous argmax (same fixed point; f64 has no value
+        ties, so the tie rules cannot diverge)."""
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
+
+        n = 5_120
+        m = aiyagari_preset(grid_size=n)
+        prefs = m.preferences
+        w = wage_from_r(R_TEST, m.config.technology.alpha,
+                        m.config.technology.delta)
+        v0 = jnp.zeros((7, n), m.dtype)
+        # golden_iters=0: the final continuous refine would amplify the
+        # routes' sub-1e-9 value differences (different escalation rounds)
+        # across the flat objective top; the discrete fixed point is the
+        # claim under test.
+        kw = dict(sigma=prefs.sigma, beta=prefs.beta, tol=1e-6, max_iter=40,
+                  howard_steps=30, golden_iters=0, grid_power=2.0)
+        sol_w = solve_aiyagari_vfi_continuous(
+            v0, m.a_grid, m.s, m.P, R_TEST, w, m.amin, slab=False, **kw)
+        sol_s = solve_aiyagari_vfi_continuous(
+            v0, m.a_grid, m.s, m.P, R_TEST, w, m.amin, slab=True, **kw)
+        np.testing.assert_array_equal(np.asarray(sol_s.policy_idx),
+                                      np.asarray(sol_w.policy_idx))
+        np.testing.assert_allclose(np.asarray(sol_s.v), np.asarray(sol_w.v),
+                                   rtol=0, atol=1e-9)
+        np.testing.assert_array_equal(np.asarray(sol_s.policy_k),
+                                      np.asarray(sol_w.policy_k))
+
 
 class TestBackendEquivalence:
     def test_vfi_numpy_vs_jax(self, model, vfi_sol):
