@@ -121,12 +121,22 @@ def solve(
 
             # Honor dtype="float64" even when global x64 is off (see
             # precision_scope — without it the request silently truncates).
+            # Grid-axis mesh (BackendConfig.mesh_axes containing "grid"):
+            # the EGM household solves run DISTRIBUTED with the knots
+            # ring-redistributed across the mesh (solvers/egm_sharded.py).
+            mesh = None
+            if "grid" in backend.mesh_axes:
+                from aiyagari_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
             with precision_scope(backend.dtype):
                 m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
                 if aggregation == "distribution":
-                    result = solve_equilibrium_distribution(m, solver=solver, eq=equilibrium)
+                    result = solve_equilibrium_distribution(
+                        m, solver=solver, eq=equilibrium, mesh=mesh)
                 else:
-                    result = solve_equilibrium(m, solver=solver, sim=sim, eq=equilibrium)
+                    result = solve_equilibrium(
+                        m, solver=solver, sim=sim, eq=equilibrium, mesh=mesh)
         gap = (
             abs(result.k_supply[-1] - result.k_demand[-1])
             if result.k_supply else float("inf")

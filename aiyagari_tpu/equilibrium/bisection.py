@@ -67,10 +67,16 @@ def _initial_consumption_guess(model: AiyagariModel, r: float, w: float):
 
 
 def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = SolverConfig(),
-                    warm_start=None, block_size: int = 0):
+                    warm_start=None, block_size: int = 0, mesh=None):
     """Solve the household problem at interest rate r; returns a VFISolution
     or EGMSolution depending on solver.method. `warm_start` is the previous
-    value function (VFI) or consumption policy (EGM)."""
+    value function (VFI) or consumption policy (EGM).
+
+    `mesh` (a Mesh with a "grid" axis, from BackendConfig.mesh_axes) routes
+    the exogenous-labor EGM solve through the DISTRIBUTED fixed point with
+    ring-redistributed knots (solvers/egm_sharded.py) — O(na/D) per-device
+    memory. Escapes, non-power grids, and the other solver families fall
+    back to the single-device routes below."""
     prefs = model.preferences
     tech = model.config.technology
     w = wage_from_r(r, tech.alpha, tech.delta)
@@ -93,6 +99,62 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             use_pallas=solver.use_pallas, progress_every=solver.progress_every,
         )
     if solver.method == "egm":
+        from aiyagari_tpu.parallel.ring import ring_slab_fits
+
+        if (
+            mesh is not None
+            and not model.config.endogenous_labor
+            and model.config.grid.power > 0
+            and na % int(mesh.shape["grid"]) == 0
+            # Slab-geometry soundness: grids too small for the ring slab
+            # (the same predicate behind solve_aiyagari_egm_sharded's loud
+            # guard) silently use the single-device routes — nothing to
+            # distribute there anyway.
+            and ring_slab_fits(na, int(mesh.shape["grid"]))
+        ):
+            from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+
+            C0 = warm_start
+            if C0 is None and solver.grid_sequencing and na > 1600:
+                # Cold fine-grid start: run the single-device multiscale
+                # ladder up to the penultimate stage and prolong — the
+                # sharded fine solve then runs a warm handful of sweeps
+                # instead of ~290 cold full-size ones (the same nested
+                # iteration the single-device path uses).
+                from aiyagari_tpu.ops.interp import prolong_power_grid
+                from aiyagari_tpu.solvers.egm import (
+                    LADDER_COARSEST,
+                    LADDER_REFINE,
+                    _cached_grid_bounds,
+                    solve_aiyagari_egm_multiscale,
+                )
+                from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
+
+                lo, hi = _cached_grid_bounds(model.a_grid)
+                sizes = stage_sizes(na, LADDER_COARSEST, LADDER_REFINE)
+                if len(sizes) > 1:
+                    gp = float(model.config.grid.power)
+                    coarse = stage_grid(sizes[-2], lo, hi, gp, model.dtype)
+                    csol = solve_aiyagari_egm_multiscale(
+                        coarse, model.s, model.P, r, w, model.amin,
+                        sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                        max_iter=solver.max_iter, grid_power=gp,
+                        relative_tol=solver.relative_tol,
+                    )
+                    C0 = prolong_power_grid(csol.policy_c, lo, hi, gp, na)
+            if C0 is None:
+                C0 = _initial_consumption_guess(model, r, w)
+            sol = solve_aiyagari_egm_sharded(
+                mesh, C0, model.a_grid, model.s, model.P, r, w, model.amin,
+                sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                max_iter=solver.max_iter,
+                relative_tol=solver.relative_tol,
+                grid_power=model.config.grid.power,
+            )
+            if not bool(sol.escaped):
+                return sol
+            # Slab overflow: fall through to the single-device routes (the
+            # same host-level retry contract as solve_aiyagari_egm_safe).
         if (
             solver.grid_sequencing
             and warm_start is None
@@ -223,7 +285,8 @@ class _DistributionAggregator:
 
 def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
             eq: EquilibriumConfig, on_iteration: Optional[Callable],
-            checkpoint_dir: Optional[str], checkpoint_configs) -> EquilibriumResult:
+            checkpoint_dir: Optional[str], checkpoint_configs,
+            mesh=None) -> EquilibriumResult:
     """Shared GE bisection driver (Aiyagari_VFI.m:133-206): bracket r, re-solve
     the household problem warm-started at each midpoint, ask the aggregator for
     capital supply, compare against the firm FOC demand curve. Checkpoint/
@@ -265,7 +328,8 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         sol = None
     else:
         # Warm-start pass at r_init, as the reference does before its loop (:63-129).
-        sol = solve_household(model, eq.r_init, solver=solver, warm_start=None)
+        sol = solve_household(model, eq.r_init, solver=solver, warm_start=None,
+                              mesh=mesh)
         warm = _warm_state(sol, solver.method)
 
     converged = False
@@ -274,7 +338,8 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         it_t0 = time.perf_counter()
         r_mid = 0.5 * (r_low + r_high)
         w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
-        sol = solve_household(model, r_mid, solver=solver, warm_start=warm)
+        sol = solve_household(model, r_mid, solver=solver, warm_start=warm,
+                              mesh=mesh)
         warm = _warm_state(sol, solver.method)
         supply, extras = aggregator.supply(sol, r_mid, w)
         demand = float(capital_demand(r_mid, model.labor_raw, tech.alpha, tech.delta))
@@ -335,7 +400,8 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
 def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
                       sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
                       on_iteration: Optional[Callable] = None,
-                      checkpoint_dir: Optional[str] = None) -> EquilibriumResult:
+                      checkpoint_dir: Optional[str] = None,
+                      mesh=None) -> EquilibriumResult:
     """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
     midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
 
@@ -351,7 +417,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
     return _bisect(
         model, _SimulationAggregator(model, sim), solver=solver, eq=eq,
         on_iteration=on_iteration, checkpoint_dir=checkpoint_dir,
-        checkpoint_configs=(sim,),
+        checkpoint_configs=(sim,), mesh=mesh,
     )
 
 
@@ -361,6 +427,7 @@ def solve_equilibrium_distribution(
     dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
     on_iteration: Optional[Callable] = None,
     checkpoint_dir: Optional[str] = None,
+    mesh=None,
 ) -> EquilibriumResult:
     """Non-stochastic GE closure: same r-bisection as solve_equilibrium, but
     capital supply is E[a] under the stationary distribution computed by the
@@ -379,5 +446,5 @@ def solve_equilibrium_distribution(
         model, _DistributionAggregator(model, dist_tol, dist_max_iter),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
-        checkpoint_configs=(dist_tol, dist_max_iter),
+        checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,
     )

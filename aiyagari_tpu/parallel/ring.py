@@ -60,8 +60,25 @@ from aiyagari_tpu.ops.interp import (
 )
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
 
-__all__ = ["inverse_interp_power_grid_ring", "ring_inverse_local",
-           "ring_buffer_size"]
+__all__ = ["DEFAULT_CAPACITY", "inverse_interp_power_grid_ring",
+           "ring_inverse_local", "ring_buffer_size", "ring_slab_fits"]
+
+# The default per-device slab capacity (in shards): the measured EGM slab
+# requirement is 1.11 shards (module docstring); 2.0 is ~80% headroom.
+# Single source of truth for the solver default and the config-level
+# soundness gate (equilibrium/bisection.py).
+DEFAULT_CAPACITY = 2.0
+
+
+def ring_slab_fits(n_k: int, D: int,
+                   capacity: float = DEFAULT_CAPACITY) -> bool:
+    """Whether the per-device slab is geometrically sound: it must not
+    exceed the (block-padded) knot row itself, or the window clamp's
+    arithmetic inverts and the slab fetch silently duplicates knot blocks.
+    The single predicate behind solve_aiyagari_egm_sharded's loud guard and
+    the config-level silent degrade (equilibrium/bisection.py)."""
+    KB = _INV_KBLOCK
+    return ring_buffer_size(n_k, D, capacity) <= -(-n_k // KB) * KB
 
 
 def ring_buffer_size(n_k: int, D: int, capacity: float) -> int:
@@ -78,7 +95,7 @@ def ring_buffer_size(n_k: int, D: int, capacity: float) -> int:
 
 def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
                        lo: float, hi: float, power: float,
-                       capacity: float = 2.0, pad: int = 8):
+                       capacity: float = DEFAULT_CAPACITY, pad: int = 8):
     """Shard-local body of the ring-redistribution inversion — call from
     INSIDE a shard_map over `axis`.
 
@@ -181,7 +198,8 @@ _RING_PROGRAMS: dict = {}
 def inverse_interp_power_grid_ring(mesh, x, lo: float, hi: float,
                                    power: float, n_q: int, *,
                                    axis: str = "grid",
-                                   capacity: float = 2.0, pad: int = 8):
+                                   capacity: float = DEFAULT_CAPACITY,
+                                   pad: int = 8):
     """Distributed inverse interpolation onto the n_q-point power grid with
     ring-redistributed knots (module docstring). x [..., n_k] sorted knots,
     sharded (or shardable) along the last axis over mesh[axis]; the axis

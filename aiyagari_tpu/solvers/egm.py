@@ -15,8 +15,15 @@ from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
 from aiyagari_tpu.ops.interp import prolong_power_grid
 
+# Multigrid ladder defaults, shared with the mesh warm-start route
+# (equilibrium/bisection.py) so the stage geometry cannot drift.
+LADDER_COARSEST = 400
+LADDER_REFINE = 10
+
 __all__ = [
     "EGMSolution",
+    "LADDER_COARSEST",
+    "LADDER_REFINE",
     "initial_consumption_guess",
     "solve_aiyagari_egm",
     "solve_aiyagari_egm_safe",
@@ -341,8 +348,9 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   beta: float, tol: float, max_iter: int,
-                                  grid_power: float = 2.0, coarsest: int = 400,
-                                  refine_factor: int = 10,
+                                  grid_power: float = 2.0,
+                                  coarsest: int = LADDER_COARSEST,
+                                  refine_factor: int = LADDER_REFINE,
                                   relative_tol: bool = False,
                                   progress_every: int = 0,
                                   noise_floor_ulp: float = 0.0,
@@ -413,8 +421,8 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                                         sigma: float, beta: float, psi: float,
                                         eta: float, tol: float, max_iter: int,
                                         grid_power: float = 2.0,
-                                        coarsest: int = 400,
-                                        refine_factor: int = 10,
+                                        coarsest: int = LADDER_COARSEST,
+                                        refine_factor: int = LADDER_REFINE,
                                         relative_tol: bool = False,
                                         progress_every: int = 0,
                                         noise_floor_ulp: float = 0.0) -> EGMSolution:

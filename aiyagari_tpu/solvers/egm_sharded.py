@@ -41,7 +41,11 @@ from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
-from aiyagari_tpu.parallel.ring import ring_inverse_local
+from aiyagari_tpu.parallel.ring import (
+    DEFAULT_CAPACITY,
+    ring_inverse_local,
+    ring_slab_fits,
+)
 from aiyagari_tpu.solvers.egm import EGMSolution, _cached_grid_bounds, _fetch_scalars
 from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
 
@@ -53,8 +57,10 @@ _EGM_PROGRAMS: dict = {}
 def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                                sigma: float, beta: float, tol: float,
                                max_iter: int, grid_power: float,
+                               relative_tol: bool = False,
                                noise_floor_ulp: float = 0.0,
-                               capacity: float = 2.0, pad: int = 8,
+                               capacity: float = DEFAULT_CAPACITY,
+                               pad: int = 8,
                                axis: str = "grid") -> EGMSolution:
     """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
     knots resident per device (module docstring).
@@ -88,12 +94,19 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
         raise ValueError(f"mesh axis size {D} must divide the grid {na}")
     if pad < 1:
         raise ValueError(f"pad must be >= 1, got {pad}")  # ring.py rationale
+    if not ring_slab_fits(na, D, capacity):
+        # A grid this small has nothing to gain from distribution — fail
+        # loudly (ring_slab_fits docstring).
+        raise ValueError(
+            f"grid of {na} points is too small for the ring slab at "
+            f"capacity={capacity} on {D} devices (the slab would exceed "
+            "the knot row); use the single-device solver")
     dtype = C_init.dtype
     lo, hi = _cached_grid_bounds(a_grid)
     run = _egm_program(mesh, axis, N, na, lo, hi, float(grid_power),
                        float(capacity), int(pad), float(sigma), float(beta),
-                       float(tol), int(max_iter), float(noise_floor_ulp),
-                       jnp.dtype(dtype).name)
+                       float(tol), int(max_iter), bool(relative_tol),
+                       float(noise_floor_ulp), jnp.dtype(dtype).name)
     C, policy_k, dist, it, esc, tol_eff = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
@@ -104,7 +117,7 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
 
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
-                 beta: float, tol: float, max_iter: int,
+                 beta: float, tol: float, max_iter: int, relative_tol: bool,
                  noise_floor_ulp: float, dtype_name: str):
     D = int(mesh.shape[axis])
     na_loc = na // D
@@ -152,8 +165,13 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
             def body(carry):
                 C, _, _, it, esc, _ = carry
                 C_new, policy_k, esc_new = sweep(C)
-                dist = jax.lax.pmax(jnp.max(jnp.abs(C_new - C)), axis)
-                if noise_floor_ulp > 0.0:
+                diff = jnp.abs(C_new - C)
+                # Same criterion family as solve_aiyagari_egm: relative
+                # sup-norm when asked, else absolute (+ optional floor).
+                local = (jnp.max(diff / (jnp.abs(C) + 1e-10))
+                         if relative_tol else jnp.max(diff))
+                dist = jax.lax.pmax(local, axis)
+                if noise_floor_ulp > 0.0 and not relative_tol:
                     # The f32 ulp-noise stopping floor of
                     # solve_aiyagari_egm; sup-norm of the iterate pmax'd so
                     # the effective tolerance is the global one.
@@ -176,5 +194,6 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, tol, max_iter,
-                                          noise_floor_ulp, dtype_name)
+                                          relative_tol, noise_floor_ulp,
+                                          dtype_name)
     return cached_program(_EGM_PROGRAMS, key, build)

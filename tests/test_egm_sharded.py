@@ -143,16 +143,18 @@ class TestShardedEGMSolver:
     @pytest.mark.slow
     def test_trajectory_matches_unsharded_at_scale(self):
         # The 100k+-point composition the blueprint demands (VERDICT round 2
-        # #1): 102,400 points, 12,800-knot shards, 3 sweeps on the 8-device
-        # mesh vs the single-device windowed solver.
+        # #1): 102,400 points, 12,800-knot shards on the 8-device mesh vs
+        # the single-device windowed solver. ONE sweep: per-sweep equality
+        # is the sharding claim (multi-sweep dynamics are pinned at 8,192
+        # above), and each extra sweep costs ~2.5 min of one-core CPU here.
         n = 102_400
         m, w, C0, kw = _egm_problem(n)
-        kw.update(tol=1e-30, max_iter=3)
+        kw.update(tol=1e-30, max_iter=1)
         ref = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
         mesh = make_mesh(("grid",))
         sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
                                          w, m.amin, **kw)
-        assert int(sol.iterations) == 3 and not bool(sol.escaped)
+        assert int(sol.iterations) == 1 and not bool(sol.escaped)
         np.testing.assert_allclose(np.asarray(sol.policy_c),
                                    np.asarray(ref.policy_c), atol=1e-11)
 
@@ -163,8 +165,9 @@ class TestShardedEGMSolver:
         # it to a handful without changing the fixed point).
         from aiyagari_tpu.ops.interp import prolong_power_grid
 
-        n = 8_192
+        n = 6_144   # windowed regime; sized for the one-core CPU budget
         m, w, C0, kw = _egm_problem(n)
+        kw.update(tol=1e-5)
         coarse = aiyagari_preset(grid_size=512)
         Cc = initial_consumption_guess(coarse.a_grid, coarse.s, 0.04, w)
         kwc = dict(kw, grid_power=float(coarse.config.grid.power))
@@ -229,17 +232,56 @@ class TestShardedEGMSolver:
     def test_escape_contract_on_undersized_slab(self):
         # Undersized slab: capacity=0.0 degenerates the buffer to its floor
         # of exactly one shard (B = L), below the measured 1.11L slab
-        # requirement of the real EGM endogenous grids — the solver must
-        # raise the flag and NaN-poison, never return silently wrong
-        # brackets. max_iter leaves room for the worst-requirement sweep.
+        # requirement of the real EGM endogenous grids at their FIRST sweep
+        # (the per-sweep capped-need profile starts at its 1.111L maximum)
+        # — the solver must raise the flag and NaN-poison, never return
+        # silently wrong brackets.
         n = 40_960
         m, w, C0, kw = _egm_problem(n)
-        kw.update(tol=1e-30, max_iter=12)
+        kw.update(tol=1e-30, max_iter=2)
         mesh = make_mesh(("grid",))
         sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P, 0.04,
                                          w, m.amin, capacity=0.0, **kw)
         assert bool(sol.escaped)
         assert np.isnan(np.asarray(sol.policy_c)).all()
+
+    @pytest.mark.slow
+    def test_mesh_household_route_matches_single_device(self):
+        # The solve_household mesh branch (the BackendConfig.mesh_axes
+        # routing target): coarse-ladder warm start + sharded fine solve
+        # equals the single-device solve at 6,144 points — the smallest
+        # windowed-regime grid whose ring slab is sound at D=8. (A full GE
+        # bisection through this route measured ~30 min of one-core CPU —
+        # per-iteration fine solves — so the dispatch plumbing above it is
+        # pinned by the cheap small-grid at.solve smoke below instead.)
+        from aiyagari_tpu.config import SolverConfig
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+
+        n = 6_144
+        m, w, C0, kw = _egm_problem(n)
+        scfg = SolverConfig(method="egm", tol=1e-5, max_iter=2000)
+        ref = solve_household(m, 0.04, solver=scfg)
+        res = solve_household(m, 0.04, solver=scfg,
+                              mesh=make_mesh(("grid",)))
+        assert not bool(res.escaped)
+        np.testing.assert_allclose(np.asarray(res.policy_c),
+                                   np.asarray(ref.policy_c), atol=5e-5)
+
+    def test_small_grid_mesh_request_degrades_to_single_device(self):
+        # Below the slab-soundness bound the config-level mesh request must
+        # silently use the single-device routes (solve_household's gate),
+        # and the raw solver must refuse loudly.
+        import aiyagari_tpu as at
+
+        cfg = at.AiyagariConfig(grid=at.GridSpecConfig(n_points=512))
+        res = at.solve(cfg, method="egm", aggregation="distribution",
+                       backend=at.BackendConfig(mesh_axes=("grid",)),
+                       equilibrium=at.EquilibriumConfig(max_iter=2))
+        assert np.isfinite(res.r)
+        m, w, C0, kw = _egm_problem(512)
+        with pytest.raises(ValueError, match="too small"):
+            solve_aiyagari_egm_sharded(make_mesh(("grid",)), C0, m.a_grid,
+                                       m.s, m.P, 0.04, w, m.amin, **kw)
 
     def test_rejects_bad_arguments(self):
         m, w, C0, kw = _egm_problem(1002)

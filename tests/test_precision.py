@@ -182,7 +182,7 @@ class TestFineGridF32:
         from aiyagari_tpu.solvers.egm import initial_consumption_guess, solve_aiyagari_egm
         from aiyagari_tpu.utils.firm import wage_from_r
 
-        n = 1200
+        n = 600   # semantics are n-independent; cold sweeps cost n^2 on this box
         for dtype in (jnp.float32, jnp.float64):
             m = aiyagari_preset(grid_size=n, dtype=dtype)
             w = float(wage_from_r(0.04, m.config.technology.alpha,

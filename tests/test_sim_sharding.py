@@ -251,15 +251,18 @@ class TestHaloShardedInversion:
         from aiyagari_tpu.parallel.halo import inverse_interp_power_grid_halo
         from aiyagari_tpu.parallel.mesh import make_mesh
 
-        n = 40_960   # 5,120-knot shards + 2,048-knot halos on 8 devices
-        # (the shifted second row's bracket lag measures ~1,170 knots at the
-        # sqrt-dense bottom of the power grid, past a 1,024 halo — which the
-        # escape test below exercises on purpose).
+        n = 16_384   # 2,048-knot shards + 1,536-knot halos on 8 devices
+        # (the distorted first row's bracket lag at the sqrt-dense bottom
+        # scales with n: ~1,180 knots here — past a 1,024 halo, inside
+        # 1,536; the escape test below exercises the too-small case on
+        # purpose). Down from 40,960 in round 2: the lag/halo geometry is
+        # scale-proportional, and the unsharded reference route at 40,960
+        # cost ~2.5 min of the one-core suite budget.
         x, lo, hi, power = self._knots(n)
         xq = jnp.stack([x, x * 1.01 + 0.05])
         mesh = make_mesh(("grid",))
         got, esc = inverse_interp_power_grid_halo(mesh, xq, lo, hi, power, n,
-                                                  halo=2048)
+                                                  halo=1536)
         want, esc_w = inverse_interp_power_grid(xq, lo, hi, power, n,
                                                 with_escape=True)
         assert not bool(esc) and not bool(esc_w)

@@ -140,6 +140,10 @@ class TestContinuousVFI:
         # routes' sub-1e-9 value differences (different escalation rounds)
         # across the flat objective top; the discrete fixed point is the
         # claim under test.
+        # Full convergence, NOT bounded rounds: the routes escalate to the
+        # global search in different rounds (different window geometries),
+        # so mid-flight iterates differ — only the converged fixed point is
+        # the equality claim (measured: bounded-round equality fails).
         kw = dict(sigma=prefs.sigma, beta=prefs.beta, tol=1e-6, max_iter=40,
                   howard_steps=30, golden_iters=0, grid_power=2.0)
         sol_w = solve_aiyagari_vfi_continuous(
