@@ -100,6 +100,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
         )
     if solver.method == "egm":
         from aiyagari_tpu.parallel.ring import ring_slab_fits
+        from aiyagari_tpu.solvers.egm import LADDER_MIN_FINE, ladder_warm_start
 
         if (
             mesh is not None
@@ -114,34 +115,17 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
         ):
             from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
 
+            ladder_C0 = None
             C0 = warm_start
-            if C0 is None and solver.grid_sequencing and na > 1600:
-                # Cold fine-grid start: run the single-device multiscale
-                # ladder up to the penultimate stage and prolong — the
-                # sharded fine solve then runs a warm handful of sweeps
-                # instead of ~290 cold full-size ones (the same nested
-                # iteration the single-device path uses).
-                from aiyagari_tpu.ops.interp import prolong_power_grid
-                from aiyagari_tpu.solvers.egm import (
-                    LADDER_COARSEST,
-                    LADDER_REFINE,
-                    _cached_grid_bounds,
-                    solve_aiyagari_egm_multiscale,
+            if C0 is None and solver.grid_sequencing and na > LADDER_MIN_FINE:
+                ladder_C0 = ladder_warm_start(
+                    model.a_grid, model.s, model.P, r, w, model.amin,
+                    sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                    max_iter=solver.max_iter,
+                    grid_power=float(model.config.grid.power),
+                    relative_tol=solver.relative_tol,
                 )
-                from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
-
-                lo, hi = _cached_grid_bounds(model.a_grid)
-                sizes = stage_sizes(na, LADDER_COARSEST, LADDER_REFINE)
-                if len(sizes) > 1:
-                    gp = float(model.config.grid.power)
-                    coarse = stage_grid(sizes[-2], lo, hi, gp, model.dtype)
-                    csol = solve_aiyagari_egm_multiscale(
-                        coarse, model.s, model.P, r, w, model.amin,
-                        sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
-                        max_iter=solver.max_iter, grid_power=gp,
-                        relative_tol=solver.relative_tol,
-                    )
-                    C0 = prolong_power_grid(csol.policy_c, lo, hi, gp, na)
+                C0 = ladder_C0
             if C0 is None:
                 C0 = _initial_consumption_guess(model, r, w)
             sol = solve_aiyagari_egm_sharded(
@@ -154,11 +138,17 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             if not bool(sol.escaped):
                 return sol
             # Slab overflow: fall through to the single-device routes (the
-            # same host-level retry contract as solve_aiyagari_egm_safe).
+            # same host-level retry contract as solve_aiyagari_egm_safe),
+            # keeping an already-converged ladder warm start so the retry
+            # does not pay the coarse stages a second time. A cold initial
+            # guess is NOT promoted: with no ladder product the retry should
+            # take its own multiscale route below.
+            if ladder_C0 is not None:
+                warm_start = ladder_C0
         if (
             solver.grid_sequencing
             and warm_start is None
-            and na > 1600
+            and na > LADDER_MIN_FINE
             and model.config.grid.power > 0
         ):
             # Cold start on a fine grid: coarse-to-fine stages cut the

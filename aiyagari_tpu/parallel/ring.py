@@ -212,6 +212,16 @@ def inverse_interp_power_grid_ring(mesh, x, lo: float, hi: float,
     if n_k % D or n_q % D:
         raise ValueError(
             f"mesh axis size {D} must divide n_k={n_k} and n_q={n_q}")
+    if not ring_slab_fits(n_k, D, capacity):
+        # Slab > padded knot row inverts the window clamp's arithmetic and
+        # silently duplicates knot blocks (ring_slab_fits docstring) — the
+        # geometry is a hard error at every public entry, not just the EGM
+        # solver's.
+        raise ValueError(
+            f"ring slab does not fit: n_k={n_k} over {D} devices at "
+            f"capacity={capacity} needs a {ring_buffer_size(n_k, D, capacity)}"
+            f"-knot buffer > the padded knot row; use fewer devices or a "
+            f"larger grid (ring_slab_fits)")
     if pad < 1:
         # pad >= 1 keeps each device's first query's LOWER bracketing knot
         # (global index c-1) inside the slab; pad=0 would silently degrade

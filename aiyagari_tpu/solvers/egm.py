@@ -19,12 +19,19 @@ from aiyagari_tpu.ops.interp import prolong_power_grid
 # (equilibrium/bisection.py) so the stage geometry cannot drift.
 LADDER_COARSEST = 400
 LADDER_REFINE = 10
+# Grids at or below this size take the single-stage solve even when grid
+# sequencing is on: the ladder's extra stages cost more than the ~290 cold
+# sweeps they save at small n. Shared by every grid-sequencing gate
+# (equilibrium/bisection.py routes) so the trigger cannot drift per route.
+LADDER_MIN_FINE = 1600
 
 __all__ = [
     "EGMSolution",
     "LADDER_COARSEST",
+    "LADDER_MIN_FINE",
     "LADDER_REFINE",
     "initial_consumption_guess",
+    "ladder_warm_start",
     "solve_aiyagari_egm",
     "solve_aiyagari_egm_safe",
     "solve_aiyagari_egm_labor",
@@ -344,6 +351,36 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  use_pallas=use_pallas)
         esc = esc | sol.escaped
     return dataclasses.replace(sol, escaped=esc)
+
+
+def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+                      tol: float, max_iter: int, grid_power: float,
+                      relative_tol: bool = False):
+    """Converge the multiscale ladder's PENULTIMATE stage and prolong its
+    consumption policy to the full grid — the warm start the mesh route
+    feeds solve_aiyagari_egm_sharded, so the sharded fine solve runs a warm
+    handful of sweeps instead of ~290 cold full-size ones (the same nested
+    iteration solve_aiyagari_egm_multiscale performs internally). Returns
+    None when the ladder has a single stage (nothing coarser to solve)."""
+    from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
+
+    n_final = int(a_grid.shape[-1])
+    lo, hi = _cached_grid_bounds(a_grid)
+    sizes = stage_sizes(n_final, LADDER_COARSEST, LADDER_REFINE)
+    if len(sizes) < 2:
+        return None
+    coarse = stage_grid(sizes[-2], lo, hi, grid_power, a_grid.dtype)
+    csol = solve_aiyagari_egm_multiscale(
+        coarse, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+        max_iter=max_iter, grid_power=grid_power, relative_tol=relative_tol)
+    if bool(csol.escaped):
+        # The multiscale generic-route retry normally clears the flag; if an
+        # escape ever survives, the policy is NaN-poisoned and would enter
+        # the sharded solve as a "warm start" whose NaNs exit its loop after
+        # one sweep with escaped=False — a silently-converged NaN solution.
+        # A cold start is the safe fallback.
+        return None
+    return prolong_power_grid(csol.policy_c, lo, hi, grid_power, n_final)
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,

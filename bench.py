@@ -23,6 +23,7 @@ Usage: python bench.py [--grid 400] [--quick] [--metric {all,vfi,ks,scale}]
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -90,14 +91,85 @@ def _measure_numpy_vfi400(n_runs: int, tol: float = 1e-5,
     return sorted(times)
 
 
-def numpy_vfi400_denominator() -> dict:
-    """The reference-scale NumPy VFI denominator, robust to CPU load
-    (VERDICT round 2 #2): prefer the FROZEN median recorded in BASELINE.json
-    (python bench.py --refresh-baseline, idle box, fingerprinted), so a
-    contended denominator draw cannot move vs_baseline; always ALSO measure
-    live (median-of-3, spread recorded) so the artifact shows this run's
-    actual machine state next to the frozen constant."""
-    live = _measure_numpy_vfi400(3)
+@functools.lru_cache(maxsize=1)
+def _numpy_ks_panel_inputs():
+    """Inputs for the K-S panel denominator, f64 NumPy: the bench policy
+    table (0.9*k_grid broadcast) and the PRNGKey(0) shock panel at reference
+    scale (Krusell_Smith_VFI.m:10-11). The shock DRAW's dtype lineage does
+    not affect the loop's cost — the denominator is a time, not a path.
+    Cached: bench_ks_agents builds the same panel for the TPU numerator, and
+    the underlying jit programs are shared, so the second build inside one
+    process is pure recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import KrusellSmithConfig
+    from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+    from aiyagari_tpu.sim.ks_panel import (
+        simulate_aggregate_shocks,
+        simulate_employment_panel,
+    )
+
+    cfg = KrusellSmithConfig()
+    T, pop = 1100, 10_000
+    model = KrusellSmithModel.from_config(cfg, jnp.float32)
+    kz, ke = jax.random.split(jax.random.PRNGKey(0))
+    z = simulate_aggregate_shocks(model.pz, kz, T=T)
+    eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
+                                    cfg.shocks.u_bad, ke, T=T, population=pop)
+    k_opt = 0.9 * np.broadcast_to(
+        np.asarray(model.k_grid, np.float64)[None, None, :],
+        (4, cfg.K_size, cfg.k_size)).copy()
+    return (k_opt, np.asarray(model.k_grid, np.float64),
+            np.asarray(model.K_grid, np.float64),
+            np.asarray(z), np.asarray(eps), T, pop)
+
+
+def _numpy_ks_panel_seconds(k_opt_np, k_grid_np, K_grid_np, z_np, eps_np,
+                            T: int, pop: int, T_base: int) -> float:
+    """One timed NumPy panel simulation (the reference's per-t step,
+    Krusell_Smith_VFI.m:222-248, vectorized with np.interp per state),
+    run for T_base-1 steps and scaled to the full T-1."""
+    k_pop = np.full(pop, K_grid_np[0])
+    t0 = time.perf_counter()
+    for t_i in range(T_base - 1):
+        K_t = k_pop.mean()
+        iK = np.clip(np.searchsorted(K_grid_np, K_t) - 1, 0, len(K_grid_np) - 2)
+        tK = (K_t - K_grid_np[iK]) / (K_grid_np[iK + 1] - K_grid_np[iK])
+        pol = k_opt_np[:, iK, :] * (1 - tK) + k_opt_np[:, iK + 1, :] * tK
+        s_t = z_np[t_i] % 2 + 2 * eps_np[t_i]
+        new_k = np.empty(pop)
+        for s_i in range(4):
+            m = s_t == s_i
+            if m.any():
+                new_k[m] = np.interp(k_pop[m], k_grid_np, pol[s_i])
+        k_pop = new_k
+    return (time.perf_counter() - t0) * (T - 1) / (T_base - 1)
+
+
+def _measure_numpy_ks_panel(n_runs: int) -> list[float]:
+    inputs = _numpy_ks_panel_inputs()
+    return sorted(_numpy_ks_panel_seconds(*inputs, T_base=300)
+                  for _ in range(n_runs))
+
+
+# Every frozen-denominator entry in BASELINE.json: name -> measure fn
+# returning n_runs sorted seconds. Adding a metric's denominator here gives
+# it the frozen/live policy and --refresh-baseline coverage automatically.
+_DENOMINATORS = {
+    "numpy_vfi_400": _measure_numpy_vfi400,
+    "numpy_ks_panel_10000x1100": _measure_numpy_ks_panel,
+}
+
+
+def frozen_denominator(name: str, n_live: int = 3) -> dict:
+    """A NumPy denominator robust to CPU load (VERDICT round 2 #2): prefer
+    the FROZEN median recorded in BASELINE.json (python bench.py
+    --refresh-baseline, idle box, fingerprinted), so a contended denominator
+    draw cannot move vs_baseline; always ALSO measure live (median-of-n,
+    spread recorded) so the artifact shows this run's actual machine state
+    next to the frozen constant."""
+    live = _DENOMINATORS[name](n_live)
     med = live[len(live) // 2]
     out = {
         "baseline_live_seconds": round(med, 4),
@@ -106,7 +178,7 @@ def numpy_vfi400_denominator() -> dict:
     frozen = None
     try:
         with open(_BASELINE_JSON) as f:
-            frozen = json.load(f).get("frozen_denominators", {}).get("numpy_vfi_400")
+            frozen = json.load(f).get("frozen_denominators", {}).get(name)
     except (OSError, json.JSONDecodeError):
         pass
     if frozen and frozen.get("fingerprint") == _machine_fingerprint():
@@ -121,26 +193,32 @@ def numpy_vfi400_denominator() -> dict:
     return out
 
 
+def numpy_vfi400_denominator() -> dict:
+    return frozen_denominator("numpy_vfi_400")
+
+
 def refresh_frozen_baseline(n_runs: int = 7) -> dict:
-    """Measure the NumPy denominator n_runs times and freeze the median (+
-    spread + machine fingerprint + date) into BASELINE.json. Run on an IDLE
-    box: a loaded denominator would inflate every future vs_baseline."""
-    times = _measure_numpy_vfi400(n_runs)
-    entry = {
-        "median_seconds": round(times[len(times) // 2], 4),
-        "spread_seconds": [round(times[0], 4), round(times[-1], 4)],
-        "n_runs": n_runs,
-        "tol": 1e-5,
-        "fingerprint": _machine_fingerprint(),
-        "frozen_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-    }
+    """Measure every registered NumPy denominator n_runs times and freeze
+    the medians (+ spread + machine fingerprint + date) into BASELINE.json.
+    Run on an IDLE box: a loaded denominator would inflate every future
+    vs_baseline."""
+    entries = {}
+    for name, measure in _DENOMINATORS.items():
+        times = measure(n_runs)
+        entries[name] = {
+            "median_seconds": round(times[len(times) // 2], 4),
+            "spread_seconds": [round(times[0], 4), round(times[-1], 4)],
+            "n_runs": n_runs,
+            "fingerprint": _machine_fingerprint(),
+            "frozen_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
     with open(_BASELINE_JSON) as f:
         data = json.load(f)
-    data.setdefault("frozen_denominators", {})["numpy_vfi_400"] = entry
+    data.setdefault("frozen_denominators", {}).update(entries)
     with open(_BASELINE_JSON, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
-    return entry
+    return entries
 
 
 def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
@@ -450,27 +528,22 @@ def bench_ks_agents(quick: bool) -> dict:
     t = min(times) / reps
     agent_steps = pop * (T - 1)
 
-    # NumPy baseline: same panel step, vectorized with np.interp per state.
-    k_opt_np = np.asarray(k_opt, np.float64)
-    k_grid_np = np.asarray(model.k_grid, np.float64)
-    K_grid_np = np.asarray(model.K_grid, np.float64)
-    z_np, eps_np = np.asarray(z), np.asarray(eps)
-    T_base = min(T, 120 if quick else 300)
-    k_pop = np.full(pop, K_grid_np[0])
-    t0 = time.perf_counter()
-    for t_i in range(T_base - 1):
-        K_t = k_pop.mean()
-        iK = np.clip(np.searchsorted(K_grid_np, K_t) - 1, 0, len(K_grid_np) - 2)
-        tK = (K_t - K_grid_np[iK]) / (K_grid_np[iK + 1] - K_grid_np[iK])
-        pol = k_opt_np[:, iK, :] * (1 - tK) + k_opt_np[:, iK + 1, :] * tK
-        s_t = z_np[t_i] % 2 + 2 * eps_np[t_i]
-        new_k = np.empty(pop)
-        for s_i in range(4):
-            m = s_t == s_i
-            if m.any():
-                new_k[m] = np.interp(k_pop[m], k_grid_np, pol[s_i])
-        k_pop = new_k
-    t_np = (time.perf_counter() - t0) * (T - 1) / (T_base - 1)
+    # NumPy baseline: same panel step, vectorized with np.interp per state
+    # (_numpy_ks_panel_seconds). The driver-facing (non-quick) path takes
+    # the frozen/live denominator policy; quick mode — a smoke path, not an
+    # artifact — just measures a short live loop at the quick T and stays
+    # contention-sensitive.
+    if quick:
+        k_opt_np = np.asarray(k_opt, np.float64)
+        t_np = _numpy_ks_panel_seconds(
+            k_opt_np, np.asarray(model.k_grid, np.float64),
+            np.asarray(model.K_grid, np.float64), np.asarray(z),
+            np.asarray(eps), T, pop, T_base=min(T, 120))
+        base_fields = {}
+    else:
+        den = frozen_denominator("numpy_ks_panel_10000x1100")
+        t_np = den.pop("seconds")
+        base_fields = {"baseline_seconds": round(t_np, 4), **den}
 
     from aiyagari_tpu.diagnostics.roofline import panel_step_cost, utilization
 
@@ -481,6 +554,7 @@ def bench_ks_agents(quick: bool) -> dict:
         "value": round(agent_steps / t, 1),
         "unit": "agent_steps/sec",
         "vs_baseline": round(t_np / t, 2),
+        **base_fields,
         **utilization(t, cost, platform),
     }
 
@@ -576,8 +650,8 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_enable_x64", True)
-        entry = refresh_frozen_baseline()
-        print(json.dumps({"frozen_numpy_vfi_400": entry}))
+        entries = refresh_frozen_baseline()
+        print(json.dumps({"frozen_denominators": entries}))
         return 0
 
     if args.probe_timeout is None:

@@ -106,6 +106,16 @@ class TestRingInversion:
             inverse_interp_power_grid_ring(mesh, jnp.zeros(1001), 0.0, 1.0,
                                            2.0, 1001)
 
+    def test_rejects_unsound_slab_geometry(self):
+        # 512 knots over 8 devices: the default-capacity slab (3,584 knots)
+        # exceeds the padded knot row, the geometry ring_slab_fits exists to
+        # catch — the public entry must refuse loudly, not silently
+        # duplicate knot blocks (same contract as solve_aiyagari_egm_sharded).
+        mesh = make_mesh(("grid",))
+        with pytest.raises(ValueError, match="slab does not fit"):
+            inverse_interp_power_grid_ring(mesh, jnp.zeros(512), 0.0, 1.0,
+                                           2.0, 512)
+
     def test_buffer_size_is_static_and_bounded(self):
         # The memory claim: B = capacity*shard + one window of slack — O(n/D)
         # with the measured model constant, NOT the full row.
