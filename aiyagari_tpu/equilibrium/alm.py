@@ -251,6 +251,7 @@ def _solve_krusell_smith_impl(
     best_f32 = np.inf   # best diff_B seen in the mixed f32 phase
     f32_stall = 0       # consecutive rounds without meaningful f32 progress
     f32_in_band = False  # diff_B has entered the near-convergence band
+    house_tol_0 = solver.tol  # tightened to alm.tol/10 at the phase switch
     if checkpoint_dir is not None:
         from aiyagari_tpu.io_utils.checkpoint import CheckpointManager, config_fingerprint
 
@@ -288,13 +289,21 @@ def _solve_krusell_smith_impl(
             best_f32 = float(sc.get("best_f32", np.inf))
             f32_stall = int(sc.get("f32_stall", 0))
             f32_in_band = bool(sc.get("f32_in_band", False))
+            # A resume mid-finishing-phase must keep the tightened household
+            # tolerance (set at the f32 -> f64 switch) — reverting to the
+            # loose tol would re-introduce the solver-noise hovering, or
+            # accept a B still carrying household-tolerance bias. Absent in
+            # legacy checkpoints (-> the configured tol).
+            house_tol_0 = float(sc.get("house_tol", solver.tol))
 
     converged = False
     diff_B = np.inf
     r2 = np.zeros(2)
     sol = None
+    house_tol = house_tol_0
     for it in range(start_it, alm.max_iter):
         it_t0 = time.perf_counter()
+        phase_switched = False      # set when THIS round triggers f32 -> f64
         B_dev = jnp.asarray(B, dtype)
         if solver.method == "vfi":
             sol = solve_ks_vfi(
@@ -302,7 +311,7 @@ def _solve_krusell_smith_impl(
                 model.r_table, model.w_table, model.eps_by_state,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
-                tol=solver.tol, max_iter=solver.max_iter,
+                tol=house_tol, max_iter=solver.max_iter,
                 howard_steps=solver.howard_steps, improve_every=solver.improve_every,
                 golden_iters=solver.golden_iters, relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
@@ -315,7 +324,7 @@ def _solve_krusell_smith_impl(
                 model.z_by_state, model.L_by_state, tech.alpha,
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
-                tol=solver.tol, max_iter=solver.max_iter, double_alm=double_alm,
+                tol=house_tol, max_iter=solver.max_iter, double_alm=double_alm,
                 progress_every=solver.progress_every,
             )
         else:
@@ -345,6 +354,11 @@ def _solve_krusell_smith_impl(
             K_ts, cross_new = simulate_capital_path(
                 k_opt_sim, k_grid_sim, K_grid_sim, z_path, eps_panel,
                 cross, T=alm.T,
+                # The k-grid is power-spaced (config.k_power, reference
+                # Krusell_Smith_VFI.m:16) — the panel step takes the
+                # analytic-bucket interpolation, 1.34x per step at the
+                # reference panel (ops/interp.state_policy_interp_power).
+                grid_power=float(config.k_power),
             )
         # Regression always in f64: the closed-form normal-equation sums over
         # ~1,000 log-K terms lose ~3 digits in f32, directly polluting B_new
@@ -405,7 +419,28 @@ def _solve_krusell_smith_impl(
             if f32_stall >= (2 if diff_B < 1e-2 else 6):
                 sim_dtype = jnp.float64
                 k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
-        if alm.acceleration == "anderson":
+                # The fixed-point map itself just changed (f32 -> f64
+                # simulation): Anderson extrapolation across the switch
+                # mixes residuals of the two maps — measured 14 hovering
+                # rounds at 2e-6..1.4e-5 after an otherwise-clean switch at
+                # reference scale. Restart the mixing history AND keep this
+                # round's (B, G_f32(B)) pair out of it — G was evaluated
+                # under the old map, and appending it would hand the first
+                # f64 round a cross-map residual difference anyway. The
+                # switch round updates B damped; Anderson re-accelerates on
+                # the new map's own residuals from the next round.
+                B_hist.clear()
+                G_hist.clear()
+                phase_switched = True
+                # The hovering this phase exists to break is also
+                # solver-noise-bound: a household solve at tol injects
+                # O(tol) noise into B_new, so with house_tol == alm.tol the
+                # finishing phase wanders at 1-7e-6 for ~9 rounds (measured,
+                # EGM at reference scale). Tighten the household tolerance
+                # an order below the ALM target for the finishing rounds —
+                # warm-started solves pay a handful of extra sweeps.
+                house_tol = min(house_tol, 0.1 * alm.tol)
+        if alm.acceleration == "anderson" and not phase_switched:
             B_hist.append(B.copy())
             G_hist.append(B_new.copy())
             B_hist, G_hist = B_hist[-(alm.anderson_depth + 1):], G_hist[-(alm.anderson_depth + 1):]
@@ -423,7 +458,8 @@ def _solve_krusell_smith_impl(
                          "G_hist": [g.tolist() for g in G_hist],
                          "sim_phase": str(np.dtype(sim_dtype)),
                          "best_f32": float(best_f32), "f32_stall": f32_stall,
-                         "f32_in_band": f32_in_band},
+                         "f32_in_band": f32_in_band,
+                         "house_tol": float(house_tol)},
                 arrays={
                     "value": np.asarray(value),
                     "k_opt": np.asarray(k_opt),

@@ -14,9 +14,33 @@ the caller's back.
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 __all__ = ["enable_compilation_cache"]
+
+
+def _host_cpu_tag() -> str:
+    """Short stable tag for the host CPU model. XLA:CPU AOT executables are
+    compiled for the build host's exact feature set; this image's home
+    directory PERSISTS across VM reprovisioning onto different CPU steppings,
+    and loading another stepping's artifacts logs a feature-mismatch error
+    with a documented SIGILL risk (observed live: a 2.70GHz box's cache
+    loaded on a 2.10GHz successor). Keying the directory by CPU model keeps
+    each stepping's artifacts separate."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for ln in f:
+                if ln.startswith(("model name", "flags")):
+                    model += ln
+                    if model.count("\n") >= 2:
+                        break
+    except OSError:
+        import platform
+
+        model = platform.processor()
+    return hashlib.sha256(model.encode()).hexdigest()[:10]
 
 
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
@@ -50,12 +74,13 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     # for a TPU-attached default run and for a CPU fallback run when the
     # TPU tunnel is down.
     backend = jax.default_backend()
+    suffix = f"{backend}-{_host_cpu_tag()}"
     if cache_dir is None:
         cache_dir = os.path.join(
-            os.path.expanduser("~"), ".cache", "aiyagari_tpu", f"xla-{backend}"
+            os.path.expanduser("~"), ".cache", "aiyagari_tpu", f"xla-{suffix}"
         )
     else:
-        cache_dir = f"{cache_dir.rstrip(os.sep)}-{backend}"
+        cache_dir = f"{cache_dir.rstrip(os.sep)}-{suffix}"
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # Cache every program: the workload is dominated by a handful of

@@ -153,12 +153,20 @@ def _measure_numpy_ks_panel(n_runs: int) -> list[float]:
                   for _ in range(n_runs))
 
 
-# Every frozen-denominator entry in BASELINE.json: name -> measure fn
-# returning n_runs sorted seconds. Adding a metric's denominator here gives
-# it the frozen/live policy and --refresh-baseline coverage automatically.
+# Every frozen-denominator entry in BASELINE.json: name -> (measure fn
+# returning n_runs sorted seconds, workload parameters the measurement
+# embodies). Adding a metric's denominator here gives it the frozen/live
+# policy and --refresh-baseline coverage automatically. The workload dict is
+# written into the frozen entry and compared on load: a frozen number
+# measured under different workload parameters (e.g. a changed tol or
+# T_base) must not silently keep feeding vs_baseline.
 _DENOMINATORS = {
-    "numpy_vfi_400": _measure_numpy_vfi400,
-    "numpy_ks_panel_10000x1100": _measure_numpy_ks_panel,
+    "numpy_vfi_400": (_measure_numpy_vfi400,
+                      {"grid": 400, "states": 7, "tol": 1e-5,
+                       "max_iter": 1000}),
+    "numpy_ks_panel_10000x1100": (_measure_numpy_ks_panel,
+                                  {"population": 10_000, "T": 1100,
+                                   "T_base": 300}),
 }
 
 
@@ -169,7 +177,8 @@ def frozen_denominator(name: str, n_live: int = 3) -> dict:
     draw cannot move vs_baseline; always ALSO measure live (median-of-n,
     spread recorded) so the artifact shows this run's actual machine state
     next to the frozen constant."""
-    live = _DENOMINATORS[name](n_live)
+    measure, workload = _DENOMINATORS[name]
+    live = measure(n_live)
     med = live[len(live) // 2]
     out = {
         "baseline_live_seconds": round(med, 4),
@@ -181,12 +190,13 @@ def frozen_denominator(name: str, n_live: int = 3) -> dict:
             frozen = json.load(f).get("frozen_denominators", {}).get(name)
     except (OSError, json.JSONDecodeError):
         pass
-    if frozen and frozen.get("fingerprint") == _machine_fingerprint():
+    if (frozen and frozen.get("fingerprint") == _machine_fingerprint()
+            and frozen.get("workload") == workload):
         out["seconds"] = float(frozen["median_seconds"])
         out["baseline_source"] = "frozen"
     elif frozen:
         out["seconds"] = med
-        out["baseline_source"] = "live-median (frozen fingerprint mismatch)"
+        out["baseline_source"] = "live-median (frozen entry mismatch)"
     else:
         out["seconds"] = med
         out["baseline_source"] = "live-median (no frozen baseline)"
@@ -203,12 +213,13 @@ def refresh_frozen_baseline(n_runs: int = 7) -> dict:
     Run on an IDLE box: a loaded denominator would inflate every future
     vs_baseline."""
     entries = {}
-    for name, measure in _DENOMINATORS.items():
+    for name, (measure, workload) in _DENOMINATORS.items():
         times = measure(n_runs)
         entries[name] = {
             "median_seconds": round(times[len(times) // 2], 4),
             "spread_seconds": [round(times[0], 4), round(times[-1], 4)],
             "n_runs": n_runs,
+            "workload": workload,
             "fingerprint": _machine_fingerprint(),
             "frozen_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
@@ -513,7 +524,8 @@ def bench_ks_agents(quick: bool) -> dict:
         def one(carry, _):
             k0 = jnp.full((pop,), K0, dtype) + 0.0 * carry
             K_ts, _ = simulate_capital_path(k_opt, model.k_grid, model.K_grid,
-                                            z, eps, k0, T=T)
+                                            z, eps, k0, T=T,
+                                            grid_power=float(cfg.k_power))
             return K_ts[-1], K_ts[-1]
         _, lasts = jax.lax.scan(one, jnp.array(0.0, dtype), None, length=reps)
         return lasts[-1]

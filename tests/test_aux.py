@@ -308,20 +308,25 @@ class TestCompileCache:
             )
         }
         try:
-            # Explicit and env dirs are backend-suffixed too: an
-            # unsuffixed shared dir lets a TPU-attached process's XLA:CPU
-            # AOT artifacts (+prefer-no-scatter/-gather machine features)
-            # collide with a pure-CPU process's — the documented SIGILL
-            # hazard (ADVICE round 2).
+            # Explicit and env dirs are suffixed by backend AND host-CPU
+            # tag: an unsuffixed shared dir lets a TPU-attached process's
+            # XLA:CPU AOT artifacts (+prefer-no-scatter/-gather machine
+            # features) collide with a pure-CPU process's — the documented
+            # SIGILL hazard (ADVICE round 2) — and this image reprovisions
+            # the SAME home directory onto different CPU steppings, whose
+            # AOT artifacts also must not mix.
+            from aiyagari_tpu.io_utils.compile_cache import _host_cpu_tag
+
+            suffix = f"-cpu-{_host_cpu_tag()}"
             d = enable_compilation_cache(str(tmp_path / "xla"))
-            assert d == str(tmp_path / "xla") + "-cpu"
+            assert d == str(tmp_path / "xla") + suffix
             assert jax.config.jax_compilation_cache_dir == d
             # Empty env var is the documented opt-out.
             monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", "")
             assert enable_compilation_cache() is None
             # Env var wins over the default location.
             monkeypatch.setenv("AIYAGARI_TPU_COMPILE_CACHE", str(tmp_path / "env"))
-            assert enable_compilation_cache() == str(tmp_path / "env") + "-cpu"
+            assert enable_compilation_cache() == str(tmp_path / "env") + suffix
         finally:
             for name, val in old.items():
                 jax.config.update(name, val)

@@ -56,6 +56,50 @@ class TestStatePolicyInterp:
                                        jnp.array(q[b])))
             assert abs(got[b] - want) < 1e-9, b
 
+    def test_analytic_power_route_matches_stored_knots(self, rng):
+        # The analytic-bucket route (no knot array, closed-form brackets)
+        # agrees with the stored-knot route on a power grid whose segments
+        # are resolvable — including edge-segment extrapolation both sides.
+        from aiyagari_tpu.ops.interp import (
+            state_policy_interp,
+            state_policy_interp_power,
+        )
+
+        lo, hi, power, n = 0.5, 100.0, 2.0, 60
+        x = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        policies = rng.normal(size=(4, n)) * 50
+        states = rng.integers(0, 4, 500)
+        q = rng.uniform(-10, 120, 500)
+        got = np.asarray(state_policy_interp_power(
+            jnp.array(policies), jnp.array(states), jnp.array(q),
+            lo=lo, hi=hi, power=power))
+        want = np.asarray(state_policy_interp(
+            jnp.array(x), jnp.array(policies), jnp.array(states), jnp.array(q)))
+        np.testing.assert_allclose(got, want, atol=1e-9)
+
+    def test_analytic_power_route_collapsed_segments_stay_finite(self, rng):
+        # The K-S power-7 geometry at f32: bottom segments are narrower than
+        # f32 resolution (first segment ~1e-11 at span 1000); the route must
+        # degrade them to the left knot value, never divide by a collapsed
+        # width (the unguarded form walked a panel mean negative — see the
+        # docstring). Values must stay inside the policy row's hull since
+        # every query is in range.
+        from aiyagari_tpu.ops.interp import state_policy_interp_power
+
+        lo, hi, power, n = 1e-4, 1000.0, 7.0, 100
+        policies = jnp.asarray(
+            np.sort(rng.uniform(0.0, 900.0, size=(4, n)), axis=1), jnp.float32)
+        states = jnp.asarray(rng.integers(0, 4, 4000), jnp.int32)
+        q = jnp.asarray(
+            np.geomspace(lo, hi, 4000) * rng.uniform(0.9, 1.1, 4000),
+            jnp.float32)
+        q = jnp.clip(q, lo, hi)
+        got = np.asarray(state_policy_interp_power(
+            policies, states, q, lo=lo, hi=hi, power=power))
+        assert np.isfinite(got).all()
+        assert (got >= float(policies.min()) - 1e-3).all()
+        assert (got <= float(policies.max()) + 1e-3).all()
+
 
 class TestPchip:
     def test_matches_scipy(self, rng):

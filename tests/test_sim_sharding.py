@@ -85,6 +85,39 @@ class TestSharding:
         np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_sh), rtol=1e-12)
         np.testing.assert_allclose(np.asarray(kpop_ref), np.asarray(kpop_sh), rtol=1e-12)
 
+    def test_sharded_panel_matches_unsharded_analytic_route(self):
+        # The analytic-bucket interpolation (grid_power > 0) must shard
+        # identically to the stored-knot route: per-agent elementwise work
+        # plus the same mean collective.
+        cfg = KrusellSmithConfig(k_size=20)
+        model = KrusellSmithModel.from_config(cfg)
+        kz, ke = jax.random.split(jax.random.PRNGKey(11))
+        T, pop = 150, 800
+        z = simulate_aggregate_shocks(model.pz, kz, T=T)
+        eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
+                                        cfg.shocks.u_bad, ke, T=T, population=pop)
+        k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size))
+        gp = float(cfg.k_power)
+
+        K_ref, kpop_ref = simulate_capital_path(
+            k_opt, model.k_grid, model.K_grid, z, eps,
+            jnp.full((pop,), float(model.K_grid[0])), T=T, grid_power=gp)
+        # The two interpolation routes agree on the whole trajectory to f64
+        # interp resolution on this well-resolved 20-point grid.
+        K_onehot, _ = simulate_capital_path(
+            k_opt, model.k_grid, model.K_grid, z, eps,
+            jnp.full((pop,), float(model.K_grid[0])), T=T)
+        np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_onehot),
+                                   rtol=1e-8)
+
+        mesh = make_mesh(("agents",))
+        eps_sh = shard_panel(eps, mesh, batch_axis=1)
+        k0_sh = shard_panel(jnp.full((pop,), float(model.K_grid[0])), mesh, batch_axis=0)
+        K_sh, kpop_sh = simulate_capital_path(
+            k_opt, model.k_grid, model.K_grid, z, eps_sh, k0_sh, T=T, grid_power=gp)
+        np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_sh), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(kpop_ref), np.asarray(kpop_sh), rtol=1e-12)
+
     def test_sharded_mean_is_global(self):
         mesh = make_mesh(("agents",))
         x = jnp.arange(8000, dtype=jnp.float64)
@@ -114,6 +147,18 @@ class TestSharding:
         )
         np.testing.assert_allclose(np.asarray(K_ref), np.asarray(K_sm), rtol=1e-12)
         np.testing.assert_allclose(np.asarray(kpop_ref), np.asarray(kpop_sm), rtol=1e-12)
+        # Same agreement on the analytic-bucket route (grid_power > 0): the
+        # explicit-collective program must thread grid_power through its
+        # cached shard_map build.
+        gp = float(cfg.k_power)
+        K_ga, kpop_ga = simulate_capital_path(
+            k_opt, model.k_grid, model.K_grid, z, eps,
+            jnp.full((pop,), float(model.K_grid[0])), T=T, grid_power=gp)
+        K_sa, kpop_sa = simulate_capital_path_shardmap(
+            mesh, k_opt, model.k_grid, model.K_grid, z, eps,
+            jnp.full((pop,), float(model.K_grid[0])), grid_power=gp)
+        np.testing.assert_allclose(np.asarray(K_ga), np.asarray(K_sa), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(kpop_ga), np.asarray(kpop_sa), rtol=1e-12)
 
     def test_shardmap_panel_rejects_ragged_population(self):
         mesh = make_mesh(("agents",))
