@@ -454,6 +454,22 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
             "tol_effective": float(getattr(sol, "tol_effective", tol)),
         }
 
+    if scale_solver == "egm" and not quick:
+        # Accuracy IN the artifact, not just speed: off-grid Euler residuals
+        # (utils/accuracy.py, Judd's E_EE, log10 consumption units) of the
+        # shipped solution, over unconstrained midpoints — the noise-floor
+        # stop's effect is then visible as solution accuracy, which is the
+        # quantity the f64 yardstick (BENCHMARKS.md) shows it preserves.
+        from aiyagari_tpu.utils.accuracy import euler_equation_errors
+
+        errs, mask = euler_equation_errors(
+            sol.policy_c, sol.policy_k, model.a_grid, model.s, model.P,
+            r, w, model.amin, sigma=model.preferences.sigma,
+            beta=model.preferences.beta)
+        vals = np.asarray(errs)[np.asarray(mask)]
+        strict["euler_log10_median"] = round(float(np.median(vals)), 2)
+        strict["euler_log10_p99"] = round(float(np.percentile(vals, 99)), 2)
+
     # Utilization model: final-stage sweeps only (the coarse ladder stages
     # are ~7% of wall-clock at 400k — BENCHMARKS.md stage timings), over the
     # whole measured time, so the fractions are conservative. Modeled for the

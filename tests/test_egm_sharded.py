@@ -245,8 +245,11 @@ class TestShardedEGMSolver:
         # requirement of the real EGM endogenous grids at their FIRST sweep
         # (the per-sweep capped-need profile starts at its 1.111L maximum)
         # — the solver must raise the flag and NaN-poison, never return
-        # silently wrong brackets.
-        n = 40_960
+        # silently wrong brackets. Smallest geometry where the B = L floor
+        # binds (the escape precondition): L = n/8 must reach the one-window
+        # floor M*KB = 3,072, i.e. n = 24,576 — the claim is L-relative, so
+        # larger grids add compile time, not coverage (was 40,960).
+        n = 24_576
         m, w, C0, kw = _egm_problem(n)
         kw.update(tol=1e-30, max_iter=2)
         mesh = make_mesh(("grid",))
