@@ -380,6 +380,10 @@ def _solve_krusell_smith_impl(
             "seconds": time.perf_counter() - it_t0,
             "house_dtype": str(np.dtype(dtype)),
             "sim_dtype": str(np.dtype(sim_dtype)),
+            # The tolerance THIS round's household solve ran at — tightened
+            # to alm.tol/10 by the mixed-phase switch, so switch behavior is
+            # observable in the records (and testable across a resume).
+            "house_tol": float(house_tol),
         }
         records.append(rec)
         if on_iteration is not None:

@@ -262,6 +262,39 @@ class TestCheckpoint:
         for r_full, r_res in zip(full.per_iteration[2:], resumed.per_iteration[2:]):
             np.testing.assert_allclose(r_res["B"], r_full["B"], atol=1e-10)
 
+    def test_ks_resume_restores_tightened_house_tol(self, tmp_path):
+        # The mixed-phase switch tightens the household tolerance to
+        # alm.tol/10 for the finishing rounds; a resume mid-finishing-phase
+        # must keep it (a revert to the loose tol would re-introduce the
+        # solver-noise hovering the switch exists to break). Simulate the
+        # post-switch state by rewriting the saved scalar, as the switch
+        # itself only triggers at real scale.
+        cfg = KrusellSmithConfig(k_size=15)
+        alm = ALMConfig(T=120, population=300, discard=30, max_iter=3, seed=2)
+        kw = dict(method="vfi",
+                  solver=SolverConfig(method="vfi", tol=1e-4, max_iter=50, howard_steps=10))
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            assert rec["house_tol"] == 1e-4    # pre-switch: the solver tol
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_krusell_smith(cfg, alm=alm, on_iteration=interrupt,
+                                checkpoint_dir=tmp_path, **kw)
+        path = tmp_path / "ks_vfi.ckpt.npz"
+        scalars, arrays = load_checkpoint(path)
+        scalars["house_tol"] = 1e-7            # as the phase switch would set
+        save_checkpoint(path, scalars=scalars, arrays=arrays)
+        seen = []
+        resumed = solve_krusell_smith(cfg, alm=alm, checkpoint_dir=tmp_path,
+                                      on_iteration=lambda r: seen.append(r["house_tol"]),
+                                      **kw)
+        assert seen and all(t == 1e-7 for t in seen)
+
 
 class TestReports:
     def test_equilibrium_report(self, tmp_path):
