@@ -233,8 +233,11 @@ def _solve_krusell_smith_impl(
     # Initial cross-section at K_grid[0] (:100): Monte-Carlo households for the
     # panel closure, an (employment, capital) histogram for the Young closure.
     if use_histogram:
-        u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
-        cross = initial_distribution(k_grid_sim, K_grid_sim, u0, sim_dtype)
+        # Period-1 unemployment rate: ONE host read of z_path[0], reused by
+        # the per-round rescale below (a per-round read costs a transport
+        # round trip each iteration; the panel closure never needs it).
+        u0_hist = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
+        cross = initial_distribution(k_grid_sim, K_grid_sim, u0_hist, sim_dtype)
     else:
         cross = jnp.full((alm.population,), float(model.K_grid[0]), sim_dtype)
         if panel_sharding is not None:
@@ -342,8 +345,7 @@ def _solve_krusell_smith_impl(
             # marginal is u(z_0) at t=0 (the final-period marginal is
             # u(z_{T-1})) — rescale the rows so the exact-u(z_t) invariant
             # holds every iteration. Idempotent on the first pass.
-            u0 = sh.u_good if int(z_path[0]) == 0 else sh.u_bad
-            target = jnp.asarray([1.0 - u0, u0], sim_dtype)
+            target = jnp.asarray([1.0 - u0_hist, u0_hist], sim_dtype)
             row_mass = jnp.sum(cross, axis=1, keepdims=True)
             cross = cross * (target[:, None] / jnp.maximum(row_mass, 1e-300))
             K_ts, cross_new = distribution_capital_path(
@@ -363,9 +365,18 @@ def _solve_krusell_smith_impl(
         # Regression always in f64: the closed-form normal-equation sums over
         # ~1,000 log-K terms lose ~3 digits in f32, directly polluting B_new
         # at the 1e-6 tolerance; casting the [T] path costs nothing.
-        B_new, r2_dev = alm_regression(K_ts.astype(jnp.float64), z_path, alm.discard)
+        B_new_dev, r2_dev = alm_regression(K_ts.astype(jnp.float64), z_path, alm.discard)
+        # ONE batched host fetch per round. The sequential route — five
+        # separate reads (B_new, r2, solver iterations/distance, and the
+        # whole [T] path pulled just for its mean) — costs ~0.1 s of
+        # transport latency EACH on this image's remote-TPU tunnel, most of
+        # the measured 0.65 s marginal round (same lesson as the EGM
+        # ladder's _fetch_scalars; BENCHMARKS.md round 3).
+        B_new, r2, sol_iters, sol_dist, K_mean = jax.device_get(
+            (B_new_dev, r2_dev, sol.iterations, sol.distance,
+             jnp.mean(K_ts[alm.discard:])))
         B_new = np.asarray(B_new, np.float64)
-        r2 = np.asarray(r2_dev, np.float64)
+        r2 = np.asarray(r2, np.float64)
         diff_B = float(np.max(np.abs(B_new - B)))
 
         rec = {
@@ -374,9 +385,9 @@ def _solve_krusell_smith_impl(
             "diff_B": diff_B,
             "r2_good": float(r2[0]),
             "r2_bad": float(r2[1]),
-            "solver_iterations": int(sol.iterations),
-            "solver_distance": float(sol.distance),
-            "K_mean": float(np.mean(np.asarray(K_ts)[alm.discard:])),
+            "solver_iterations": int(sol_iters),
+            "solver_distance": float(sol_dist),
+            "K_mean": float(K_mean),
             "seconds": time.perf_counter() - it_t0,
             "house_dtype": str(np.dtype(dtype)),
             "sim_dtype": str(np.dtype(sim_dtype)),
