@@ -8,9 +8,11 @@ exactly where it stopped — the preemption-tolerance pattern TPU pods require
 (SURVEY.md §5.3).
 
 Format: a single .npz per run (atomic replace), arrays + a JSON-encoded
-scalar-state blob. Policies at reference scale are MBs; at scaled-up grids
-checkpoint from the sharded representation via orbax instead (the API here is
-deliberately the same shape).
+scalar-state blob. Sharded device arrays (the mesh routes' policies and
+cross-sections) are packed PER SHARD: each addressable shard is fetched and
+stored as its own entry, so no step of save or (sharding-aware) restore
+ever materializes the full array on host — the memory-scaling property the
+ring-sharded solvers exist to provide (SURVEY.md §5.4, VERDICT round 3 #7).
 """
 
 from __future__ import annotations
@@ -23,16 +25,151 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["save_checkpoint", "load_checkpoint", "config_fingerprint", "CheckpointManager"]
+__all__ = ["save_checkpoint", "load_checkpoint", "config_fingerprint",
+           "restore_array", "CheckpointManager"]
+
+_SHARD_META_KEY = "__shard_meta__"
+
+
+def _is_distributed(v) -> bool:
+    """A jax.Array whose sharding actually splits the data (a replicated or
+    single-device array round-trips through np.asarray unchanged)."""
+    try:
+        import jax
+
+        return (isinstance(v, jax.Array)
+                and not v.sharding.is_fully_replicated)
+    except ImportError:                                  # pragma: no cover
+        return False
+
+
+def _norm_index(index, shape) -> tuple:
+    """Canonical ((start, stop), ...) form of a shard's index tuple."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        assert step == 1
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _pack_arrays(arrays: Optional[dict]) -> tuple[dict, dict]:
+    """Split distributed jax.Arrays into per-shard entries (name__shard{i})
+    plus an index-map meta blob; pass everything else to np.asarray whole.
+    The per-shard np.asarray fetches one shard-sized buffer at a time.
+    Shards replicated over a second mesh axis (e.g. a ("agents","grid")
+    mesh) repeat the same index — deduped here, so the file carries each
+    distinct slice once. Multi-process arrays (shards on non-addressable
+    devices) are refused loudly: each process would silently write only
+    its shards to the same path and a resume would read a half-empty
+    checkpoint — coordinated multi-host checkpointing is an orbax job,
+    not this format's."""
+    plain: dict = {}
+    meta: dict = {}
+    for k, v in (arrays or {}).items():
+        if _is_distributed(v):
+            if not v.is_fully_addressable:
+                raise ValueError(
+                    f"checkpoint array {k!r} spans multiple processes; "
+                    "per-shard npz checkpointing is single-process only — "
+                    "gather it or use a coordinated (orbax) checkpointer")
+            by_index = {}
+            for sh in v.addressable_shards:
+                by_index.setdefault(_norm_index(sh.index, v.shape), sh)
+            indices = []
+            for i, (idx, sh) in enumerate(sorted(by_index.items())):
+                plain[f"{k}__shard{i}"] = np.asarray(sh.data)
+                indices.append([list(p) for p in idx])
+            meta[k] = {"shape": list(v.shape), "dtype": str(v.dtype),
+                       "indices": indices}
+        else:
+            plain[k] = np.asarray(v)
+    return plain, meta
+
+
+def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
+                  dtype=None):
+    """Reassemble array `name` from a checkpoint's (scalars, arrays) pair.
+
+    Plain entries return as stored. Per-shard entries (written by the
+    sharded save path) are restored WITHOUT host materialization when
+    `sharding` (a NamedSharding matching the original mesh layout) is
+    given: jax.make_array_from_callback places each stored shard directly
+    on its device. With a different sharding — or none — the shards are
+    assembled into one host array first (the resharding fallback, which
+    does materialize; callers resuming a mesh run pass the mesh's
+    sharding). Returns None when the name is absent entirely."""
+    meta = (scalars.get(_SHARD_META_KEY) or {}).get(name)
+    if meta is None:
+        v = arrays.get(name)
+        if v is None:
+            return None
+        if dtype is not None:
+            v = np.asarray(v, dtype)
+        if sharding is not None:
+            # Plain (legacy / unsharded-save) entry resumed under a mesh:
+            # place it once here, so callers never pay an implicit
+            # full-array reshard inside their first jitted step.
+            import jax
+
+            return jax.device_put(v, sharding)
+        return v
+    shape = tuple(meta["shape"])
+    lookup = {tuple(tuple(p) for p in idx): arrays[f"{name}__shard{i}"]
+              for i, idx in enumerate(meta["indices"])}
+    if dtype is not None:
+        lookup = {k: np.asarray(v, dtype) for k, v in lookup.items()}
+    if sharding is not None:
+        import jax
+
+        full = None
+
+        def cb(index):
+            nonlocal full
+            key = _norm_index(index, shape)
+            hit = lookup.get(key)
+            if hit is None:
+                # Mesh geometry changed between save and resume: assemble
+                # the stored shards ONCE and serve every request by slice.
+                if full is None:
+                    full = _assemble(lookup, shape)
+                hit = full[tuple(slice(a, b) for a, b in key)]
+            return hit
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+    return _assemble(lookup, shape)
+
+
+def _assemble(lookup: dict, shape) -> np.ndarray:
+    first = next(iter(lookup.values()))
+    out = np.empty(shape, dtype=first.dtype)
+    covered = np.zeros(shape, dtype=bool)
+    for key, data in lookup.items():
+        sl = tuple(slice(a, b) for a, b in key)
+        out[sl] = data
+        covered[sl] = True
+    if not covered.all():
+        # A gap here means the checkpoint was written by a process that
+        # did not hold every shard — surfacing it beats silently returning
+        # uninitialized memory as a "restored" array.
+        raise ValueError(
+            "stored shards do not tile the full array "
+            f"(shape {shape}): incomplete (multi-process?) checkpoint")
+    return out
 
 
 def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> None:
-    """Atomically write scalar state (JSON-serializable) + named arrays."""
+    """Atomically write scalar state (JSON-serializable) + named arrays.
+    Distributed jax.Arrays among `arrays` are stored per shard
+    (_pack_arrays) and restored via restore_array."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    packed, shard_meta = _pack_arrays(arrays)
+    if shard_meta:
+        scalars = {**scalars, _SHARD_META_KEY: shard_meta}
     payload = {"__scalars__": np.frombuffer(json.dumps(scalars).encode(), dtype=np.uint8)}
-    for k, v in (arrays or {}).items():
-        payload[k] = np.asarray(v)
+    for k, v in packed.items():
+        payload[k] = v
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
