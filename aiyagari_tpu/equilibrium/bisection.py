@@ -73,10 +73,11 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
     value function (VFI) or consumption policy (EGM).
 
     `mesh` (a Mesh with a "grid" axis, from BackendConfig.mesh_axes) routes
-    the exogenous-labor EGM solve through the DISTRIBUTED fixed point with
-    ring-redistributed knots (solvers/egm_sharded.py) — O(na/D) per-device
-    memory. Escapes, non-power grids, and the other solver families fall
-    back to the single-device routes below."""
+    BOTH EGM families through their DISTRIBUTED fixed points with
+    ring-redistributed knots (solvers/egm_sharded.py: the exogenous solve
+    rings the knot shards, the labor solve rings stacked (knot, value)
+    pairs) — O(na/D) per-device memory. Escapes, non-power grids, and the
+    VFI family fall back to the single-device routes below."""
     prefs = model.preferences
     tech = model.config.technology
     w = wage_from_r(r, tech.alpha, tech.delta)
@@ -100,11 +101,14 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
         )
     if solver.method == "egm":
         from aiyagari_tpu.parallel.ring import ring_slab_fits
-        from aiyagari_tpu.solvers.egm import LADDER_MIN_FINE, ladder_warm_start
+        from aiyagari_tpu.solvers.egm import (
+            LADDER_MIN_FINE,
+            ladder_warm_start,
+            ladder_warm_start_labor,
+        )
 
         if (
             mesh is not None
-            and not model.config.endogenous_labor
             and model.config.grid.power > 0
             and na % int(mesh.shape["grid"]) == 0
             # Slab-geometry soundness: grids too small for the ring slab
@@ -113,28 +117,51 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             # distribute there anyway.
             and ring_slab_fits(na, int(mesh.shape["grid"]))
         ):
-            from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+            from aiyagari_tpu.solvers.egm_sharded import (
+                solve_aiyagari_egm_labor_sharded,
+                solve_aiyagari_egm_sharded,
+            )
 
+            labor = model.config.endogenous_labor
             ladder_C0 = None
             C0 = warm_start
             if C0 is None and solver.grid_sequencing and na > LADDER_MIN_FINE:
-                ladder_C0 = ladder_warm_start(
-                    model.a_grid, model.s, model.P, r, w, model.amin,
-                    sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
-                    max_iter=solver.max_iter,
-                    grid_power=float(model.config.grid.power),
-                    relative_tol=solver.relative_tol,
-                )
+                if labor:
+                    ladder_C0 = ladder_warm_start_labor(
+                        model.a_grid, model.s, model.P, r, w, model.amin,
+                        sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi,
+                        eta=prefs.eta, tol=solver.tol,
+                        max_iter=solver.max_iter,
+                        grid_power=float(model.config.grid.power),
+                        relative_tol=solver.relative_tol,
+                    )
+                else:
+                    ladder_C0 = ladder_warm_start(
+                        model.a_grid, model.s, model.P, r, w, model.amin,
+                        sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                        max_iter=solver.max_iter,
+                        grid_power=float(model.config.grid.power),
+                        relative_tol=solver.relative_tol,
+                    )
                 C0 = ladder_C0
             if C0 is None:
                 C0 = _initial_consumption_guess(model, r, w)
-            sol = solve_aiyagari_egm_sharded(
-                mesh, C0, model.a_grid, model.s, model.P, r, w, model.amin,
-                sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
-                max_iter=solver.max_iter,
-                relative_tol=solver.relative_tol,
-                grid_power=model.config.grid.power,
-            )
+            if labor:
+                sol = solve_aiyagari_egm_labor_sharded(
+                    mesh, C0, model.a_grid, model.s, model.P, r, w, model.amin,
+                    sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi,
+                    eta=prefs.eta, tol=solver.tol, max_iter=solver.max_iter,
+                    relative_tol=solver.relative_tol,
+                    grid_power=model.config.grid.power,
+                )
+            else:
+                sol = solve_aiyagari_egm_sharded(
+                    mesh, C0, model.a_grid, model.s, model.P, r, w, model.amin,
+                    sigma=prefs.sigma, beta=prefs.beta, tol=solver.tol,
+                    max_iter=solver.max_iter,
+                    relative_tol=solver.relative_tol,
+                    grid_power=model.config.grid.power,
+                )
             if not bool(sol.escaped):
                 return sol
             # Slab overflow: fall through to the single-device routes (the
@@ -313,7 +340,21 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         start_it = min(sc["iteration"] + 1, eq.max_iter - 1)
         r_hist, ks_hist, kd_hist = r_hist[:start_it], ks_hist[:start_it], kd_hist[:start_it]
         records = records[:start_it]
-        warm = jnp.asarray(arrays["warm"], model.dtype)
+        # A warm start saved from the mesh route is stored per shard; with
+        # the mesh available it is restored shard-by-shard straight onto
+        # the devices (io_utils/checkpoint.restore_array), never assembled
+        # on host.
+        from aiyagari_tpu.io_utils.checkpoint import restore_array
+
+        warm_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            warm_sharding = NamedSharding(mesh, PartitionSpec(None, "grid"))
+        warm = restore_array(sc, arrays, "warm", sharding=warm_sharding,
+                             dtype=np.dtype(str(jnp.dtype(model.dtype))))
+        if isinstance(warm, np.ndarray):   # meshless restore stays host-side
+            warm = jnp.asarray(warm, model.dtype)
         aggregator.restore(start_it, arrays)
         sol = None
     else:
@@ -364,7 +405,10 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
                     "r_hist": r_hist, "ks_hist": ks_hist, "kd_hist": kd_hist,
                     "records": records,
                 },
-                arrays={"warm": np.asarray(warm), **aggregator.arrays()},
+                # `warm` passes through as the device array: if the mesh
+                # route left it sharded, save_checkpoint packs it per shard
+                # without a host gather (io_utils/checkpoint._pack_arrays).
+                arrays={"warm": warm, **aggregator.arrays()},
             )
 
     if mgr is not None:

@@ -212,9 +212,13 @@ def state_policy_interp_power(policies: jnp.ndarray, state_idx: jnp.ndarray,
                               power: float) -> jnp.ndarray:
     """state_policy_interp for an ANALYTIC power grid x[i] = lo +
     (hi-lo)*(i/(n-1))**power: the bucket index and both bracketing knot
-    values come from closed forms, so the only data-dependent work is one
-    hat-weighted reduction over the knot axis — elementwise + sum, no
-    HIGHEST matmuls, no [B, n] one-hot materialization. Queries below lo
+    values come from closed forms, so the data-dependent work reduces to a
+    hat-weighted reduction over the knot axis. The [B, n] hat-weight array
+    `w` and the per-state-masked policy rows ARE materialized (and the
+    state-selection loop scales with ns — revisit for large state spaces);
+    the measured win over state_policy_interp comes from eliminating its
+    HIGHEST-precision matmuls and searchsorted, not from avoiding [B, n]
+    intermediates. Queries below lo
     clamp into the first segment and above hi into the last (edge-segment
     extrapolation, matching state_policy_interp up to the analytic
     bracket's f32 rounding; agreement is O(segment width) * eps — measured
@@ -299,6 +303,31 @@ def _finish_inverse(cnt, x0, x1, xr, *, lo, hi, power, n_q, n_k, q_vals=None):
     )
     out_below = gk_of(jnp.int32(0)) + (q_vals - xr[0]) * sl
     return jnp.where(below, out_below, out)
+
+
+def _finish_monotone(x0, x1, y0, y1, xr, yr, q_vals):
+    """Shared tail of the monotone-value interpolation: bracket data ->
+    interpolated values. (x0, x1)/(y0, y1) are the bracketing knots/values
+    (±inf where absent); only the first two entries of the knot/value rows
+    (xr, yr) are read (the below-range extrapolation slope), so callers
+    holding a shard may pass just the global head pairs. q_vals is this
+    caller's query slice. Used by interp_monotone_power_grid and the
+    ring-sharded route (parallel/ring.ring_interp_local), so the edge
+    semantics — nearest above the top knot, first-segment linear
+    extrapolation below the first — cannot drift between them."""
+    dtype = xr.dtype
+    have_lo = jnp.isfinite(x0)          # some knot strictly below q
+    have_hi = jnp.isfinite(x1)          # some knot at-or-above q
+    dx = x1 - x0
+    ok = have_lo & have_hi & (dx > 0)
+    tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
+    out = jnp.where(have_lo, y0, yr[0]) + tq * (y1 - jnp.where(have_lo, y0, yr[0]))
+    # Above the top knot: nearest (last) value.
+    out = jnp.where(have_lo & ~have_hi, y0, out)
+    # Below the first knot: linear extrapolation on the first segment.
+    sl = (yr[1] - yr[0]) / jnp.maximum(xr[1] - xr[0], jnp.finfo(dtype).tiny)
+    out_below = yr[0] + (q_vals - xr[0]) * sl
+    return jnp.where(~have_lo, out_below, out)
 
 
 def inverse_interp_power_grid(x: jnp.ndarray, lo: float, hi: float, power: float,
@@ -498,23 +527,11 @@ def interp_monotone_power_grid(x: jnp.ndarray, y: jnp.ndarray, lo: float,
     span = hi - lo
     q_vals = lo + span * (jnp.arange(n_q).astype(dtype) / (n_q - 1)) ** power
 
-    def finish(x0, x1, y0, y1, xr, yr):
-        have_lo = jnp.isfinite(x0)          # some knot strictly below q
-        have_hi = jnp.isfinite(x1)          # some knot at-or-above q
-        dx = x1 - x0
-        ok = have_lo & have_hi & (dx > 0)
-        tq = jnp.where(ok, (q_vals - x0) / jnp.where(ok, dx, 1.0), 0.0)
-        out = jnp.where(have_lo, y0, yr[0]) + tq * (y1 - jnp.where(have_lo, y0, yr[0]))
-        # Above the top knot: nearest (last) value.
-        out = jnp.where(have_lo & ~have_hi, y0, out)
-        # Below the first knot: linear extrapolation on the first segment.
-        sl = (yr[1] - yr[0]) / jnp.maximum(xr[1] - xr[0], jnp.finfo(dtype).tiny)
-        out_below = yr[0] + (q_vals - xr[0]) * sl
-        return jnp.where(~have_lo, out_below, out)
-
     _, x0, x1, y0, y1, escaped = _bracket_power_grid(x, y, lo, hi, power, n_q)
-    out = jax.vmap(finish)(x0, x1, y0, y1, x.reshape((-1, n_k)),
-                           y.reshape((-1, n_k)))
+    out = jax.vmap(
+        lambda a0, a1, b0, b1, xr, yr: _finish_monotone(a0, a1, b0, b1, xr, yr,
+                                                        q_vals)
+    )(x0, x1, y0, y1, x.reshape((-1, n_k)), y.reshape((-1, n_k)))
     out = jnp.where(escaped, jnp.nan, out).reshape(x.shape[:-1] + (n_q,))
     return (out, escaped) if with_escape else out
 

@@ -57,11 +57,14 @@ from aiyagari_tpu.ops.interp import (
     _INV_QBLOCK,
     _INV_WBLOCKS,
     _finish_inverse,
+    _finish_monotone,
 )
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
 
-__all__ = ["DEFAULT_CAPACITY", "inverse_interp_power_grid_ring",
-           "ring_inverse_local", "ring_buffer_size", "ring_slab_fits"]
+__all__ = ["DEFAULT_CAPACITY", "interp_monotone_power_grid_ring",
+           "inverse_interp_power_grid_ring", "ring_inverse_local",
+           "ring_interp_local", "ring_buffer_size", "ring_slab_assemble",
+           "ring_slab_fits"]
 
 # The default per-device slab capacity (in shards): the measured EGM slab
 # requirement is 1.11 shards (module docstring); 2.0 is ~80% headroom.
@@ -93,19 +96,57 @@ def ring_buffer_size(n_k: int, D: int, capacity: float) -> int:
     return max(-(-B // KB) * KB, -(-L // KB) * KB, M * KB)
 
 
-def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
-                       lo: float, hi: float, power: float,
-                       capacity: float = DEFAULT_CAPACITY, pad: int = 8):
-    """Shard-local body of the ring-redistribution inversion — call from
-    INSIDE a shard_map over `axis`.
+def ring_slab_assemble(visit, s_start, *, B: int, n_k: int, axis: str,
+                       D: int):
+    """Step 2 of the ring redistribution, shared by every sharded kernel
+    that needs a contiguous global knot slab resident per device: rotate
+    the [C, R, L] stacked shard channels around the ring (D-1
+    `lax.ppermute` rounds) and align each visiting shard into the [C, R, B]
+    buffer with one roll + mask per row. Row r's buffer covers global
+    positions [s_start[r], s_start[r] + B); positions outside [0, n_k)
+    carry ±inf sentinels (-inf below, +inf at-or-above), which make global
+    bracket counts telescope exactly and read as out-of-range knots to
+    every downstream kernel. Call from INSIDE a shard_map over `axis`;
+    global order: device d owns positions [d*L, (d+1)*L)."""
+    C, R, L = visit.shape
+    dtype = visit.dtype
+    dev = jax.lax.axis_index(axis)
+    neg = jnp.array(-jnp.inf, dtype)
+    pos = jnp.array(jnp.inf, dtype)
+    g0 = s_start[:, None] + jnp.arange(B)[None, :]                  # [R, B]
+    buf0 = jnp.where(g0 < 0, neg, pos)
+    buf = jnp.broadcast_to(buf0[None], (C, R, B))
+    perm = [(i, (i - 1) % D) for i in range(D)]
+    bpos = jnp.arange(B)
 
-    xl [R, n_k/D] is this device's contiguous sorted-knot shard (global
-    order: device d owns indices [d·L, (d+1)·L)), q [n_q/D] its slice of
-    the analytic power query grid. Returns (out [R, n_q/D], escaped int32
-    scalar pmax'd across the axis), `out` already NaN-poisoned on escape.
-    Semantics match ops/interp.inverse_interp_power_grid exactly (strict-<
-    brackets, below-range extrapolation, top truncation).
-    """
+    def merge_row(bufr, vr, off):
+        padded = jnp.concatenate([vr, jnp.full((B - L,), pos)])
+        rolled = jnp.roll(padded, off)
+        m = (bpos >= off) & (bpos < off + L)
+        return jnp.where(m, rolled, bufr)
+
+    merge = jax.vmap(jax.vmap(merge_row), in_axes=(0, 0, None))
+    for t in range(D):
+        f = (dev + t) % D                       # visiting shard's global id
+        off = f * L - s_start                   # [R] buffer offset
+        buf = merge(buf, visit, off)
+        if t < D - 1:
+            visit = jax.lax.ppermute(visit, axis, perm)
+    return buf
+
+
+def _ring_bracket_local(xl, yl, q, *, axis: str, D: int, n_k: int, n_q: int,
+                        lo: float, hi: float, power: float,
+                        capacity: float, pad: int):
+    """Shared slab assembly + windowed bracket of the ring-sharded kernels:
+    steps 1-3 of the module docstring for this device's knot shard xl
+    [R, n_k/D] and query slice q [n_q/D], optionally carrying a VALUE shard
+    yl of the same shape through the identical rotation/merge (the monotone
+    value interpolation needs the bracketing values; the inverse
+    reconstructs them from the count). Returns (cnt, x0, x1, y0, y1,
+    escaped) with y0/y1 None when yl is None — the sharded mirror of
+    ops/interp._bracket_power_grid, and the single place the slab geometry
+    lives so the inverse and value kernels cannot drift."""
     R, L = xl.shape
     nq_loc = q.shape[-1]
     dtype = xl.dtype
@@ -118,6 +159,7 @@ def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
     Lw = M * KB
     nkb_buf = B // KB
     nb = -(-nq_loc // S)
+    with_y = yl is not None
 
     # 1. Exact global bracket starts: every device's first query is analytic,
     # so each device counts its own knots below ALL of them and one psum
@@ -129,33 +171,21 @@ def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
     c_all = jax.lax.psum(cnt_part, axis)                            # [R, D]
     s_start = c_all[:, dev] - pad                                   # [R]
 
-    # 2. Assemble the buffer: rotate the shards around the ring; align each
-    # visiting shard into the buffer with one roll + mask per row.
-    g0 = s_start[:, None] + jnp.arange(B)[None, :]                  # [R, B]
-    buf = jnp.where(g0 < 0, neg, pos)
-    perm = [(i, (i - 1) % D) for i in range(D)]
-    bpos = jnp.arange(B)
-
-    def merge_row(bufr, vr, off):
-        padded = jnp.concatenate([vr, jnp.full((B - L,), pos)])
-        rolled = jnp.roll(padded, off)
-        m = (bpos >= off) & (bpos < off + L)
-        return jnp.where(m, rolled, bufr)
-
-    visit = xl
-    for t in range(D):
-        f = (dev + t) % D                       # visiting shard's global id
-        off = f * L - s_start                   # [R] buffer offset
-        buf = jax.vmap(merge_row)(buf, visit, off)
-        if t < D - 1:
-            visit = jax.lax.ppermute(visit, axis, perm)
+    # 2. Assemble the buffer(s): the value shard rides the SAME rotation as
+    # a stacked channel (one ppermute per round, not two), and shares the
+    # ±inf sentinels: at positions outside [0, n_k) the x sentinel decides
+    # the comparison mask and the matching y sentinel keeps the masked
+    # max/min reductions unaffected.
+    visit = jnp.stack([xl, yl]) if with_y else xl[None]             # [C, R, L]
+    buf = ring_slab_assemble(visit, s_start, B=B, n_k=n_k, axis=axis, D=D)
+    C = buf.shape[0]
 
     # 3. Two-level windowed bracket against the local buffer (the geometry
     # of ops/interp._bracket_power_grid's windowed route, buffer-offset).
     jq = jnp.minimum(jnp.arange(nb * S), nq_loc - 1)    # clamp query padding
     qs = q[jq].reshape(nb, S)
 
-    def bracket_row(bufr, s0):
+    def bracket_row(bufr, byr, s0):
         s_first = jnp.sum(bufr[None, :] < qs[:, :1], axis=1).astype(jnp.int32)
         ab = jnp.minimum(jnp.clip(s_first - 1, 0, B - 1) // KB, nkb_buf - M)
         seg = bufr.reshape(nkb_buf, KB)[ab[:, None] + jnp.arange(M)[None, :]]
@@ -174,12 +204,43 @@ def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
         def cut(a):
             return a.reshape(-1)[:nq_loc]
 
-        return cut(cnt), cut(x0), cut(x1), esc
+        if not with_y:
+            return cut(cnt), cut(x0), cut(x1), cut(x0), cut(x1), esc
+        # The y brackets come from the SAME mask: y is monotone (caller's
+        # contract, cf. interp_monotone_power_grid), so the masked max/min
+        # are exactly the bracket's endpoint values whenever the x bracket
+        # is exact (same saturation rule).
+        segy = byr.reshape(nkb_buf, KB)[ab[:, None] + jnp.arange(M)[None, :]]
+        segy = segy.reshape(nb, Lw)
+        y0 = jnp.max(jnp.where(lt, segy[:, None, :], neg), axis=-1)
+        y1 = jnp.min(jnp.where(lt, pos, segy[:, None, :]), axis=-1)
+        return cut(cnt), cut(x0), cut(x1), cut(y0), cut(y1), esc
 
-    cnt, x0, x1, esc_rows = jax.vmap(bracket_row)(buf, s_start)
+    cnt, x0, x1, y0, y1, esc_rows = jax.vmap(bracket_row)(
+        buf[0], buf[C - 1], s_start)
     escaped = jax.lax.pmax(jnp.any(esc_rows).astype(jnp.int32), axis)
+    return cnt, x0, x1, (y0 if with_y else None), (y1 if with_y else None), \
+        escaped
 
-    # 4. Shared finish (below-range slope needs the global first knot pair:
+
+def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
+                       lo: float, hi: float, power: float,
+                       capacity: float = DEFAULT_CAPACITY, pad: int = 8):
+    """Shard-local body of the ring-redistribution inversion — call from
+    INSIDE a shard_map over `axis`.
+
+    xl [R, n_k/D] is this device's contiguous sorted-knot shard (global
+    order: device d owns indices [d·L, (d+1)·L)), q [n_q/D] its slice of
+    the analytic power query grid. Returns (out [R, n_q/D], escaped int32
+    scalar pmax'd across the axis), `out` already NaN-poisoned on escape.
+    Semantics match ops/interp.inverse_interp_power_grid exactly (strict-<
+    brackets, below-range extrapolation, top truncation).
+    """
+    cnt, x0, x1, _, _, escaped = _ring_bracket_local(
+        xl, None, q, axis=axis, D=D, n_k=n_k, n_q=n_q, lo=lo, hi=hi,
+        power=power, capacity=capacity, pad=pad)
+
+    # Shared finish (below-range slope needs the global first knot pair:
     # all-gather the tiny per-shard heads, take device 0's).
     head2 = jax.lax.all_gather(xl[:, :2], axis)[0]
     out = jax.vmap(
@@ -188,6 +249,37 @@ def ring_inverse_local(xl, q, *, axis: str, D: int, n_k: int, n_q: int,
             q_vals=q,
         )
     )(cnt, x0, x1, head2)
+    out = jnp.where(escaped > 0, jnp.nan, out)
+    return out, escaped
+
+
+def ring_interp_local(xl, yl, q, *, axis: str, D: int, n_k: int, n_q: int,
+                      lo: float, hi: float, power: float,
+                      capacity: float = DEFAULT_CAPACITY, pad: int = 8):
+    """Shard-local monotone VALUE interpolation with ring-redistributed
+    (knot, value) pairs — call from INSIDE a shard_map over `axis`. The
+    sharded form of ops/interp.interp_monotone_power_grid (the labor-EGM
+    hot operation, Aiyagari_Endogenous_Labor_EGM.m:90): xl [R, n_k/D] this
+    device's sorted-knot shard, yl its monotone value shard (monotonicity
+    is the caller's contract, as in the unsharded kernel), q [n_q/D] its
+    analytic query slice. Returns (out [R, n_q/D], escaped int32 scalar
+    pmax'd across the axis), NaN-poisoned on escape. The value shard rides
+    the knot rotation as a stacked channel, so the ring traffic is 2x the
+    inversion's — still one O(n/D) slab per device, never the full row.
+    """
+    cnt, x0, x1, y0, y1, escaped = _ring_bracket_local(
+        xl, yl, q, axis=axis, D=D, n_k=n_k, n_q=n_q, lo=lo, hi=hi,
+        power=power, capacity=capacity, pad=pad)
+    del cnt  # the value kernel reads brackets, not counts
+
+    # Global head pairs for the below-range extrapolation slope: one
+    # all-gather of the stacked [2, R, 2] shard heads, take device 0's
+    # (its shard starts at global index 0).
+    heads = jax.lax.all_gather(jnp.stack([xl[:, :2], yl[:, :2]]), axis)[0]
+    out = jax.vmap(
+        lambda a0, a1, b0, b1, hx, hy: _finish_monotone(a0, a1, b0, b1,
+                                                        hx, hy, q)
+    )(x0, x1, y0, y1, heads[0], heads[1])
     out = jnp.where(escaped > 0, jnp.nan, out)
     return out, escaped
 
@@ -260,3 +352,69 @@ def _ring_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
     key = mesh_fingerprint(mesh, axis) + (n_k, n_q, lo, hi, power, capacity,
                                           pad, dtype_name)
     return cached_program(_RING_PROGRAMS, key, build)
+
+
+_RING_INTERP_PROGRAMS: dict = {}
+
+
+def interp_monotone_power_grid_ring(mesh, x, y, lo: float, hi: float,
+                                    power: float, n_q: int, *,
+                                    axis: str = "grid",
+                                    capacity: float = DEFAULT_CAPACITY,
+                                    pad: int = 8):
+    """Distributed monotone VALUE interpolation onto the n_q-point power
+    grid with ring-redistributed (knot, value) pairs — the host-level entry
+    over ring_interp_local, mirroring inverse_interp_power_grid_ring.
+    x [..., n_k] sorted knots, y same shape with non-decreasing values
+    (the caller's monotonicity contract, as in
+    ops/interp.interp_monotone_power_grid, whose semantics this matches);
+    both sharded (or shardable) along the last axis over mesh[axis].
+    Returns (out [..., n_q] sharded along the last axis, escaped bool)."""
+    D = mesh.shape[axis]
+    n_k = x.shape[-1]
+    if x.shape != y.shape:
+        raise ValueError(f"x and y must share a shape, got {x.shape} vs {y.shape}")
+    if n_k % D or n_q % D:
+        raise ValueError(
+            f"mesh axis size {D} must divide n_k={n_k} and n_q={n_q}")
+    if not ring_slab_fits(n_k, D, capacity):
+        raise ValueError(
+            f"ring slab does not fit: n_k={n_k} over {D} devices at "
+            f"capacity={capacity} needs a {ring_buffer_size(n_k, D, capacity)}"
+            f"-knot buffer > the padded knot row; use fewer devices or a "
+            f"larger grid (ring_slab_fits)")
+    if pad < 1:
+        raise ValueError(f"pad must be >= 1, got {pad}")
+    lead = x.shape[:-1]
+    run = _ring_interp_fn(mesh, axis, n_k, n_q, float(lo), float(hi),
+                          float(power), float(capacity), int(pad),
+                          jnp.dtype(x.dtype).name)
+    out, escaped = run(x.reshape((-1, n_k)), y.reshape((-1, n_k)))
+    return out.reshape(lead + (n_q,)), escaped > 0
+
+
+def _ring_interp_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
+                    power: float, capacity: float, pad: int, dtype_name: str):
+    D = mesh.shape[axis]
+    nq_loc = n_q // D
+    dtype = jnp.dtype(dtype_name)
+    span = hi - lo
+
+    def build():
+        def local(xl, yl):
+            dev = jax.lax.axis_index(axis)
+            j = dev * nq_loc + jnp.arange(nq_loc)
+            q = lo + span * (j.astype(dtype) / (n_q - 1)) ** power
+            return ring_interp_local(xl, yl, q, axis=axis, D=D, n_k=n_k,
+                                     n_q=n_q, lo=lo, hi=hi, power=power,
+                                     capacity=capacity, pad=pad)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, axis), P(None, axis)),
+            out_specs=(P(None, axis), P()),
+        ))
+
+    key = mesh_fingerprint(mesh, axis) + (n_k, n_q, lo, hi, power, capacity,
+                                          pad, dtype_name)
+    return cached_program(_RING_INTERP_PROGRAMS, key, build)

@@ -32,6 +32,7 @@ __all__ = [
     "LADDER_REFINE",
     "initial_consumption_guess",
     "ladder_warm_start",
+    "ladder_warm_start_labor",
     "solve_aiyagari_egm",
     "solve_aiyagari_egm_safe",
     "solve_aiyagari_egm_labor",
@@ -353,15 +354,15 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
     return dataclasses.replace(sol, escaped=esc)
 
 
-def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-                      tol: float, max_iter: int, grid_power: float,
-                      relative_tol: bool = False):
-    """Converge the multiscale ladder's PENULTIMATE stage and prolong its
-    consumption policy to the full grid — the warm start the mesh route
-    feeds solve_aiyagari_egm_sharded, so the sharded fine solve runs a warm
-    handful of sweeps instead of ~290 cold full-size ones (the same nested
-    iteration solve_aiyagari_egm_multiscale performs internally). Returns
-    None when the ladder has a single stage (nothing coarser to solve)."""
+def _penultimate_warm_start(a_grid, grid_power: float, solve_coarse):
+    """Shared body of the mesh routes' ladder warm starts: converge the
+    multiscale ladder's PENULTIMATE stage via `solve_coarse(grid)` and
+    prolong its consumption policy to the full grid. Returns None when the
+    ladder has a single stage (nothing coarser to solve) or the coarse
+    solve escaped — an escape here means the policy is NaN-poisoned and
+    would enter the sharded solve as a "warm start" whose NaNs exit its
+    loop after one sweep with escaped=False, a silently-converged NaN
+    solution; a cold start is the safe fallback."""
     from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
 
     n_final = int(a_grid.shape[-1])
@@ -370,17 +371,44 @@ def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     if len(sizes) < 2:
         return None
     coarse = stage_grid(sizes[-2], lo, hi, grid_power, a_grid.dtype)
-    csol = solve_aiyagari_egm_multiscale(
-        coarse, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
-        max_iter=max_iter, grid_power=grid_power, relative_tol=relative_tol)
+    csol = solve_coarse(coarse)
     if bool(csol.escaped):
-        # The multiscale generic-route retry normally clears the flag; if an
-        # escape ever survives, the policy is NaN-poisoned and would enter
-        # the sharded solve as a "warm start" whose NaNs exit its loop after
-        # one sweep with escaped=False — a silently-converged NaN solution.
-        # A cold start is the safe fallback.
         return None
     return prolong_power_grid(csol.policy_c, lo, hi, grid_power, n_final)
+
+
+def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+                      tol: float, max_iter: int, grid_power: float,
+                      relative_tol: bool = False):
+    """Converge the multiscale ladder's PENULTIMATE stage and prolong its
+    consumption policy to the full grid — the warm start the mesh route
+    feeds solve_aiyagari_egm_sharded, so the sharded fine solve runs a warm
+    handful of sweeps instead of ~290 cold full-size ones (the same nested
+    iteration solve_aiyagari_egm_multiscale performs internally). Returns
+    None when there is nothing coarser to solve (_penultimate_warm_start)."""
+    return _penultimate_warm_start(
+        a_grid, grid_power,
+        lambda coarse: solve_aiyagari_egm_multiscale(
+            coarse, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+            max_iter=max_iter, grid_power=grid_power,
+            relative_tol=relative_tol))
+
+
+def ladder_warm_start_labor(a_grid, s, P, r, w, amin, *, sigma: float,
+                            beta: float, psi: float, eta: float, tol: float,
+                            max_iter: int, grid_power: float,
+                            relative_tol: bool = False):
+    """ladder_warm_start for the endogenous-labor family: the penultimate
+    stage runs the labor multiscale ladder and only the consumption policy
+    is prolonged (the labor/asset policies are closed-form per sweep,
+    solve_aiyagari_egm_labor_multiscale's rationale). Feeds
+    solve_aiyagari_egm_labor_sharded's warm start in the mesh route."""
+    return _penultimate_warm_start(
+        a_grid, grid_power,
+        lambda coarse: solve_aiyagari_egm_labor_multiscale(
+            coarse, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi,
+            eta=eta, tol=tol, max_iter=max_iter, grid_power=grid_power,
+            relative_tol=relative_tol))
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,

@@ -40,16 +40,22 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.bellman import expectation
+from aiyagari_tpu.ops.egm import constrained_consumption_labor
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
 from aiyagari_tpu.parallel.ring import (
     DEFAULT_CAPACITY,
+    ring_interp_local,
     ring_inverse_local,
     ring_slab_fits,
 )
 from aiyagari_tpu.solvers.egm import EGMSolution, _cached_grid_bounds, _fetch_scalars
-from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
+from aiyagari_tpu.utils.utility import (
+    crra_marginal,
+    crra_marginal_inverse,
+    labor_foc_inverse,
+)
 
-__all__ = ["solve_aiyagari_egm_sharded"]
+__all__ = ["solve_aiyagari_egm_sharded", "solve_aiyagari_egm_labor_sharded"]
 
 _EGM_PROGRAMS: dict = {}
 
@@ -197,3 +203,178 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                                           relative_tol, noise_floor_ulp,
                                           dtype_name)
     return cached_program(_EGM_PROGRAMS, key, build)
+
+
+_EGM_LABOR_PROGRAMS: dict = {}
+
+
+def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
+                                     amin, *, sigma: float, beta: float,
+                                     psi: float, eta: float, tol: float,
+                                     max_iter: int, grid_power: float,
+                                     relative_tol: bool = False,
+                                     noise_floor_ulp: float = 0.0,
+                                     capacity: float = DEFAULT_CAPACITY,
+                                     pad: int = 8,
+                                     axis: str = "grid") -> EGMSolution:
+    """solve_aiyagari_egm_labor with the grid axis sharded over mesh[axis]
+    and the endogenous (knot, consumption) pairs resident per device — the
+    labor-family form of solve_aiyagari_egm_sharded, generalizing the ring
+    machinery from the grid INVERSION to the monotone VALUE interpolation
+    (parallel/ring.ring_interp_local; the hot op of
+    Aiyagari_Endogenous_Labor_EGM.m:90, SURVEY.md §2.4(1)).
+
+    Per sweep, everything is local except:
+      * the ring rotation now carries the stacked (a_hat, c_next) channels
+        (2x the inversion's neighbor traffic, still O(na/D) per device);
+      * the cross-device cummax prefix covers BOTH arrays (the windowed
+        value kernel's bracketing max/min trick needs c_next monotone too,
+        cf. ops/egm.egm_step_labor), folded with the constrained-region
+        global first knot into ONE stacked all_gather of [3, N] tails;
+      * the O(D) bracket-start psum, head-pair all_gather, and pmax'd
+        sup-norm/escape reductions, as in the exogenous program.
+
+    The constrained-region static solution (ops/egm.
+    constrained_consumption_labor) is elementwise in the asset grid, so
+    each device computes its own slice once per solve — loop-invariant, no
+    communication. Same stopping rule, escape contract
+    (NaN-poisoning + `escaped`; callers fall back to the unsharded labor
+    routes), and trajectory as the single-device windowed fast path, up to
+    the Euler matmul's shard-shape reassociation (pinned at 1e-12 by
+    tests/test_egm_sharded.py::TestShardedLaborEGMSolver)."""
+    if grid_power <= 0.0:
+        raise ValueError(
+            "solve_aiyagari_egm_labor_sharded requires a power-spaced grid: "
+            f"pass its actual spacing exponent as grid_power, got {grid_power}")
+    D = int(mesh.shape[axis])
+    N, na = C_init.shape
+    if na % D:
+        raise ValueError(f"mesh axis size {D} must divide the grid {na}")
+    if pad < 1:
+        raise ValueError(f"pad must be >= 1, got {pad}")  # ring.py rationale
+    if not ring_slab_fits(na, D, capacity):
+        raise ValueError(
+            f"grid of {na} points is too small for the ring slab at "
+            f"capacity={capacity} on {D} devices (the slab would exceed "
+            "the knot row); use the single-device solver")
+    dtype = C_init.dtype
+    lo, hi = _cached_grid_bounds(a_grid)
+    run = _egm_labor_program(mesh, axis, N, na, lo, hi, float(grid_power),
+                             float(capacity), int(pad), float(sigma),
+                             float(beta), float(psi), float(eta), float(tol),
+                             int(max_iter), bool(relative_tol),
+                             float(noise_floor_ulp), jnp.dtype(dtype).name)
+    C, policy_k, policy_l, dist, it, esc, tol_eff = run(
+        C_init, a_grid, s, P_mat,
+        jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
+    )
+    return _fetch_scalars(
+        EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff))
+
+
+def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
+                       power: float, capacity: float, pad: int, sigma: float,
+                       beta: float, psi: float, eta: float, tol: float,
+                       max_iter: int, relative_tol: bool,
+                       noise_floor_ulp: float, dtype_name: str):
+    D = int(mesh.shape[axis])
+    na_loc = na // D
+    dtype = jnp.dtype(dtype_name)
+    span = hi - lo
+    tol_c = jnp.asarray(tol, dtype)
+    floor_k = float(noise_floor_ulp) * float(jnp.finfo(dtype).eps)
+    neg = jnp.array(-jnp.inf, dtype)
+
+    def build():
+        def local(C0, a_loc, s, Pm, r, w, amin):
+            dev = jax.lax.axis_index(axis)
+            j = dev * na_loc + jnp.arange(na_loc)
+            q = lo + span * (j.astype(dtype) / (na - 1)) ** power
+            ws = w * s[:, None]                                   # [N, 1]
+            # Loop-invariant constrained-region solution on the local grid
+            # slice (elementwise in a_grid — no communication).
+            c_con = constrained_consumption_labor(
+                a_loc, s, r, w, amin, sigma=sigma, psi=psi, eta=eta)
+
+            def sweep(C):
+                # ops/egm.egm_step_labor on the local shard; see its
+                # docstring for the operator and the reference quirks kept.
+                RHS = (1.0 + r) * expectation(Pm, crra_marginal(C, sigma), beta)
+                c_next = crra_marginal_inverse(RHS, sigma)
+                l_endo = labor_foc_inverse(
+                    ws * crra_marginal(c_next, sigma), psi, eta)      # :86
+                a_hat = (c_next + a_loc[None, :] - ws * l_endo) / (1.0 + r)
+                # Global cummax on BOTH arrays: local cummax + cross-device
+                # prefix of the shard tails (associative, bitwise-equal to
+                # the unsharded row cummax). One stacked all_gather also
+                # carries the global first endogenous knot for the
+                # constrained region (device 0's head is prefix-free).
+                a_hat = jax.lax.cummax(a_hat, axis=1)
+                c_next = jax.lax.cummax(c_next, axis=1)
+                packed = jnp.stack(
+                    [a_hat[:, -1], c_next[:, -1], a_hat[:, 0]])   # [3, N]
+                g = jax.lax.all_gather(packed, axis)              # [D, 3, N]
+                mask = (jnp.arange(D) < dev)[:, None]
+                a_hat = jnp.maximum(
+                    a_hat, jnp.max(jnp.where(mask, g[:, 0], neg), axis=0)[:, None])
+                c_next = jnp.maximum(
+                    c_next, jnp.max(jnp.where(mask, g[:, 1], neg), axis=0)[:, None])
+                first_knot = g[0, 2]                              # [N]
+                g_c, esc = ring_interp_local(
+                    a_hat, c_next, q, axis=axis, D=D, n_k=na, n_q=na,
+                    lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
+                )
+                # Constrained region + the reference's sequencing quirks,
+                # exactly as ops/egm.egm_step_labor (its comments) — against
+                # the CALLER's grid shard, as the single-device route
+                # compares a_grid, not the analytic rebuild.
+                g_c = jnp.where(a_loc[None, :] < first_knot[:, None], c_con, g_c)
+                g_c = jnp.where(a_loc[None, :] < amin, amin, g_c)         # :91
+                # The constrained-region overwrite is FINITE, so it would
+                # partially un-poison an escaped sweep — re-poison to keep
+                # the whole-solution NaN contract of the exogenous route.
+                g_c = jnp.where(esc > 0, jnp.nan, g_c)
+                policy_l = labor_foc_inverse(
+                    ws * crra_marginal(g_c, sigma), psi, eta)             # :95
+                policy_k = jnp.clip(
+                    (1.0 + r) * a_loc[None, :] + ws * policy_l - g_c,
+                    0.0, hi)                                              # :99
+                return g_c, policy_k, policy_l, esc
+
+            def cond(carry):
+                _, _, _, dist, it, _, tol_eff = carry
+                return (dist >= tol_eff) & (it < max_iter)
+
+            def body(carry):
+                C, _, _, _, it, esc, _ = carry
+                C_new, policy_k, policy_l, esc_new = sweep(C)
+                diff = jnp.abs(C_new - C)
+                local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
+                           if relative_tol else jnp.max(diff))
+                dist = jax.lax.pmax(local_d, axis)
+                if noise_floor_ulp > 0.0 and not relative_tol:
+                    tol_eff = jnp.maximum(
+                        tol_c,
+                        floor_k * jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis))
+                else:
+                    tol_eff = tol_c
+                return (C_new, policy_k, policy_l, dist, it + 1,
+                        esc | (esc_new > 0), tol_eff)
+
+            z = jnp.zeros_like(C0)
+            init = (C0, z, z, jnp.array(jnp.inf, dtype), jnp.int32(0),
+                    jnp.array(False), tol_c)
+            return jax.lax.while_loop(cond, body, init)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
+            out_specs=(P(None, axis), P(None, axis), P(None, axis),
+                       P(), P(), P(), P()),
+        ))
+
+    key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
+                                          pad, sigma, beta, psi, eta, tol,
+                                          max_iter, relative_tol,
+                                          noise_floor_ulp, dtype_name)
+    return cached_program(_EGM_LABOR_PROGRAMS, key, build)

@@ -22,15 +22,26 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from aiyagari_tpu.models.aiyagari import aiyagari_preset
-from aiyagari_tpu.ops.interp import inverse_interp_power_grid
+from aiyagari_tpu.models.aiyagari import aiyagari_labor_preset, aiyagari_preset
+from aiyagari_tpu.ops.interp import (
+    interp_monotone_power_grid,
+    inverse_interp_power_grid,
+)
 from aiyagari_tpu.parallel.mesh import make_mesh
 from aiyagari_tpu.parallel.ring import (
+    interp_monotone_power_grid_ring,
     inverse_interp_power_grid_ring,
     ring_buffer_size,
 )
-from aiyagari_tpu.solvers.egm import initial_consumption_guess, solve_aiyagari_egm
-from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+from aiyagari_tpu.solvers.egm import (
+    initial_consumption_guess,
+    solve_aiyagari_egm,
+    solve_aiyagari_egm_labor,
+)
+from aiyagari_tpu.solvers.egm_sharded import (
+    solve_aiyagari_egm_labor_sharded,
+    solve_aiyagari_egm_sharded,
+)
 from aiyagari_tpu.utils.firm import wage_from_r
 
 
@@ -40,6 +51,17 @@ def _egm_problem(n):
                           m.config.technology.delta))
     C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
     kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+              tol=1e-6, max_iter=2000, grid_power=float(m.config.grid.power))
+    return m, w, C0, kw
+
+
+def _labor_problem(n):
+    m = aiyagari_labor_preset(grid_size=n)
+    w = float(wage_from_r(0.04, m.config.technology.alpha,
+                          m.config.technology.delta))
+    C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
+    kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+              psi=m.preferences.psi, eta=m.preferences.eta,
               tol=1e-6, max_iter=2000, grid_power=float(m.config.grid.power))
     return m, w, C0, kw
 
@@ -127,6 +149,216 @@ class TestRingInversion:
         # The constant is per-DEVICE: at larger meshes the slab keeps
         # shrinking while GSPMD's re-materialized row would not.
         assert ring_buffer_size(n, 64, 4.0) <= n // 16 + 6 * 512
+
+
+class TestRingValueInterp:
+    """The ring-sharded monotone VALUE interpolation (the labor family's hot
+    op) vs the single-device windowed kernel."""
+
+    def _lagged_pairs(self, n, shift):
+        # Same large-fraction bracket lag as TestRingInversion, plus a
+        # monotone value row riding the knots (the stacked channel).
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        x = np.sort((gk + shift + 0.3 * np.sin(gk / 7.0)) / 1.04)
+        y = 3.0 * np.sqrt(x - x[0] + 0.1) + 0.05 * x
+        return jnp.asarray(x), jnp.asarray(y), lo, hi, power
+
+    def test_matches_unsharded_route_large_lag(self):
+        n = 16_384
+        x, y, lo, hi, power = self._lagged_pairs(n, shift=-3.0)
+        xq = jnp.stack([x, x * 1.01 + 0.05])
+        yq = jnp.stack([y, y * 1.02 + 0.1])
+        mesh = make_mesh(("grid",))
+        got, esc = interp_monotone_power_grid_ring(mesh, xq, yq, lo, hi,
+                                                   power, n)
+        want, esc_w = interp_monotone_power_grid(xq, yq, lo, hi, power, n,
+                                                 with_escape=True)
+        assert not bool(esc) and not bool(esc_w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-12)
+
+    def test_below_and_above_range_edges(self):
+        # First queries below all knots (first-segment extrapolation from
+        # the global head pair) and last queries above (nearest / last
+        # value) must reproduce the unsharded edge semantics exactly.
+        n = 8_192
+        lo, hi, power = 0.0, 52.0, 2.0
+        gk = lo + (hi - lo) * (np.arange(n) / (n - 1)) ** power
+        x = jnp.asarray(gk * 0.9 + 0.5)
+        y = jnp.asarray(np.log1p(gk) + 2.0)
+        mesh = make_mesh(("grid",))
+        got, esc = interp_monotone_power_grid_ring(mesh, x, y, lo, hi,
+                                                   power, n)
+        want = interp_monotone_power_grid(x, y, lo, hi, power, n)
+        assert not bool(esc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-12)
+
+    def test_escape_on_undersized_buffer(self):
+        n = 8_192
+        lo, hi, power = 0.0, 52.0, 2.0
+        x = jnp.asarray(np.linspace(0.97 * hi, 0.99 * hi, n))
+        y = jnp.asarray(np.linspace(1.0, 2.0, n))
+        mesh = make_mesh(("grid",))
+        out, esc = interp_monotone_power_grid_ring(mesh, x, y, lo, hi, power,
+                                                   n, capacity=1.5)
+        assert bool(esc)
+        assert np.isnan(np.asarray(out)).all()
+
+    def test_rejects_bad_shapes(self):
+        mesh = make_mesh(("grid",))
+        with pytest.raises(ValueError, match="share a shape"):
+            interp_monotone_power_grid_ring(mesh, jnp.zeros(8192),
+                                            jnp.zeros(4096), 0.0, 1.0, 2.0,
+                                            8192)
+        with pytest.raises(ValueError, match="slab does not fit"):
+            interp_monotone_power_grid_ring(mesh, jnp.zeros(512),
+                                            jnp.zeros(512), 0.0, 1.0, 2.0,
+                                            512)
+
+
+class TestShardedLaborEGMSolver:
+    """The labor-family distributed fixed point: ring-redistributed
+    (knot, consumption) pairs (VERDICT round 3 #1 — the generalization of
+    the exogenous-only round-3 capability)."""
+
+    def test_trajectory_matches_unsharded(self):
+        # Bounded-sweep trajectory equality at 8,192 points: per-sweep
+        # agreement pins the sharded composition (ring value interp +
+        # double cummax prefix + constrained region) as hard as full
+        # convergence (TestShardedEGMSolver's rationale).
+        n = 8_192
+        m, w, C0, kw = _labor_problem(n)
+        kw.update(tol=1e-30, max_iter=6)
+        ref = solve_aiyagari_egm_labor(C0, m.a_grid, m.s, m.P, 0.04, w,
+                                       m.amin, **kw)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_labor_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                               0.04, w, m.amin, **kw)
+        assert int(sol.iterations) == int(ref.iterations) == 6
+        assert not bool(sol.escaped)
+        np.testing.assert_allclose(np.asarray(sol.policy_c),
+                                   np.asarray(ref.policy_c), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(sol.policy_k),
+                                   np.asarray(ref.policy_k), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(sol.policy_l),
+                                   np.asarray(ref.policy_l), atol=1e-12)
+
+    @pytest.mark.slow
+    def test_converged_solve_matches_unsharded(self):
+        # Full fixed point from a coarse warm start, stopping rule included
+        # (the labor mirror of TestShardedEGMSolver's converged test).
+        from aiyagari_tpu.ops.interp import prolong_power_grid
+
+        n = 6_144
+        m, w, C0, kw = _labor_problem(n)
+        kw.update(tol=1e-5)
+        coarse = aiyagari_labor_preset(grid_size=512)
+        Cc = initial_consumption_guess(coarse.a_grid, coarse.s, 0.04, w)
+        kwc = dict(kw, grid_power=float(coarse.config.grid.power))
+        sol_c = solve_aiyagari_egm_labor(Cc, coarse.a_grid, coarse.s,
+                                         coarse.P, 0.04, w, coarse.amin,
+                                         **kwc)
+        C_warm = prolong_power_grid(sol_c.policy_c, float(m.a_grid[0]),
+                                    float(m.a_grid[-1]), kw["grid_power"], n)
+        ref = solve_aiyagari_egm_labor(C_warm, m.a_grid, m.s, m.P, 0.04, w,
+                                       m.amin, **kw)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_labor_sharded(mesh, C_warm, m.a_grid, m.s,
+                                               m.P, 0.04, w, m.amin, **kw)
+        assert not bool(sol.escaped)
+        assert float(sol.distance) < float(sol.tol_effective)
+        assert int(sol.iterations) == int(ref.iterations)
+        np.testing.assert_allclose(np.asarray(sol.policy_c),
+                                   np.asarray(ref.policy_c), atol=1e-10)
+
+    def test_no_full_grid_crosses_devices(self):
+        # The knots-resident assertion for the LABOR program: the ring
+        # rotation's collective-permutes carry the stacked [2, N, na/D]
+        # channels (2x the inversion's traffic, still O(na/D)); every
+        # all-gather/all-reduce is O(D)-sized.
+        n = 16_384
+        m, w, C0, kw = _labor_problem(n)
+        kw.update(tol=1e-30, max_iter=2)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_labor_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                               0.04, w, m.amin, **kw)
+        assert int(sol.iterations) == 2
+        from aiyagari_tpu.solvers.egm_sharded import _EGM_LABOR_PROGRAMS
+
+        (prog,) = [p for k, p in _EGM_LABOR_PROGRAMS.items() if n in k]
+        C0_j = jnp.asarray(C0)
+        hlo = prog.lower(
+            C0_j, m.a_grid, m.s, m.P,
+            jnp.asarray(0.04, C0_j.dtype), jnp.asarray(w, C0_j.dtype),
+            jnp.asarray(m.amin, C0_j.dtype),
+        ).compile().as_text()
+        # Stacked (knot, value) channels: up to 2 * N * (n/8) per permute.
+        shard_elems = 2 * 7 * (n // 8)
+        seen = []
+        for ln in hlo.splitlines():
+            mm = re.search(r"= \w+\[([0-9,]*)\][^ ]* (all-gather|all-reduce|"
+                           r"collective-permute)", ln)
+            if mm:
+                dims = [int(d) for d in mm.group(1).split(",") if d]
+                seen.append((mm.group(2), dims))
+        assert seen, "no collectives found — parsing broke or program changed"
+        for op, dims in seen:
+            elems = int(np.prod(dims)) if dims else 1
+            if op == "collective-permute":
+                assert elems <= shard_elems, (op, dims)
+            else:
+                assert elems <= 1024, (op, dims)
+            assert elems < 7 * n, (op, dims)
+
+    @pytest.mark.slow
+    def test_escape_contract_on_undersized_slab(self):
+        # capacity=0.0 degenerates the buffer to its floor (the same
+        # geometry as the exogenous escape test — L must reach the
+        # one-window floor, n = 24,576 at D=8); the labor solver must raise
+        # the flag and NaN-poison, never silently mis-bracket.
+        n = 24_576
+        m, w, C0, kw = _labor_problem(n)
+        kw.update(tol=1e-30, max_iter=2)
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_labor_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                               0.04, w, m.amin,
+                                               capacity=0.0, **kw)
+        assert bool(sol.escaped)
+        assert np.isnan(np.asarray(sol.policy_c)).all()
+
+    @pytest.mark.slow
+    def test_mesh_household_route_matches_single_device(self):
+        # The solve_household mesh branch for the LABOR family (the gate
+        # dropped this round — VERDICT round 3 #1): labor-ladder warm start
+        # + sharded labor fine solve equals the single-device route.
+        from aiyagari_tpu.config import SolverConfig
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+
+        n = 6_144
+        m, w, C0, kw = _labor_problem(n)
+        scfg = SolverConfig(method="egm", tol=1e-5, max_iter=2000)
+        ref = solve_household(m, 0.04, solver=scfg)
+        res = solve_household(m, 0.04, solver=scfg,
+                              mesh=make_mesh(("grid",)))
+        assert not bool(res.escaped)
+        np.testing.assert_allclose(np.asarray(res.policy_c),
+                                   np.asarray(ref.policy_c), atol=5e-5)
+        np.testing.assert_allclose(np.asarray(res.policy_l),
+                                   np.asarray(ref.policy_l), atol=5e-5)
+
+    def test_rejects_bad_arguments(self):
+        m, w, C0, kw = _labor_problem(1024)
+        mesh = make_mesh(("grid",))
+        kw["grid_power"] = 0.0
+        with pytest.raises(ValueError, match="power-spaced"):
+            solve_aiyagari_egm_labor_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                             0.04, w, m.amin, **kw)
+        m2, w2, C02, kw2 = _labor_problem(512)
+        with pytest.raises(ValueError, match="too small"):
+            solve_aiyagari_egm_labor_sharded(mesh, C02, m2.a_grid, m2.s,
+                                             m2.P, 0.04, w2, m2.amin, **kw2)
 
 
 class TestShardedEGMSolver:
@@ -279,6 +511,68 @@ class TestShardedEGMSolver:
         assert not bool(res.escaped)
         np.testing.assert_allclose(np.asarray(res.policy_c),
                                    np.asarray(ref.policy_c), atol=5e-5)
+
+    @pytest.mark.slow
+    def test_mesh_equilibrium_bisection_matches_single_device(self, tmp_path):
+        # The full GE composition through the mesh route (VERDICT round 3
+        # #6): solve_equilibrium_distribution -> solve_household(mesh) ->
+        # ladder warm start (first solve) -> warm-started sharded re-solves
+        # at each midpoint — PLUS the sharded-representation checkpointing
+        # (VERDICT round 3 #7): the run is interrupted mid-bisection, the
+        # checkpoint is verified to hold the warm start PER SHARD (no
+        # full-array entry ever materialized on host), and the resumed run
+        # restores it shard-by-shard and finishes identically. A 4-device
+        # submesh at 6,144 points is the SMALLEST sound geometry
+        # (ring_slab_fits: D=2 never fits at the default capacity — the
+        # slab 2*(n/2)+window always exceeds the row; at D=4, n >= 6,144
+        # is the bound); 3 bisection iterations exercise the warm-start
+        # hand-off without the ~30 min full-depth cost measured in round 3.
+        from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+        from aiyagari_tpu.equilibrium.bisection import (
+            solve_equilibrium_distribution,
+        )
+        from aiyagari_tpu.io_utils.checkpoint import load_checkpoint
+
+        n = 6_144
+        m, w, C0, kw = _egm_problem(n)
+        scfg = SolverConfig(method="egm", tol=1e-5, max_iter=2000)
+        eq = EquilibriumConfig(max_iter=3)
+        mesh4 = make_mesh(("grid",), (4,), devices=jax.devices()[:4])
+        ref = solve_equilibrium_distribution(m, solver=scfg, eq=eq)
+
+        class Stop(Exception):
+            pass
+
+        def interrupt(rec):
+            if rec["iteration"] == 1:
+                raise Stop
+
+        with pytest.raises(Stop):
+            solve_equilibrium_distribution(m, solver=scfg, eq=eq, mesh=mesh4,
+                                           on_iteration=interrupt,
+                                           checkpoint_dir=tmp_path)
+        # The checkpoint holds the sharded warm start per shard: 4 shard
+        # entries of [7, 1536], and NO assembled full-grid entry.
+        (ckpt,) = tmp_path.glob("*.npz")
+        sc, arrays = load_checkpoint(ckpt)
+        shard_keys = [k for k in arrays if k.startswith("warm__shard")]
+        assert len(shard_keys) == 4 and "warm" not in arrays
+        assert arrays["warm__shard0"].shape == (7, n // 4)
+        res = solve_equilibrium_distribution(m, solver=scfg, eq=eq,
+                                             mesh=mesh4,
+                                             checkpoint_dir=tmp_path)
+        # The sharded solves differ from the single-device ones only by the
+        # Euler matmul's reassociation (~1e-12 on f64 policies), so every
+        # bisection decision — and hence the bracket path and r* — must be
+        # identical, and the final policies agree far inside the solver tol.
+        assert res.iterations == ref.iterations
+        assert res.r == pytest.approx(ref.r, abs=1e-12)
+        np.testing.assert_allclose(np.asarray(res.r_history),
+                                   np.asarray(ref.r_history), atol=1e-12)
+        np.testing.assert_allclose(np.asarray(res.solution.policy_c),
+                                   np.asarray(ref.solution.policy_c),
+                                   atol=1e-8)
+        assert res.k_supply[-1] == pytest.approx(ref.k_supply[-1], abs=1e-8)
 
     def test_small_grid_mesh_request_degrades_to_single_device(self):
         # Below the slab-soundness bound the config-level mesh request must
