@@ -203,6 +203,21 @@ def _solve_krusell_smith_impl(
     k_z, k_eps = jax.random.split(key)
     z_path = simulate_aggregate_shocks(model.pz, k_z, T=alm.T)
     panel_sharding = None
+    # Grid-axis mesh (BackendConfig.mesh_axes containing "grid", EGM method):
+    # the [ns, nK, nk] household fixed point runs DISTRIBUTED over the fine
+    # k-axis with ring-assembled knot slabs (solvers/ks_egm_sharded.py;
+    # SURVEY.md §2.4(1)). Unsound geometry (nk not divisible) silently uses
+    # the single-device solver, like the Aiyagari config route.
+    grid_mesh = None
+    mesh = None
+    if backend.mesh_axes:
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+        if ("grid" in backend.mesh_axes and method == "egm"
+                and config.k_size % int(mesh.shape["grid"]) == 0
+                and config.k_size // int(mesh.shape["grid"]) >= 16):
+            grid_mesh = mesh
     if use_histogram:
         eps_panel = None
     else:
@@ -219,10 +234,9 @@ def _solve_krusell_smith_impl(
         # the employment panel and the capital cross-section are sharded over
         # the mesh so the per-step policy evaluation data-parallelizes and the
         # K=mean(k) reduction lowers to a psum over ICI (SURVEY.md §2.4).
-        if backend.mesh_axes:
-            from aiyagari_tpu.parallel.mesh import agents_sharding, make_mesh
+        if mesh is not None and "agents" in backend.mesh_axes:
+            from aiyagari_tpu.parallel.mesh import agents_sharding
 
-            mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
             eps_panel = jax.device_put(eps_panel, agents_sharding(mesh, batch_axis=1))
             panel_sharding = agents_sharding(mesh, batch_axis=0)
 
@@ -277,12 +291,31 @@ def _solve_krusell_smith_impl(
             if mixed and sc.get("sim_phase") == "float64":
                 sim_dtype = jnp.float64
                 k_grid_sim, K_grid_sim, eps_trans_sim = sim_tables()
-            value = jnp.asarray(arrays["value"], dtype)
-            k_opt = jnp.asarray(arrays["k_opt"], dtype)
+            # Sharded checkpoints (the mesh routes) restore shard-by-shard
+            # straight onto the devices (io_utils/checkpoint.restore_array
+            # — no host materialization); plain entries as before.
+            from aiyagari_tpu.io_utils.checkpoint import restore_array
+
+            k_sharding = None
+            if grid_mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                k_sharding = NamedSharding(grid_mesh,
+                                           PartitionSpec(None, None, "grid"))
+            def _restore(name, sharding, cast):
+                # restore_array handles shard-exact placement, resharding,
+                # and device_put of plain entries when a sharding is given;
+                # only the meshless case needs the host->device cast here.
+                v = restore_array(sc, arrays, name, sharding=sharding,
+                                  dtype=np.dtype(str(jnp.dtype(cast))))
+                return jnp.asarray(v, cast) if isinstance(v, np.ndarray) else v
+
+            value = _restore("value", k_sharding, dtype)
+            k_opt = _restore("k_opt", k_sharding, dtype)
             # legacy checkpoints stored the cross-section as "k_population"
-            cross = jnp.asarray(arrays.get("cross", arrays.get("k_population")), sim_dtype)
-            if panel_sharding is not None:
-                cross = jax.device_put(cross, panel_sharding)
+            cross_name = "cross" if ("cross" in arrays or "cross__shard0"
+                                     in arrays) else "k_population"
+            cross = _restore(cross_name, panel_sharding, sim_dtype)
             # Anderson mixing history (short: depth+1 entries) — persisted so
             # a resume continues extrapolating from the pre-crash trajectory
             # instead of silently re-warming with damped steps. Absent in
@@ -321,15 +354,32 @@ def _solve_krusell_smith_impl(
             )
             value = sol.value
         elif solver.method == "egm":
-            sol = solve_ks_egm(
-                k_opt, B_dev, model.k_grid, model.K_grid, model.P,
-                model.r_table, model.w_table, model.eps_by_state,
-                model.z_by_state, model.L_by_state, tech.alpha,
+            egm_kw = dict(
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=house_tol, max_iter=solver.max_iter, double_alm=double_alm,
-                progress_every=solver.progress_every,
             )
+            sol = None
+            if grid_mesh is not None:
+                from aiyagari_tpu.solvers.ks_egm_sharded import solve_ks_egm_sharded
+
+                sol, escaped = solve_ks_egm_sharded(
+                    grid_mesh, k_opt, B_dev, model.k_grid, model.K_grid,
+                    model.P, model.r_table, model.w_table, model.eps_by_state,
+                    model.z_by_state, model.L_by_state, tech.alpha,
+                    grid_power=float(config.k_power), **egm_kw,
+                )
+                if escaped:
+                    # Slab overflow: the standard host-level fallback to the
+                    # single-device route (solve_aiyagari_egm_safe's contract).
+                    sol = None
+            if sol is None:
+                sol = solve_ks_egm(
+                    k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                    model.r_table, model.w_table, model.eps_by_state,
+                    model.z_by_state, model.L_by_state, tech.alpha,
+                    progress_every=solver.progress_every, **egm_kw,
+                )
         else:
             raise ValueError(f"unknown method {solver.method!r}")
         k_opt = sol.k_opt
@@ -475,11 +525,10 @@ def _solve_krusell_smith_impl(
                          "best_f32": float(best_f32), "f32_stall": f32_stall,
                          "f32_in_band": f32_in_band,
                          "house_tol": float(house_tol)},
-                arrays={
-                    "value": np.asarray(value),
-                    "k_opt": np.asarray(k_opt),
-                    "cross": np.asarray(cross),
-                },
+                # Device arrays pass through: the mesh routes' sharded
+                # value/policy/cross-section are packed PER SHARD by
+                # save_checkpoint (no host gather).
+                arrays={"value": value, "k_opt": k_opt, "cross": cross},
             )
 
     if mgr is not None:

@@ -124,7 +124,61 @@ def _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, mean_fn,
     return K_ts, k_population
 
 
+_GRID_POWER_OK: dict = {}
+
+
+def _check_grid_power(k_grid, grid_power: float) -> None:
+    """Host-level probe that k_grid really follows the analytic spacing law
+    k[i] = lo + (hi-lo)*(i/(n-1))**grid_power the analytic-bucket route
+    assumes (ops/interp.state_policy_interp_power): a caller passing
+    grid_power > 0 with any OTHER grid would get silently wrong
+    interpolation, since the analytic knots diverge from the stored ones.
+    Two interior probe points against the formula catch every wrong-spacing
+    case at f32 resolution. Id-keyed memo (the _cached_grid_bounds pattern,
+    solvers/egm.py): the ALM loop re-simulates every iteration on the same
+    grid array, so validation costs one device fetch per distinct grid,
+    not per call. Under a TRACE (callers composing the simulator inside
+    their own jit, e.g. the driver's forward step — even a concrete
+    closed-over k_grid yields tracers from any op there) the probe skips:
+    the precondition is then on that caller's concrete operand."""
+    if grid_power <= 0.0 or isinstance(k_grid, jax.core.Tracer):
+        return
+    key = (id(k_grid), float(grid_power))
+    hit = _GRID_POWER_OK.get(key)
+    if hit is not None and hit is k_grid:
+        return
+    import numpy as np
+
+    n = int(k_grid.shape[-1])
+    try:
+        probes = np.asarray(jax.device_get(
+            k_grid[jnp.asarray([0, 1, n // 2, n - 1])]))
+    except jax.errors.TracerArrayConversionError:
+        return    # inside someone else's jit: nothing concrete to probe
+    lo, hi = float(probes[0]), float(probes[-1])
+    scale = max(abs(lo), abs(hi), 1.0)
+    for j, v in ((1, float(probes[1])), (n // 2, float(probes[2]))):
+        want = lo + (hi - lo) * (j / (n - 1)) ** grid_power
+        if abs(v - want) > 1e-4 * scale:
+            raise ValueError(
+                f"grid_power={grid_power} declared, but k_grid[{j}]={v:.6g} "
+                f"!= the analytic power-grid value {want:.6g} (lo={lo:.6g}, "
+                f"hi={hi:.6g}, n={n}): the analytic-bucket interpolation "
+                "would silently mis-bucket — pass the grid's true spacing "
+                "exponent, or grid_power=0.0 for the generic route")
+    if len(_GRID_POWER_OK) >= 16:
+        _GRID_POWER_OK.pop(next(iter(_GRID_POWER_OK)))
+    _GRID_POWER_OK[key] = k_grid
+
+
 @partial(jax.jit, static_argnames=("T", "grid_power"))
+def _simulate_capital_path_jit(k_opt, k_grid, K_grid, z_path, eps_panel,
+                               k_population, *, T: int,
+                               grid_power: float = 0.0):
+    return _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population,
+                       jnp.mean, grid_power)
+
+
 def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population, *,
                           T: int, grid_power: float = 0.0):
     """Step the agent panel through T-1 periods under the policy k_opt
@@ -134,15 +188,19 @@ def simulate_capital_path(k_opt, k_grid, K_grid, z_path, eps_panel, k_population
     devices; the mean lowers to a psum over ICI (implicitly, via GSPMD — see
     simulate_capital_path_shardmap for the explicit-collective form).
     grid_power > 0 selects the analytic-bucket interpolation for a
-    power-spaced k_grid (_panel_scan docstring).
+    power-spaced k_grid (_panel_scan docstring); the declared exponent is
+    validated against the stored knots once per grid array
+    (_check_grid_power) — host-level entry, not callable inside jit.
 
     k_population is NOT donated: callers legitimately reuse the same initial
     cross-section across runs (e.g. to compare this path against the
     shard_map variant), and donating a [pop]-sized buffer saves nothing
     next to the [T, pop] shock panel.
     """
-    return _panel_scan(k_opt, k_grid, K_grid, z_path, eps_panel, k_population,
-                       jnp.mean, grid_power)
+    _check_grid_power(k_grid, grid_power)
+    return _simulate_capital_path_jit(k_opt, k_grid, K_grid, z_path,
+                                      eps_panel, k_population, T=T,
+                                      grid_power=grid_power)
 
 
 @lru_cache(maxsize=None)
@@ -191,5 +249,6 @@ def simulate_capital_path_shardmap(mesh, k_opt, k_grid, K_grid, z_path, eps_pane
         raise ValueError(
             f"population {population} not divisible by mesh axis {axis!r} size {n}"
         )
+    _check_grid_power(k_grid, grid_power)
     run = _shardmap_panel_fn(mesh, axis, float(grid_power))
     return run(k_opt, k_grid, K_grid, z_path, eps_panel, k_population)

@@ -1,0 +1,273 @@
+"""Distributed Krusell-Smith EGM solver: the [ns, nK, nk] policy fixed
+point under one `jax.shard_map` program with the FINE individual-capital
+axis sharded across the mesh and the endogenous knots resident per device.
+
+This generalizes the ring-redistribution machinery (parallel/ring.py) from
+the Aiyagari families' linear/value interpolation to the K-S EGM's
+sort/mask/pchip re-interpolation (Krusell_Smith_EGM.m:192-198; SURVEY.md
+§2.4(1) — the last solver family without a grid-sharded form). Per sweep:
+
+  * the Euler expectation, inversion, and endogenous-grid back-out are
+    elementwise in k' — local to each device's [ns, nK, nk/D] shard (the
+    next-period policy slice k_opt[s', K'_idx, :] is a row pick in the
+    tiny (s, K) table, local along k);
+  * one ring rotation (parallel/ring.ring_slab_assemble) gives each of
+    the ns*nK rows an O(nk/D) contiguous slab of the global endogenous
+    knots, positioned by the exact psum-telescoped bracket starts;
+  * each device then runs the SAME masked-pchip kernel as the
+    single-device solver (ops/interp.masked_pchip_interp) against its
+    slab, rolled so the slab's valid run sits at index 0 — the exogenous
+    re-interpolation values are the analytic power grid, so only the knot
+    channel rides the ring;
+  * O(D) collectives: the bracket-start psum, the cummax-prefix tails
+    all_gather, and the pmax'd sup-norm/escape reductions.
+
+Monotonicity note: the single-device solver SORTS the endogenous grid
+(the reference's insurance at Krusell_Smith_EGM.m:192); here the
+cross-device repair is a cummax (exact no-op when the grid is monotone,
+which it is in exact arithmetic — consumption is increasing in k'), so
+the two routes agree wherever the endogenous grid is genuinely monotone
+(pinned at f64 by tests/test_ks_sharded.py) and differ only in WHICH
+repair they apply to f32 rounding inversions.
+
+Escape contract: a slab too small for a row's bracket range (or a pchip
+stencil reaching past a truncated slab) NaN-poisons the solution and
+raises `escaped`, exactly as the Aiyagari sharded solvers; callers fall
+back to the single-device solve_ks_egm.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aiyagari_tpu.ops.interp import masked_pchip_interp
+from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
+from aiyagari_tpu.parallel.ring import ring_slab_assemble
+from aiyagari_tpu.solvers.ks_vfi import KSSolution, _alm_next_K_index
+from aiyagari_tpu.utils.utility import crra_marginal, crra_marginal_inverse
+
+__all__ = ["ks_ring_slab_size", "solve_ks_egm_sharded"]
+
+_KS_EGM_PROGRAMS: dict = {}
+
+# The pchip stencil needs this many knots of slack between any query's
+# bracket and a truncated slab end (d[idx] and d[idx+1] read knots
+# idx-1..idx+2), and the bracket-start pad must cover the same stencil on
+# the low side.
+_STENCIL = 3
+
+
+def ks_ring_slab_size(nk: int, D: int, capacity: float, pad: int) -> int:
+    """Per-device slab length for the K-S ring: capacity shards plus the
+    bracket pad and pchip stencil margins, capped at nk + pad (a slab
+    covering the whole row plus its low pad cannot escape and needs no
+    cap games — at the K-S fine grids, 1k-4k points, that degenerate case
+    is still far below any memory concern). No 512-block rounding: unlike
+    the windowed Aiyagari kernels this slab feeds a dense local pchip, so
+    block granularity buys nothing at these row lengths."""
+    L = nk // D
+    B = int(capacity * L) + 2 * pad + 2 * _STENCIL
+    return min(max(B, L + 2 * pad), nk + pad)
+
+
+def solve_ks_egm_sharded(mesh, k_opt_init, B_coef, k_grid, K_grid, P_mat,
+                         r_table, w_table, eps_by_state, z_by_state,
+                         L_by_state, alpha: float, *, theta: float,
+                         beta: float, mu: float, l_bar: float, delta: float,
+                         k_min: float, k_max: float, tol: float,
+                         max_iter: int, grid_power: float,
+                         double_alm: bool = False, capacity: float = 2.0,
+                         pad: int = 8, axis: str = "grid") -> KSSolution:
+    """solve_ks_egm with the fine k-axis sharded over mesh[axis] (module
+    docstring). Same stopping rule and fixed point as the single-device
+    solver; `grid_power` must be k_grid's actual spacing exponent (the
+    K-S power-7 law, utils/grids.ks_k_grid) — the slab positioning uses
+    the analytic query form. Host-level entry — not callable inside jit.
+
+    Returns (KSSolution, escaped): KSSolution has no escape field (the
+    single-device K-S solvers cannot escape), so the flag rides alongside;
+    on escape the solution is NaN-poisoned and the caller falls back to
+    the unsharded solve_ks_egm."""
+    if grid_power <= 0.0:
+        raise ValueError(
+            "solve_ks_egm_sharded requires a power-spaced k_grid: pass its "
+            f"actual spacing exponent as grid_power, got {grid_power}")
+    D = int(mesh.shape[axis])
+    ns, nK, nk = k_opt_init.shape
+    if nk % D:
+        raise ValueError(f"mesh axis size {D} must divide the k-grid {nk}")
+    if pad < _STENCIL:
+        raise ValueError(
+            f"pad must be >= {_STENCIL} (the pchip stencil), got {pad}")
+    if capacity < 1.0:
+        raise ValueError(f"capacity must be >= 1.0, got {capacity}")
+    dtype = k_opt_init.dtype
+    run = _ks_egm_program(mesh, axis, ns, nK, nk, float(grid_power),
+                          float(capacity), int(pad), float(theta),
+                          float(beta), float(mu), float(l_bar), float(delta),
+                          float(k_min), float(k_max), float(tol),
+                          int(max_iter), bool(double_alm),
+                          jnp.dtype(dtype).name)
+    k_opt, dist, it, esc = run(k_opt_init, B_coef, k_grid, K_grid, P_mat,
+                               r_table, w_table, eps_by_state)
+    esc_h, dist_h, it_h = jax.device_get((esc, dist, it))
+    return KSSolution(jnp.zeros_like(k_opt), k_opt, it_h, dist_h), bool(esc_h)
+
+
+def _ks_egm_program(mesh, axis: str, ns: int, nK: int, nk: int, power: float,
+                    capacity: float, pad: int, theta: float, beta: float,
+                    mu: float, l_bar: float, delta: float, k_min: float,
+                    k_max: float, tol: float, max_iter: int,
+                    double_alm: bool, dtype_name: str):
+    D = int(mesh.shape[axis])
+    L = nk // D
+    dtype = jnp.dtype(dtype_name)
+    B = ks_ring_slab_size(nk, D, capacity, pad)
+    span = k_max - k_min
+    R = ns * nK
+    neg = jnp.array(-jnp.inf, dtype)
+
+    def gk_of(i):
+        # The analytic K-S spacing law (utils/grids.ks_k_grid).
+        return k_min + span * (i.astype(dtype) / (nk - 1)) ** power
+
+    def build():
+        def local(k0, B_coef, k_loc, K_grid, Pm, r_table, w_table,
+                  eps_by_state):
+            dev = jax.lax.axis_index(axis)
+            labor_endow = eps_by_state * l_bar + (1.0 - eps_by_state) * mu
+
+            Kp_idx = _alm_next_K_index(B_coef, K_grid, ns)         # [ns, nK]
+            Kp_val = K_grid[Kp_idx]
+            zp_index = jnp.arange(ns) % 2
+            if double_alm:
+                from aiyagari_tpu.solvers.ks_vfi import alm_predict
+
+                Kpp = alm_predict(B_coef, Kp_val[:, :, None],
+                                  zp_index[None, None, :])
+                Kpp = jnp.clip(Kpp, K_grid[0], K_grid[-1])
+                Knext_idx = jnp.argmin(
+                    jnp.abs(K_grid[None, None, None, :] - Kpp[..., None]),
+                    axis=-1).astype(jnp.int32)
+            else:
+                Knext_idx = jnp.broadcast_to(Kp_idx[:, :, None],
+                                             (ns, nK, ns))
+            r_next_tab = r_table[jnp.arange(ns)[None, None, :], Knext_idx]
+            w_next_tab = w_table[jnp.arange(ns)[None, None, :], Knext_idx]
+
+            # Every device's first query, analytically, for the psum'd
+            # bracket starts (ring step 1; ulp drift vs the caller's grid
+            # array is absorbed by pad).
+            e = jnp.arange(D)
+            q_first_all = gk_of(e * L)                              # [D]
+
+            def sweep(k_opt):
+                def euler_row(s, K_i):
+                    def per_next(sp):
+                        rn = r_next_tab[s, K_i, sp]
+                        wn = w_next_tab[s, K_i, sp]
+                        kp_next = k_opt[sp, Knext_idx[s, K_i, sp], :]
+                        res_next = (1.0 + rn - delta) * k_loc \
+                            + wn * labor_endow[sp]
+                        c_next = jnp.maximum(res_next - kp_next, 1e-8)
+                        return Pm[s, sp] * (1.0 + rn - delta) \
+                            * crra_marginal(c_next, theta)
+
+                    expected = jnp.sum(jax.vmap(per_next)(jnp.arange(ns)),
+                                       axis=0)                      # [L]
+                    c = crra_marginal_inverse(beta * expected, theta)
+                    k_endo = (c + k_loc - w_table[s, K_i] * labor_endow[s]) \
+                        / (1.0 + r_table[s, K_i] - delta)
+                    return k_endo
+
+                s_idx, K_idx = jnp.meshgrid(jnp.arange(ns), jnp.arange(nK),
+                                            indexing="ij")
+                k_endo = jax.vmap(euler_row)(s_idx.ravel(), K_idx.ravel())
+                # [R, L] local endogenous-knot shards.
+
+                # Global cummax (the sharded form of the reference's sort —
+                # module docstring): local cummax + cross-device prefix.
+                k_endo = jax.lax.cummax(k_endo, axis=1)
+                tails = jax.lax.all_gather(k_endo[:, -1], axis)     # [D, R]
+                mask = (jnp.arange(D) < dev)[:, None]
+                pref = jnp.max(jnp.where(mask, tails, neg), axis=0)
+                k_endo = jnp.maximum(k_endo, pref[:, None])
+
+                # Exact global bracket starts (valid-count psum rides along
+                # for the degenerate-slab escape).
+                cnt_part = jnp.sum(
+                    k_endo[:, None, :] < q_first_all[None, :, None],
+                    axis=-1).astype(jnp.int32)                      # [R, D]
+                nv_part = jnp.sum(
+                    (k_endo >= k_min) & (k_endo <= k_max),
+                    axis=-1).astype(jnp.int32)                      # [R]
+                c_all, nv_glob = jax.lax.psum((cnt_part, nv_part), axis)
+                s_start = c_all[:, dev] - pad                       # [R]
+
+                buf = ring_slab_assemble(k_endo[None], s_start, B=B,
+                                         n_k=nk, axis=axis, D=D)[0]  # [R, B]
+
+                def interp_row(bufr, s0, nvg):
+                    # Valid run inside the slab (contiguous: the knots are
+                    # globally monotone and the out-of-range sentinels are
+                    # ±inf, so invalids form a prefix and a suffix).
+                    valid = (bufr >= k_min) & (bufr <= k_max)
+                    nv = jnp.sum(valid).astype(jnp.int32)
+                    o = jnp.argmax(valid).astype(jnp.int32)
+                    # Roll the valid run to index 0 and re-sentinel the
+                    # tail: the slab then looks exactly like the
+                    # single-device sorted/masked row to masked_pchip.
+                    xs = jnp.roll(bufr, -o)
+                    xs = jnp.where(jnp.arange(B) < nv, xs, jnp.inf)
+                    # Exogenous values of the valid knots: the analytic
+                    # grid at their RAW global positions.
+                    ys = gk_of(jnp.clip(s0 + o + jnp.arange(B), 0, nk - 1))
+                    out = masked_pchip_interp(xs, ys, jnp.maximum(nv, 2),
+                                              k_loc)
+                    # Escapes: (a) the slab's valid run is truncated by the
+                    # slab top while global knots continue, and some
+                    # query's bracket (or its pchip stencil) reaches the
+                    # truncation; (b) the slab misses so much of the valid
+                    # run that fewer than a stencil's worth of knots
+                    # remain while the global run is larger.
+                    cnt_loc = jnp.sum(bufr[None, :] < k_loc[:, None],
+                                      axis=-1).astype(jnp.int32)    # [L]
+                    truncated = (o + nv >= B) & (s0 + B < nk)
+                    esc = truncated & (jnp.max(cnt_loc) + _STENCIL >= o + nv)
+                    esc = esc | ((nv < 2 + _STENCIL) & (nvg > nv))
+                    return jnp.clip(out, k_min, k_max), esc
+
+                out, esc_rows = jax.vmap(interp_row)(buf, s_start, nv_glob)
+                escaped = jax.lax.pmax(
+                    jnp.any(esc_rows).astype(jnp.int32), axis)
+                out = jnp.where(escaped > 0, jnp.nan, out)
+                return out.reshape(ns, nK, L), escaped
+
+            def cond(carry):
+                _, dist, it, _ = carry
+                return (dist >= tol) & (it < max_iter)
+
+            def body(carry):
+                k_opt, _, it, esc = carry
+                k_new, esc_new = sweep(k_opt)
+                dist = jax.lax.pmax(jnp.max(jnp.abs(k_new - k_opt)), axis)
+                return k_new, dist, it + 1, esc | (esc_new > 0)
+
+            init = (k0, jnp.array(jnp.inf, dtype), jnp.int32(0),
+                    jnp.array(False))
+            return jax.lax.while_loop(cond, body, init)
+
+        return jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, None, axis), P(), P(axis), P(), P(), P(),
+                      P(), P()),
+            out_specs=(P(None, None, axis), P(), P(), P()),
+        ))
+
+    key = mesh_fingerprint(mesh, axis) + (ns, nK, nk, power, capacity, pad,
+                                          theta, beta, mu, l_bar, delta,
+                                          k_min, k_max, tol, max_iter,
+                                          double_alm, dtype_name)
+    return cached_program(_KS_EGM_PROGRAMS, key, build)
