@@ -38,12 +38,17 @@ def _host_cpu_tag() -> str:
     next (loading) run, all exclusively the two pseudo-features — the real
     ISA sets match, the executables run, and the suite is green. That spam
     is cosmetic; driver-facing entry points set TF_CPP_MIN_LOG_LEVEL to
-    keep it out of artifacts. Do NOT re-chase it as a correctness bug."""
+    keep it out of artifacts. Do NOT re-chase it as a correctness bug.
+
+    Keyed on 'model name' + 'stepping' only — NOT the 'flags' line, whose
+    content shifts with kernel/microcode updates on identical hardware and
+    would silently orphan cache directories (cold recompiles + unbounded
+    ~/.cache growth) without any ISA change."""
     model = ""
     try:
         with open("/proc/cpuinfo") as f:
             for ln in f:
-                if ln.startswith(("model name", "flags")):
+                if ln.startswith(("model name", "stepping")):
                     model += ln
                     if model.count("\n") >= 2:
                         break

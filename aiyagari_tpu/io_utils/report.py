@@ -284,7 +284,9 @@ def krusell_smith_report(result, outdir, discard: int = 100) -> dict:
     fig.savefig(out / "wealth_cross_section.png", dpi=120)
     plt.close(fig)
 
-    err = np.abs(K_approx[discard + 1:] - K_ts[discard + 1:]) / K_ts[discard + 1:]
+    from aiyagari_tpu.utils.accuracy import alm_dynamic_path_error
+
+    err_max, err_mean = alm_dynamic_path_error(K_ts, z, B, discard)
     summary = {
         "B": B.tolist(),
         "r2_good": float(result.r2[0]),
@@ -293,7 +295,8 @@ def krusell_smith_report(result, outdir, discard: int = 100) -> dict:
         "iterations": result.iterations,
         "diff_B": result.diff_B,
         "K_mean": float(K_ts[discard:].mean()),
-        "alm_path_max_rel_error": float(err.max()),
+        "alm_path_max_rel_error": err_max,
+        "alm_path_mean_rel_error": err_mean,
         "wealth_gini": wealth_gini,
         "solve_seconds": result.solve_seconds,
     }

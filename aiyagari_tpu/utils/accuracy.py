@@ -18,7 +18,33 @@ import jax.numpy as jnp
 from aiyagari_tpu.ops.interp import linear_interp
 from aiyagari_tpu.utils.utility import crra_marginal
 
-__all__ = ["euler_equation_errors"]
+__all__ = ["alm_dynamic_path_error", "euler_equation_errors"]
+
+
+def alm_dynamic_path_error(K_ts, z_path, B, discard: int = 100):
+    """Den Haan (2010) dynamic-forecast accuracy of a fitted ALM: iterate
+    the law of motion K_{t+1} = exp(b0(z_t) + b1(z_t) log K_t) from the
+    TRUE path's starting point WITHOUT ever re-anchoring on the realized
+    path, and compare against the realized K_ts. This is the statistic
+    that certifies the R^2 headline — a one-step R^2 near 1 can coexist
+    with a drifting dynamic forecast along a near-unit-root ridge, and the
+    multi-step error is what reveals it (the fine-grid identification
+    caveat, BENCHMARKS.md). Mirrors compute_approxKprime
+    (Krusell_Smith_VFI.m:367-375); shared by io_utils/report.
+
+    Returns (max_rel_error, mean_rel_error) over t > discard."""
+    import numpy as np
+
+    K_ts = np.asarray(K_ts, np.float64)
+    z = np.asarray(z_path)
+    B = np.asarray(B, np.float64)
+    K_approx = np.empty_like(K_ts)
+    K_approx[discard] = K_ts[discard]
+    for t in range(discard, len(K_ts) - 1):
+        b0, b1 = (B[0], B[1]) if z[t] == 0 else (B[2], B[3])
+        K_approx[t + 1] = np.exp(b0 + b1 * np.log(K_approx[t]))
+    err = np.abs(K_approx[discard + 1:] - K_ts[discard + 1:]) / K_ts[discard + 1:]
+    return float(err.max()), float(err.mean())
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta"))

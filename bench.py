@@ -498,11 +498,20 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
     }
 
 
-def bench_ks_agents(quick: bool) -> dict:
-    """Krusell-Smith panel-simulation throughput (agents*steps/sec) at the
-    reference scale: 10,000 agents x 1,100 periods (Krusell_Smith_VFI.m:10)."""
+def _ks_panel_throughput(T: int, pop: int, *, reps: int, outer: int) -> dict:
+    """One K-S panel throughput measurement at (T, pop): chain `reps` full
+    panel simulations inside ONE jitted program — each repetition's initial
+    cross-section data-depends on the previous repetition's final aggregate
+    (k0 + 0*prev; XLA cannot fold 0*x away since 0*NaN != 0), so all reps
+    run sequentially on device — fetch once, and take the MEDIAN of `outer`
+    such timings. Median, not min (VERDICT round 3 weak #1): the shipped
+    artifact number must be what a re-run reproduces, and the min of a few
+    draws over the remote transport rides the best-case tail that a
+    different session does not hit; the per-rep min/max spread is recorded
+    alongside so the artifact carries this run's variability."""
     import jax
     import jax.numpy as jnp
+    from functools import partial
 
     from aiyagari_tpu.config import KrusellSmithConfig
     from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
@@ -513,26 +522,15 @@ def bench_ks_agents(quick: bool) -> dict:
     )
 
     cfg = KrusellSmithConfig()
-    T, pop = (300, 10_000) if quick else (1100, 10_000)
     platform = jax.default_backend()
     dtype = jnp.float32 if platform == "tpu" else jnp.float64
     model = KrusellSmithModel.from_config(cfg, dtype)
-    key = jax.random.PRNGKey(0)
-    kz, ke = jax.random.split(key)
+    kz, ke = jax.random.split(jax.random.PRNGKey(0))
     z = simulate_aggregate_shocks(model.pz, kz, T=T)
     eps = simulate_employment_panel(z, model.eps_trans, cfg.shocks.u_good,
                                     cfg.shocks.u_bad, ke, T=T, population=pop)
-    k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size)).astype(dtype)
-
-    # Amortized timing (same scheme as bench_aiyagari_vfi): chain `reps` full
-    # panel simulations inside ONE jitted program — each repetition's initial
-    # cross-section data-depends on the previous repetition's final aggregate
-    # (k0 + 0*prev; XLA cannot fold 0*x away since 0*NaN != 0), so all reps
-    # run sequentially on device — and fetch once. This keeps the ~100 ms
-    # remote-transport round trip of this image's TPU tunnel out of the
-    # per-simulation number.
-    from functools import partial
-
+    k_opt = 0.9 * jnp.broadcast_to(
+        model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size)).astype(dtype)
     K0 = float(model.K_grid[0])
 
     @partial(jax.jit, static_argnames=("reps",))
@@ -546,14 +544,39 @@ def bench_ks_agents(quick: bool) -> dict:
         _, lasts = jax.lax.scan(one, jnp.array(0.0, dtype), None, length=reps)
         return lasts[-1]
 
-    reps = 2 if quick else 8
     float(chained(reps=reps))  # compile + warmup, fenced
     times = []
-    for _ in range(1 if quick else 3):
+    for _ in range(outer):
         t0 = time.perf_counter()
         float(chained(reps=reps))   # scalar transfer = timing fence
         times.append(time.perf_counter() - t0)
-    t = min(times) / reps
+    times.sort()
+    t = times[len(times) // 2] / reps
+    spread = [round(times[0] / reps, 5), round(times[-1] / reps, 5)]
+    return {"model": model, "k_opt": k_opt, "z": z, "eps": eps, "cfg": cfg,
+            "dtype": dtype, "platform": platform, "t": t,
+            "per_sim_spread": spread}
+
+
+def bench_ks_agents(quick: bool) -> dict:
+    """Krusell-Smith panel-simulation throughput (agents*steps/sec) at the
+    reference scale: 10,000 agents x 1,100 periods (Krusell_Smith_VFI.m:10)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, pop = (300, 10_000) if quick else (1100, 10_000)
+    platform = jax.default_backend()
+    # reps amortize the per-program fetch round trip (~100 ms on this
+    # image's remote transport): at ~25 ms/sim on TPU, 8 reps left ~12% of
+    # the per-sim number to the fence — the measured gap between the
+    # BENCHMARKS headline and the round-3 driver artifact. 24 reps cut the
+    # fence share below ~2%. CPU sims are ~100x slower; small reps suffice.
+    if platform == "tpu":
+        reps, outer = (4, 1) if quick else (24, 5)
+    else:
+        reps, outer = (1, 1) if quick else (2, 3)
+    m = _ks_panel_throughput(T, pop, reps=reps, outer=outer)
+    t = m["t"]
     agent_steps = pop * (T - 1)
 
     # NumPy baseline: same panel step, vectorized with np.interp per state
@@ -562,11 +585,11 @@ def bench_ks_agents(quick: bool) -> dict:
     # artifact — just measures a short live loop at the quick T and stays
     # contention-sensitive.
     if quick:
-        k_opt_np = np.asarray(k_opt, np.float64)
+        k_opt_np = np.asarray(m["k_opt"], np.float64)
         t_np = _numpy_ks_panel_seconds(
-            k_opt_np, np.asarray(model.k_grid, np.float64),
-            np.asarray(model.K_grid, np.float64), np.asarray(z),
-            np.asarray(eps), T, pop, T_base=min(T, 120))
+            k_opt_np, np.asarray(m["model"].k_grid, np.float64),
+            np.asarray(m["model"].K_grid, np.float64), np.asarray(m["z"]),
+            np.asarray(m["eps"]), T, pop, T_base=min(T, 120))
         base_fields = {}
     else:
         den = frozen_denominator("numpy_ks_panel_10000x1100")
@@ -575,15 +598,107 @@ def bench_ks_agents(quick: bool) -> dict:
 
     from aiyagari_tpu.diagnostics.roofline import panel_step_cost, utilization
 
+    cfg = m["cfg"]
     cost = (T - 1) * panel_step_cost(pop, ns=4, nk=cfg.k_size,
-                                     itemsize=jnp.dtype(dtype).itemsize)
+                                     itemsize=jnp.dtype(m["dtype"]).itemsize)
     return {
         "metric": "ks_panel_agent_steps_per_sec",
         "value": round(agent_steps / t, 1),
         "unit": "agent_steps/sec",
         "vs_baseline": round(t_np / t, 2),
+        "per_sim_seconds_spread": m["per_sim_spread"],
         **base_fields,
         **utilization(t, cost, platform),
+    }
+
+
+def bench_ks_agents_large(quick: bool) -> dict:
+    """K-S panel throughput at 100,000 agents per device — the DP-scaling
+    axis where the analytic-bucket interpolation's win lives (measured
+    1.84x over the one-hot route at this population; BENCHMARKS.md round 3
+    — prose-only until this record). Shorter T than the reference panel:
+    the quantity is steady-state per-step throughput, which T=300 already
+    measures (the scan body is T-invariant), and the 10x population keeps
+    total agent-steps comparable. vs_baseline is a LIVE NumPy run of the
+    same 100k-agent panel (no frozen entry: this workload is framework-
+    defined, not the reference's — flagged in baseline_source)."""
+    import jax
+    import jax.numpy as jnp
+
+    T, pop = (120, 100_000) if quick else (300, 100_000)
+    platform = jax.default_backend()
+    if platform == "tpu":
+        reps, outer = (2, 1) if quick else (8, 5)
+    else:
+        reps, outer = (1, 1) if quick else (1, 3)
+    m = _ks_panel_throughput(T, pop, reps=reps, outer=outer)
+    t = m["t"]
+    agent_steps = pop * (T - 1)
+
+    # Live NumPy denominator at the same population (scaled from a short
+    # loop like the reference-scale denominator's T_base policy).
+    t_np = np.inf
+    for _ in range(1 if quick else 2):
+        t_np = min(t_np, _numpy_ks_panel_seconds(
+            np.asarray(m["k_opt"], np.float64),
+            np.asarray(m["model"].k_grid, np.float64),
+            np.asarray(m["model"].K_grid, np.float64), np.asarray(m["z"]),
+            np.asarray(m["eps"]), T, pop, T_base=min(T, 60)))
+
+    from aiyagari_tpu.diagnostics.roofline import panel_step_cost, utilization
+
+    cfg = m["cfg"]
+    cost = (T - 1) * panel_step_cost(pop, ns=4, nk=cfg.k_size,
+                                     itemsize=jnp.dtype(m["dtype"]).itemsize)
+    return {
+        "metric": "ks_panel_agent_steps_per_sec_pop100k",
+        "value": round(agent_steps / t, 1),
+        "unit": "agent_steps/sec",
+        "vs_baseline": round(t_np / t, 2),
+        "baseline_seconds": round(t_np, 4),
+        "baseline_source": "live-best-of-2 (framework-defined workload)",
+        "per_sim_seconds_spread": m["per_sim_spread"],
+        **utilization(t, cost, platform),
+    }
+
+
+def bench_ks_fine(quick: bool, k_size: int = 1000, method: str = "egm") -> dict:
+    """Fine-grid Krusell-Smith GE accuracy record (VERDICT round 3 #8a):
+    full ALM fixed point at k_size points (mixed precision, Anderson,
+    histogram closure — the round-3 fine-grid configuration), reporting the
+    per-regime R^2 AND the Den Haan dynamic-forecast error
+    (utils/accuracy.alm_dynamic_path_error) — the statistic that certifies
+    what the R^2 cannot along the near-unit-root ridge (the fine-grid
+    identification caveat, BENCHMARKS.md). Not part of --metric all: the
+    GE solve is minutes-scale; run explicitly and record in BENCHMARKS.md."""
+    import aiyagari_tpu as at
+    from aiyagari_tpu.utils.accuracy import alm_dynamic_path_error
+
+    if quick:
+        k_size = min(k_size, 200)
+    t0 = time.perf_counter()
+    res = at.solve(
+        at.KrusellSmithConfig(k_size=k_size), method=method,
+        backend=at.BackendConfig(dtype="mixed"),
+        alm=at.ALMConfig(acceleration="anderson"),
+        aggregation="distribution",
+    )
+    wall = time.perf_counter() - t0
+    err_max, err_mean = alm_dynamic_path_error(
+        res.K_ts, res.z_path, res.B, discard=100)
+    return {
+        "metric": f"ks_fine_ge_k{k_size}_{method}",
+        "value": round(wall, 2),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "converged": bool(res.converged),
+        "iterations": int(res.iterations),
+        "diff_B": float(res.diff_B),
+        "r2_good": round(float(res.r2[0]), 7),
+        "r2_bad": round(float(res.r2[1]), 7),
+        "den_haan_max_rel_err": round(err_max, 6),
+        "den_haan_mean_rel_err": round(err_mean, 6),
+        "B": [round(float(b), 5) for b in res.B],
     }
 
 
@@ -644,10 +759,15 @@ def main() -> int:
     ap.add_argument("--grid", type=int, default=400)
     ap.add_argument("--grid-scale", type=int, default=400_000)
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--metric", choices=["all", "vfi", "ks", "scale"], default="all",
+    ap.add_argument("--metric",
+                    choices=["all", "vfi", "ks", "ks_large", "ks_fine",
+                             "scale", "scale_vfi"],
+                    default="all",
                     help="'all' (default) emits one JSON line per headline "
-                         "metric — reference-scale VFI, K-S panel throughput, "
-                         "and the north-star scale — in one device session")
+                         "metric — reference-scale VFI, K-S panel throughput "
+                         "(reference + 100k-agent populations), and the "
+                         "north-star scale for both solver families — in one "
+                         "device session")
     ap.add_argument("--platform", choices=["cpu", "tpu"], default=None,
                     help="force a jax platform (the JAX_PLATFORMS env var is "
                          "overridden by this image's TPU plugin, so use this flag)")
@@ -716,13 +836,21 @@ def main() -> int:
     runners = {
         "vfi": lambda: bench_aiyagari_vfi(args.grid, args.quick),
         "ks": lambda: bench_ks_agents(args.quick),
+        "ks_large": lambda: bench_ks_agents_large(args.quick),
+        "ks_fine": lambda: bench_ks_fine(args.quick),
         "scale": lambda: bench_scale(args.grid_scale, args.quick, args.scale_solver,
                                      args.noise_floor_ulp, args.pallas_inversion),
+        "scale_vfi": lambda: bench_scale(args.grid_scale, args.quick, "vfi",
+                                         args.noise_floor_ulp, False),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
-    # even if a later, longer metric dies).
-    for name in (("vfi", "ks", "scale") if args.metric == "all" else (args.metric,)):
+    # even if a later, longer metric dies; scale_vfi last — the declared
+    # north-star metric names VFI, so the artifact measures it at the
+    # north-star scale too, not only the EGM carrier).
+    names = (("vfi", "ks", "ks_large", "scale", "scale_vfi")
+             if args.metric == "all" else (args.metric,))
+    for name in names:
         result = runners[name]()
         print(json.dumps(result), flush=True)
     return 0
