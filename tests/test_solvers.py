@@ -123,14 +123,16 @@ class TestContinuousVFI:
     def test_slab_route_matches_local_window(self):
         """The monotone-policy slab improvement + one-hot Howard contraction
         (the fine-grid route, BENCHMARKS.md round 3) against the
-        local-window gather route on the same 5,120-point problem — the
-        slab paths are otherwise dead below the 4,096-point auto gate, so
-        this is the pin for the 'bitwise equal to the gather' claim and
-        the tie-to-previous argmax (same fixed point; f64 has no value
-        ties, so the tie rules cannot diverge)."""
+        local-window gather route, both FORCED via the `slab` flag — the
+        claim (identical discrete fixed point and tie-to-previous argmax;
+        f64 has no value ties, so the tie rules cannot diverge) is
+        geometry-relative, so the smallest slab-sound grid pins it:
+        use_slab needs ceil(na/256) >= 6 blocks, and 2,304 = 9 knot-blocks
+        exercises the padded-tail geometry too (was 5,120 — ~2.2x the
+        wall for no added coverage; round-3 trim technique)."""
         from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
 
-        n = 5_120
+        n = 2_304
         m = aiyagari_preset(grid_size=n)
         prefs = m.preferences
         w = wage_from_r(R_TEST, m.config.technology.alpha,
