@@ -415,7 +415,6 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         idxp_pad, ab_all = _slab_geometry(idx)
         idxb = idxp_pad.reshape(N, nbp, _SQ)
         joff = jnp.arange(_SLAB, dtype=jnp.int32)
-        jglob = ab_all[:, :, None] * _KB + joff[None, None, :]  # [N,nbp,_SLAB]
         # BOTH bounds: within-block monotonicity of idx is not guaranteed
         # (improve_global on an f32 tie plateau can jump non-monotonically),
         # so an index below its block's slab start is as reachable as one
@@ -428,10 +427,26 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
             EV = expectation(P, v, beta)
             EVp = jnp.concatenate(
                 [EV, jnp.zeros((N, padk_s), EV.dtype)], axis=1)
-            seg = _slab_fetch(EVp, ab_all)                      # [N,nbp,_SLAB]
-            g = jnp.sum(jnp.where(jglob[:, :, None, :] == idxb[..., None],
-                                  seg[:, :, None, :], 0.0), axis=3)
-            return u_pol + g.reshape(N, nbp * _SQ)[:, :na], None
+
+            # Chunked like improve_slab, and for the same reason: the
+            # un-chunked [N, nbp, _SQ, _SLAB] one-hot broadcast is ~17 GB
+            # at 400k points — it CRASHED the TPU worker (HBM OOM) the
+            # first time the north-star scale ran this solver; per chunk
+            # it is ~176 MB.
+            def chunk(t):
+                ab = jax.lax.dynamic_slice_in_dim(ab_all, t * _CB, _CB,
+                                                  axis=1)
+                seg = _slab_fetch(EVp, ab)                   # [N,_CB,_SLAB]
+                jg = ab[:, :, None] * _KB + joff[None, None, :]
+                idxc = jax.lax.dynamic_slice_in_dim(
+                    idxb, t * _CB, _CB, axis=1)              # [N,_CB,_SQ]
+                return jnp.sum(
+                    jnp.where(jg[:, :, None, :] == idxc[..., None],
+                              seg[:, :, None, :], 0.0), axis=3)
+
+            g = jax.lax.map(chunk, jnp.arange(nT))           # [nT,N,_CB,_SQ]
+            g = jnp.moveaxis(g, 0, 1).reshape(N, nbp * _SQ)[:, :na]
+            return u_pol + g, None
 
         def run_slab(v):
             v, _ = jax.lax.scan(sweep_slab, v, None,
