@@ -1,0 +1,29 @@
+"""The shared ulp-noise stopping floor of the household solvers.
+
+One implementation of tol_effective = max(tol, noise_floor_ulp * eps *
+max|iterate|) so the EGM solvers (single-device and ring-sharded) and the
+continuous VFI cannot drift apart in convergence semantics — each
+docstring claims "exactly the EGM rule", and this makes the claim
+structural (round-4 review finding). Rationale for the rule itself:
+solvers/egm.solve_aiyagari_egm's noise_floor_ulp docstring.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["effective_tolerance"]
+
+
+def effective_tolerance(tol_c, max_abs, *, noise_floor_ulp: float,
+                        relative_tol: bool, dtype):
+    """Effective stopping tolerance given the iterate's sup-norm `max_abs`
+    (callers pass jnp.max(jnp.abs(x)) — or its pmax under shard_map, so the
+    sharded routes apply the GLOBAL floor). Static no-op (returns tol_c
+    unchanged) when the floor is disabled or the criterion is relative —
+    the relative criterion is already scale-free, so the band argument
+    does not apply."""
+    if noise_floor_ulp <= 0.0 or relative_tol:
+        return tol_c
+    floor_k = float(noise_floor_ulp) * float(jnp.finfo(dtype).eps)
+    return jnp.maximum(tol_c, floor_k * max_abs)

@@ -146,8 +146,9 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     reached before the band matters). The applied tolerance is returned as
     EGMSolution.tol_effective; convergence checks must use it."""
 
+    from aiyagari_tpu.solvers._stopping import effective_tolerance
+
     tol_c = jnp.asarray(tol, C_init.dtype)
-    floor_k = float(noise_floor_ulp) * float(jnp.finfo(C_init.dtype).eps)
 
     def cond(carry):
         _, _, dist, it, _, tol_eff = carry
@@ -162,12 +163,9 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
                                             use_pallas=use_pallas)
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
-        if noise_floor_ulp > 0.0 and not relative_tol:
-            tol_eff = jnp.maximum(tol_c, floor_k * jnp.max(jnp.abs(C_new)))
-        else:
-            # The relative criterion is already scale-free; the band argument
-            # does not apply, so the floor is ignored there.
-            tol_eff = tol_c
+        tol_eff = effective_tolerance(
+            tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
+            relative_tol=relative_tol, dtype=C_init.dtype)
         device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
         return C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff
 
@@ -228,8 +226,9 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
     c_con = constrained_consumption_labor(
         a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
     )
+    from aiyagari_tpu.solvers._stopping import effective_tolerance
+
     tol_c = jnp.asarray(tol, C_init.dtype)
-    floor_k = float(noise_floor_ulp) * float(jnp.finfo(C_init.dtype).eps)
 
     def cond(carry):
         return (carry[3] >= carry[6]) & (carry[4] < max_iter)
@@ -242,10 +241,9 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
         )
         diff = jnp.abs(C_new - C)
         dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
-        if noise_floor_ulp > 0.0 and not relative_tol:
-            tol_eff = jnp.maximum(tol_c, floor_k * jnp.max(jnp.abs(C_new)))
-        else:
-            tol_eff = tol_c
+        tol_eff = effective_tolerance(
+            tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
+            relative_tol=relative_tol, dtype=C_init.dtype)
         device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
         return C_new, policy_k, policy_l, dist, it + 1, esc | esc_new, tol_eff
 

@@ -125,12 +125,13 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
                  noise_floor_ulp: float, dtype_name: str):
+    from aiyagari_tpu.solvers._stopping import effective_tolerance
+
     D = int(mesh.shape[axis])
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
     tol_c = jnp.asarray(tol, dtype)
-    floor_k = float(noise_floor_ulp) * float(jnp.finfo(dtype).eps)
     neg = jnp.array(-jnp.inf, dtype)
 
     def build():
@@ -177,15 +178,11 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                 local = (jnp.max(diff / (jnp.abs(C) + 1e-10))
                          if relative_tol else jnp.max(diff))
                 dist = jax.lax.pmax(local, axis)
-                if noise_floor_ulp > 0.0 and not relative_tol:
-                    # The f32 ulp-noise stopping floor of
-                    # solve_aiyagari_egm; sup-norm of the iterate pmax'd so
-                    # the effective tolerance is the global one.
-                    tol_eff = jnp.maximum(
-                        tol_c,
-                        floor_k * jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis))
-                else:
-                    tol_eff = tol_c
+                # Sup-norm pmax'd so the effective tolerance is global.
+                tol_eff = effective_tolerance(
+                    tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
+                    noise_floor_ulp=noise_floor_ulp,
+                    relative_tol=relative_tol, dtype=dtype)
                 return C_new, policy_k, dist, it + 1, esc | (esc_new > 0), tol_eff
 
             init = (C0, jnp.zeros_like(C0), jnp.array(jnp.inf, dtype),
@@ -281,8 +278,9 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
+    from aiyagari_tpu.solvers._stopping import effective_tolerance
+
     tol_c = jnp.asarray(tol, dtype)
-    floor_k = float(noise_floor_ulp) * float(jnp.finfo(dtype).eps)
     neg = jnp.array(-jnp.inf, dtype)
 
     def build():
@@ -352,12 +350,10 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                 local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
                            if relative_tol else jnp.max(diff))
                 dist = jax.lax.pmax(local_d, axis)
-                if noise_floor_ulp > 0.0 and not relative_tol:
-                    tol_eff = jnp.maximum(
-                        tol_c,
-                        floor_k * jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis))
-                else:
-                    tol_eff = tol_c
+                tol_eff = effective_tolerance(
+                    tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
+                    noise_floor_ulp=noise_floor_ulp,
+                    relative_tol=relative_tol, dtype=dtype)
                 return (C_new, policy_k, policy_l, dist, it + 1,
                         esc | (esc_new > 0), tol_eff)
 

@@ -49,6 +49,13 @@ class VFISolution:
     policy_l: jax.Array       # [N, na]
     iterations: jax.Array     # scalar int32
     distance: jax.Array       # scalar, final sup-norm
+    # The tolerance the stopping rule actually applied: == tol for the
+    # discrete solvers, max(tol, noise floor) when the continuous solver's
+    # ulp-noise floor was engaged (noise_floor_ulp). Convergence checks
+    # should compare distance against THIS, not tol
+    # (cf. EGMSolution.tol_effective).
+    tol_effective: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0.0))
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps", "block_size", "relative_tol", "use_pallas", "progress_every"))
@@ -112,18 +119,20 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
     v, idx, dist, it = jax.lax.while_loop(cond, body, init)
     policy_k = a_grid[idx]
     policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
-    return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it, dist)
+    return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it,
+                       dist, jnp.asarray(tol, v.dtype))
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
                                    "golden_iters", "relative_tol", "grid_power",
-                                   "slab"))
+                                   "slab", "noise_floor_ulp"))
 def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: float,
                                   beta: float, tol: float, max_iter: int,
                                   howard_steps: int = 20, golden_iters: int = 48,
                                   relative_tol: bool = False,
                                   grid_power: float = 0.0,
-                                  slab: bool | None = None) -> VFISolution:
+                                  slab: bool | None = None,
+                                  noise_floor_ulp: float = 0.0) -> VFISolution:
     """Scalable VFI: coarse-to-fine maximization of u(coh - a'_j) + EV_j over
     grid *indices* j (ops/golden.unimodal_argmax_index), followed by one
     continuous golden-section refinement of the converged policy within its
@@ -151,8 +160,19 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     slab=None auto-selects the monotone-policy SLAB improvement/evaluation
     above 4,096 points (block-DMA dense argmax + one-hot Howard
     contraction — no EV element gathers; BENCHMARKS.md round 3); True or
-    False forces a route (TestContinuousVFI pins slab == local-window at
-    5,120 points).
+    False forces a route (TestContinuousVFI pins slab == local-window).
+
+    noise_floor_ulp > 0 widens the absolute stopping tolerance to
+    max(tol, noise_floor_ulp * eps(dtype) * max|v|) — the VALUE criterion's
+    own f32 rounding band, exactly the EGM solvers' noise_floor_ulp
+    semantics (solvers/egm.solve_aiyagari_egm docstring). Why it exists
+    here too: at [7, 400k] f32 the value sup-norm wanders at 1.2-4.9e-4
+    (~24 ulp of values O(100)) forever while tol=1e-5 never fires, and the
+    policy-stability stop cannot catch every flat-top wobble pattern at
+    2.8M points — the un-floored loop ran to max_iter inside one device
+    call until the remote transport killed the TPU worker (round 4,
+    BENCHMARKS.md). The applied tolerance is returned as
+    VFISolution.tol_effective; convergence checks must use it.
     """
     from aiyagari_tpu.ops.golden import golden_section_max, unimodal_argmax_index
     from aiyagari_tpu.ops.interp import bucket_index, power_bucket_index
@@ -455,12 +475,28 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
 
         return jax.lax.cond(in_slab, run_slab, run_gather, v)
 
+    # The f32 ulp-noise stopping floor (the EGM solvers' noise_floor_ulp,
+    # solvers/egm.solve_aiyagari_egm docstring, applied to the VALUE
+    # criterion): at fine grids the value iterate reaches its fixed point
+    # and then wanders in the rounding band of the sup-norm — measured
+    # 1.2-4.9e-4 at [7, 400k] f32 (values O(100): ~24 ulp), with absolute
+    # tol 1e-5 UNREACHABLE there; the un-floored loop ground to max_iter
+    # inside one device call until the remote transport killed the worker.
+    from aiyagari_tpu.solvers._stopping import effective_tolerance
+
+    tol_c = jnp.asarray(tol, v_init.dtype)
+
+    def _tol_eff_of(v_new):
+        return effective_tolerance(
+            tol_c, jnp.max(jnp.abs(v_new)), noise_floor_ulp=noise_floor_ulp,
+            relative_tol=relative_tol, dtype=v_init.dtype)
+
     def cond(carry):
-        _, _, _, dist, it, same = carry
-        return (dist >= tol) & (it < max_iter) & jnp.logical_not(same)
+        _, _, _, dist, it, same, tol_eff = carry
+        return (dist >= tol_eff) & (it < max_iter) & jnp.logical_not(same)
 
     def body(carry):
-        v, idx_prev, idx_prev2, _, it, _ = carry
+        v, idx_prev, idx_prev2, _, it, _, _ = carry
         idx = improve(v, idx_prev)
         v_new = evaluate(v, idx)
         diff = jnp.abs(v_new - v)
@@ -489,12 +525,13 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         near = dist < 1e3 * tol
         same = near & ((jnp.all(idx == idx_prev) & (it > 0)) | (
             jnp.all(idx == idx_prev2) & (it > 1)))
-        return v_new, idx, idx_prev, dist, it + 1, same
+        return v_new, idx, idx_prev, dist, it + 1, same, _tol_eff_of(v_new)
 
     z_idx = jnp.zeros(coh.shape, jnp.int32)
     init = (v_init, z_idx, z_idx,
-            jnp.array(jnp.inf, v_init.dtype), jnp.int32(0), jnp.array(False))
-    v, idx, _, dist, it, same = jax.lax.while_loop(cond, body, init)
+            jnp.array(jnp.inf, v_init.dtype), jnp.int32(0), jnp.array(False),
+            tol_c)
+    v, idx, _, dist, it, same, tol_eff = jax.lax.while_loop(cond, body, init)
 
     # Policy-repeat exits still owe v a polish: with the policy fixed, each
     # evaluate() burst contracts the value residual by ~beta^howard_steps,
@@ -502,21 +539,21 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     # tolerance the value-based stop would have — without re-running the
     # gather-heavy improvement rounds (the whole point of the early exit).
     def _pol_cond(c):
-        _, d, k = c
-        return (d >= tol) & (k < jnp.int32(50))
+        _, d, k, te = c
+        return (d >= te) & (k < jnp.int32(50))
 
     def _pol_body(c):
-        vv, _, k = c
+        vv, _, k, _ = c
         v2 = evaluate(vv, idx)
         diff = jnp.abs(v2 - vv)
         d = jnp.max(diff / (jnp.abs(vv) + 1e-10)) if relative_tol else jnp.max(diff)
-        return v2, d, k + 1
+        return v2, d, k + 1, _tol_eff_of(v2)
 
-    v, dist, _ = jax.lax.cond(
+    v, dist, _, tol_eff = jax.lax.cond(
         same,
         lambda c: jax.lax.while_loop(_pol_cond, _pol_body, c),
         lambda c: c,
-        (v, dist, jnp.int32(0)),
+        (v, dist, jnp.int32(0), tol_eff),
     )
 
     policy_k = a_grid[idx]
@@ -543,7 +580,7 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
 
     policy_c = jnp.maximum(coh - policy_k, c_floor)
     return VFISolution(v, idx, policy_k, policy_c,
-                       jnp.ones_like(policy_k), it, dist)
+                       jnp.ones_like(policy_k), it, dist, tol_eff)
 
 
 def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -552,7 +589,8 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   howard_steps: int = 20, golden_iters: int = 48,
                                   coarsest: int = 400,
                                   refine_factor: int = 10,
-                                  relative_tol: bool = False) -> VFISolution:
+                                  relative_tol: bool = False,
+                                  noise_floor_ulp: float = 0.0) -> VFISolution:
     """Grid-sequenced continuous VFI: solve coarse, prolong the VALUE function
     to each finer power grid (ops/interp.prolong_power_grid — closed-form
     bucket, one dispatch per stage), and re-converge there.
@@ -598,6 +636,7 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
             # In-cell continuous refinement only matters on the final grid.
             golden_iters=golden_iters if n == n_final else 0,
             relative_tol=relative_tol, grid_power=grid_power,
+            noise_floor_ulp=noise_floor_ulp,
         )
     return sol
 
@@ -660,4 +699,5 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: f
     policy_k = a_grid[a_idx]
     policy_l = labor_grid[l_idx]
     policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] * policy_l - policy_k
-    return VFISolution(v, a_idx, policy_k, policy_c, policy_l, it, dist)
+    return VFISolution(v, a_idx, policy_k, policy_c, policy_l, it, dist,
+                       jnp.asarray(tol, v.dtype))

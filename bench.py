@@ -382,11 +382,17 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
             # howard_steps=25: with the slab improvement/evaluation the
             # per-round balance shifted — measured 2.88 s at hs=25 vs
             # 3.06 s at hs=50 at [7, 40k] (BENCHMARKS.md round 3).
+            # noise_floor_ulp: the VALUE criterion's f32 rounding band at
+            # 400k sits at ~24 ulp of max|v| (~5e-4, values O(100)) — the
+            # strict 1e-5 is unreachable there and the un-floored loop
+            # ground to max_iter until the transport killed the worker
+            # (BENCHMARKS.md round 4).
             return solve_aiyagari_vfi_multiscale(
                 model.a_grid, model.s, model.P, r, w, model.amin,
                 sigma=model.preferences.sigma, beta=model.preferences.beta,
                 tol=tol, max_iter=max_iter, howard_steps=25,
                 grid_power=model.config.grid.power,
+                noise_floor_ulp=noise_floor_ulp,
             )
 
     sol = run()

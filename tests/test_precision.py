@@ -215,6 +215,44 @@ class TestFineGridF32:
                 np.testing.assert_array_equal(np.asarray(floored.policy_c),
                                               np.asarray(strict.policy_c))
 
+    def test_vfi_noise_floor_rule_semantics(self):
+        """The continuous VFI's noise_floor_ulp (round 4): at 400k f32 the
+        VALUE sup-norm wanders at ~24 ulp of max|v| (~5e-4) and the strict
+        1e-5 never fires — the un-floored loop ran to max_iter in one
+        device call until the transport killed the TPU worker. This pins
+        the rule's mechanics at test scale: tol_effective reported above
+        tol in f32 (values O(100) -> floor_24 ~ 2.9e-4), no more sweeps
+        than strict, and an exact no-op in f64."""
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi_continuous
+        from aiyagari_tpu.utils.firm import wage_from_r
+
+        n = 600
+        for dtype in (jnp.float32, jnp.float64):
+            m = aiyagari_preset(grid_size=n, dtype=dtype)
+            w = float(wage_from_r(0.04, m.config.technology.alpha,
+                                  m.config.technology.delta))
+            v0 = jnp.zeros((m.P.shape[0], n), dtype)
+            kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+                      tol=1e-5, max_iter=2000, howard_steps=25,
+                      golden_iters=0, grid_power=2.0)
+            strict = solve_aiyagari_vfi_continuous(
+                v0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+            floored = solve_aiyagari_vfi_continuous(
+                v0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                noise_floor_ulp=24.0, **kw)
+            assert bool(jnp.all(jnp.isfinite(floored.v)))
+            if dtype == jnp.float32:
+                assert float(floored.tol_effective) > 1e-5
+                assert int(floored.iterations) <= int(strict.iterations)
+                # Same noise cone as the EGM rule: both stop within their
+                # own tolerance of the fixed point.
+                bound = (float(floored.tol_effective) + 1e-5) / (1 - m.preferences.beta)
+                assert float(jnp.max(jnp.abs(floored.v - strict.v))) < bound
+            else:
+                assert float(floored.tol_effective) == pytest.approx(1e-5)
+                np.testing.assert_array_equal(np.asarray(floored.v),
+                                              np.asarray(strict.v))
+
     @pytest.mark.slow
     def test_labor_egm_f32_converges_on_fine_grid(self):
         # Same hazard as test_egm_f32_converges_on_fine_grid but through the
