@@ -123,16 +123,26 @@ def egm_sweep_cost(N: int, na: int, itemsize: int = 4,
 
 
 def panel_step_cost(population: int, ns: int = 4, nk: int = 100,
-                    itemsize: int = 4) -> KernelCost:
-    """One Krusell-Smith panel step (sim/ks_panel._panel_scan +
-    ops/interp.state_policy_interp): per agent, a [1,ns]x[ns,nk] one-hot row
-    pick (MXU), an nk-wide bucket one-hot + segment contraction (VPU), and
-    the mean reduction. HBM model assumes the [B, nk] one-hot and row-pick
-    intermediates materialize once each (they are matmul operands, not
-    fusable elementwise temporaries)."""
-    mxu = 2.0 * population * ns * nk       # ohS @ policies
-    vpu = population * (ns + 7.0 * nk)     # one-hot build + 4 contractions + interp
-    bytes_ = itemsize * population * (3.0 * nk + 8.0)   # ohS/sel/Y + k in/out
+                    itemsize: int = 4, analytic: bool = False) -> KernelCost:
+    """One Krusell-Smith panel step (sim/ks_panel._panel_scan).
+
+    analytic=False models the one-hot route (ops/interp.
+    state_policy_interp): per agent, a [1,ns]x[ns,nk] one-hot row pick
+    (MXU), an nk-wide bucket one-hot + segment contraction (VPU); the HBM
+    model assumes the [B, nk] one-hot and row-pick intermediates
+    materialize once each (matmul operands, not fusable temporaries).
+
+    analytic=True models the production power-grid route
+    (state_policy_interp_power, the grid_power>0 path): the bucket and
+    bracketing values are closed forms, no HIGHEST matmuls, and XLA fuses
+    the hat-weighted reduction into ONE streamed [B, nk] pass — modeling
+    the one-hot route's three materialized intermediates here overcounts
+    bytes ~3x (observed: membw_frac 1.5 at 100k agents, a physically
+    impossible fraction from the wrong model)."""
+    mxu = (0.0 if analytic else 2.0 * population * ns * nk)   # ohS @ policies
+    vpu = population * (ns + 7.0 * nk)     # weights/masks + reductions
+    per_agent_bytes = (nk + 8.0) if analytic else (3.0 * nk + 8.0)
+    bytes_ = itemsize * population * per_agent_bytes
     return KernelCost(mxu, vpu, bytes_)
 
 

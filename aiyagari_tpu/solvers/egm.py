@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
 from aiyagari_tpu.ops.interp import prolong_power_grid
+from aiyagari_tpu.solvers._stopping import effective_tolerance
 
 # Multigrid ladder defaults, shared with the mesh warm-start route
 # (equilibrium/bisection.py) so the stage geometry cannot drift.
@@ -146,8 +147,6 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     reached before the band matters). The applied tolerance is returned as
     EGMSolution.tol_effective; convergence checks must use it."""
 
-    from aiyagari_tpu.solvers._stopping import effective_tolerance
-
     tol_c = jnp.asarray(tol, C_init.dtype)
 
     def cond(carry):
@@ -226,8 +225,6 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, 
     c_con = constrained_consumption_labor(
         a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
     )
-    from aiyagari_tpu.solvers._stopping import effective_tolerance
-
     tol_c = jnp.asarray(tol, C_init.dtype)
 
     def cond(carry):

@@ -48,6 +48,7 @@ from aiyagari_tpu.parallel.ring import (
     ring_inverse_local,
     ring_slab_fits,
 )
+from aiyagari_tpu.solvers._stopping import effective_tolerance
 from aiyagari_tpu.solvers.egm import EGMSolution, _cached_grid_bounds, _fetch_scalars
 from aiyagari_tpu.utils.utility import (
     crra_marginal,
@@ -125,8 +126,6 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
                  noise_floor_ulp: float, dtype_name: str):
-    from aiyagari_tpu.solvers._stopping import effective_tolerance
-
     D = int(mesh.shape[axis])
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
@@ -278,8 +277,6 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
-    from aiyagari_tpu.solvers._stopping import effective_tolerance
-
     tol_c = jnp.asarray(tol, dtype)
     neg = jnp.array(-jnp.inf, dtype)
 

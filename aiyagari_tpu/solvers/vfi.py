@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.solvers._stopping import effective_tolerance
 from aiyagari_tpu.ops.bellman import (
     expectation,
     bellman_step,
@@ -482,8 +483,6 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     # 1.2-4.9e-4 at [7, 400k] f32 (values O(100): ~24 ulp), with absolute
     # tol 1e-5 UNREACHABLE there; the un-floored loop ground to max_iter
     # inside one device call until the remote transport killed the worker.
-    from aiyagari_tpu.solvers._stopping import effective_tolerance
-
     tol_c = jnp.asarray(tol, v_init.dtype)
 
     def _tol_eff_of(v_new):
