@@ -737,6 +737,20 @@ def _run_in_child(timeout_s: float) -> int | None:
         stderr = getattr(e, "stderr", None)
         if stderr:
             sys.stderr.write(stderr if isinstance(stderr, str) else stderr.decode())
+        # TimeoutExpired carries the partial stdout: metrics measured on
+        # the real device before the hang must be relayed, not re-run on
+        # CPU as wrong-platform duplicates (same contract as the
+        # partial-battery path below).
+        stdout = getattr(e, "stdout", None)
+        if stdout:
+            stdout = stdout if isinstance(stdout, str) else stdout.decode()
+            lines = [l for l in stdout.splitlines()
+                     if l.startswith('{"metric"')]
+            if lines:
+                print("\n".join(lines), flush=True)
+                print(f"bench: child timed out after {len(lines)} metric(s); "
+                      "partial results relayed above", file=sys.stderr)
+                return 1
         print(f"bench: child run failed ({type(e).__name__} after "
               f"{timeout_s:.0f}s); falling back to --platform cpu", file=sys.stderr)
         return None

@@ -538,21 +538,32 @@ class TestShardedEGMSolver:
         n = 6_144
         m, w, C0, kw = _egm_problem(n)
         scfg = SolverConfig(method="egm", tol=1e-5, max_iter=2000)
-        eq = EquilibriumConfig(max_iter=3)
+        # 2 bisection midpoints and a looser distribution fixed point: the
+        # composition claims (warm-start hand-off, identical bracket
+        # decisions, per-shard checkpoint round trip) are count- and
+        # dist-tol-independent, and each midpoint costs a full sharded
+        # solve on the one-core mesh (this test measured 38 min of the
+        # round-4 suite at max_iter=3 / dist 1e-10).
+        eq = EquilibriumConfig(max_iter=2)
+        dist_kw = dict(dist_tol=1e-8, dist_max_iter=3000)
         mesh8 = make_mesh(("grid",))
-        ref = solve_equilibrium_distribution(m, solver=scfg, eq=eq)
+        ref = solve_equilibrium_distribution(m, solver=scfg, eq=eq, **dist_kw)
 
         class Stop(Exception):
             pass
 
         def interrupt(rec):
+            # Fires BEFORE iteration 1's own save — the checkpoint on disk
+            # is iteration 0's, so the resume re-runs iteration 1 from the
+            # per-shard warm start.
             if rec["iteration"] == 1:
                 raise Stop
 
         with pytest.raises(Stop):
             solve_equilibrium_distribution(m, solver=scfg, eq=eq, mesh=mesh8,
                                            on_iteration=interrupt,
-                                           checkpoint_dir=tmp_path)
+                                           checkpoint_dir=tmp_path,
+                                           **dist_kw)
         # The checkpoint holds the sharded warm start per shard: 8 shard
         # entries of [7, 768], and NO assembled full-grid entry.
         (ckpt,) = tmp_path.glob("*.npz")
@@ -562,7 +573,8 @@ class TestShardedEGMSolver:
         assert arrays["warm__shard0"].shape == (7, n // 8)
         res = solve_equilibrium_distribution(m, solver=scfg, eq=eq,
                                              mesh=mesh8,
-                                             checkpoint_dir=tmp_path)
+                                             checkpoint_dir=tmp_path,
+                                             **dist_kw)
         # The sharded solves differ from the single-device ones only by the
         # Euler matmul's reassociation (~1e-12 on f64 policies), so every
         # bisection decision — and hence the bracket path and r* — must be
