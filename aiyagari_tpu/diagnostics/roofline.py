@@ -36,6 +36,7 @@ __all__ = [
     "KernelCost",
     "CHIP_PEAKS",
     "vfi_sweep_cost",
+    "vfi_slab_cost",
     "egm_sweep_cost",
     "panel_step_cost",
     "utilization",
@@ -86,6 +87,40 @@ def vfi_sweep_cost(N: int, na: int, itemsize: int = 4) -> KernelCost:
     bytes_ = itemsize * (N * na * na      # U read
                          + 4.0 * N * na)  # v read, EV write/read, v_new+idx write
     return KernelCost(mxu, vpu, bytes_)
+
+
+def vfi_slab_cost(N: int, na: int, itemsize: int = 4, *,
+                  improve_rounds: int = 1, eval_sweeps: int = 0,
+                  sq: int = 256, kb: int = 256, mw: int = 6) -> KernelCost:
+    """Slab-argmax continuous VFI (solvers/vfi.solve_aiyagari_vfi_continuous,
+    use_slab route): `improve_rounds` slab improvement rounds plus
+    `eval_sweeps` Howard one-hot evaluation sweeps — the two passes share
+    the slab geometry (sq-query blocks, mw contiguous kb-cell knot blocks =
+    a sq*kb*mw/sq-wide candidate slab per query), so both are dominated by
+    dense VPU work over N * ceil(na/sq) * sq * (kb*mw) slab cells.
+
+    Per improvement cell: consumption (sub+clamp), CRRA u (pow+div ~3),
+    + seg add, feasibility (3 compares + combine ~6), max-reduce compare,
+    tie-to-previous argmin pass (~3) — ~16 ops. Per evaluation cell: the
+    one-hot contraction's eq-compare + select + add — 3 ops. HBM: the slab
+    block-DMA fetch (mw*kb cells per sq queries = mw*kb/sq bytes/query) plus
+    ~6-8 [N, na] operand streams; both passes are an order of magnitude
+    below the VPU term, which matches the measured bound (BENCHMARKS.md
+    round 5). VFISolution.iterations / .eval_sweeps supply the two counts
+    (final multiscale stage only — coarse-ladder stages are <10% of wall,
+    same convention as the EGM model's use here)."""
+    slab = float(kb * mw)
+    nbp = -(-na // sq)
+    cells = float(N) * nbp * sq * slab
+    imp = KernelCost(
+        mxu_flops=2.0 * N * N * na,
+        vpu_ops=16.0 * cells,
+        hbm_bytes=itemsize * (N * nbp * slab + 8.0 * N * na))
+    ev = KernelCost(
+        mxu_flops=2.0 * N * N * na,
+        vpu_ops=3.0 * cells + float(N) * na,
+        hbm_bytes=itemsize * (N * nbp * slab + 6.0 * N * na))
+    return improve_rounds * imp + eval_sweeps * ev
 
 
 def egm_sweep_cost(N: int, na: int, itemsize: int = 4,

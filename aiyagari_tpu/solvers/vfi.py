@@ -34,6 +34,7 @@ __all__ = [
     "solve_aiyagari_vfi_labor",
     "solve_aiyagari_vfi_continuous",
     "solve_aiyagari_vfi_multiscale",
+    "solve_aiyagari_vfi_egm_warmstart",
 ]
 
 
@@ -57,6 +58,13 @@ class VFISolution:
     # (cf. EGMSolution.tol_effective).
     tol_effective: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.array(0.0))
+    # Total policy-EVALUATION sweeps executed (pre-warm burst + one
+    # howard_steps burst per improvement round + post-exit polish), for the
+    # continuous solver only (0 elsewhere). `iterations` counts improvement
+    # ROUNDS; the roofline cost model (diagnostics/roofline.vfi_slab_cost)
+    # needs both, since an EGM-warm-started solve is almost all evaluation.
+    eval_sweeps: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0, jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps", "block_size", "relative_tol", "use_pallas", "progress_every"))
@@ -133,7 +141,8 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
                                   relative_tol: bool = False,
                                   grid_power: float = 0.0,
                                   slab: bool | None = None,
-                                  noise_floor_ulp: float = 0.0) -> VFISolution:
+                                  noise_floor_ulp: float = 0.0,
+                                  idx_init=None) -> VFISolution:
     """Scalable VFI: coarse-to-fine maximization of u(coh - a'_j) + EV_j over
     grid *indices* j (ops/golden.unimodal_argmax_index), followed by one
     continuous golden-section refinement of the converged policy within its
@@ -162,6 +171,17 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     above 4,096 points (block-DMA dense argmax + one-hot Howard
     contraction — no EV element gathers; BENCHMARKS.md round 3); True or
     False forces a route (TestContinuousVFI pins slab == local-window).
+
+    idx_init (optional [N, na] int32) is a cross-method POLICY warm start:
+    the policy-index guess is first made value-consistent by a pure
+    policy-evaluation fixed point (no improvement work), and the Howard loop
+    then starts at (V^pi0, pi0) instead of (v_init, all-zeros). With a guess
+    from a converged EGM solve the improvement rounds collapse to the 1-3
+    verification rounds policy iteration needs near its fixed point — the
+    cold solve's 17-31 rounds at fine grids are exactly the walk this skips
+    (BENCHMARKS.md round 5). The policy-repeat stopping test arms
+    immediately under a warm start (the initial policy is a real iterate,
+    not the all-zeros sentinel the cold arming delay protects against).
 
     noise_floor_ulp > 0 widens the absolute stopping tolerance to
     max(tol, noise_floor_ulp * eps(dtype) * max|v|) — the VALUE criterion's
@@ -490,6 +510,37 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
             tol_c, jnp.max(jnp.abs(v_new)), noise_floor_ulp=noise_floor_ulp,
             relative_tol=relative_tol, dtype=v_init.dtype)
 
+    def _eval_fixed_point(v0, idx_fix, d0, max_calls: int):
+        """Pure policy evaluation iterated to the value stopping rule with
+        the policy held fixed: each call is one howard_steps-sweep burst, so
+        the per-call contraction is ~beta^howard_steps. Shared by the warm
+        pre-evaluation (making an idx_init value-consistent) and the
+        policy-repeat polish. Returns (v, dist, calls, tol_eff)."""
+
+        def c_(c):
+            _, d, k, te = c
+            return (d >= te) & (k < jnp.int32(max_calls))
+
+        def b_(c):
+            vv, _, k, _ = c
+            v2 = evaluate(vv, idx_fix)
+            diff = jnp.abs(v2 - vv)
+            d = (jnp.max(diff / (jnp.abs(vv) + 1e-10)) if relative_tol
+                 else jnp.max(diff))
+            return v2, d, k + 1, _tol_eff_of(v2)
+
+        return jax.lax.while_loop(c_, b_, (v0, d0, jnp.int32(0), tol_c))
+
+    warm = idx_init is not None
+    if warm:
+        idx0 = jnp.clip(idx_init.astype(jnp.int32), lo_idx, hi_idx)
+        v_start, _, pre_calls, _ = _eval_fixed_point(
+            v_init, idx0, jnp.array(jnp.inf, v_init.dtype), 200)
+    else:
+        idx0 = jnp.zeros(coh.shape, jnp.int32)
+        v_start = v_init
+        pre_calls = jnp.int32(0)
+
     def cond(carry):
         _, _, _, dist, it, same, tol_eff = carry
         return (dist >= tol_eff) & (it < max_iter) & jnp.logical_not(same)
@@ -522,12 +573,15 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         # re-improves, so the suboptimal member would be returned without
         # any convergence signal (ADVICE round 2).
         near = dist < 1e3 * tol
-        same = near & ((jnp.all(idx == idx_prev) & (it > 0)) | (
-            jnp.all(idx == idx_prev2) & (it > 1)))
+        # Warm starts arm one round earlier: idx_prev at round one is the
+        # warm policy (a real, value-consistent iterate), not the all-zeros
+        # init sentinel the cold arming delay exists for.
+        rep = jnp.all(idx == idx_prev) & (jnp.bool_(True) if warm else (it > 0))
+        cyc = jnp.all(idx == idx_prev2) & ((it > 0) if warm else (it > 1))
+        same = near & (rep | cyc)
         return v_new, idx, idx_prev, dist, it + 1, same, _tol_eff_of(v_new)
 
-    z_idx = jnp.zeros(coh.shape, jnp.int32)
-    init = (v_init, z_idx, z_idx,
+    init = (v_start, idx0, idx0,
             jnp.array(jnp.inf, v_init.dtype), jnp.int32(0), jnp.array(False),
             tol_c)
     v, idx, _, dist, it, same, tol_eff = jax.lax.while_loop(cond, body, init)
@@ -537,23 +591,13 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
     # so iterating pure evaluation to the SAME value criterion delivers the
     # tolerance the value-based stop would have — without re-running the
     # gather-heavy improvement rounds (the whole point of the early exit).
-    def _pol_cond(c):
-        _, d, k, te = c
-        return (d >= te) & (k < jnp.int32(50))
-
-    def _pol_body(c):
-        vv, _, k, _ = c
-        v2 = evaluate(vv, idx)
-        diff = jnp.abs(v2 - vv)
-        d = jnp.max(diff / (jnp.abs(vv) + 1e-10)) if relative_tol else jnp.max(diff)
-        return v2, d, k + 1, _tol_eff_of(v2)
-
-    v, dist, _, tol_eff = jax.lax.cond(
+    v, dist, pol_calls, tol_eff = jax.lax.cond(
         same,
-        lambda c: jax.lax.while_loop(_pol_cond, _pol_body, c),
-        lambda c: c,
-        (v, dist, jnp.int32(0), tol_eff),
+        lambda c: _eval_fixed_point(c[0], idx, c[1], 50),
+        lambda c: (c[0], c[1], jnp.int32(0), c[2]),
+        (v, dist, tol_eff),
     )
+    eval_sweeps = max(howard_steps, 1) * (pre_calls + it + pol_calls)
 
     policy_k = a_grid[idx]
     if golden_iters > 0:
@@ -561,25 +605,68 @@ def solve_aiyagari_vfi_continuous(v_init, a_grid, s, P, r, w, amin, *, sigma: fl
         # converged discrete policy: the interval is at most two cells wide,
         # so f32 flatness jitter is bounded by the grid resolution the
         # discrete solution already has — it can only improve the policy.
+        #
+        # The search interval spans at most the 3 cells [idx-1, idx+2], so
+        # the 4 bracketing EV and grid values are pre-gathered ONCE and every
+        # golden iteration works in that local frame (a 3-wide one-hot
+        # select) — the earlier per-iteration global locate + element
+        # gathers cost ~10 [N, na] gathers x 48 iterations = 9.6 s of the
+        # 11.2 s warm 400k solve (measured round 5); this form is ~60 ms.
         EV = expectation(P, v, beta)
+        jbase = jnp.clip(idx - 1, 0, na - 4)
+        j4 = jbase[:, :, None] + jnp.arange(4, dtype=jnp.int32)   # [N,na,4]
+        E4 = jnp.take_along_axis(EV, j4.reshape(N, -1), axis=1
+                                 ).reshape(N, na, 4)
+        if grid_power > 0.0:
+            a4 = a_grid[0] + (a_grid[-1] - a_grid[0]) * (
+                j4.astype(v.dtype) / (na - 1)) ** grid_power
+        else:
+            a4 = a_grid[j4]
+
+        def sel(X, o):
+            # One-hot select along the (3- or 4-wide) local trailing axis.
+            return jnp.sum(jnp.where(
+                jnp.arange(X.shape[-1], dtype=jnp.int32) == o[..., None],
+                X, 0.0), axis=-1)
 
         def f_cont(ap):
-            j = locate(ap)
-            t = (ap - a_grid[j]) / (a_grid[j + 1] - a_grid[j])
-            e0 = jnp.take_along_axis(EV, j, axis=1)
-            e1 = jnp.take_along_axis(EV, j + 1, axis=1)
+            # Cell within the local 4-point frame containing ap (0..2).
+            o = jnp.sum(a4[..., 1:3] <= ap[..., None], axis=-1
+                        ).astype(jnp.int32)
+            a0, a1 = sel(a4[..., :3], o), sel(a4[..., 1:], o)
+            e0, e1 = sel(E4[..., :3], o), sel(E4[..., 1:], o)
+            t = (ap - a0) / (a1 - a0)
             c = jnp.maximum(coh - ap, c_floor)
             return _u(c, sigma) + e0 * (1.0 - t) + e1 * t
 
-        lo_r = jnp.maximum(a_grid[jnp.maximum(idx - 1, 0)], amin)
+        lo_r = jnp.maximum(
+            sel(a4, jnp.clip(idx - 1, 0, na - 1) - jbase), amin)
         hi_r = jnp.maximum(
-            jnp.minimum(a_grid[jnp.minimum(idx + 1, na - 1)], coh), lo_r
-        )
+            jnp.minimum(sel(a4, jnp.minimum(idx + 1, na - 1) - jbase), coh),
+            lo_r)
         policy_k = golden_section_max(f_cont, lo_r, hi_r, n_iters=golden_iters)
 
     policy_c = jnp.maximum(coh - policy_k, c_floor)
     return VFISolution(v, idx, policy_k, policy_c,
-                       jnp.ones_like(policy_k), it, dist, tol_eff)
+                       jnp.ones_like(policy_k), it, dist, tol_eff,
+                       eval_sweeps.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("lo", "hi", "power", "n"))
+def _warm_stage_idx(warm_policy_k, g, *, lo: float, hi: float, power: float,
+                    n: int):
+    """Re-sample a final-grid savings policy onto an n-point stage grid and
+    snap to the nearest stage-grid index — ONE dispatch (the eager op chain
+    costs ~15 sequential ~100 ms round trips per stage on this image's
+    remote TPU transport; measured as the bulk of an 11.5 s warm 400k
+    solve before this was fused)."""
+    from aiyagari_tpu.ops.interp import power_bucket_index, prolong_power_grid
+
+    pk = (warm_policy_k if n == warm_policy_k.shape[-1] else
+          prolong_power_grid(warm_policy_k, lo, hi, power, n))
+    j = power_bucket_index(g, pk, lo, hi, power)
+    return jnp.where(jnp.abs(g[j + 1] - pk) < jnp.abs(g[j] - pk),
+                     j + 1, j).astype(jnp.int32)
 
 
 def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -589,7 +676,8 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   coarsest: int = 400,
                                   refine_factor: int = 10,
                                   relative_tol: bool = False,
-                                  noise_floor_ulp: float = 0.0) -> VFISolution:
+                                  noise_floor_ulp: float = 0.0,
+                                  warm_policy_k=None) -> VFISolution:
     """Grid-sequenced continuous VFI: solve coarse, prolong the VALUE function
     to each finer power grid (ops/interp.prolong_power_grid — closed-form
     bucket, one dispatch per stage), and re-converge there.
@@ -607,6 +695,15 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
     exponent: both the stage-grid construction and the closed-form locators
     trust it, and a mismatch converges to a silently wrong policy rather
     than erroring.
+
+    warm_policy_k (optional [N, na_final] on the FINAL grid, e.g. a
+    converged EGM solution's policy_k) is the cross-method policy warm
+    start: each stage re-samples it onto the stage grid, snaps to the
+    nearest stage-grid index, and passes it as
+    solve_aiyagari_vfi_continuous's idx_init — so every stage (including
+    the expensive final one) starts at a near-optimal policy and spends
+    its wall on policy EVALUATION, not improvement-round walking. See
+    solve_aiyagari_vfi_egm_warmstart for the composed recipe.
     """
     from aiyagari_tpu.ops.interp import prolong_power_grid
     from aiyagari_tpu.utils.grids import stage_grid, stage_sizes
@@ -629,15 +726,58 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
         g = a_grid if n == n_final else stage_grid(n, lo, hi, grid_power, dtype)
         v = (jnp.zeros((s.shape[0], n), dtype) if i == 0
              else prolong_power_grid(sol.v, lo, hi, grid_power, n))
+        idx_i = None
+        if warm_policy_k is not None:
+            idx_i = _warm_stage_idx(warm_policy_k, g, lo=lo, hi=hi,
+                                    power=grid_power, n=n)
         sol = solve_aiyagari_vfi_continuous(
             v, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
             max_iter=max_iter, howard_steps=howard_steps,
             # In-cell continuous refinement only matters on the final grid.
             golden_iters=golden_iters if n == n_final else 0,
             relative_tol=relative_tol, grid_power=grid_power,
-            noise_floor_ulp=noise_floor_ulp,
+            noise_floor_ulp=noise_floor_ulp, idx_init=idx_i,
         )
     return sol
+
+
+def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
+                                     beta: float, tol: float, max_iter: int,
+                                     grid_power: float,
+                                     howard_steps: int = 25,
+                                     golden_iters: int = 48,
+                                     coarsest: int = 400,
+                                     refine_factor: int = 10,
+                                     relative_tol: bool = False,
+                                     noise_floor_ulp: float = 0.0,
+                                     egm_solution=None) -> VFISolution:
+    """Cross-method warm start for the north-star-scale VFI: obtain the
+    converged EGM consumption policy (O(na) per sweep — ~0.2 s at 400k,
+    BENCH_r04), map its savings policy to grid indices, and run the
+    multiscale slab VFI from it. The improvement rounds then only VERIFY
+    the policy (1-3 rounds) instead of walking to it (17-31 rounds cold at
+    40k-400k), and the wall is dominated by policy evaluation — the
+    structurally cheap part of the slab solver.
+
+    The fixed point is the same as the cold solve's (same operator, same
+    stopping rule; pinned by test_solvers.TestWarmStartVFI). egm_solution
+    lets a caller that already holds a converged EGMSolution (the bench
+    times the EGM leg separately) skip the inner solve.
+    """
+    if egm_solution is None:
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
+
+        egm_solution = solve_aiyagari_egm_multiscale(
+            a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+            max_iter=max_iter, grid_power=grid_power,
+            noise_floor_ulp=noise_floor_ulp)
+    return solve_aiyagari_vfi_multiscale(
+        a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
+        max_iter=max_iter, grid_power=grid_power, howard_steps=howard_steps,
+        golden_iters=golden_iters, coarsest=coarsest,
+        refine_factor=refine_factor, relative_tol=relative_tol,
+        noise_floor_ulp=noise_floor_ulp,
+        warm_policy_k=egm_solution.policy_k)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))
