@@ -203,20 +203,24 @@ def _solve_krusell_smith_impl(
     k_z, k_eps = jax.random.split(key)
     z_path = simulate_aggregate_shocks(model.pz, k_z, T=alm.T)
     panel_sharding = None
-    # Grid-axis mesh (BackendConfig.mesh_axes containing "grid", EGM method):
-    # the [ns, nK, nk] household fixed point runs DISTRIBUTED over the fine
-    # k-axis with ring-assembled knot slabs (solvers/ks_egm_sharded.py;
-    # SURVEY.md §2.4(1)). Unsound geometry (nk not divisible) silently uses
-    # the single-device solver, like the Aiyagari config route.
+    # Grid-axis mesh (BackendConfig.mesh_axes containing "grid"): the
+    # [ns, nK, nk] household fixed point runs DISTRIBUTED over the fine
+    # k-axis — ring-assembled knot slabs for EGM (solvers/ks_egm_sharded.py)
+    # and the replicated-table/local-candidate program for VFI
+    # (solvers/ks_vfi_sharded.py; SURVEY.md §2.4(1)). Unsound geometry (nk
+    # not divisible, shards too thin, or — EGM only, whose slab positioning
+    # is analytic — a non-power k-grid) silently uses the single-device
+    # solver, like the Aiyagari config route.
     grid_mesh = None
     mesh = None
     if backend.mesh_axes:
         from aiyagari_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
-        if ("grid" in backend.mesh_axes and method == "egm"
+        if ("grid" in backend.mesh_axes and method in ("egm", "vfi")
                 and config.k_size % int(mesh.shape["grid"]) == 0
-                and config.k_size // int(mesh.shape["grid"]) >= 16):
+                and config.k_size // int(mesh.shape["grid"]) >= 16
+                and (method == "vfi" or config.k_power > 0)):
             grid_mesh = mesh
     if use_histogram:
         eps_panel = None
@@ -342,16 +346,27 @@ def _solve_krusell_smith_impl(
         phase_switched = False      # set when THIS round triggers f32 -> f64
         B_dev = jnp.asarray(B, dtype)
         if solver.method == "vfi":
-            sol = solve_ks_vfi(
-                value, k_opt, B_dev, model.k_grid, model.K_grid, model.P,
-                model.r_table, model.w_table, model.eps_by_state,
+            vfi_kw = dict(
                 theta=prefs.sigma, beta=prefs.beta, mu=config.mu, l_bar=config.l_bar,
                 delta=tech.delta, k_min=config.k_min, k_max=config.k_max,
                 tol=house_tol, max_iter=solver.max_iter,
                 howard_steps=solver.howard_steps, improve_every=solver.improve_every,
                 golden_iters=solver.golden_iters, relative_tol=solver.relative_tol,
-                progress_every=solver.progress_every,
             )
+            if grid_mesh is not None:
+                from aiyagari_tpu.solvers.ks_vfi_sharded import solve_ks_vfi_sharded
+
+                sol = solve_ks_vfi_sharded(
+                    grid_mesh, value, k_opt, B_dev, model.k_grid,
+                    model.K_grid, model.P, model.r_table, model.w_table,
+                    model.eps_by_state, **vfi_kw,
+                )
+            else:
+                sol = solve_ks_vfi(
+                    value, k_opt, B_dev, model.k_grid, model.K_grid, model.P,
+                    model.r_table, model.w_table, model.eps_by_state,
+                    progress_every=solver.progress_every, **vfi_kw,
+                )
             value = sol.value
         elif solver.method == "egm":
             egm_kw = dict(
