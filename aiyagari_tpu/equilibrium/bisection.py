@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import lru_cache
 from typing import Callable, Optional
 
 import jax
@@ -245,7 +246,7 @@ class _SimulationAggregator:
         self.series = None
         self.mu = None
 
-    def restore(self, start_it: int, arrays: dict) -> None:
+    def restore(self, start_it: int, scalars: dict, arrays: dict) -> None:
         # Fast-forward the PRNG stream to where the run stopped.
         for _ in range(start_it):
             self.key, _ = jax.random.split(self.key)
@@ -264,6 +265,14 @@ class _SimulationAggregator:
         return {}
 
 
+@lru_cache(maxsize=None)
+def _replicate_program(sharding):
+    """Compiled identity with replicated out_shardings, cached per sharding
+    (a fresh jit(lambda) per call would re-trace+compile the all-gather on
+    EVERY bisection iteration — the _shardmap_panel_fn caching pattern)."""
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
 class _DistributionAggregator:
     """Capital supply as E[a] under the Young-histogram stationary
     distribution (sim/distribution.py) — deterministic, no analogue in the
@@ -278,9 +287,17 @@ class _DistributionAggregator:
         self.series = None
         self.mu = None
 
-    def restore(self, start_it: int, arrays: dict) -> None:
-        if "mu" in arrays:
-            self.mu = jnp.asarray(arrays["mu"], self.model.dtype)
+    def restore(self, start_it: int, scalars: dict, arrays: dict) -> None:
+        # The distribution may have been saved per shard (mesh routes, where
+        # the GSPMD stationary-distribution output is sharded over the
+        # grid); restore_array reassembles either representation. [na] is
+        # host-assembled — the tiny 1-D aggregator state, not the [N, na]
+        # policy arrays whose no-materialization property matters.
+        from aiyagari_tpu.io_utils.checkpoint import restore_array
+
+        mu = restore_array(scalars, arrays, "mu")
+        if mu is not None:
+            self.mu = jnp.asarray(np.asarray(mu), self.model.dtype)
 
     def supply(self, sol, r_mid: float, w: float):
         from aiyagari_tpu.sim.distribution import (
@@ -288,8 +305,23 @@ class _DistributionAggregator:
             stationary_distribution,
         )
 
+        # Multi-process mesh runs: the Young histogram is an inherently
+        # GLOBAL [na]-sized computation (its lottery buckets the whole
+        # policy), and its eager entry ops are refused on process-spanning
+        # operands (ShardingTypeError on the searchsorted ravel — found by
+        # the 2-process resume test). Replicate the policy first with one
+        # compiled all-gather: [N, na] is tiny next to the solver state
+        # the per-shard machinery exists for (22 MB even at 400k).
+        # Single-process sharded arrays keep the GSPMD route untouched.
+        policy_k = sol.policy_k
+        if isinstance(policy_k, jax.Array) and not policy_k.is_fully_addressable:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(policy_k.sharding.mesh, PartitionSpec())
+            policy_k = _replicate_program(rep)(policy_k)
+
         dist_sol = stationary_distribution(
-            sol.policy_k, self.model.a_grid, self.model.P,
+            policy_k, self.model.a_grid, self.model.P,
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
         )
         self.mu = dist_sol.mu
@@ -297,7 +329,10 @@ class _DistributionAggregator:
         return supply, {"distribution_iterations": int(dist_sol.iterations)}
 
     def arrays(self) -> dict:
-        return {"mu": np.asarray(self.mu)}
+        # The raw device array: _pack_arrays np.asarray's it when replicated
+        # and packs it per shard when distributed — np.asarray HERE would
+        # raise on a process-spanning mu (multi-process mesh runs).
+        return {"mu": self.mu}
 
 
 def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
@@ -355,7 +390,7 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
                              dtype=np.dtype(str(jnp.dtype(model.dtype))))
         if isinstance(warm, np.ndarray):   # meshless restore stays host-side
             warm = jnp.asarray(warm, model.dtype)
-        aggregator.restore(start_it, arrays)
+        aggregator.restore(start_it, sc, arrays)
         sol = None
     else:
         # Warm-start pass at r_init, as the reference does before its loop (:63-129).

@@ -13,6 +13,24 @@ cross-sections) are packed PER SHARD: each addressable shard is fetched and
 stored as its own entry, so no step of save or (sharding-aware) restore
 ever materializes the full array on host — the memory-scaling property the
 ring-sharded solvers exist to provide (SURVEY.md §5.4, VERDICT round 3 #7).
+
+Multi-process runs (round 5 — the pod-preemption story past the process
+boundary, VERDICT round 4 missing #3): under jax.process_count() > 1 every
+process writes its OWN file, `<path>.proc{i}of{N}`, holding its
+addressable shards (global indices in the shard meta) plus the full scalar
+blob; nothing is gathered. Restore reads ALL process files from the shared
+checkpoint directory and merges them with three loud completeness checks —
+all N files present, matching save-sequence stamps across files (a torn
+save, e.g. preemption between two processes' writes, must not restore a
+mixed iteration; full-blob comparison is impossible since per-process
+wall-time fields legitimately differ), and the merged shards tiling each
+full array. Per-shard
+placement then proceeds exactly as in the single-process case: each
+process's make_array_from_callback serves its addressable shards from the
+merged map, so no process ever materializes a full array. Requires the
+processes to share (or replicate) the checkpoint directory, the normal pod
+arrangement. Pinned end-to-end by
+tests/test_sim_sharding.py::test_two_process_interrupted_resume.
 """
 
 from __future__ import annotations
@@ -29,6 +47,14 @@ __all__ = ["save_checkpoint", "load_checkpoint", "config_fingerprint",
            "restore_array", "CheckpointManager"]
 
 _SHARD_META_KEY = "__shard_meta__"
+_SAVE_SEQ_KEY = "__save_seq__"
+
+# Per-path count of save_checkpoint calls in THIS process — stamped into
+# every multi-process file so a torn save (preemption between two
+# processes' writes of the same outer iteration) is detectable at merge
+# without comparing the full scalar blob, which legitimately differs
+# across processes in wall-time fields (per-iteration "seconds" records).
+_SAVE_COUNTS: dict = {}
 
 
 def _is_distributed(v) -> bool:
@@ -53,26 +79,31 @@ def _norm_index(index, shape) -> tuple:
     return tuple(out)
 
 
+def _process_topology() -> tuple[int, int]:
+    """(process_id, process_count) of the running jax cluster; (0, 1)
+    without jax or outside a multi-process run."""
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except ImportError:                                  # pragma: no cover
+        return 0, 1
+
+
 def _pack_arrays(arrays: Optional[dict]) -> tuple[dict, dict]:
     """Split distributed jax.Arrays into per-shard entries (name__shard{i})
     plus an index-map meta blob; pass everything else to np.asarray whole.
     The per-shard np.asarray fetches one shard-sized buffer at a time.
     Shards replicated over a second mesh axis (e.g. a ("agents","grid")
     mesh) repeat the same index — deduped here, so the file carries each
-    distinct slice once. Multi-process arrays (shards on non-addressable
-    devices) are refused loudly: each process would silently write only
-    its shards to the same path and a resume would read a half-empty
-    checkpoint — coordinated multi-host checkpointing is an orbax job,
-    not this format's."""
+    distinct slice once. Multi-process arrays contribute only THIS
+    process's addressable shards (global indices in the meta); the
+    per-process save files are merged — with completeness checks — at
+    load (module docstring)."""
     plain: dict = {}
     meta: dict = {}
     for k, v in (arrays or {}).items():
         if _is_distributed(v):
-            if not v.is_fully_addressable:
-                raise ValueError(
-                    f"checkpoint array {k!r} spans multiple processes; "
-                    "per-shard npz checkpointing is single-process only — "
-                    "gather it or use a coordinated (orbax) checkpointer")
             by_index = {}
             for sh in v.addressable_shards:
                 by_index.setdefault(_norm_index(sh.index, v.shape), sh)
@@ -115,10 +146,16 @@ def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
             return jax.device_put(v, sharding)
         return v
     shape = tuple(meta["shape"])
-    lookup = {tuple(tuple(p) for p in idx): arrays[f"{name}__shard{i}"]
+    # Index-box -> entry-NAME map; the data itself is fetched per request,
+    # so a lazy merged view (_LazyEntries) only reads the shards this
+    # process's sharding actually asks for.
+    keymap = {tuple(tuple(p) for p in idx): f"{name}__shard{i}"
               for i, idx in enumerate(meta["indices"])}
-    if dtype is not None:
-        lookup = {k: np.asarray(v, dtype) for k, v in lookup.items()}
+
+    def _fetch(kn):
+        v = arrays[kn]
+        return np.asarray(v, dtype) if dtype is not None else v
+
     if sharding is not None:
         import jax
 
@@ -127,17 +164,18 @@ def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
         def cb(index):
             nonlocal full
             key = _norm_index(index, shape)
-            hit = lookup.get(key)
-            if hit is None:
-                # Mesh geometry changed between save and resume: assemble
-                # the stored shards ONCE and serve every request by slice.
-                if full is None:
-                    full = _assemble(lookup, shape)
-                hit = full[tuple(slice(a, b) for a, b in key)]
-            return hit
+            kn = keymap.get(key)
+            if kn is not None:
+                return _fetch(kn)
+            # Mesh geometry changed between save and resume: assemble
+            # the stored shards ONCE and serve every request by slice.
+            if full is None:
+                full = _assemble(
+                    {k: _fetch(kn2) for k, kn2 in keymap.items()}, shape)
+            return full[tuple(slice(a, b) for a, b in key)]
 
         return jax.make_array_from_callback(shape, sharding, cb)
-    return _assemble(lookup, shape)
+    return _assemble({k: _fetch(kn) for k, kn in keymap.items()}, shape)
 
 
 def _assemble(lookup: dict, shape) -> np.ndarray:
@@ -158,18 +196,11 @@ def _assemble(lookup: dict, shape) -> np.ndarray:
     return out
 
 
-def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> None:
-    """Atomically write scalar state (JSON-serializable) + named arrays.
-    Distributed jax.Arrays among `arrays` are stored per shard
-    (_pack_arrays) and restored via restore_array."""
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    packed, shard_meta = _pack_arrays(arrays)
-    if shard_meta:
-        scalars = {**scalars, _SHARD_META_KEY: shard_meta}
-    payload = {"__scalars__": np.frombuffer(json.dumps(scalars).encode(), dtype=np.uint8)}
-    for k, v in packed.items():
-        payload[k] = v
+def _proc_file(path: Path, pid: int, nproc: int) -> Path:
+    return path.with_name(f"{path.name}.proc{pid}of{nproc}")
+
+
+def _write_npz(path: Path, payload: dict) -> None:
     fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -181,15 +212,183 @@ def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> No
         raise
 
 
-def load_checkpoint(path) -> Optional[tuple[dict, dict]]:
-    """Returns (scalars, arrays) or None if no checkpoint exists."""
+def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> None:
+    """Atomically write scalar state (JSON-serializable) + named arrays.
+    Distributed jax.Arrays among `arrays` are stored per shard
+    (_pack_arrays) and restored via restore_array. In a multi-process run
+    every process must call this with the SAME path and scalars: each
+    writes its own `<path>.proc{i}of{N}` file with its addressable shards
+    (module docstring). A topology change between runs (single <-> multi,
+    or a different process count) is self-healing: each save removes the
+    other representations of this path, so a later resume can never read
+    a stale pre-change file in preference to newer state."""
     path = Path(path)
-    if not path.exists():
-        return None
+    path.parent.mkdir(parents=True, exist_ok=True)
+    packed, shard_meta = _pack_arrays(arrays)
+    if shard_meta:
+        scalars = {**scalars, _SHARD_META_KEY: shard_meta}
+    payload = {"__scalars__": np.frombuffer(json.dumps(scalars).encode(), dtype=np.uint8)}
+    for k, v in packed.items():
+        payload[k] = v
+    pid, nproc = _process_topology()
+    if nproc > 1:
+        seq = _SAVE_COUNTS.get(str(path), 0) + 1
+        _SAVE_COUNTS[str(path)] = seq
+        scalars = {**scalars, _SAVE_SEQ_KEY: seq}
+        payload["__scalars__"] = np.frombuffer(
+            json.dumps(scalars).encode(), dtype=np.uint8)
+        _write_npz(_proc_file(path, pid, nproc), payload)
+        # Topology-change cleanup: a stale single-process file at `path`
+        # would SHADOW the proc files at every load (load_checkpoint
+        # prefers it), silently regressing the run to the pre-change
+        # iteration on each resume; other-topology proc files would make
+        # the file-count completeness check unsatisfiable. Processes only
+        # remove files no current process writes; concurrent removal is
+        # guarded by missing_ok.
+        path.unlink(missing_ok=True)
+        for f in path.parent.glob(path.name + ".proc*of*"):
+            if not str(f.name).endswith(f"of{nproc}"):
+                f.unlink(missing_ok=True)
+    else:
+        _write_npz(path, payload)
+        for f in path.parent.glob(path.name + ".proc*of*"):
+            f.unlink(missing_ok=True)
+
+
+def _load_npz(path: Path) -> tuple[dict, dict]:
     with np.load(path) as z:
         scalars = json.loads(bytes(z["__scalars__"]).decode())
         arrays = {k: z[k] for k in z.files if k != "__scalars__"}
     return scalars, arrays
+
+
+class _LazyEntries(dict):
+    """Mapping of entry name -> np.ndarray that opens the backing .npz ONLY
+    when an entry is read. The merged multi-process view must not load
+    every process's shards into every process's host memory (that would
+    transiently materialize the full solver state per host — the exact
+    thing the per-shard format exists to avoid); restore_array reads only
+    the shards the local sharding requests. Subclasses dict so key
+    iteration / membership behave normally; values are (file, entry-name)
+    pointers resolved per access."""
+
+    def __getitem__(self, k):
+        f, orig = super().__getitem__(k)
+        with np.load(f) as z:
+            return z[orig]
+
+    def get(self, k, default=None):
+        return self[k] if k in self else default
+
+    def values(self):                                    # pragma: no cover
+        return (self[k] for k in self)
+
+    def items(self):
+        return ((k, self[k]) for k in self)
+
+
+def _merge_process_files(path: Path, files: list) -> tuple[dict, dict]:
+    """Merge per-process checkpoint files into one (scalars, lazy arrays)
+    view, with the three loud completeness checks of the module docstring.
+    Only scalar blobs and entry NAMES are read here; shard data loads
+    lazily on access (_LazyEntries)."""
+    # Group by declared topology: save-time cleanup removes other-topology
+    # files, but a preemption mid-cleanup can leave a mixture — prefer the
+    # topology matching the CURRENT process count, else require uniqueness.
+    by_nproc: dict = {}
+    for f in files:
+        by_nproc.setdefault(int(str(f.name).rsplit("of", 1)[1]), []).append(f)
+    _, cur_nproc = _process_topology()
+    if cur_nproc in by_nproc:
+        nproc, group = cur_nproc, by_nproc[cur_nproc]
+    elif len(by_nproc) == 1:
+        (nproc, group), = by_nproc.items()
+    else:
+        raise ValueError(
+            f"multi-process checkpoint at {path} carries files from "
+            f"multiple process topologies {sorted(by_nproc)} and none "
+            f"matches the current process count {cur_nproc}; delete the "
+            "stale topology's files")
+    if len(group) != nproc:
+        raise ValueError(
+            f"incomplete multi-process checkpoint at {path}: found "
+            f"{len(group)} of {nproc} process files")
+    parts = []          # (file, scalars, entry-names)
+    for f in sorted(group):
+        with np.load(f) as z:
+            sc = json.loads(bytes(z["__scalars__"]).decode())
+            names = [k for k in z.files if k != "__scalars__"]
+        parts.append((f, sc, names))
+    # Consistency marker, not a full-blob comparison: scalar state
+    # legitimately differs across processes in wall-time fields (e.g. the
+    # bisection records' per-iteration "seconds"), so the torn-save check
+    # compares the save SEQUENCE stamped by save_checkpoint (same count of
+    # saves on this path in every process) plus the run fingerprint.
+    marks = {(s.get(_SAVE_SEQ_KEY), s.get("__fingerprint__"))
+             for _, s, _ in parts}
+    if len(marks) > 1:
+        raise ValueError(
+            f"inconsistent multi-process checkpoint at {path}: process "
+            "files carry different save sequences (torn save — e.g. "
+            "preemption between two processes' writes); delete and restart")
+    # Seed this process's save counter from the restored sequence: a
+    # resumed run's counters start at 0, and without re-seeding its first
+    # post-resume save would stamp seq=1 again — making a later torn save
+    # indistinguishable from a pre-resume generation (review round 5).
+    restored_seq = parts[0][1].get(_SAVE_SEQ_KEY)
+    if isinstance(restored_seq, int):
+        _SAVE_COUNTS[str(path)] = max(
+            _SAVE_COUNTS.get(str(path), 0), restored_seq)
+    scalars = {k: v for k, v in parts[0][1].items() if k != _SAVE_SEQ_KEY}
+    meta = scalars.get(_SHARD_META_KEY) or {}
+    arrays = _LazyEntries()
+    merged_meta: dict = {}
+    for name, m in meta.items():
+        # Re-number shards globally, deduping identical index boxes
+        # (replication across processes or mesh axes).
+        by_index: dict = {}
+        for f, s_part, _ in parts:
+            part_meta = (s_part.get(_SHARD_META_KEY) or {}).get(name)
+            if part_meta is None:
+                continue
+            for i, idx in enumerate(part_meta["indices"]):
+                key = tuple(tuple(p) for p in idx)
+                by_index.setdefault(key, (f, f"{name}__shard{i}"))
+        indices = []
+        for j, (idx, ptr) in enumerate(sorted(by_index.items())):
+            dict.__setitem__(arrays, f"{name}__shard{j}", ptr)
+            indices.append([list(p) for p in idx])
+        shape = tuple(m["shape"])
+        covered = sum(
+            int(np.prod([b - a for a, b in idx])) for idx in by_index)
+        if covered != int(np.prod(shape)):
+            raise ValueError(
+                f"multi-process checkpoint shards for {name!r} do not tile "
+                f"the full array (shape {shape}): {covered} of "
+                f"{int(np.prod(shape))} elements covered")
+        merged_meta[name] = {**m, "indices": indices}
+    # Plain (replicated) entries: identical in every file; take the first.
+    for f, _, names in parts:
+        for k in names:
+            if "__shard" not in k and k not in arrays:
+                dict.__setitem__(arrays, k, (f, k))
+    if merged_meta:
+        scalars = {**scalars, _SHARD_META_KEY: merged_meta}
+    return scalars, arrays
+
+
+def load_checkpoint(path) -> Optional[tuple[dict, dict]]:
+    """Returns (scalars, arrays) or None if no checkpoint exists. A
+    multi-process checkpoint (per-process files, module docstring) is
+    merged with completeness checks; every process sees the same merged
+    view and restore_array places only its addressable shards."""
+    path = Path(path)
+    if path.exists():
+        return _load_npz(path)
+    files = list(path.parent.glob(path.name + ".proc*of*"))
+    if not files:
+        return None
+    return _merge_process_files(path, files)
 
 
 def config_fingerprint(*objs: Any) -> str:
@@ -250,3 +449,5 @@ class CheckpointManager:
     def delete(self) -> None:
         if self.path.exists():
             self.path.unlink()
+        for f in self.path.parent.glob(self.path.name + ".proc*of*"):
+            f.unlink()

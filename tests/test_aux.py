@@ -179,6 +179,135 @@ class TestCheckpoint:
             restore_array(sc, arrays, "plain"), np.ones(3))
         assert restore_array(sc, arrays, "absent") is None
 
+    def test_multiprocess_merge_and_completeness_checks(self, tmp_path):
+        """The round-5 multi-process format (io_utils/checkpoint.py module
+        docstring): per-process files merge into one view, and each of the
+        three loud completeness checks fires. The files are crafted via
+        save_checkpoint itself with a monkeypatched process topology — the
+        exact bytes a 2-process run writes (the real 2-process flow is
+        pinned by test_sim_sharding.test_two_process_interrupted_resume)."""
+        from unittest import mock
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from aiyagari_tpu.io_utils import checkpoint as ck
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        full = np.arange(7 * 64.0).reshape(7, 64)
+        # Each "process" holds half the devices: a 4-device sharded array
+        # carrying its half of the data, saved under a 2-process topology.
+        p = tmp_path / "mp.npz"
+        for pid in (0, 1):
+            mesh4 = make_mesh(("grid",), (4,),
+                              devices=jax.devices()[4 * pid:4 * pid + 4])
+            sh4 = NamedSharding(mesh4, P(None, "grid"))
+            half = jax.device_put(
+                jnp.asarray(full[:, 32 * pid:32 * pid + 32]), sh4)
+            with mock.patch.object(ck, "_process_topology",
+                                   return_value=(pid, 2)):
+                # The meta must carry GLOBAL indices: patch the shard
+                # index view by saving the half and fixing the meta up —
+                # instead, emulate the real layout with a process-spanning
+                # array below if addressable. Here: write the half, then
+                # rewrite its meta to global coordinates.
+                ck.save_checkpoint(p, scalars={"it": 3},
+                                   arrays={"w": half, "plain": np.ones(2)})
+            f = ck._proc_file(p, pid, 2)
+            sc, arrays = ck._load_npz(f)
+            # Both mocked "processes" saved from THIS test process, so the
+            # per-path save counter gave them different sequences; a real
+            # 2-process run stamps the same count in each. Normalize.
+            sc[ck._SAVE_SEQ_KEY] = 1
+            meta = sc[ck._SHARD_META_KEY]["w"]
+            meta["shape"] = [7, 64]
+            meta["indices"] = [[[0, 7], [32 * pid + 8 * i, 32 * pid + 8 * (i + 1)]]
+                               for i in range(4)]
+            payload = {"__scalars__": np.frombuffer(
+                json.dumps(sc).encode(), dtype=np.uint8)}
+            payload.update(arrays)
+            ck._write_npz(f, payload)
+
+        # Merge: all 8 shards, tiling the full array; scalars agree.
+        sc, arrays = ck.load_checkpoint(p)
+        assert sc["it"] == 3
+        shard_keys = [k for k in arrays if k.startswith("w__shard")]
+        assert len(shard_keys) == 8
+        np.testing.assert_array_equal(ck.restore_array(sc, arrays, "w"), full)
+        np.testing.assert_array_equal(arrays["plain"], np.ones(2))
+
+        # Check 1: a missing process file is an incomplete checkpoint.
+        f1 = ck._proc_file(p, 1, 2)
+        blob = f1.read_bytes()
+        f1.unlink()
+        with pytest.raises(ValueError, match="incomplete multi-process"):
+            ck.load_checkpoint(p)
+        f1.write_bytes(blob)
+
+        # Check 2: diverging save sequences across files is a torn save
+        # (one process preempted before its write of the same iteration).
+        sc1, arrays1 = ck._load_npz(f1)
+        sc1[ck._SAVE_SEQ_KEY] = 99
+        payload = {"__scalars__": np.frombuffer(
+            json.dumps(sc1).encode(), dtype=np.uint8)}
+        payload.update(arrays1)
+        ck._write_npz(f1, payload)
+        with pytest.raises(ValueError, match="torn save"):
+            ck.load_checkpoint(p)
+        f1.write_bytes(blob)
+
+        # Check 3: shards that do not tile the array are refused (the
+        # per-process shard meta is excluded from the torn-save comparison,
+        # so the TILING check is the one that fires).
+        sc1, arrays1 = ck._load_npz(f1)
+        meta = sc1[ck._SHARD_META_KEY]["w"]
+        meta["indices"] = meta["indices"][:-1]
+        del arrays1["w__shard3"]
+        payload = {"__scalars__": np.frombuffer(
+            json.dumps(sc1).encode(), dtype=np.uint8)}
+        payload.update(arrays1)
+        ck._write_npz(f1, payload)
+        with pytest.raises(ValueError, match="do not tile"):
+            ck.load_checkpoint(p)
+
+    def test_multiprocess_topology_change_and_seq_seeding(self, tmp_path):
+        """Round-5 review pins: (a) a save under a NEW process topology
+        removes the other representations of the path (a stale
+        single-process file would otherwise shadow the proc files at every
+        load, silently regressing the run each preemption); (b) restoring
+        a merged checkpoint seeds the save counter, so a post-resume save
+        continues the sequence instead of restarting at 1 (which would
+        make a later torn save undetectable across run generations)."""
+        from unittest import mock
+
+        from aiyagari_tpu.io_utils import checkpoint as ck
+
+        p = tmp_path / "topo.npz"
+        # Single-process save, then a 2-process save: the single file must
+        # be removed by the multi-topology save.
+        ck.save_checkpoint(p, scalars={"it": 0}, arrays={"a": np.ones(4)})
+        assert p.exists()
+        for pid in (0, 1):
+            # Each mocked "process" owns its counter in a real run; both
+            # must stamp the SAME sequence for the merge to accept them.
+            ck._SAVE_COUNTS[str(p)] = 0
+            with mock.patch.object(ck, "_process_topology",
+                                   return_value=(pid, 2)):
+                ck.save_checkpoint(p, scalars={"it": 1},
+                                   arrays={"a": np.ones(4)})
+        assert not p.exists()
+        assert len(list(tmp_path.glob("topo.npz.proc*of2"))) == 2
+        # (b) a fresh process's counter starts at 0; loading the merged
+        # view re-seeds it from the restored sequence.
+        ck._SAVE_COUNTS.pop(str(p), None)
+        sc, arrays = ck.load_checkpoint(p)
+        assert sc["it"] == 1
+        assert ck._SAVE_COUNTS[str(p)] == 1
+        # A later single-process save removes the proc files (symmetric
+        # topology-change cleanup).
+        ck.save_checkpoint(p, scalars={"it": 2}, arrays={"a": np.ones(4)})
+        assert p.exists()
+        assert not list(tmp_path.glob("topo.npz.proc*of*"))
+
     def test_bisection_resume(self, tmp_path):
         model = AiyagariModel.from_config(SMALL)
         solver = SolverConfig(method="egm")

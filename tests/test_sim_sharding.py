@@ -124,6 +124,43 @@ class TestSharding:
         x_sh = jax.device_put(x, agents_sharding(mesh))
         assert float(jnp.mean(x_sh)) == float(jnp.mean(x))
 
+    def test_batch_matches_single_sims(self):
+        # The width-batched panel (round 5: simulate_capital_paths_batch,
+        # one scan serving W independent sims to amortize the per-step
+        # launch overhead that bounds the 10k-agent panel) is the SAME
+        # per-sim arithmetic — every lane must match its single-sim run.
+        from aiyagari_tpu.sim.ks_panel import simulate_capital_paths_batch
+
+        cfg = KrusellSmithConfig(k_size=20)
+        model = KrusellSmithModel.from_config(cfg)
+        T, pop, W = 120, 600, 3
+        gp = float(cfg.k_power)
+        k_opt = 0.9 * jnp.broadcast_to(
+            model.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size))
+        zs, epss = [], []
+        for i in range(W):
+            kz, ke = jax.random.split(jax.random.PRNGKey(100 + i))
+            z = simulate_aggregate_shocks(model.pz, kz, T=T)
+            zs.append(z)
+            epss.append(simulate_employment_panel(
+                z, model.eps_trans, cfg.shocks.u_good, cfg.shocks.u_bad,
+                ke, T=T, population=pop))
+        k0 = jnp.full((pop,), float(model.K_grid[0]))
+        K_b, kpop_b = simulate_capital_paths_batch(
+            k_opt, model.k_grid, model.K_grid, jnp.stack(zs),
+            jnp.stack(epss), jnp.broadcast_to(k0, (W, pop)), T=T,
+            grid_power=gp)
+        assert K_b.shape == (W, T) and kpop_b.shape == (W, pop)
+        for i in range(W):
+            K_i, kpop_i = simulate_capital_path(
+                k_opt, model.k_grid, model.K_grid, zs[i], epss[i], k0,
+                T=T, grid_power=gp)
+            np.testing.assert_allclose(np.asarray(K_b[i]), np.asarray(K_i),
+                                       rtol=0, atol=1e-12)
+            np.testing.assert_allclose(np.asarray(kpop_b[i]),
+                                       np.asarray(kpop_i), rtol=0,
+                                       atol=1e-12)
+
     def test_shardmap_panel_matches_gspmd(self):
         # The explicit shard_map+pmean collective path (SURVEY.md §2.4(2))
         # agrees with the implicit GSPMD path on the same inputs.
@@ -417,6 +454,14 @@ from aiyagari_tpu.parallel.distributed import initialize_distributed
 ctx = initialize_distributed(coordinator_address="127.0.0.1:%d",
                              num_processes=2, process_id=int(sys.argv[1]))
 assert ctx.initialized and ctx.num_processes == 2, ctx
+# Same persistent XLA:CPU compile cache as conftest.py — without it every
+# suite run re-pays each worker's sharded-program compiles (minutes, twice
+# over; the biggest slow-set cost found in the round-5 budget pass). Must
+# come AFTER initialize_distributed: the cache suffix resolves the backend,
+# and touching it earlier breaks the coordinator handshake.
+from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+enable_compilation_cache(os.path.join(os.path.expanduser("~"),
+                                      ".cache", "aiyagari_tpu", "xla-tests"))
 assert ctx.global_device_count == 2 and ctx.local_device_count == 1, ctx
 mesh = jax.make_mesh((2,), ("p",))
 sh = NamedSharding(mesh, P("p"))
@@ -483,6 +528,14 @@ from aiyagari_tpu.parallel.distributed import initialize_distributed
 ctx = initialize_distributed(coordinator_address="127.0.0.1:%d",
                              num_processes=2, process_id=int(sys.argv[1]))
 assert ctx.initialized and ctx.num_processes == 2, ctx
+# Same persistent XLA:CPU compile cache as conftest.py — without it every
+# suite run re-pays each worker's sharded-program compiles (minutes, twice
+# over; the biggest slow-set cost found in the round-5 budget pass). Must
+# come AFTER initialize_distributed: the cache suffix resolves the backend,
+# and touching it earlier breaks the coordinator handshake.
+from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+enable_compilation_cache(os.path.join(os.path.expanduser("~"),
+                                      ".cache", "aiyagari_tpu", "xla-tests"))
 assert ctx.global_device_count == 8 and ctx.local_device_count == 4, ctx
 
 # (a) Cross-process sharded panel simulation: deterministic shocks, the
@@ -570,6 +623,139 @@ print("WORKER_OK", ctx.process_id)
                 for q in procs:
                     q.kill()
                 pytest.fail("two-process real-solve cluster hung")
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, f"worker failed rc={rc}\n{out}\n{err}"
+            assert "WORKER_OK" in out, (out, err)
+
+    @pytest.mark.slow
+    def test_two_process_interrupted_resume(self, tmp_path):
+        # The pod-preemption story past the process boundary (VERDICT
+        # round 4 missing #3): a 2-process x 4-device mesh GE bisection is
+        # interrupted mid-run; each process has written ONLY its own
+        # `.proc{i}of2` checkpoint file with its addressable warm-start
+        # shards (no host gather, no full-array entry anywhere); the
+        # resumed 2-process run merges the files — completeness-checked —
+        # places shards per process, and finishes with the identical
+        # bracket path. Same worker pattern as the real-solves test.
+        import os
+        import socket
+        import subprocess
+        import sys as _sys
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        worker = r"""
+import os, sys, time, pathlib
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from aiyagari_tpu.parallel.distributed import initialize_distributed
+
+ctx = initialize_distributed(coordinator_address="127.0.0.1:%d",
+                             num_processes=2, process_id=int(sys.argv[1]))
+assert ctx.initialized and ctx.num_processes == 2, ctx
+# Same persistent XLA:CPU compile cache as conftest.py — without it every
+# suite run re-pays each worker's sharded-program compiles (minutes, twice
+# over; the biggest slow-set cost found in the round-5 budget pass). Must
+# come AFTER initialize_distributed: the cache suffix resolves the backend,
+# and touching it earlier breaks the coordinator handshake.
+from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+enable_compilation_cache(os.path.join(os.path.expanduser("~"),
+                                      ".cache", "aiyagari_tpu", "xla-tests"))
+
+from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+from aiyagari_tpu.equilibrium.bisection import solve_equilibrium_distribution
+from aiyagari_tpu.io_utils import checkpoint as ck
+from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+n = 6144
+m = aiyagari_preset(grid_size=n)
+# Starved inner budgets: the claims under test (per-process files, merged
+# completeness-checked restore, shard-exact placement, identical bracket
+# path) are determinism claims, not convergence claims, and each midpoint
+# solve executes 8 virtual devices serially across two gRPC-coupled
+# processes on one core — full-tolerance solves measured ~20 min here.
+scfg = SolverConfig(method="egm", tol=1e-4, max_iter=600)
+eq = EquilibriumConfig(max_iter=2)
+dist_kw = dict(dist_tol=1e-6, dist_max_iter=500)
+mesh8 = jax.make_mesh((8,), ("grid",))
+ckdir = sys.argv[2]
+
+# Uninterrupted reference first (all sharded programs compile here and
+# are reused by the interrupted + resumed runs).
+ref = solve_equilibrium_distribution(m, solver=scfg, eq=eq, mesh=mesh8,
+                                     **dist_kw)
+
+class Stop(Exception):
+    pass
+
+def interrupt(rec):
+    if rec["iteration"] == 1:
+        raise Stop
+
+try:
+    solve_equilibrium_distribution(m, solver=scfg, eq=eq, mesh=mesh8,
+                                   on_iteration=interrupt,
+                                   checkpoint_dir=ckdir, **dist_kw)
+    raise SystemExit("expected the interruption to fire")
+except Stop:
+    pass
+
+# This process wrote ONLY its own file, holding its 4 addressable warm
+# shards — per-shard entries, no assembled full-grid array.
+base = pathlib.Path(ckdir) / "bisection_egm_dist.ckpt.npz"
+own = ck._proc_file(base, ctx.process_id, 2)
+assert own.exists(), own
+assert not base.exists()
+sc_own, arr_own = ck._load_npz(own)
+shard_keys = [k for k in arr_own if k.startswith("warm__shard")]
+assert len(shard_keys) == 4 and "warm" not in arr_own, sorted(arr_own)
+assert arr_own[shard_keys[0]].shape == (7, n // 8), arr_own[shard_keys[0]].shape
+
+# The peer's save is host-side and can skew by ms — wait for its file
+# before resuming (a real resume happens at job restart, long after).
+peer = ck._proc_file(base, 1 - ctx.process_id, 2)
+for _ in range(600):
+    if peer.exists():
+        break
+    time.sleep(0.1)
+assert peer.exists(), "peer checkpoint file never appeared"
+
+res = solve_equilibrium_distribution(m, solver=scfg, eq=eq, mesh=mesh8,
+                                     checkpoint_dir=ckdir, **dist_kw)
+np.testing.assert_allclose(np.asarray(res.r_history),
+                           np.asarray(ref.r_history), rtol=0, atol=1e-12)
+assert abs(res.r - ref.r) < 1e-12
+print("WORKER_OK", ctx.process_id)
+""" % port
+
+        env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+            [os.getcwd()] + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                    "JAX_PROCESS_ID", "XLA_FLAGS", "JAX_PLATFORMS"):
+            env.pop(var, None)
+        procs = [subprocess.Popen(
+            [_sys.executable, "-c", worker, str(pid), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for pid in (0, 1)]
+        outs = []
+        for p in procs:
+            try:
+                # Cold-cache first run compiles three bisection phases' worth
+                # of sharded programs in both processes on one core (~20 min
+                # observed); cached runs are minutes.
+                out, err = p.communicate(timeout=2400)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("two-process interrupted-resume cluster hung")
             outs.append((p.returncode, out, err))
         for rc, out, err in outs:
             assert rc == 0, f"worker failed rc={rc}\n{out}\n{err}"
