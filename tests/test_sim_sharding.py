@@ -422,6 +422,7 @@ class TestDistributed:
         assert ctx.global_device_count == len(jax.devices())
 
     @pytest.mark.slow
+    @pytest.mark.cluster
     def test_two_process_cluster_cross_process_psum(self):
         # The REAL multi-process path (SURVEY.md §5.8; VERDICT round 2 #5):
         # two fresh processes, a localhost coordinator, one CPU device each
@@ -495,6 +496,7 @@ print("WORKER_OK", ctx.process_id, float(total))
             assert "WORKER_OK" in out, (out, err)
 
     @pytest.mark.slow
+    @pytest.mark.cluster
     def test_two_process_cluster_real_solves(self):
         # Capability, not just plumbing (VERDICT round 3 #5): a 2-process x
         # 4-virtual-device cluster (the one-process-per-host topology of a
@@ -629,6 +631,7 @@ print("WORKER_OK", ctx.process_id)
             assert "WORKER_OK" in out, (out, err)
 
     @pytest.mark.slow
+    @pytest.mark.cluster
     def test_two_process_interrupted_resume(self, tmp_path):
         # The pod-preemption story past the process boundary (VERDICT
         # round 4 missing #3): a 2-process x 4-device mesh GE bisection is

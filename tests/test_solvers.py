@@ -321,6 +321,7 @@ class TestMultiscaleVFI:
 
 
 class TestWarmStartVFI:
+    @pytest.mark.slow
     def test_egm_warmstart_matches_cold(self):
         """The cross-method warm start (EGM policy -> VFI idx_init,
         solvers/vfi.solve_aiyagari_vfi_egm_warmstart) reaches the cold
