@@ -744,10 +744,10 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
 def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
                                      beta: float, tol: float, max_iter: int,
                                      grid_power: float,
-                                     howard_steps: int = 25,
+                                     howard_steps: int = 15,
                                      golden_iters: int = 48,
                                      coarsest: int = 400,
-                                     refine_factor: int = 10,
+                                     refine_factor: int = 32,
                                      relative_tol: bool = False,
                                      noise_floor_ulp: float = 0.0,
                                      egm_solution=None) -> VFISolution:
@@ -763,6 +763,15 @@ def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
     stopping rule; pinned by test_solvers.TestWarmStartVFI). egm_solution
     lets a caller that already holds a converged EGMSolution (the bench
     times the EGM leg separately) skip the inner solve.
+
+    Defaults are the measured-best warm recipe at 400k on the v5e
+    (round-5 A/B, BENCHMARKS.md): a 3-stage ladder (refine_factor=32 —
+    the 4-stage default pays ~0.1 s of stage overhead for warmth the EGM
+    policy already provides) and howard_steps=15 (per-call contraction
+    beta^15 ~ 0.54 keeps the value stop honest while halving the
+    evaluation sweeps of hs=25; hs <= 8 shaves ~80 ms more but the
+    per-call contraction degrades to ~0.7-0.8, loosening what the
+    stopping band certifies).
     """
     if egm_solution is None:
         from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale

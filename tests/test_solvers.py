@@ -349,8 +349,13 @@ class TestWarmStartVFI:
             m.a_grid, m.s, m.P, R_TEST, w, m.amin, howard_steps=25, **kw)
         egm = solve_aiyagari_egm_multiscale(
             m.a_grid, m.s, m.P, R_TEST, w, m.amin, **kw)
+        # The warm leg runs the SHIPPED defaults (3-stage ladder, hs=15 —
+        # the tuned recipe the bench measures), deliberately NOT the cold
+        # reference's knobs: the claim is that the fixed point is
+        # recipe-independent, so the equality must hold across the two
+        # configurations, not just for matched ones.
         warm = solve_aiyagari_vfi_egm_warmstart(
-            m.a_grid, m.s, m.P, R_TEST, w, m.amin, howard_steps=25,
+            m.a_grid, m.s, m.P, R_TEST, w, m.amin,
             egm_solution=egm, **kw)
         assert float(warm.distance) < 1e-5
         # Same fixed point: values agree to the stopping tolerance, policies
