@@ -550,9 +550,12 @@ def _bench_scale_vfi(model, grid_scale: int, quick: bool, r: float, w: float,
     # Cold reference: one timed run (it is ~10x the warm wall; best-of-N
     # would double the battery for a comparison field).
     def run_cold():
+        # Every round-4-comparable knob pinned EXPLICITLY (not inherited
+        # from multiscale defaults, which a future tuning could move the
+        # way this round moved the warm wrapper's): hs=25, 4-stage ladder.
         return solve_aiyagari_vfi_multiscale(
             model.a_grid, model.s, model.P, r, w, model.amin,
-            howard_steps=25, **kw)
+            howard_steps=25, coarsest=400, refine_factor=10, **kw)
 
     cold = run_cold()
     float(cold.distance)
