@@ -41,6 +41,30 @@ class TestKernelCosts:
         assert s.mxu_flops == 4 * c.mxu_flops
         assert s.hbm_bytes == 4 * c.hbm_bytes
 
+    def test_vfi_slab_counts(self):
+        # Round-5 model for the slab VFI (the scale_vfi artifact's
+        # utilization source): cells = N * ceil(na/sq) * sq * (kb*mw);
+        # improvement ~16 ops/cell, evaluation ~3 ops/cell + the u_pol
+        # add; slab DMA is mw*kb cells per sq queries.
+        from aiyagari_tpu.diagnostics.roofline import vfi_slab_cost
+
+        N, na = 7, 1024
+        cells = N * 4 * 256 * 1536          # ceil(1024/256)=4 blocks
+        imp = vfi_slab_cost(N, na, 4, improve_rounds=1, eval_sweeps=0)
+        ev = vfi_slab_cost(N, na, 4, improve_rounds=0, eval_sweeps=1)
+        assert imp.vpu_ops == 16 * cells
+        assert ev.vpu_ops == 3 * cells + N * na
+        assert imp.mxu_flops == ev.mxu_flops == 2 * N * N * na
+        assert imp.hbm_bytes == 4 * (N * 4 * 1536 + 8 * N * na)
+        # Linearity in the two counters (the bench multiplies by the
+        # solver-reported rounds/sweeps).
+        both = vfi_slab_cost(N, na, 4, improve_rounds=2, eval_sweeps=5)
+        assert both.vpu_ops == 2 * imp.vpu_ops + 5 * ev.vpu_ops
+        # The slab VFI is VPU-bound under this model at any plausible wall
+        # (the scale_vfi row's "bound": "vpu").
+        u = utilization(1.0, both, "tpu")
+        assert u["bound"] == "vpu"
+
 
 class TestUtilization:
     def test_fractions_against_documented_peaks(self):
