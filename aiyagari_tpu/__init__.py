@@ -25,7 +25,13 @@ from aiyagari_tpu.config import (
     Technology,
 )
 from aiyagari_tpu.diagnostics.errors import ConvergenceError, ConvergenceWarning
-from aiyagari_tpu.dispatch import solve
+from aiyagari_tpu.dispatch import solve, sweep
+from aiyagari_tpu.equilibrium.batched import (
+    SweepResult,
+    excess_demand_batch,
+    solve_equilibrium_batched,
+    solve_equilibrium_sweep,
+)
 from aiyagari_tpu.equilibrium.bisection import (
     EquilibriumResult,
     solve_equilibrium,
@@ -42,10 +48,15 @@ __version__ = "0.1.0"
 
 __all__ = [
     "solve",
+    "sweep",
     "ConvergenceError",
     "ConvergenceWarning",
     "solve_equilibrium",
     "solve_equilibrium_distribution",
+    "solve_equilibrium_batched",
+    "solve_equilibrium_sweep",
+    "excess_demand_batch",
+    "SweepResult",
     "solve_household",
     "AiyagariModel",
     "aiyagari_preset",

@@ -200,13 +200,24 @@ class SimConfig:
 
 @dataclasses.dataclass(frozen=True)
 class EquilibriumConfig:
-    """GE bisection on the interest rate. Reference: Aiyagari_VFI.m:133-136."""
+    """GE closure on the interest rate. Reference: Aiyagari_VFI.m:133-136.
+
+    batch <= 1 (default) runs the reference's serial bisection: one full
+    household solve + aggregation per candidate rate, max_iter midpoints.
+    batch >= 2 opts into the parallel-bracket root finder
+    (equilibrium/batched.py): each outer ROUND evaluates `batch` candidate
+    rates through one vmapped excess-demand kernel, shrinking the bracket by
+    (batch+1)x per round instead of bisection's 2x — max_iter then caps
+    ROUNDS, and the device executes ~log2(batch+1)-fold fewer sequential
+    programs for the same root resolution.
+    """
 
     max_iter: int = 10
     tol: float = 1e-5
     r_low: float = -0.05
     r_high: Optional[float] = None    # None -> 1/beta - 1
     r_init: float = 0.04              # warm-start rate (Aiyagari_VFI.m:63)
+    batch: int = 1                    # >= 2: candidate rates per device round
 
 
 @dataclasses.dataclass(frozen=True)
@@ -287,7 +298,12 @@ def precision_scope(dtype: str):
 
     # "mixed" needs x64 available for its f64 simulation/regression half.
     if dtype in ("float64", "mixed") and not jax.config.jax_enable_x64:
-        return jax.enable_x64()
+        # jax >= 0.6 exposes the scoped switch at top level; 0.4.x only in
+        # jax.experimental. Same context manager either way.
+        enable = getattr(jax, "enable_x64", None)
+        if enable is None:
+            from jax.experimental import enable_x64 as enable
+        return enable()
     import contextlib
 
     return contextlib.nullcontext()

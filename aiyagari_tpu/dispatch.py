@@ -13,7 +13,9 @@ numbers, so speedups are reported against this at the reference's scales).
 
 from __future__ import annotations
 
-from typing import Optional, Union
+import dataclasses
+import itertools
+from typing import Optional, Sequence, Union
 
 import jax.numpy as jnp
 
@@ -29,7 +31,7 @@ from aiyagari_tpu.config import (
     SolverConfig,
 )
 
-__all__ = ["solve"]
+__all__ = ["solve", "sweep"]
 
 
 def _dtype_of(backend: BackendConfig):
@@ -108,6 +110,10 @@ def solve(
         if backend.backend == "numpy":
             if aggregation != "simulation":
                 raise ValueError("aggregation='distribution' requires backend='jax'")
+            if equilibrium.batch >= 2:
+                raise ValueError(
+                    "EquilibriumConfig.batch >= 2 (batched GE) requires "
+                    "backend='jax'")
             from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
 
             result = solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
@@ -131,7 +137,26 @@ def solve(
                 mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
             with precision_scope(backend.dtype):
                 m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
-                if aggregation == "distribution":
+                if equilibrium.batch >= 2:
+                    # Opt-in batched GE (equilibrium/batched.py): B candidate
+                    # rates per device round through one vmapped excess-demand
+                    # kernel, same fixed point as the serial bisection below
+                    # in ~log2(B+1)-fold fewer rounds. Incompatible with the
+                    # grid-axis mesh routes (the batch axis IS the
+                    # parallelism); both closures are supported.
+                    if mesh is not None:
+                        raise ValueError(
+                            "EquilibriumConfig.batch >= 2 cannot be combined "
+                            "with a grid-axis device mesh; drop 'grid' from "
+                            "BackendConfig.mesh_axes or use the serial path")
+                    from aiyagari_tpu.equilibrium.batched import (
+                        solve_equilibrium_batched,
+                    )
+
+                    result = solve_equilibrium_batched(
+                        m, solver=solver, eq=equilibrium, sim=sim,
+                        aggregation=aggregation)
+                elif aggregation == "distribution":
                     result = solve_equilibrium_distribution(
                         m, solver=solver, eq=equilibrium, mesh=mesh)
                 else:
@@ -173,3 +198,131 @@ def solve(
         return result
 
     raise TypeError(f"unknown model config type: {type(model).__name__}")
+
+
+# Parameter-grid keys sweep() knows how to thread into an AiyagariConfig:
+# name -> (config section, field). All are r-relevant economics: preferences
+# move the supply curve, the borrowing limit moves the grid, the income
+# process moves both the chain and the normalized labor endowment.
+_SWEEP_PARAMS = {
+    "beta": ("preferences", "beta"),
+    "sigma": ("preferences", "sigma"),
+    "psi": ("preferences", "psi"),
+    "eta": ("preferences", "eta"),
+    "borrowing_limit": (None, "borrowing_limit"),
+    "rho": ("income", "rho"),
+    "sigma_e": ("income", "sigma_e"),
+}
+
+
+def _scenario_config(base: AiyagariConfig, assignment: dict) -> AiyagariConfig:
+    cfg = base
+    for name, value in assignment.items():
+        section, field = _SWEEP_PARAMS[name]
+        if section is None:
+            cfg = dataclasses.replace(cfg, **{field: value})
+        else:
+            sub = dataclasses.replace(getattr(cfg, section), **{field: value})
+            cfg = dataclasses.replace(cfg, **{section: sub})
+    return cfg
+
+
+def sweep(
+    base: AiyagariConfig,
+    *,
+    method: Optional[str] = None,
+    backend: Union[str, BackendConfig] = "jax",
+    solver: Optional[SolverConfig] = None,
+    sim: Optional[SimConfig] = None,
+    equilibrium: Optional[EquilibriumConfig] = None,
+    aggregation: str = "distribution",
+    configs: Optional[Sequence[AiyagariConfig]] = None,
+    **param_grids,
+):
+    """Solve MANY Aiyagari economies to general equilibrium as one batched
+    device program (equilibrium/batched.py).
+
+    Scenarios come either from `configs` (an explicit list of
+    AiyagariConfigs sharing grid shapes and technology) or from the
+    cartesian product of parameter grids passed as keyword lists over the
+    r-relevant scalars: beta, sigma, psi, eta, borrowing_limit, rho,
+    sigma_e. Example:
+
+        res = sweep(AiyagariConfig(),
+                    beta=[0.94, 0.95, 0.96],
+                    sigma=[2.0, 3.0, 5.0])      # 9 scenarios
+        res.r                                    # [9] equilibrium rates
+        res.params[4]                            # {"beta": 0.95, "sigma": 3.0}
+
+    Every scenario advances its own interest-rate bisection in lockstep: one
+    round = one vmapped excess-demand kernel call over all S scenarios (the
+    vmap-compatible solver entry points make sigma/beta traced operands, so
+    the whole batch compiles once). With BackendConfig.mesh_axes containing
+    "scenarios", the scenario axis is sharded across the device mesh —
+    scenarios/sec then scales with the device count; the result records
+    `scenarios_per_sec` either way.
+
+    aggregation="distribution" (default) closes each scenario with the
+    deterministic Young-histogram supply; "simulation" uses per-scenario
+    Monte-Carlo panels. Returns a SweepResult ([S]-arrays of r/w/K plus the
+    batched household solutions, still on device).
+    """
+    if isinstance(backend, str):
+        backend = BackendConfig(backend=backend)
+    if backend.backend != "jax":
+        raise ValueError("sweep() requires backend='jax'")
+    if solver is not None and method is not None and solver.method != method:
+        raise ValueError(
+            f"conflicting methods: method={method!r} but solver.method={solver.method!r}"
+        )
+    method = method or (solver.method if solver is not None else "vfi")
+    if method not in ("vfi", "egm"):
+        raise ValueError(f"unknown method {method!r}; expected 'vfi' or 'egm'")
+    solver = solver or SolverConfig(method=method)
+    sim = sim or SimConfig()
+    equilibrium = equilibrium or EquilibriumConfig()
+    if aggregation not in ("simulation", "distribution"):
+        raise ValueError(
+            f"unknown aggregation {aggregation!r}; expected 'simulation' or 'distribution'"
+        )
+
+    params: Optional[list] = None
+    if configs is None:
+        unknown = set(param_grids) - set(_SWEEP_PARAMS)
+        if unknown:
+            raise ValueError(
+                f"unknown sweep parameter(s) {sorted(unknown)}; supported: "
+                f"{sorted(_SWEEP_PARAMS)}")
+        if not param_grids:
+            raise ValueError(
+                "sweep() needs scenarios: pass parameter grids "
+                "(e.g. beta=[...]) or an explicit configs=[...] list")
+        names = sorted(param_grids)
+        grids = [list(param_grids[n]) for n in names]
+        params = [dict(zip(names, combo))
+                  for combo in itertools.product(*grids)]
+        configs = [_scenario_config(base, p) for p in params]
+    elif param_grids:
+        raise ValueError("pass either configs=[...] or parameter grids, not both")
+
+    from aiyagari_tpu.config import precision_scope
+    from aiyagari_tpu.equilibrium.batched import (
+        solve_equilibrium_sweep,
+        stack_scenarios,
+    )
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    mesh = None
+    if "scenarios" in backend.mesh_axes:
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+    with precision_scope(backend.dtype):
+        models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
+                  for c in configs]
+        batch = stack_scenarios(models, mesh=mesh)
+        result = solve_equilibrium_sweep(
+            batch, solver=solver, eq=equilibrium, sim=sim,
+            aggregation=aggregation)
+    result.params = params
+    return result

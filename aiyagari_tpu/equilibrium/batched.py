@@ -1,0 +1,581 @@
+"""Batched general-equilibrium machinery: evaluate the excess-capital-demand
+curve at MANY candidate interest rates (or many parameter scenarios) per
+device round, instead of the serial one-solve-per-candidate bisection of
+equilibrium/bisection.py.
+
+Two entry points, one kernel:
+
+  * solve_equilibrium_batched — a parallel-bracket root finder for ONE
+    economy: each outer round evaluates B candidate rates through a single
+    vmapped excess-demand program (household fixed point + Young stationary
+    distribution + aggregate_capital fused in one jit), shrinking the
+    bracket by a factor of (B+1) per round where bisection manages 2. The
+    host loop therefore runs ~log2(B+1)-fold fewer sequential device rounds
+    for the same root resolution, and each round warm-starts every candidate
+    from the NEAREST converged candidate of the previous round (the bracket
+    nests, so the two survivors of round k are exactly the closest warm
+    states for round k+1's interior points).
+
+  * solve_equilibrium_sweep — many INDEPENDENT scenarios (grids over beta,
+    sigma, borrowing limit, shock process, ...) advanced through their own
+    bisections in lockstep: the batch axis is the scenario, every round is
+    one vmapped kernel call over [S] economies, and the stacked model arrays
+    can be sharded over a device mesh "scenarios" axis (parallel/mesh.py),
+    making throughput scale with the device count. dispatch.sweep() is the
+    user-facing wrapper that builds the scenario batch from parameter grids.
+
+Both build on the vmap-compatibility refactor of the household solvers:
+sigma/beta (and psi/eta, amin, r, w) are traced operands of
+solvers/vfi.solve_aiyagari_vfi and solvers/egm.solve_aiyagari_egm, so a
+whole scenario batch compiles ONCE and maps onto the same program.
+
+The reference has no analogue (its closure is the strictly serial
+Aiyagari_VFI.m:133-206 loop); this is the batched-fixed-point pattern the
+north star names, applied to the price axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from functools import lru_cache
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
+from aiyagari_tpu.equilibrium.bisection import EquilibriumResult
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+
+__all__ = [
+    "excess_demand_batch",
+    "solve_equilibrium_batched",
+    "ScenarioBatch",
+    "SweepResult",
+    "stack_scenarios",
+    "solve_equilibrium_sweep",
+    "batched_round_bound",
+]
+
+
+def batched_round_bound(serial_iters: int, batch: int) -> int:
+    """Upper bound on the rounds the parallel-bracket solver needs to reach
+    the bracket width serial bisection reaches in `serial_iters` halvings:
+    each round splits the bracket into (batch+1) subintervals, so
+    ceil(serial_iters * ln 2 / ln(batch+1)), plus one slack round for the
+    tolerance check landing between grid refinements. Pinned by
+    tests/test_batched_ge.py's round-count assertion."""
+    if batch < 2:
+        return serial_iters
+    return math.ceil(serial_iters * math.log(2.0) / math.log(batch + 1.0)) + 1
+
+
+def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
+           dist_max_iter: int, sim: SimConfig):
+    """The static-knob tuple _ge_round_program destructures — ONE builder so
+    the positional contract cannot drift between callers."""
+    return (
+        solver.tol, solver.max_iter, solver.howard_steps, solver.relative_tol,
+        alpha, delta, dist_tol, dist_max_iter,
+        sim.periods, sim.n_agents, sim.discard,
+    )
+
+
+def _model_knobs(model: AiyagariModel, solver: SolverConfig,
+                 dist_tol: float, dist_max_iter: int, sim: SimConfig):
+    tech = model.config.technology
+    return _knobs(solver, tech.alpha, tech.delta, dist_tol, dist_max_iter, sim)
+
+
+@lru_cache(maxsize=None)
+def _ge_round_program(method: str, labor: bool, aggregation: str,
+                      knobs: tuple, scenario_axes: bool, cold: bool):
+    """Build + jit one GE round: (warm selection ->) vmapped household solve
+    -> aggregation -> excess demand, for B candidates (or S scenarios) in a
+    single device program.
+
+    Cache key = everything that changes the traced program: the solver
+    family, the closure, the static solver/sim knobs, whether the model
+    arrays carry a leading scenario axis, and whether this is the cold first
+    round (no previous candidates to warm-start from). lru_cache'd so every
+    outer round of every solve reuses the same compiled executable.
+    """
+    (tol, max_iter, howard_steps, relative_tol, alpha, delta,
+     dist_tol, dist_max_iter, periods, n_agents, discard) = knobs
+
+    def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
+            amin, labor_raw):
+        from aiyagari_tpu.sim.distribution import (
+            aggregate_capital,
+            stationary_distribution,
+        )
+
+        w = wage_from_r(r, alpha, delta)
+        if method == "vfi":
+            from aiyagari_tpu.solvers.vfi import (
+                solve_aiyagari_vfi,
+                solve_aiyagari_vfi_labor,
+            )
+
+            if labor:
+                sol = solve_aiyagari_vfi_labor(
+                    warm, a_grid, labor_grid, s, P, r, w, sigma=sigma,
+                    beta=beta, psi=psi, eta=eta, tol=tol, max_iter=max_iter,
+                    howard_steps=howard_steps, relative_tol=relative_tol)
+            else:
+                sol = solve_aiyagari_vfi(
+                    warm, a_grid, s, P, r, w, sigma=sigma, beta=beta,
+                    tol=tol, max_iter=max_iter, howard_steps=howard_steps,
+                    relative_tol=relative_tol)
+            warm_out = sol.v
+        else:
+            from aiyagari_tpu.solvers.egm import (
+                solve_aiyagari_egm,
+                solve_aiyagari_egm_labor,
+            )
+
+            # grid_power=0.0: the generic exact inversion route. The windowed
+            # fast path's escape contract needs a HOST retry
+            # (solve_aiyagari_egm_safe), which a fused batched kernel cannot
+            # perform mid-program.
+            if labor:
+                sol = solve_aiyagari_egm_labor(
+                    warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
+                    psi=psi, eta=eta, tol=tol, max_iter=max_iter,
+                    relative_tol=relative_tol, grid_power=0.0)
+            else:
+                sol = solve_aiyagari_egm(
+                    warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
+                    tol=tol, max_iter=max_iter, relative_tol=relative_tol,
+                    grid_power=0.0)
+            warm_out = sol.policy_c
+
+        out = {"warm": warm_out, "sol": sol,
+               "solver_iterations": sol.iterations,
+               "solver_distance": sol.distance}
+        if aggregation == "distribution":
+            dist_sol = stationary_distribution(
+                sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter)
+            supply = aggregate_capital(dist_sol.mu, a_grid)
+            out["mu"] = dist_sol.mu
+        else:
+            from aiyagari_tpu.sim.ergodic import simulate_panel
+
+            series = simulate_panel(
+                sol.policy_k, sol.policy_c, sol.policy_l, a_grid, s, P, r, w,
+                key, periods=periods, n_agents=n_agents, delta=delta)
+            supply = jnp.mean(series.k[discard:])
+            out["series"] = series
+        out["supply"] = supply
+        out["demand"] = capital_demand(r, labor_raw, alpha, delta)
+        out["gap"] = out["supply"] - out["demand"]
+        return out
+
+    mx = 0 if scenario_axes else None       # model arrays / scalars axis
+    in_axes = (0, 0, 0, mx, mx, mx, mx, mx, mx, mx, mx, mx, mx)
+    batched = jax.vmap(one, in_axes=in_axes)
+
+    def round_fn(r_new, r_prev, warm_prev, keys, a_grid, s, P, labor_grid,
+                 sigma, beta, psi, eta, amin, labor_raw):
+        if cold:
+            # First round: no previous candidates. VFI starts at v=0 (the
+            # reference's init); EGM at the consume-cash-on-hand guess
+            # evaluated at each candidate's own prices (Aiyagari_EGM.m:64).
+            B = r_new.shape[0]
+            if method == "vfi":
+                shape = ((B,) + warm_prev.shape[-2:])
+                warm = jnp.zeros(shape, a_grid.dtype)
+            else:
+                from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+                w_new = wage_from_r(r_new, alpha, delta)
+                guess_axes = (None if not scenario_axes else 0, None
+                              if not scenario_axes else 0, 0, 0)
+                warm = jax.vmap(initial_consumption_guess,
+                                in_axes=guess_axes)(a_grid, s, r_new, w_new)
+        elif scenario_axes:
+            # Sweep mode: one candidate per scenario per round — the nearest
+            # previous candidate is the scenario's own last iterate.
+            warm = warm_prev
+        else:
+            # Parallel bracket: warm-start each new candidate from the
+            # nearest previous candidate (the round-k survivors bracket
+            # round k+1's interior points, so this is the closest converged
+            # state available — the serial loop's warm-start carried over).
+            j = jnp.argmin(jnp.abs(r_new[:, None] - r_prev[None, :]), axis=1)
+            warm = jnp.take(warm_prev, j, axis=0)
+        return batched(warm, r_new, keys, a_grid, s, P, labor_grid,
+                       sigma, beta, psi, eta, amin, labor_raw)
+
+    return jax.jit(round_fn)
+
+
+def _model_operands(model: AiyagariModel):
+    prefs = model.preferences
+    dt = model.dtype
+    sc = lambda x: jnp.asarray(x, dt)
+    return (model.a_grid, model.s, model.P, model.labor_grid,
+            sc(prefs.sigma), sc(prefs.beta), sc(prefs.psi), sc(prefs.eta),
+            sc(model.amin), sc(model.labor_raw))
+
+
+def _round_keys(seed: int, rnd: int, n: int):
+    return jax.random.split(jax.random.fold_in(jax.random.PRNGKey(seed), rnd), n)
+
+
+def excess_demand_batch(model: AiyagariModel, r_batch, *,
+                        solver: SolverConfig = SolverConfig(),
+                        aggregation: str = "distribution",
+                        warm=None, r_warm=None,
+                        sim: SimConfig = SimConfig(),
+                        dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+                        keys=None):
+    """Evaluate gap(r) = K_supply(r) - K_demand(r) at every rate in
+    `r_batch` as ONE jitted device program: vmapped household solve
+    (solvers/vfi.py or solvers/egm.py, per solver.method), stationary
+    distribution (sim/distribution.py) or panel simulation (sim/ergodic.py,
+    per `aggregation`), and the firm FOC demand curve, fused.
+
+    warm/r_warm (optional, [Bp, N, na] / [Bp]) warm-start each candidate
+    from its nearest previous candidate; None cold-starts every candidate.
+    Returns (gap [B], aux) with aux carrying supply/demand/warm/sol (all
+    batched, still on device).
+    """
+    if aggregation not in ("distribution", "simulation"):
+        raise ValueError(f"unknown aggregation {aggregation!r}")
+    B = int(np.shape(r_batch)[0])
+    knobs = _model_knobs(model, solver, dist_tol, dist_max_iter, sim)
+    cold = warm is None
+    if not cold and r_warm is None:
+        raise ValueError("warm states need their candidate rates: pass r_warm")
+    fn = _ge_round_program(solver.method, model.config.endogenous_labor,
+                           aggregation, knobs, False, cold)
+    ops = _model_operands(model)
+    r_new = jnp.asarray(r_batch, model.dtype)
+    if keys is None:
+        keys = _round_keys(sim.seed, 0, B)
+    if cold:
+        # Shape-only placeholder: the cold program reads nothing but its
+        # trailing (N, na) shape (VFI) or ignores it entirely (EGM).
+        N, na = model.P.shape[0], model.a_grid.shape[0]
+        warm = jnp.zeros((1, N, na), model.dtype)
+        r_warm = r_new
+    out = fn(r_new, jnp.asarray(r_warm, model.dtype), warm, keys, *ops)
+    return out["gap"], out
+
+
+def solve_equilibrium_batched(
+    model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+    eq: EquilibriumConfig = EquilibriumConfig(batch=8),
+    sim: SimConfig = SimConfig(),
+    aggregation: str = "distribution",
+    dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+    on_iteration: Optional[Callable] = None,
+) -> EquilibriumResult:
+    """Parallel-bracket GE root finder: same fixed point as the serial
+    bisection (equilibrium/bisection.py — the excess-demand curve is
+    IDENTICAL; only the query schedule changes), in ~log2(batch+1)-fold
+    fewer sequential device rounds.
+
+    Each round places eq.batch candidates at the interior points
+    lo + (hi-lo) * i/(B+1), i=1..B, evaluates them through one vmapped
+    excess-demand program, and shrinks the bracket to the sign change
+    (gap = supply - demand is increasing in r: supply rises toward the
+    1/beta - 1 asymptote, the firm FOC demand falls). Convergence criterion
+    and bracket semantics match the serial loop: stop when some candidate's
+    |gap| < eq.tol; eq.max_iter caps ROUNDS.
+
+    aggregation="distribution" (default here — deterministic supply makes
+    the parallel bracket exact) or "simulation" (per-candidate panels with
+    per-round PRNG keys split from sim.seed; the bracket then chases the
+    same Monte-Carlo noise the serial closure does).
+
+    Returns an EquilibriumResult whose histories carry EVERY evaluated
+    candidate (len == rounds * batch, aligned across r/supply/demand) and
+    whose `iterations` counts rounds — the device-round metric the batched
+    solver exists to shrink.
+    """
+    if eq.batch < 2:
+        raise ValueError(
+            f"solve_equilibrium_batched needs eq.batch >= 2, got {eq.batch}; "
+            "use equilibrium/bisection.py for the serial loop")
+    if aggregation not in ("distribution", "simulation"):
+        raise ValueError(f"unknown aggregation {aggregation!r}")
+    t0 = time.perf_counter()
+    B = int(eq.batch)
+    prefs = model.preferences
+    tech = model.config.technology
+    lo = float(eq.r_low)
+    hi = float(eq.r_high if eq.r_high is not None else 1.0 / prefs.beta - 1.0)
+    offsets = np.arange(1, B + 1) / (B + 1.0)
+
+    knobs = _model_knobs(model, solver, dist_tol, dist_max_iter, sim)
+    labor = model.config.endogenous_labor
+    ops = _model_operands(model)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+
+    r_prev = None
+    warm_prev = jnp.zeros((1, N, na), model.dtype)
+    out = None
+    r_hist, ks_hist, kd_hist, records = [], [], [], []
+    converged = False
+    best = 0
+    r_cand = np.array([0.5 * (lo + hi)])
+    rounds = 0
+    for rnd in range(eq.max_iter):
+        it_t0 = time.perf_counter()
+        r_cand = lo + (hi - lo) * offsets
+        r_dev = jnp.asarray(r_cand, model.dtype)
+        keys = _round_keys(sim.seed, rnd, B)
+        fn = _ge_round_program(solver.method, labor, aggregation, knobs,
+                               False, rnd == 0)
+        out = fn(r_dev, r_prev if r_prev is not None else r_dev,
+                 warm_prev, keys, *ops)
+        gaps, supplies, demands, sol_iters = jax.device_get(
+            (out["gap"], out["supply"], out["demand"],
+             out["solver_iterations"]))
+        gaps = np.asarray(gaps, np.float64)
+        rounds = rnd + 1
+        r_hist.extend(float(r) for r in r_cand)
+        ks_hist.extend(float(x) for x in supplies)
+        kd_hist.extend(float(x) for x in demands)
+        finite = np.where(np.isfinite(gaps), np.abs(gaps), np.inf)
+        best = int(np.argmin(finite))
+        rec = {
+            "round": rnd,
+            "r_candidates": [float(r) for r in r_cand],
+            "gaps": [float(g) for g in gaps],
+            "bracket": (lo, hi),
+            "best_r": float(r_cand[best]),
+            "best_gap": float(gaps[best]),
+            "solver_iterations_max": int(np.max(sol_iters)),
+            "seconds": time.perf_counter() - it_t0,
+        }
+        records.append(rec)
+        if on_iteration is not None:
+            on_iteration(rec)
+        if np.isfinite(gaps[best]) and abs(gaps[best]) < eq.tol:
+            converged = True
+            break
+        # Shrink to the sign change: gap is increasing in r, so the root
+        # sits above the last negative candidate and below the first
+        # positive one (bracket edges cover the all-one-sign cases).
+        neg = gaps < 0.0
+        if neg.any():
+            i_star = int(np.max(np.nonzero(neg)[0]))
+            new_lo = float(r_cand[i_star])
+            new_hi = float(r_cand[i_star + 1]) if i_star + 1 < B else hi
+        else:
+            new_lo, new_hi = lo, float(r_cand[0])
+        lo, hi = new_lo, new_hi
+        r_prev, warm_prev = r_dev, out["warm"]
+
+    take = lambda x: jax.tree_util.tree_map(lambda l: l[best], x)
+    sol_best = take(out["sol"])
+    series_best = take(out["series"]) if "series" in out else None
+    mu_best = out["mu"][best] if "mu" in out else None
+    r_star = float(r_cand[best])
+    return EquilibriumResult(
+        r=r_star,
+        w=float(wage_from_r(r_star, tech.alpha, tech.delta)),
+        capital=float(supplies[best]),
+        solution=sol_best,
+        series=series_best,
+        r_history=r_hist,
+        k_supply=ks_hist,
+        k_demand=kd_hist,
+        iterations=rounds,
+        converged=converged,
+        solve_seconds=time.perf_counter() - t0,
+        per_iteration=records,
+        mu=mu_best,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioBatch:
+    """Stacked device operands for S scenarios sharing one grid geometry:
+    every array carries a leading scenario axis, ready for the vmapped GE
+    kernel (and for sharding that axis over a device mesh)."""
+
+    a_grid: jax.Array       # [S, na]
+    s: jax.Array            # [S, N]
+    P: jax.Array            # [S, N, N]
+    labor_grid: jax.Array   # [S, nl]
+    sigma: jax.Array        # [S]
+    beta: jax.Array         # [S]
+    psi: jax.Array          # [S]
+    eta: jax.Array          # [S]
+    amin: jax.Array         # [S]
+    labor_raw: jax.Array    # [S]
+    alpha: float
+    delta: float
+    endogenous_labor: bool
+    dtype: object
+    size: int
+
+    def operands(self):
+        return (self.a_grid, self.s, self.P, self.labor_grid, self.sigma,
+                self.beta, self.psi, self.eta, self.amin, self.labor_raw)
+
+
+def stack_scenarios(models: Sequence[AiyagariModel], *, mesh=None) -> ScenarioBatch:
+    """Stack per-scenario model primitives into one scenario-major batch.
+
+    All scenarios must share shapes (asset-grid size, income states, labor
+    grid), the endogenous_labor flag, and the technology block (alpha/delta
+    stay static so the firm curves fold into the compiled program) — exactly
+    the invariants the one-compilation contract needs. With `mesh` (carrying
+    a "scenarios" axis), the stacked arrays are placed sharded over it, so
+    the vmapped kernel runs scenario-parallel across devices.
+    """
+    if not models:
+        raise ValueError("stack_scenarios needs at least one scenario")
+    m0 = models[0]
+    tech0 = m0.config.technology
+    for m in models[1:]:
+        if (m.a_grid.shape != m0.a_grid.shape
+                or m.P.shape != m0.P.shape
+                or m.labor_grid.shape != m0.labor_grid.shape):
+            raise ValueError(
+                "sweep scenarios must share grid shapes: got "
+                f"{m.a_grid.shape}/{m.P.shape} vs {m0.a_grid.shape}/{m0.P.shape}")
+        if m.config.endogenous_labor != m0.config.endogenous_labor:
+            raise ValueError("sweep scenarios must share endogenous_labor")
+        if m.config.technology != tech0:
+            raise ValueError(
+                "sweep scenarios must share the technology block "
+                "(alpha/delta are compiled statically into the firm curves)")
+    dt = m0.dtype
+    stack = lambda xs: jnp.stack([jnp.asarray(x, dt) for x in xs])
+    batch = ScenarioBatch(
+        a_grid=stack([m.a_grid for m in models]),
+        s=stack([m.s for m in models]),
+        P=stack([m.P for m in models]),
+        labor_grid=stack([m.labor_grid for m in models]),
+        sigma=jnp.asarray([m.preferences.sigma for m in models], dt),
+        beta=jnp.asarray([m.preferences.beta for m in models], dt),
+        psi=jnp.asarray([m.preferences.psi for m in models], dt),
+        eta=jnp.asarray([m.preferences.eta for m in models], dt),
+        amin=jnp.asarray([m.amin for m in models], dt),
+        labor_raw=jnp.asarray([m.labor_raw for m in models], dt),
+        alpha=float(tech0.alpha),
+        delta=float(tech0.delta),
+        endogenous_labor=bool(m0.config.endogenous_labor),
+        dtype=dt,
+        size=len(models),
+    )
+    if mesh is not None:
+        from aiyagari_tpu.parallel.mesh import SCENARIOS_AXIS, scenarios_sharding
+
+        # Divisibility is against the "scenarios" AXIS size, not the total
+        # device count: a multi-axis mesh only splits the scenario axis that
+        # wide (the other axes replicate).
+        axis_size = int(mesh.shape[SCENARIOS_AXIS])
+        if batch.size % axis_size != 0:
+            raise ValueError(
+                f"scenario count {batch.size} must divide evenly over the "
+                f"{axis_size}-wide '{SCENARIOS_AXIS}' mesh axis")
+        shard = lambda x: jax.device_put(
+            x, scenarios_sharding(mesh, ndim=x.ndim))
+        batch = dataclasses.replace(
+            batch, **{f.name: shard(getattr(batch, f.name))
+                      for f in dataclasses.fields(batch)
+                      if isinstance(getattr(batch, f.name), jax.Array)})
+    return batch
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Per-scenario equilibria from one lockstep batched sweep."""
+
+    r: np.ndarray               # [S] equilibrium rates
+    w: np.ndarray               # [S] wages at r
+    capital: np.ndarray         # [S] K_supply at r
+    gap: np.ndarray             # [S] final |supply - demand| signed gap
+    converged: np.ndarray       # [S] bool
+    rounds: int                 # lockstep device rounds executed
+    scenarios: int
+    solve_seconds: float
+    scenarios_per_sec: float
+    solutions: object           # batched household solution pytree (device)
+    mu: object = None           # [S, N, na] stationary distributions, if
+                                # the distribution closure produced them
+    params: Optional[list] = None   # per-scenario parameter dicts (sweep())
+
+
+def solve_equilibrium_sweep(
+    batch: ScenarioBatch, *, solver: SolverConfig = SolverConfig(),
+    eq: EquilibriumConfig = EquilibriumConfig(),
+    sim: SimConfig = SimConfig(),
+    aggregation: str = "distribution",
+    dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+) -> SweepResult:
+    """Advance S independent GE bisections in lockstep: every round solves
+    ALL scenarios' midpoint households through one vmapped device program
+    (sharded over a "scenarios" mesh axis when `batch` was stacked with
+    one). A converged scenario keeps its midpoint pinned while the rest
+    finish, so the program shape never changes and rounds stay one compile.
+
+    The per-scenario fixed point is identical to running
+    solve_equilibrium_distribution (or solve_equilibrium) scenario by
+    scenario — same bracket update, same |gap| < eq.tol criterion — at
+    1/S-th the sequential device rounds.
+    """
+    if aggregation not in ("distribution", "simulation"):
+        raise ValueError(f"unknown aggregation {aggregation!r}")
+    t0 = time.perf_counter()
+    S = batch.size
+    tech_alpha, tech_delta = batch.alpha, batch.delta
+    beta_host = np.asarray(jax.device_get(batch.beta), np.float64)
+    lo = np.full(S, float(eq.r_low))
+    hi = (np.full(S, float(eq.r_high)) if eq.r_high is not None
+          else 1.0 / beta_host - 1.0)
+    conv = np.zeros(S, bool)
+    r_mid = 0.5 * (lo + hi)
+    gaps = np.full(S, np.inf)
+    supplies = np.zeros(S)
+
+    knobs = _knobs(solver, tech_alpha, tech_delta, dist_tol, dist_max_iter,
+                   sim)
+    warm = jnp.zeros((1,) + tuple(batch.P.shape[-1:]) + tuple(
+        batch.a_grid.shape[-1:]), batch.dtype)
+    out = None
+    rounds = 0
+    for rnd in range(eq.max_iter):
+        r_mid = np.where(conv, r_mid, 0.5 * (lo + hi))
+        r_dev = jnp.asarray(r_mid, batch.dtype)
+        keys = _round_keys(sim.seed, rnd, S)
+        fn = _ge_round_program(solver.method, batch.endogenous_labor,
+                               aggregation, knobs, True, rnd == 0)
+        out = fn(r_dev, r_dev, warm, keys, *batch.operands())
+        warm = out["warm"]
+        gaps, supplies = (np.asarray(x, np.float64) for x in
+                          jax.device_get((out["gap"], out["supply"])))
+        rounds = rnd + 1
+        newly = np.isfinite(gaps) & (np.abs(gaps) < eq.tol)
+        conv = conv | newly
+        if conv.all():
+            break
+        step = ~conv
+        lo = np.where(step & (gaps < 0.0), r_mid, lo)
+        hi = np.where(step & (gaps >= 0.0), r_mid, hi)
+
+    wall = time.perf_counter() - t0
+    return SweepResult(
+        r=r_mid.copy(),
+        w=np.asarray(wage_from_r(r_mid, tech_alpha, tech_delta)),
+        capital=supplies,
+        gap=gaps,
+        converged=conv,
+        rounds=rounds,
+        scenarios=S,
+        solve_seconds=wall,
+        scenarios_per_sec=S / wall if wall > 0 else float("inf"),
+        solutions=out["sol"],
+        mu=out.get("mu"),
+    )

@@ -447,7 +447,11 @@ class CheckpointManager:
         save_checkpoint(self.path, scalars=scalars, arrays=arrays)
 
     def delete(self) -> None:
-        if self.path.exists():
-            self.path.unlink()
+        # missing_ok on BOTH forms (matching save_checkpoint's guard): in a
+        # multi-process run every process calls delete() on the shared
+        # directory, and the exists()/unlink() pair — or a glob hit another
+        # process already removed — is a TOCTOU race that turned run
+        # completion into FileNotFoundError.
+        self.path.unlink(missing_ok=True)
         for f in self.path.parent.glob(self.path.name + ".proc*of*"):
-            f.unlink()
+            f.unlink(missing_ok=True)

@@ -44,8 +44,7 @@ def expectation(P, v, beta: float):
     return beta * jnp.matmul(P, v, precision=jax.lax.Precision.HIGHEST)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "block_size", "use_pallas"))
-def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size: int = 0,
+def bellman_step(v, a_grid, s, P, r, w, *, sigma, beta, block_size: int = 0,
                  use_pallas: bool = False):
     """One application of the Bellman operator, exogenous labor.
 
@@ -55,22 +54,49 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size
     with infeasible (c<=0) choices masked to -inf, EV = beta * P @ v.
     Mirrors Aiyagari_VFI.m:70-83 as a single batched reduction.
 
+    sigma/beta are traced operands (they may vary across a vmapped scenario
+    batch — the batched-GE refactor); the Pallas route alone still requires a
+    concrete Python-float sigma, baked statically into the fused kernel.
+
     block_size > 0 processes the a' axis in chunks of that size (memory-bounded
     path for very fine grids); 0 means one dense [N, na, na] tensor.
     use_pallas routes the choice reduction through the fused VMEM-tiled TPU
     kernel (ops/pallas_bellman.py; interpreted off-TPU).
     """
+    if use_pallas:
+        try:
+            # Accept any concrete scalar (Python/NumPy/committed jax value);
+            # float() raises on tracers, which cannot be baked in statically.
+            sigma_static = float(sigma)
+        except Exception as e:
+            raise TypeError(
+                "bellman_step(use_pallas=True) requires a concrete scalar "
+                "sigma (the fused kernel bakes it in statically); got "
+                f"{sigma!r}"
+            ) from e
+        return _bellman_step_pallas(v, a_grid, s, P, r, w, sigma=sigma_static,
+                                    beta=beta)
+    return _bellman_step_xla(v, a_grid, s, P, r, w, sigma, beta,
+                             block_size=block_size)
+
+
+@partial(jax.jit, static_argnames=("sigma",))
+def _bellman_step_pallas(v, a_grid, s, P, r, w, *, sigma: float, beta):
+    from aiyagari_tpu.ops.pallas_bellman import bellman_max_pallas
+
+    EV = expectation(P, v, beta)                          # [N, na']
+    coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
+    return bellman_max_pallas(
+        coh, a_grid, EV, sigma=sigma,
+        interpret=(jax.default_backend() != "tpu"),
+    )
+
+
+@partial(jax.jit, static_argnames=("block_size",))
+def _bellman_step_xla(v, a_grid, s, P, r, w, sigma, beta, *, block_size: int):
     N, na = v.shape
     EV = expectation(P, v, beta)                          # [N, na']
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
-
-    if use_pallas:
-        from aiyagari_tpu.ops.pallas_bellman import bellman_max_pallas
-
-        return bellman_max_pallas(
-            coh, a_grid, EV, sigma=sigma,
-            interpret=(jax.default_backend() != "tpu"),
-        )
 
     def block_scores(ap_vals, ev_vals):
         c = coh[:, :, None] - ap_vals[None, None, :]      # [N, na, blk]
@@ -105,8 +131,8 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma: float, beta: float, block_size
     return best, best_idx
 
 
-@partial(jax.jit, static_argnames=("sigma", "dtype"))
-def choice_utility_tensor(a_grid, s, r, w, *, sigma: float, dtype=None):
+@partial(jax.jit, static_argnames=("dtype",))
+def choice_utility_tensor(a_grid, s, r, w, *, sigma, dtype=None):
     """The loop-invariant part of the Bellman score: masked flow utility
     u((1+r)a_j + w s_i - a_{j'}) over the full [N, na, na'] choice tensor
     (-inf where infeasible). The Bellman operator's per-sweep work depends on
@@ -121,8 +147,8 @@ def choice_utility_tensor(a_grid, s, r, w, *, sigma: float, dtype=None):
     ).astype(dtype)
 
 
-@partial(jax.jit, static_argnames=("beta",))
-def bellman_step_precomputed(v, U, P, *, beta: float):
+@jax.jit
+def bellman_step_precomputed(v, U, P, *, beta):
     """Bellman sweep given the precomputed choice-utility tensor: one MXU
     matmul (EV) + a broadcast add + a trailing-axis max. Identical fixed point
     to bellman_step (pinned by test_solvers), ~3x less per-sweep compute."""
@@ -131,9 +157,9 @@ def bellman_step_precomputed(v, U, P, *, beta: float):
     return jnp.max(q, axis=-1), jnp.argmax(q, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("sigma", "psi", "eta", "dtype"))
-def labor_choice_utility_tensor(a_grid, labor_grid, s, r, w, *, sigma: float,
-                                psi: float, eta: float, dtype=None):
+@partial(jax.jit, static_argnames=("dtype",))
+def labor_choice_utility_tensor(a_grid, labor_grid, s, r, w, *, sigma,
+                                psi, eta, dtype=None):
     """Loop-invariant joint-choice utility for the endogenous-labor Bellman:
     u(c) - psi l^(1+eta)/(1+eta) over the [nl, N, na, na'] grid, -inf where
     infeasible. See choice_utility_tensor; the labor axis is leading so a
@@ -147,8 +173,8 @@ def labor_choice_utility_tensor(a_grid, labor_grid, s, r, w, *, sigma: float,
     return (u - labor_disutility(labor_grid, psi, eta)[:, None, None, None]).astype(dtype)
 
 
-@partial(jax.jit, static_argnames=("beta",))
-def bellman_step_labor_precomputed(v, U4, P, *, beta: float):
+@jax.jit
+def bellman_step_labor_precomputed(v, U4, P, *, beta):
     """Endogenous-labor Bellman sweep from the precomputed [nl, N, na, na']
     joint-choice tensor: EV matmul + broadcast add + one flattened argmax over
     (l, a'). Same fixed point and tie order as bellman_step_labor."""
@@ -160,8 +186,8 @@ def bellman_step_labor_precomputed(v, U4, P, *, beta: float):
     return jnp.max(flat, axis=-1), best_flat % nap, best_flat // nap
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
-def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma: float, beta: float, psi: float, eta: float):
+@jax.jit
+def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, eta):
     """One Bellman application with a joint (labor x a') discrete choice.
 
     v [N, na] -> (v_new, policy_a_idx, policy_l_idx).
@@ -202,8 +228,8 @@ def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma: float, beta:
     return best, best_a, best_l
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta"))
-def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma: float, beta: float):
+@jax.jit
+def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma, beta):
     """Policy-evaluation sweep at a fixed discrete policy (Howard acceleration):
     v <- u(c_pol) + beta * (P @ v) gathered at the policy indices."""
     EV = expectation(P, v, beta)                           # [N, na']
@@ -213,9 +239,9 @@ def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma: float, beta: f
     return u + jnp.take_along_axis(EV, policy_idx, axis=1)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta"))
+@jax.jit
 def howard_eval_step_labor(v, policy_a_idx, policy_l_idx, a_grid, labor_grid, s, P, r, w, *,
-                           sigma: float, beta: float, psi: float, eta: float):
+                           sigma, beta, psi, eta):
     """Howard evaluation sweep for the endogenous-labor discrete policy."""
     EV = expectation(P, v, beta)
     ap = a_grid[policy_a_idx]

@@ -29,8 +29,8 @@ from aiyagari_tpu.utils.utility import (
 __all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "grid_power", "with_escape", "use_pallas"))
-def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+@partial(jax.jit, static_argnames=("grid_power", "with_escape", "use_pallas"))
+def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
              grid_power: float = 0.0, with_escape: bool = False,
              use_pallas: bool = False):
     """One EGM policy update, exogenous labor.
@@ -104,9 +104,9 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
     return C_new, policy_k
 
 
-@partial(jax.jit, static_argnames=("sigma", "psi", "eta"))
-def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma: float,
-                                  psi: float, eta: float):
+@jax.jit
+def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma,
+                                  psi, eta):
     """Static consumption where the borrowing constraint binds (a' = amin):
     damped fixed point of c = (1+r)a + w s l - amin with l from the
     intratemporal FOC. Loop-invariant across EGM sweeps — compute once per
@@ -124,9 +124,9 @@ def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma: float,
     return c_con
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "grid_power", "with_escape"))
-def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-                   psi: float, eta: float, c_constrained=None,
+@partial(jax.jit, static_argnames=("grid_power", "with_escape"))
+def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma, beta,
+                   psi, eta, c_constrained=None,
                    grid_power: float = 0.0, with_escape: bool = False):
     """One EGM policy update with endogenous labor via the closed-form
     intratemporal FOC l = ((w s u'(c))/psi)^(1/eta).

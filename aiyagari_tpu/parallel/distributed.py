@@ -40,6 +40,19 @@ def _env_int(name: str) -> Optional[int]:
     return int(v) if v not in (None, "") else None
 
 
+def _distributed_is_initialized() -> bool:
+    """jax.distributed.is_initialized, with a fallback for jax < 0.5 (this
+    image): the runtime's client handle in the global state is the same
+    predicate that accessor wraps."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:  # pragma: no cover - very old layouts
+        from jax._src.distributed import global_state as state
+    return getattr(state, "client", None) is not None
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -65,7 +78,7 @@ def initialize_distributed(
     )
     process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
 
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         # Idempotent re-entry: a launcher and a library entry point may both
         # call this defensively; a second jax.distributed.initialize raises.
         return process_info(initialized=True)

@@ -23,13 +23,35 @@ __all__ = [
     "make_mesh",
     "agents_sharding",
     "grid_sharding",
+    "scenarios_sharding",
     "replicated",
+    "shard_map",
     "shard_panel",
     "force_host_device_count",
 ]
 
 AGENTS_AXIS = "agents"
 GRID_AXIS = "grid"
+SCENARIOS_AXIS = "scenarios"
+
+# jax >= 0.6 promotes shard_map to the top-level namespace; earlier releases
+# (this image ships 0.4.x) only have the experimental module. Every sharded
+# solver imports the symbol from HERE so the version probe lives in one place.
+# All call sites use the keyword form (mesh=/in_specs=/out_specs=), which both
+# generations accept identically.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax < 0.6 images (like this one)
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+        # The experimental generation has no replication rule for while_loop
+        # (every solver fixed point here is one) unless its static
+        # replication CHECK is disabled; the check is advisory — disabling
+        # it changes no computed values.
+        kwargs.setdefault("check_rep", False)
+        return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs, **kwargs)
 
 
 def force_host_device_count(n: int) -> None:
@@ -57,10 +79,14 @@ def make_mesh(axis_names: Sequence[str] = (AGENTS_AXIS,),
         axis_sizes = [len(devices)] + [1] * (len(axis_names) - 1)
     # Auto axis types: classic GSPMD sharding propagation. (jax 0.9's
     # make_mesh defaults to Explicit sharding-in-types, which rejects gathers
-    # whose output sharding is ambiguous.)
-    axis_types = (jax.sharding.AxisType.Auto,) * len(axis_names)
+    # whose output sharding is ambiguous.) Older jax (< 0.5) predates
+    # AxisType entirely — and is Auto-only, so omitting the argument there
+    # selects the same semantics.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    kwargs = ({"axis_types": (axis_type.Auto,) * len(axis_names)}
+              if axis_type is not None else {})
     return jax.make_mesh(
-        tuple(axis_sizes), tuple(axis_names), devices=devices.ravel(), axis_types=axis_types
+        tuple(axis_sizes), tuple(axis_names), devices=devices.ravel(), **kwargs
     )
 
 
@@ -75,6 +101,16 @@ def grid_sharding(mesh: Mesh, grid_axis: int = -1, ndim: int = 2) -> NamedShardi
     """Shard a value/policy array along its (fine) asset-grid axis."""
     spec: list = [None] * ndim
     spec[grid_axis] = GRID_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def scenarios_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard a scenario-major stacked array along its leading scenario axis
+    (the batched-GE sweep's data-parallel axis, equilibrium/batched.py:
+    each device owns S/D whole economies and the vmapped excess-demand
+    kernel needs NO cross-scenario communication at all)."""
+    spec: list = [None] * ndim
+    spec[0] = SCENARIOS_AXIS
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 

@@ -50,6 +50,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.interp import (
@@ -343,7 +345,7 @@ def _ring_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
                                       n_q=n_q, lo=lo, hi=hi, power=power,
                                       capacity=capacity, pad=pad)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=P(None, axis),
             out_specs=(P(None, axis), P()),
@@ -409,7 +411,7 @@ def _ring_interp_fn(mesh, axis: str, n_k: int, n_q: int, lo: float, hi: float,
                                      n_q=n_q, lo=lo, hi=hi, power=power,
                                      capacity=capacity, pad=pad)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(None, axis)),
             out_specs=(P(None, axis), P()),

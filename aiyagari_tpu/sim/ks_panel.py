@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.models.krusell_smith import state_index
+from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from aiyagari_tpu.ops.interp import state_policy_interp, state_policy_interp_power
 
 __all__ = [
@@ -262,7 +263,7 @@ def _shardmap_panel_fn(mesh, axis: str, grid_power: float = 0.0):
         )
         return K_ts, k_pop_local
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, axis), P(axis)),

@@ -120,8 +120,8 @@ class EGMSolution:
     tol_effective: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(0.0))
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas"))
-def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas"))
+def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
@@ -130,7 +130,9 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: 
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
     grid_power > 0 enables the gather-free power-grid inversion fast path
-    (ops/egm.egm_step docstring).
+    (ops/egm.egm_step docstring). sigma/beta (and r, w, amin) are traced
+    operands: one compile covers any preference values, and the whole solve
+    vmaps over scenario batches (equilibrium/batched.py).
 
     noise_floor_ulp > 0 widens the absolute stopping tolerance to
     max(tol, noise_floor_ulp * eps(dtype) * max|C|) — the sweep operator's
@@ -208,9 +210,9 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
     return sol
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp"))
-def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
-                             psi: float, eta: float, tol: float, max_iter: int,
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp"))
+def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
+                             psi, eta, tol: float, max_iter: int,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              grid_power: float = 0.0,

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.bellman import expectation
@@ -188,7 +190,7 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     jnp.int32(0), jnp.array(False), tol_c)
             return jax.lax.while_loop(cond, body, init)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P()),
@@ -359,7 +361,7 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     jnp.array(False), tol_c)
             return jax.lax.while_loop(cond, body, init)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(None, axis),

@@ -50,6 +50,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.interp import masked_pchip_interp
@@ -269,7 +271,7 @@ def _ks_egm_program(mesh, axis: str, ns: int, nK: int, nk: int, power: float,
                     jnp.array(False))
             return jax.lax.while_loop(cond, body, init)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, None, axis), P(), P(axis), P(), P(), P(),
                       P(), P()),

@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
 from aiyagari_tpu.ops.golden import golden_section_max
@@ -174,7 +176,7 @@ def _ks_vfi_program(mesh, axis: str, ns: int, nK: int, nk: int, theta: float,
             init = (v0, k0, jnp.array(jnp.inf, dtype), jnp.int32(0))
             return jax.lax.while_loop(cond, body, init)
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, None, axis), P(None, None, axis), P(),
                       P(axis), P(), P(), P(), P(), P()),

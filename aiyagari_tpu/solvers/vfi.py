@@ -67,20 +67,10 @@ class VFISolution:
         default_factory=lambda: jnp.array(0, jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps", "block_size", "relative_tol", "use_pallas", "progress_every"))
-def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
-                       tol: float, max_iter: int, howard_steps: int = 0,
-                       block_size: int = 0, relative_tol: bool = False,
-                       use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
-    """Iterate the Bellman operator to a sup-norm fixed point.
-
-    Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
-    sup-norm, tol 1e-5, <=1000 sweeps). howard_steps>0 inserts that many
-    policy-evaluation sweeps after each improvement (not used by the reference
-    for Aiyagari, exposed for the scaled-up runs). progress_every>0 emits an
-    in-jit telemetry record every that-many sweeps (diagnostics.progress;
-    0 = off, zero cost).
-    """
+def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
+                             tol: float, max_iter: int, howard_steps: int = 0,
+                             block_size: int = 0, relative_tol: bool = False,
+                             use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
 
     def eval_sweeps(v, idx):
         if howard_steps <= 0:
@@ -130,6 +120,45 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma: float, beta: float,
     policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
     return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it,
                        dist, jnp.asarray(tol, v.dtype))
+
+
+_VFI_STATIC = ("tol", "max_iter", "howard_steps", "block_size",
+               "relative_tol", "use_pallas", "progress_every")
+# Default program: sigma/beta are TRACED operands, so (a) a batch of scenarios
+# differing only in preferences compiles once, and (b) the whole solve vmaps
+# over (r, sigma, beta, ...) — the batched-GE requirement. The Pallas route
+# alone keeps them static (the fused kernel bakes sigma in).
+_solve_vfi_traced = partial(jax.jit, static_argnames=_VFI_STATIC)(
+    _solve_aiyagari_vfi_impl)
+_solve_vfi_static_prefs = partial(
+    jax.jit, static_argnames=_VFI_STATIC + ("sigma", "beta"))(
+    _solve_aiyagari_vfi_impl)
+
+
+def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
+                       tol: float, max_iter: int, howard_steps: int = 0,
+                       block_size: int = 0, relative_tol: bool = False,
+                       use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
+    """Iterate the Bellman operator to a sup-norm fixed point.
+
+    Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
+    sup-norm, tol 1e-5, <=1000 sweeps). howard_steps>0 inserts that many
+    policy-evaluation sweeps after each improvement (not used by the reference
+    for Aiyagari, exposed for the scaled-up runs). progress_every>0 emits an
+    in-jit telemetry record every that-many sweeps (diagnostics.progress;
+    0 = off, zero cost).
+
+    sigma and beta are traced operands (jit compiles ONE program for any
+    preference values, and the solve vmaps over batched (r, sigma, beta) —
+    equilibrium/batched.py builds its excess-demand kernel on exactly this).
+    Exception: use_pallas=True requires concrete Python floats for them, since
+    the fused Pallas kernel specializes on sigma at compile time.
+    """
+    fn = _solve_vfi_static_prefs if use_pallas else _solve_vfi_traced
+    return fn(v_init, a_grid, s, P, r, w, sigma, beta, tol=tol,
+              max_iter=max_iter, howard_steps=howard_steps,
+              block_size=block_size, relative_tol=relative_tol,
+              use_pallas=use_pallas, progress_every=progress_every)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
@@ -660,6 +689,16 @@ def _warm_stage_idx(warm_policy_k, g, *, lo: float, hi: float, power: float,
     costs ~15 sequential ~100 ms round trips per stage on this image's
     remote TPU transport; measured as the bulk of an 11.5 s warm 400k
     solve before this was fused)."""
+    if power <= 0.0:
+        # Both the prolongation and the closed-form locator divide by the
+        # spacing exponent; 0.0 (the continuous solver's "not power-spaced"
+        # sentinel) would otherwise surface as a bare ZeroDivisionError at
+        # trace time, far from the caller that passed warm_policy_k.
+        raise ValueError(
+            "a warm-start policy (warm_policy_k) can only be re-sampled onto "
+            "stage grids of a power-spaced final grid: grid_power must be > 0, "
+            f"got {power}"
+        )
     from aiyagari_tpu.ops.interp import power_bucket_index, prolong_power_grid
 
     pk = (warm_policy_k if n == warm_policy_k.shape[-1] else
@@ -789,14 +828,15 @@ def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
         warm_policy_k=egm_solution.policy_k)
 
 
-@partial(jax.jit, static_argnames=("sigma", "beta", "psi", "eta", "tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))
-def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma: float,
-                             beta: float, psi: float, eta: float, tol: float,
+@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))
+def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
+                             beta, psi, eta, tol: float,
                              max_iter: int, howard_steps: int = 0,
                              relative_tol: bool = False,
                              progress_every: int = 0) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
-    (Aiyagari_Endogenous_Labor_VFI.m:64-122)."""
+    (Aiyagari_Endogenous_Labor_VFI.m:64-122). Preference scalars are traced
+    operands (vmap/scenario-batch compatible), like solve_aiyagari_vfi."""
 
     def eval_sweeps(v, a_idx, l_idx):
         if howard_steps <= 0:

@@ -21,14 +21,23 @@ __all__ = [
 ]
 
 
-def crra_utility(c, sigma: float):
+def crra_utility(c, sigma):
     """u(c) = (c^(1-sigma)-1)/(1-sigma), log(c) at sigma==1 (Aiyagari_VFI.m:74-78).
 
-    sigma is a static Python float so the branch resolves at trace time.
+    sigma may be a Python float (the branch resolves at trace time — the
+    historical contract) or a traced scalar (the batched-GE / scenario-sweep
+    kernels, where sigma varies across a vmapped batch): the traced form
+    selects the log case with jnp.where, guarding the generic power form
+    against the 0/0 it would produce exactly at sigma == 1.
     """
-    if sigma == 1.0:
-        return jnp.log(c)
-    return (c ** (1.0 - sigma) - 1.0) / (1.0 - sigma)
+    if isinstance(sigma, (int, float)):
+        if sigma == 1.0:
+            return jnp.log(c)
+        return (c ** (1.0 - sigma) - 1.0) / (1.0 - sigma)
+    is_log = sigma == 1.0
+    safe = jnp.where(is_log, 2.0, sigma)
+    return jnp.where(is_log, jnp.log(c),
+                     (c ** (1.0 - safe) - 1.0) / (1.0 - safe))
 
 
 def crra_marginal(c, sigma: float):

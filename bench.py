@@ -586,7 +586,13 @@ def _bench_scale_vfi(model, grid_scale: int, quick: bool, r: float, w: float,
                          improve_rounds=max(int(warm.iterations), 1),
                          eval_sweeps=int(warm.eval_sweeps))
     return {
-        "metric": f"aiyagari_vfi_scale_grid{grid_scale}_wallclock",
+        # Renamed from aiyagari_vfi_scale_grid{N}_wallclock when the
+        # measured workload became the EGM-warm-start recipe (round 5): the
+        # old name's round-over-round comparability would silently break.
+        # `recipe` keys the workload explicitly for artifact consumers;
+        # cold_vfi_seconds remains the first-class cold-solve metric below.
+        "metric": f"aiyagari_vfi_scale_grid{grid_scale}_warmstart_wallclock",
+        "recipe": "egm_warmstart",
         "value": round(t_total, 4),
         "unit": "seconds",
         "vs_baseline": round(t_np / t_total, 2),
@@ -607,6 +613,147 @@ def _bench_scale_vfi(model, grid_scale: int, quick: bool, r: float, w: float,
         "euler_log10_p99": round(float(np.percentile(vals, 99)), 2),
         **utilization(t_warm, cost, platform),
     }
+
+
+def bench_ge_batched(quick: bool, grid_size: int = 400, batch: int = 8) -> dict:
+    """Serial-vs-batched general-equilibrium wall-clock (the batched-GE
+    tentpole, equilibrium/batched.py): solve the SAME economy to the same
+    |K_s - K_d| < tol root with (a) the reference's serial bisection — one
+    household solve + aggregation per candidate rate — and (b) the
+    parallel-bracket solver — `batch` candidates per device round through
+    one vmapped excess-demand kernel. vs_baseline = serial/batched wall.
+    The structural win is the DEVICE-ROUND count (each serial iteration is
+    ~2 sequential device programs + fetches; each batched round is 1), which
+    is what hides launch/transport latency on an accelerator — both counts
+    are in the artifact. EGM household solves (continuous policies, so the
+    gap criterion can actually fire) with the deterministic histogram
+    closure; eq.tol=1e-3 sits above the inner solver's ~1e-4 supply noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+    from aiyagari_tpu.equilibrium.batched import solve_equilibrium_batched
+    from aiyagari_tpu.equilibrium.bisection import solve_equilibrium_distribution
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+    if quick:
+        grid_size = min(grid_size, 100)
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    sv = SolverConfig(method="egm")
+    eq_tol = 1e-3
+    ser_eq = EquilibriumConfig(max_iter=25, tol=eq_tol)
+    bat_eq = EquilibriumConfig(batch=batch, max_iter=8, tol=eq_tol)
+
+    def run_serial():
+        return solve_equilibrium_distribution(model, solver=sv, eq=ser_eq)
+
+    def run_batched():
+        return solve_equilibrium_batched(model, solver=sv, eq=bat_eq)
+
+    run_serial()                     # compile warmup (both loops fetch
+    run_batched()                    # scalars internally — self-fencing)
+    t0 = time.perf_counter()
+    ser = run_serial()
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    bat = run_batched()
+    t_batched = time.perf_counter() - t0
+
+    return {
+        "metric": f"aiyagari_ge_batched_grid{grid_size}",
+        "value": round(t_batched, 4),
+        "unit": "seconds",
+        "vs_baseline": round(t_serial / t_batched, 2),
+        "baseline_seconds": round(t_serial, 4),
+        "baseline_source": "serial bisection, same economy/tol (in-process)",
+        "batch": batch,
+        "serial_iterations": int(ser.iterations),
+        "batched_rounds": int(bat.iterations),
+        # Sequential device programs each schedule executed: the serial loop
+        # launches (household solve + distribution) per iteration; a batched
+        # round is ONE fused program.
+        "device_rounds_serial": int(ser.iterations) * 2,
+        "device_rounds_batched": int(bat.iterations),
+        "r_serial": round(float(ser.r), 8),
+        "r_batched": round(float(bat.r), 8),
+        "r_agreement": round(abs(float(ser.r) - float(bat.r)), 10),
+        "serial_converged": bool(ser.converged),
+        "batched_converged": bool(bat.converged),
+    }
+
+
+def bench_sweep(quick: bool, grid_size: int = 200) -> dict:
+    """Scenario-sweep throughput (dispatch.sweep): S independent economies
+    (a beta x sigma grid around the reference calibration) solved to GE as
+    ONE lockstep batched program — the scenarios/sec axis the north star
+    names ("as many scenarios as you can imagine"). vs_baseline = solving
+    the same scenarios one-at-a-time with the serial loop / sweep wall
+    (skipped in --quick: it re-runs every scenario)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu import sweep
+    from aiyagari_tpu.config import (
+        AiyagariConfig,
+        EquilibriumConfig,
+        SolverConfig,
+    )
+
+    import dataclasses
+
+    from aiyagari_tpu.config import BackendConfig
+
+    if quick:
+        grid_size = min(grid_size, 80)
+    platform = jax.default_backend()
+    betas = [0.94, 0.95, 0.96]
+    sigmas = [3.0, 4.0, 5.0]
+    if quick:
+        betas, sigmas = betas[:2], sigmas[:2]
+    base = AiyagariConfig()
+    base = dataclasses.replace(
+        base, grid=dataclasses.replace(base.grid, n_points=grid_size))
+    eq = EquilibriumConfig(max_iter=20, tol=1e-3)
+    backend = BackendConfig(
+        dtype="float32" if platform == "tpu" else "float64")
+
+    res = sweep(base, method="egm", beta=betas, sigma=sigmas,
+                equilibrium=eq, backend=backend)   # compile warmup
+    res = sweep(base, method="egm", beta=betas, sigma=sigmas,
+                equilibrium=eq, backend=backend)
+    out = {
+        "metric": "sweep_scenarios_per_sec",
+        "value": round(res.scenarios_per_sec, 3),
+        "unit": "scenarios/sec",
+        "scenarios": res.scenarios,
+        "grid": grid_size,
+        "rounds": res.rounds,
+        "converged": int(np.sum(np.asarray(res.converged))),
+        "sweep_seconds": round(res.solve_seconds, 4),
+    }
+    if not quick:
+        from aiyagari_tpu.equilibrium.bisection import (
+            solve_equilibrium_distribution,
+        )
+        from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+        dtype = jnp.float32 if platform == "tpu" else jnp.float64
+        t0 = time.perf_counter()
+        for p in res.params:
+            prefs = dataclasses.replace(base.preferences, **p)
+            cfg_i = dataclasses.replace(base, preferences=prefs)
+            m_i = AiyagariModel.from_config(cfg_i, dtype)
+            solve_equilibrium_distribution(
+                m_i, solver=SolverConfig(method="egm"), eq=eq)
+        t_serial = time.perf_counter() - t0
+        out["baseline_seconds"] = round(t_serial, 4)
+        out["baseline_source"] = "one-at-a-time serial GE, same scenarios"
+        out["vs_baseline"] = round(t_serial / res.solve_seconds, 2)
+    else:
+        out["vs_baseline"] = None
+    return out
 
 
 def _ks_panel_throughput(T: int, pop: int, *, reps: int, outer: int) -> dict:
@@ -953,7 +1100,7 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--metric",
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
-                             "scale", "scale_vfi"],
+                             "scale", "scale_vfi", "ge", "sweep"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -1034,6 +1181,8 @@ def main() -> int:
                                      args.noise_floor_ulp, args.pallas_inversion),
         "scale_vfi": lambda: bench_scale(args.grid_scale, args.quick, "vfi",
                                          args.noise_floor_ulp, False),
+        "ge": lambda: bench_ge_batched(args.quick),
+        "sweep": lambda: bench_sweep(args.quick),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
@@ -1041,7 +1190,8 @@ def main() -> int:
     # accuracy statistic into the artifact; scale_vfi last — the declared
     # north-star metric names VFI, so the artifact measures it at the
     # north-star scale too, not only the EGM carrier).
-    names = (("vfi", "ks", "ks_large", "scale", "ks_fine", "scale_vfi")
+    names = (("vfi", "ks", "ks_large", "scale", "ge", "sweep", "ks_fine",
+              "scale_vfi")
              if args.metric == "all" else (args.metric,))
     for name in names:
         result = runners[name]()

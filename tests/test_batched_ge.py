@@ -1,0 +1,269 @@
+"""Batched GE layer tests (equilibrium/batched.py + dispatch.sweep):
+
+* parity — the parallel-bracket root finder locates the SAME equilibrium
+  rate as the serial bisection (both closures share one excess-demand
+  curve), for both solver families, in strictly fewer device rounds;
+* the round-count bound — batched rounds <= batched_round_bound(serial
+  iterations, B), the (B+1)-ary vs binary bracket-shrink geometry;
+* vmap-compatibility — one excess_demand_batch program evaluates a whole
+  candidate batch (the traced-sigma/beta solver refactor this layer needs);
+* sweeps — lockstep scenario solves agree with one-at-a-time serial GE and
+  are invariant to sharding the scenario axis over the 8-device CPU mesh.
+
+Scale notes: 60-point/3-state economies keep each household solve tiny; the
+convergence tolerance is 1e-3 because the inner solves (tol 1e-5) leave
+~1e-4 noise in the supply curve, and the DISCRETE-choice VFI's excess
+demand is a step function (its policy moves in whole grid cells), so only
+EGM's continuous policies can actually fire the gap criterion at this grid
+size — the VFI assertions pin root location, not the unreachable gap.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    BackendConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    IncomeProcess,
+    SolverConfig,
+)
+from aiyagari_tpu.equilibrium.batched import (
+    batched_round_bound,
+    excess_demand_batch,
+    solve_equilibrium_batched,
+    solve_equilibrium_sweep,
+    stack_scenarios,
+)
+from aiyagari_tpu.equilibrium.bisection import solve_equilibrium_distribution
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+CFG = AiyagariConfig(income=IncomeProcess(n_states=3),
+                     grid=GridSpecConfig(n_points=60))
+EQ_TOL = 1e-3
+B = 8
+SERIAL_EQ = EquilibriumConfig(max_iter=25, tol=EQ_TOL)
+BATCH_EQ = EquilibriumConfig(batch=B, max_iter=8, tol=EQ_TOL)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return AiyagariModel.from_config(CFG, jnp.float64)
+
+
+class TestRoundBound:
+    def test_geometry(self):
+        # (B+1)-ary bracket shrink vs binary: 20 halvings fit in
+        # ceil(20 ln2 / ln9) + 1 = 8 rounds of an 8-wide batch.
+        assert batched_round_bound(20, 8) == 8
+        assert batched_round_bound(30, 32) == 7
+        # Degenerate batch falls back to bisection's own count.
+        assert batched_round_bound(17, 1) == 17
+
+
+class TestBatchedParity:
+    def test_egm_same_root_fewer_rounds(self, model):
+        sv = SolverConfig(method="egm")
+        ser = solve_equilibrium_distribution(model, solver=sv, eq=SERIAL_EQ)
+        bat = solve_equilibrium_batched(model, solver=sv, eq=BATCH_EQ)
+        assert ser.converged and bat.converged
+        # Same root within eq.tol (measured agreement ~1e-5: both stop when
+        # |K_s - K_d| < tol on a curve with slope ~4e2).
+        assert abs(bat.r - ser.r) < EQ_TOL
+        # The histories carry ALL candidates; the converged one is the
+        # round record's best.
+        assert abs(bat.per_iteration[-1]["best_gap"]) < EQ_TOL
+        # Strictly fewer device rounds, and within the bracket-geometry bound.
+        assert bat.iterations < ser.iterations
+        assert bat.iterations <= batched_round_bound(ser.iterations, B)
+
+    def test_vfi_same_root(self, model):
+        # Discrete VFI's excess demand steps by ~0.4 at the policy
+        # transition, so neither loop can fire |gap| < tol — both must still
+        # localize the SAME jump point to their (comparable) bracket
+        # resolutions: serial 2^-25 vs batched 9^-8 of the same bracket.
+        sv = SolverConfig(method="vfi")
+        ser = solve_equilibrium_distribution(model, solver=sv, eq=SERIAL_EQ)
+        bat = solve_equilibrium_batched(model, solver=sv, eq=BATCH_EQ)
+        assert abs(bat.r - ser.r) < 1e-4
+        assert bat.iterations < ser.iterations
+
+    def test_histories_aligned_and_round_records(self, model):
+        bat = solve_equilibrium_batched(
+            model, solver=SolverConfig(method="egm"), eq=BATCH_EQ)
+        assert len(bat.r_history) == len(bat.k_supply) == len(bat.k_demand)
+        assert len(bat.r_history) == bat.iterations * B
+        assert len(bat.per_iteration) == bat.iterations
+        rec = bat.per_iteration[-1]
+        assert len(rec["r_candidates"]) == B
+        assert rec["best_r"] == bat.r
+
+    def test_batch_below_two_rejected(self, model):
+        with pytest.raises(ValueError, match="batch >= 2"):
+            solve_equilibrium_batched(
+                model, eq=EquilibriumConfig(batch=1))
+
+
+class TestExcessDemandBatch:
+    """vmap-compatibility smoke: one program, a whole candidate batch."""
+
+    @pytest.mark.parametrize("method", ["vfi", "egm"])
+    def test_batch_evaluates_monotone_curve(self, model, method):
+        rs = np.linspace(0.005, 0.02, 5)
+        gap, aux = excess_demand_batch(
+            model, rs, solver=SolverConfig(method=method))
+        gap = np.asarray(gap)
+        assert gap.shape == (5,)
+        assert np.all(np.isfinite(gap))
+        # Supply rises and FOC demand falls in r: the gap is increasing.
+        assert np.all(np.diff(gap) > 0)
+        # The batched kernel returns the batched household solutions too.
+        assert aux["sol"].policy_k.shape == (5,) + model.P.shape[:1] + (60,)
+
+    def test_matches_serial_household_supply(self, model):
+        # One candidate's supply from the fused batch == the serial
+        # aggregator's supply at the same rate (same solver, same closure).
+        from aiyagari_tpu.equilibrium.bisection import solve_household
+        from aiyagari_tpu.sim.distribution import (
+            aggregate_capital,
+            stationary_distribution,
+        )
+
+        r = 0.012
+        gap, aux = excess_demand_batch(
+            model, np.array([r]), solver=SolverConfig(method="egm"))
+        sol = solve_household(model, r, solver=SolverConfig(method="egm"))
+        mu = stationary_distribution(sol.policy_k, model.a_grid, model.P).mu
+        supply = float(aggregate_capital(mu, model.a_grid))
+        assert abs(float(aux["supply"][0]) - supply) < 1e-6
+
+
+class TestBatchedDispatch:
+    def test_solve_batch_optin_matches_serial(self):
+        from aiyagari_tpu import solve
+
+        ser = solve(CFG, method="egm", aggregation="distribution",
+                    equilibrium=SERIAL_EQ, on_nonconvergence="ignore")
+        bat = solve(CFG, method="egm", aggregation="distribution",
+                    equilibrium=BATCH_EQ, on_nonconvergence="ignore")
+        assert bat.converged
+        assert abs(bat.r - ser.r) < EQ_TOL
+        assert bat.iterations < ser.iterations
+
+    def test_simulation_closure_smoke(self):
+        # The Monte-Carlo closure also runs batched (per-candidate panels,
+        # per-round keys); parity there is up to simulation noise, so only
+        # economic sanity is pinned.
+        from aiyagari_tpu import solve
+        from aiyagari_tpu.config import SimConfig
+
+        res = solve(CFG, method="egm",
+                    sim=SimConfig(periods=600, n_agents=4, discard=100, seed=0),
+                    equilibrium=EquilibriumConfig(batch=4, max_iter=4, tol=EQ_TOL),
+                    on_nonconvergence="ignore")
+        beta = CFG.preferences.beta
+        assert -0.05 < res.r < 1 / beta - 1
+        assert res.series is not None
+
+    def test_numpy_backend_rejected(self):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError, match="backend='jax'"):
+            solve(CFG, backend="numpy", equilibrium=BATCH_EQ)
+
+
+class TestSweepQuick:
+    def test_two_scenarios_lockstep(self):
+        from aiyagari_tpu import sweep
+
+        res = sweep(CFG, method="egm", beta=[0.94, 0.96],
+                    equilibrium=EquilibriumConfig(max_iter=8, tol=EQ_TOL))
+        assert res.scenarios == 2
+        assert res.rounds <= 8
+        assert res.scenarios_per_sec > 0
+        # A lower beta means more discounting, less saving, higher r*.
+        assert res.r[0] > res.r[1]
+        assert np.all(np.isfinite(res.capital)) and np.all(res.capital > 0)
+        assert res.params == [{"beta": 0.94}, {"beta": 0.96}]
+
+
+@pytest.mark.slow
+class TestSweep:
+    BETAS = [0.94, 0.96]
+    SIGMAS = [4.0, 5.0]
+
+    def test_lockstep_matches_serial_per_scenario(self, model):
+        from aiyagari_tpu import sweep
+
+        eq = EquilibriumConfig(max_iter=18, tol=EQ_TOL)
+        res = sweep(CFG, method="egm", beta=self.BETAS, sigma=self.SIGMAS,
+                    equilibrium=eq)
+        assert res.scenarios == 4 and res.r.shape == (4,)
+        assert res.scenarios_per_sec > 0
+        import dataclasses
+
+        for i, p in enumerate(res.params):
+            prefs = dataclasses.replace(CFG.preferences, **p)
+            cfg_i = dataclasses.replace(CFG, preferences=prefs)
+            m_i = AiyagariModel.from_config(cfg_i, jnp.float64)
+            ser = solve_equilibrium_distribution(
+                m_i, solver=SolverConfig(method="egm"), eq=eq)
+            # Lockstep bisection == serial bisection per scenario (same
+            # bracket updates on the same curve; warm-start noise only).
+            assert abs(res.r[i] - ser.r) < EQ_TOL, (i, p)
+
+    def test_sharded_sweep_matches_unsharded(self):
+        from aiyagari_tpu import sweep
+
+        eq = EquilibriumConfig(max_iter=10, tol=EQ_TOL)
+        kw = dict(method="egm", beta=[0.93, 0.94, 0.95, 0.96],
+                  sigma=self.SIGMAS, equilibrium=eq)
+        plain = sweep(CFG, **kw)
+        sharded = sweep(CFG, backend=BackendConfig(mesh_axes=("scenarios",)),
+                        **kw)
+        # 8 scenarios over the 8-virtual-device CPU mesh: identical results
+        # (the kernel has no cross-scenario communication to reorder).
+        np.testing.assert_allclose(sharded.r, plain.r, rtol=0, atol=0)
+        np.testing.assert_array_equal(sharded.converged, plain.converged)
+
+    def test_scenario_shape_mismatch_rejected(self):
+        import dataclasses
+
+        m1 = AiyagariModel.from_config(CFG, jnp.float64)
+        cfg2 = dataclasses.replace(
+            CFG, grid=dataclasses.replace(CFG.grid, n_points=40))
+        m2 = AiyagariModel.from_config(cfg2, jnp.float64)
+        with pytest.raises(ValueError, match="share grid shapes"):
+            stack_scenarios([m1, m2])
+
+    def test_param_validation(self):
+        from aiyagari_tpu import sweep
+
+        with pytest.raises(ValueError, match="unknown sweep parameter"):
+            sweep(CFG, delta=[0.05, 0.08])
+        with pytest.raises(ValueError, match="needs scenarios"):
+            sweep(CFG)
+
+
+class TestWarmStageGuard:
+    def test_warm_policy_requires_power_grid(self, model):
+        # Satellite fix: grid_power=0.0 (legal for the continuous solver)
+        # must be rejected loudly, not die in a trace-time ZeroDivisionError
+        # inside the warm-stage re-sampler.
+        from aiyagari_tpu.solvers.vfi import (
+            _warm_stage_idx,
+            solve_aiyagari_vfi_multiscale,
+        )
+
+        warm = jnp.zeros((3, 60))
+        with pytest.raises(ValueError, match="power-spaced"):
+            solve_aiyagari_vfi_multiscale(
+                model.a_grid, model.s, model.P, 0.04, 1.2, model.amin,
+                sigma=5.0, beta=0.96, tol=1e-5, max_iter=100,
+                grid_power=0.0, warm_policy_k=warm)
+        with pytest.raises(ValueError, match="grid_power must be > 0"):
+            _warm_stage_idx(warm, model.a_grid, lo=0.0, hi=10.0,
+                            power=0.0, n=60)

@@ -67,6 +67,16 @@ def test_aiyagari_examples_smoke(name, labor):
 
 
 @pytest.mark.slow
+def test_sweep_scenarios_example_smoke():
+    stdout = _run_example("sweep_scenarios.py")
+    m = re.search(r"(\d+) scenarios x", stdout)
+    assert m and int(m.group(1)) == 4, stdout
+    # The example asserts the beta/sigma comparative statics itself; here we
+    # just pin that the batched-bracket solve ran and reported rounds.
+    assert re.search(r"batched-bracket solve .*in \d+ rounds", stdout), stdout
+
+
+@pytest.mark.slow
 def test_krusell_smith_vfi_example_smoke(tmp_path):
     stdout = _run_example("krusell_smith_vfi.py", "--outdir", str(tmp_path))
     _check_ks(stdout)

@@ -308,7 +308,13 @@ class TestPrecisionScope:
 
         from aiyagari_tpu.config import precision_scope
 
-        with jax.enable_x64(False):
+        # jax < 0.6 only has the scoped x64 switch under jax.experimental
+        # (the same compat probe precision_scope itself performs).
+        enable_x64 = getattr(jax, "enable_x64", None)
+        if enable_x64 is None:
+            from jax.experimental import enable_x64
+
+        with enable_x64(False):
             assert jnp.zeros(1, jnp.float64).dtype == jnp.float32  # the trap
             with precision_scope("float64"):
                 assert jnp.zeros(1, jnp.float64).dtype == jnp.float64
