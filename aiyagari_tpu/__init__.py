@@ -20,12 +20,14 @@ from aiyagari_tpu.config import (
     IncomeProcess,
     KrusellSmithConfig,
     KSShockProcess,
+    MITShock,
     SimConfig,
     SolverConfig,
     Technology,
+    TransitionConfig,
 )
 from aiyagari_tpu.diagnostics.errors import ConvergenceError, ConvergenceWarning
-from aiyagari_tpu.dispatch import solve, sweep
+from aiyagari_tpu.dispatch import solve, solve_transition, sweep, sweep_transitions
 from aiyagari_tpu.equilibrium.batched import (
     SweepResult,
     excess_demand_batch,
@@ -43,12 +45,19 @@ from aiyagari_tpu.models.aiyagari import (
     aiyagari_labor_preset,
     aiyagari_preset,
 )
+from aiyagari_tpu.transition.mit import TransitionResult, TransitionSweepResult
 
 __version__ = "0.1.0"
 
 __all__ = [
     "solve",
     "sweep",
+    "solve_transition",
+    "sweep_transitions",
+    "MITShock",
+    "TransitionConfig",
+    "TransitionResult",
+    "TransitionSweepResult",
     "ConvergenceError",
     "ConvergenceWarning",
     "solve_equilibrium",

@@ -26,6 +26,8 @@ __all__ = [
     "EquilibriumConfig",
     "ALMConfig",
     "BackendConfig",
+    "MITShock",
+    "TransitionConfig",
 ]
 
 
@@ -241,6 +243,56 @@ class ALMConfig:
     # rounds (equilibrium/alm.py).
     acceleration: str = "damped"
     anderson_depth: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class MITShock:
+    """One-time unanticipated ("MIT") shock with AR(1) reversion: the shocked
+    parameter follows x_t = x_ss + size * rho^t over the transition window,
+    then is back at its stationary value (Boppart-Krusell-Mitman 2018).
+
+    param selects what is shocked:
+      "tfp"             — TFP z_t (z_ss = 1), moving both firm FOC prices;
+      "beta"            — the discount factor between t and t+1;
+      "sigma"           — CRRA curvature (time-varying marginal utility);
+      "borrowing_limit" — the borrowing constraint a' >= amin_t. Only
+                          TIGHTENING paths (size >= 0) are representable:
+                          the asset grid starts at the stationary limit, so
+                          a looser limit would need gridpoints that do not
+                          exist (transition/mit.py raises loudly).
+
+    The shock must be transitory (|rho| < 1): the transition starts AND ends
+    at the same stationary equilibrium, which anchors both the terminal
+    policy of the backward sweep and the initial distribution of the
+    forward push.
+    """
+
+    param: str = "tfp"
+    size: float = 0.01
+    rho: float = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class TransitionConfig:
+    """Perfect-foresight transition-path (MIT shock) solver controls
+    (transition/mit.py).
+
+    T is the truncation horizon: prices are assumed back at the stationary
+    equilibrium from period T on (choose T so rho^T * size is negligible).
+    method selects the price-path update: "newton" uses the sequence-space
+    Jacobian built once at the stationary equilibrium by the fake-news
+    algorithm (Auclert-Bardoczy-Rognlie-Straub 2021) — typically <= 5
+    rounds; "damped" is the Boppart-Krusell-Mitman relaxation
+    r <- (1-damping) r + damping * r_implied. tol bounds the max excess
+    capital demand along the whole path (units of K, same as the stationary
+    closure's |K_s - K_d| criterion).
+    """
+
+    T: int = 200
+    max_iter: int = 30
+    tol: float = 1e-6
+    damping: float = 0.5
+    method: str = "newton"
 
 
 @dataclasses.dataclass(frozen=True)

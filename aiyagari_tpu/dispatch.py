@@ -27,11 +27,13 @@ from aiyagari_tpu.config import (
     BackendConfig,
     EquilibriumConfig,
     KrusellSmithConfig,
+    MITShock,
     SimConfig,
     SolverConfig,
+    TransitionConfig,
 )
 
-__all__ = ["solve", "sweep"]
+__all__ = ["solve", "sweep", "solve_transition", "sweep_transitions"]
 
 
 def _dtype_of(backend: BackendConfig):
@@ -326,3 +328,121 @@ def sweep(
             aggregation=aggregation)
     result.params = params
     return result
+
+
+def _transition_backend(backend: Union[str, BackendConfig]) -> BackendConfig:
+    if isinstance(backend, str):
+        backend = BackendConfig(backend=backend)
+    if backend.backend != "jax":
+        raise ValueError("transition solves require backend='jax' (the "
+                         "path evaluator is a fused device scan)")
+    if backend.dtype == "mixed":
+        raise ValueError("dtype='mixed' applies to the Krusell-Smith outer "
+                         "loop only")
+    return backend
+
+
+def solve_transition(
+    model: AiyagariConfig,
+    shock: MITShock,
+    *,
+    transition: TransitionConfig = TransitionConfig(),
+    backend: Union[str, BackendConfig] = "jax",
+    solver: Optional[SolverConfig] = None,
+    equilibrium: Optional[EquilibriumConfig] = None,
+    on_nonconvergence: str = "warn",
+    **kwargs,
+):
+    """Solve a perfect-foresight MIT-shock transition path to general
+    equilibrium (transition/mit.py; ISSUE 2 tentpole).
+
+        res = solve_transition(AiyagariConfig(),
+                               MITShock(param="tfp", size=0.01, rho=0.9),
+                               transition=TransitionConfig(T=200))
+        res.r_path, res.K_ts          # equilibrium price / capital paths
+        res.max_excess_history        # per-round max excess demand
+
+    The path starts at the stationary equilibrium of `model` (its Young
+    histogram is the initial distribution) and ends back at it (its EGM
+    consumption policy is the terminal condition); transition.method picks
+    the Newton (sequence-space Jacobian) or damped update. `solver` /
+    `equilibrium` tune the anchoring stationary solve; extra kwargs (`ss`,
+    `jacobian`, `keep_policies`, `on_iteration`) pass through to
+    transition/mit.solve_transition.
+    """
+    backend = _transition_backend(backend)
+    from aiyagari_tpu.config import precision_scope
+    from aiyagari_tpu.diagnostics.errors import enforce_convergence
+    from aiyagari_tpu.transition.mit import solve_transition as _solve
+
+    with precision_scope(backend.dtype):
+        result = _solve(model, shock, trans=transition, solver=solver,
+                        eq=equilibrium, dtype=_dtype_of(backend), **kwargs)
+    enforce_convergence(
+        result.converged, on_nonconvergence, "MIT-shock transition path",
+        iterations=result.rounds,
+        distance=(result.max_excess_history[-1]
+                  if result.max_excess_history else float("inf")),
+        tol=transition.tol,
+        detail={"method": result.method, "T": result.T},
+    )
+    return result
+
+
+def sweep_transitions(
+    model: AiyagariConfig,
+    shocks=None,
+    *,
+    transition: TransitionConfig = TransitionConfig(),
+    backend: Union[str, BackendConfig] = "jax",
+    solver: Optional[SolverConfig] = None,
+    equilibrium: Optional[EquilibriumConfig] = None,
+    params: Optional[Sequence[str]] = None,
+    sizes: Optional[Sequence[float]] = None,
+    rhos: Optional[Sequence[float]] = None,
+    **kwargs,
+):
+    """Solve MANY MIT-shock scenarios of one economy in lockstep, every
+    round one vmapped device program (transition/mit.solve_transitions_sweep).
+
+    Scenarios come either from an explicit `shocks=[MITShock(...), ...]`
+    list — which may mix shocked parameters (tfp/beta/sigma/
+    borrowing_limit) — or from the cartesian product of `params` x `sizes`
+    x `rhos`:
+
+        res = sweep_transitions(AiyagariConfig(),
+                                params=["tfp", "beta"],
+                                sizes=[0.005, 0.01], rhos=[0.8, 0.95])
+        res.r_paths                   # [8, T] equilibrium rate paths
+        res.transitions_per_sec       # the throughput metric bench.py records
+
+    One stationary anchor and ONE fake-news Jacobian serve every scenario
+    (the ss linearization is shock-independent); with
+    BackendConfig(mesh_axes=("scenarios",)) the stacked shock paths shard
+    across the device mesh and rounds run scenario-parallel.
+    """
+    backend = _transition_backend(backend)
+    if shocks is None:
+        if not (params and sizes):
+            raise ValueError(
+                "sweep_transitions needs scenarios: pass shocks=[...] or "
+                "params=[...] plus sizes=[...] (and optionally rhos=[...])")
+        shocks = [MITShock(param=p, size=sz, rho=rh)
+                  for p in params for sz in sizes
+                  for rh in (rhos if rhos else [MITShock().rho])]
+    elif params or sizes or rhos:
+        raise ValueError(
+            "pass either shocks=[...] or params/sizes/rhos grids, not both")
+
+    mesh = None
+    if "scenarios" in backend.mesh_axes:
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+    from aiyagari_tpu.config import precision_scope
+    from aiyagari_tpu.transition.mit import solve_transitions_sweep as _sweep
+
+    with precision_scope(backend.dtype):
+        return _sweep(model, shocks, trans=transition, solver=solver,
+                      eq=equilibrium, mesh=mesh, dtype=_dtype_of(backend),
+                      **kwargs)

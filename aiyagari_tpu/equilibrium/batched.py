@@ -470,22 +470,13 @@ def stack_scenarios(models: Sequence[AiyagariModel], *, mesh=None) -> ScenarioBa
         size=len(models),
     )
     if mesh is not None:
-        from aiyagari_tpu.parallel.mesh import SCENARIOS_AXIS, scenarios_sharding
+        from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
 
-        # Divisibility is against the "scenarios" AXIS size, not the total
-        # device count: a multi-axis mesh only splits the scenario axis that
-        # wide (the other axes replicate).
-        axis_size = int(mesh.shape[SCENARIOS_AXIS])
-        if batch.size % axis_size != 0:
-            raise ValueError(
-                f"scenario count {batch.size} must divide evenly over the "
-                f"{axis_size}-wide '{SCENARIOS_AXIS}' mesh axis")
-        shard = lambda x: jax.device_put(
-            x, scenarios_sharding(mesh, ndim=x.ndim))
-        batch = dataclasses.replace(
-            batch, **{f.name: shard(getattr(batch, f.name))
-                      for f in dataclasses.fields(batch)
-                      if isinstance(getattr(batch, f.name), jax.Array)})
+        batch = dataclasses.replace(batch, **shard_scenario_arrays(
+            mesh, batch.size,
+            **{f.name: getattr(batch, f.name)
+               for f in dataclasses.fields(batch)
+               if isinstance(getattr(batch, f.name), jax.Array)}))
     return batch
 
 

@@ -237,18 +237,23 @@ def save_checkpoint(path, *, scalars: dict, arrays: Optional[dict] = None) -> No
         scalars = {**scalars, _SAVE_SEQ_KEY: seq}
         payload["__scalars__"] = np.frombuffer(
             json.dumps(scalars).encode(), dtype=np.uint8)
-        _write_npz(_proc_file(path, pid, nproc), payload)
-        # Topology-change cleanup: a stale single-process file at `path`
-        # would SHADOW the proc files at every load (load_checkpoint
-        # prefers it), silently regressing the run to the pre-change
-        # iteration on each resume; other-topology proc files would make
-        # the file-count completeness check unsatisfiable. Processes only
-        # remove files no current process writes; concurrent removal is
-        # guarded by missing_ok.
+        # Topology-change cleanup BEFORE the proc write (ADVICE r5 ~:248):
+        # a stale single-process file at `path` would SHADOW the proc files
+        # at every load (load_checkpoint prefers it), silently regressing
+        # the run to the pre-change iteration on each resume; were it
+        # removed only AFTER the write, a preemption between the two would
+        # leave exactly that shadowing file behind. Unlinking first leaves
+        # the worst crash window as "no checkpoint / incomplete proc set" —
+        # a fresh start or a LOUD completeness error, never a silent
+        # regression. Other-topology proc files would make the file-count
+        # completeness check unsatisfiable. Processes only remove files no
+        # current process writes; concurrent removal is guarded by
+        # missing_ok.
         path.unlink(missing_ok=True)
         for f in path.parent.glob(path.name + ".proc*of*"):
             if not str(f.name).endswith(f"of{nproc}"):
                 f.unlink(missing_ok=True)
+        _write_npz(_proc_file(path, pid, nproc), payload)
     else:
         _write_npz(path, payload)
         for f in path.parent.glob(path.name + ".proc*of*"):
@@ -270,11 +275,32 @@ class _LazyEntries(dict):
     thing the per-shard format exists to avoid); restore_array reads only
     the shards the local sharding requests. Subclasses dict so key
     iteration / membership behave normally; values are (file, entry-name)
-    pointers resolved per access."""
+    pointers resolved per access.
+
+    Every lazy open RE-VERIFIES the file's save sequence against the one
+    the merge was built from (`expected_seq`, ADVICE r5 ~:265): another
+    process's save_checkpoint may atomically replace a proc file between
+    the merge's eager scalar/meta read and a later lazy shard read, and
+    serving the NEWER file's shards against the OLDER merged metadata
+    would hand the caller a silently mixed iteration — exactly the torn
+    state the merge-time sequence check exists to refuse."""
+
+    expected_seq = None
 
     def __getitem__(self, k):
         f, orig = super().__getitem__(k)
         with np.load(f) as z:
+            if self.expected_seq is not None:
+                seq = json.loads(
+                    bytes(z["__scalars__"]).decode()).get(_SAVE_SEQ_KEY)
+                if seq != self.expected_seq:
+                    raise ValueError(
+                        f"checkpoint file {f} changed under the merged "
+                        f"view (save sequence {seq} != merged "
+                        f"{self.expected_seq}): a concurrent save replaced "
+                        "it after load_checkpoint merged the process "
+                        "files; re-run load_checkpoint for a consistent "
+                        "view")
             return z[orig]
 
     def get(self, k, default=None):
@@ -342,6 +368,9 @@ def _merge_process_files(path: Path, files: list) -> tuple[dict, dict]:
     scalars = {k: v for k, v in parts[0][1].items() if k != _SAVE_SEQ_KEY}
     meta = scalars.get(_SHARD_META_KEY) or {}
     arrays = _LazyEntries()
+    # Pin the merged generation: lazy opens refuse a proc file a concurrent
+    # save has since replaced (class docstring).
+    arrays.expected_seq = restored_seq
     merged_meta: dict = {}
     for name, m in meta.items():
         # Re-number shards globally, deduping identical index boxes

@@ -26,7 +26,8 @@ from aiyagari_tpu.utils.utility import (
     labor_foc_inverse,
 )
 
-__all__ = ["egm_step", "egm_step_labor", "constrained_consumption_labor"]
+__all__ = ["egm_step", "egm_step_labor", "egm_step_transition",
+           "constrained_consumption_labor"]
 
 
 @partial(jax.jit, static_argnames=("grid_power", "with_escape", "use_pallas"))
@@ -102,6 +103,47 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     if with_escape:
         return C_new, policy_k, escaped
     return C_new, policy_k
+
+
+@jax.jit
+def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
+                        *, sigma_now, sigma_next, beta_now):
+    """One backward EGM step along a perfect-foresight transition path
+    (transition/path.py): the stationary egm_step generalized to prices and
+    preferences that differ between today and tomorrow.
+
+    C_next [N, na] is the consumption policy AT t+1 on the exogenous grid;
+    returns (C_now [N, na], policy_k [N, na]) at t. The Euler equation dates
+    each object explicitly:
+
+        u'_{sigma_t}(c_t) = beta_t * (1 + r_{t+1}) * E_t u'_{sigma_{t+1}}(c_{t+1})
+
+    so r_next (the return earned between t and t+1) discounts tomorrow's
+    marginal utility, while (r_now, w_now) price today's budget constraint
+    c_t + a' = (1+r_t) a + w_t s. In a stationary environment every dated
+    argument collapses to its steady value and this reduces exactly to
+    egm_step's arithmetic (pinned by tests/test_transition.py's flat-path
+    identity).
+
+    Every argument is a traced operand — one compile covers the whole time
+    scan AND vmapped shock-scenario batches (transition sweeps). Only the
+    generic sort-free exact inversion route is offered (the stationary
+    kernel's windowed power-grid fast path needs a host-level escape retry
+    that a fused time scan cannot perform — the same contract that keeps
+    equilibrium/batched.py on grid_power=0).
+    """
+    RHS = (1.0 + r_next) * expectation(P, crra_marginal(C_next, sigma_next),
+                                       beta_now)                    # [N, na]
+    c_endo = crra_marginal_inverse(RHS, sigma_now)                  # [N, na]
+    a_hat = (c_endo + a_grid[None, :] - w_now * s[:, None]) / (1.0 + r_now)
+    # Same f32 monotonicity insurance as egm_step (exact no-op in f64).
+    a_hat = jax.lax.cummax(a_hat, axis=1)
+    policy_k = jax.vmap(lambda ah: linear_interp(ah, a_grid, a_grid))(a_hat)
+    # Borrowing limit may be time-varying (borrowing-limit shocks); the grid
+    # top truncation matches the stationary solvers' choice set.
+    policy_k = jnp.clip(policy_k, amin_now, a_grid[-1])
+    C_now = (1.0 + r_now) * a_grid[None, :] + w_now * s[:, None] - policy_k
+    return C_now, policy_k
 
 
 @jax.jit

@@ -24,6 +24,7 @@ __all__ = [
     "agents_sharding",
     "grid_sharding",
     "scenarios_sharding",
+    "shard_scenario_arrays",
     "replicated",
     "shard_map",
     "shard_panel",
@@ -112,6 +113,27 @@ def scenarios_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     spec: list = [None] * ndim
     spec[0] = SCENARIOS_AXIS
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_scenario_arrays(mesh: Mesh, count: int, **arrays):
+    """Place scenario-major stacked arrays (leading axis = scenario) sharded
+    over the mesh's "scenarios" axis, with the divisibility check every
+    scenario-batched entry point needs stated ONCE.
+
+    `count` is the scenario-batch size; every array in `arrays` must lead
+    with it. Divisibility is against the "scenarios" AXIS size, not the
+    total device count — a multi-axis mesh only splits the scenario axis
+    that wide (the other axes replicate). Returns the dict with each value
+    device_put under scenarios_sharding (rank-aware). Shared by the batched
+    GE sweep (equilibrium/batched.stack_scenarios) and the transition-path
+    sweep (transition/mit.py)."""
+    axis_size = int(mesh.shape[SCENARIOS_AXIS])
+    if count % axis_size != 0:
+        raise ValueError(
+            f"scenario count {count} must divide evenly over the "
+            f"{axis_size}-wide '{SCENARIOS_AXIS}' mesh axis")
+    return {k: jax.device_put(v, scenarios_sharding(mesh, ndim=v.ndim))
+            for k, v in arrays.items()}
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

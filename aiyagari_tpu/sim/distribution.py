@@ -30,6 +30,7 @@ __all__ = [
     "DistributionSolution",
     "young_lottery",
     "distribution_step",
+    "expectation_step",
     "stationary_distribution",
     "aggregate_capital",
 ]
@@ -75,6 +76,25 @@ def distribution_step(mu, idx, w_lo, P):
     )
     # HIGHEST precision: the bf16 default would leak mass at ~1e-3
     return jnp.matmul(P.T, mu_a, precision=jax.lax.Precision.HIGHEST)
+
+
+def expectation_step(f, idx, w_lo, P):
+    """Adjoint of distribution_step: pull a state function f[N, na] back one
+    period through the same policy lottery and income mixing,
+
+        (L' f)[i, j] = sum_m P[i, m] * ( w_lo[i,j] * f[m, idx[i,j]]
+                                       + (1-w_lo[i,j]) * f[m, idx[i,j]+1] ),
+
+    so <f, distribution_step(mu)> == <expectation_step(f), mu> exactly. This
+    is the expectation-function recursion of the sequence-space fake-news
+    algorithm (Auclert et al. 2021, transition/jacobian.py): iterating it
+    from f = policy gives E[policy k periods ahead | state today] under the
+    stationary dynamics — one gather + one matmul per period, the forward
+    pass's whole cost.
+    """
+    g = jnp.matmul(P, f, precision=jax.lax.Precision.HIGHEST)   # [N, na]
+    rows = jnp.broadcast_to(jnp.arange(f.shape[0])[:, None], idx.shape)
+    return w_lo * g[rows, idx] + (1.0 - w_lo) * g[rows, idx + 1]
 
 
 @partial(jax.jit, static_argnames=("tol", "max_iter"))

@@ -1,0 +1,55 @@
+"""Perfect-foresight transition dynamics (MIT shocks) for the Aiyagari
+family: deterministic equilibrium paths after a one-time unanticipated
+aggregate shock, truncated at a horizon T where the economy is back at its
+stationary equilibrium.
+
+Three layers, bottom-up:
+
+  * path.py      — the path evaluator: backward EGM sweep over time
+                   (policies under a given price path) + forward push of the
+                   stationary distribution (the implied capital path), as
+                   ONE fused device program.
+  * jacobian.py  — the sequence-space Jacobian dK/dr at the stationary
+                   equilibrium via the fake-news algorithm (one backward
+                   jvp pass + one forward expectation pass).
+  * mit.py       — solve_transition / solve_transitions_sweep: the outer
+                   Newton (or damped) price-path iteration, anchored at the
+                   existing stationary solves on both ends.
+
+References: Boppart, Krusell & Mitman (2018) "Exploiting MIT shocks";
+Auclert, Bardoczy, Rognlie & Straub (2021) "Using the Sequence-Space
+Jacobian" (PAPERS.md). The reference MATLAB scripts have no transition
+machinery at all; this subsystem exists because the TPU makes whole-path
+evaluation (a T-step lax.scan over HBM-resident grids) and whole-batch
+scenario sweeps (vmap over the scenarios mesh axis) cheap.
+"""
+
+from aiyagari_tpu.transition.jacobian import (
+    fake_news_jacobian,
+    newton_jacobian,
+)
+from aiyagari_tpu.transition.mit import (
+    TransitionResult,
+    TransitionSweepResult,
+    shock_paths,
+    solve_transition,
+    solve_transitions_sweep,
+)
+from aiyagari_tpu.transition.path import (
+    backward_policies,
+    forward_capital,
+    transition_path,
+)
+
+__all__ = [
+    "backward_policies",
+    "forward_capital",
+    "transition_path",
+    "fake_news_jacobian",
+    "newton_jacobian",
+    "shock_paths",
+    "solve_transition",
+    "solve_transitions_sweep",
+    "TransitionResult",
+    "TransitionSweepResult",
+]

@@ -1,0 +1,138 @@
+"""Sequence-space Jacobian of the household block at the stationary
+equilibrium, by the fake-news algorithm of Auclert, Bardoczy, Rognlie &
+Straub (2021).
+
+The object: J[t, s] = dA_t / dr_s — the response of aggregate end-of-period
+asset supply at date t to a perfect-foresight interest-rate perturbation at
+date s (with the wage moving along the firm FOC, dw_s = w'(r_ss) dr_s, so a
+column is a joint (r, w) price shock — the GE-relevant direction). Naively
+this is T backward solves x T forward pushes; the fake-news factorization
+needs ONE of each:
+
+  backward — a single jax.jvp through the T-step backward EGM scan with the
+      shock dated T-1: by stationarity the policy response at date t to a
+      shock at date s depends only on the lead u = s - t, so the one pass
+      yields every anticipation derivative {dk_u}. (This is where jvp
+      earns its keep over finite differences: machine-accurate
+      derivatives of a 200-step scan at 2x the primal's cost.)
+
+  forward — the expectation functions E_u = (Lambda')^u k_ss (what an agent
+      expects to be saving u periods ahead under stationary dynamics), by
+      iterating the adjoint push-forward sim/distribution.expectation_step.
+
+Assembled into the fake-news matrix
+      F[0, s] = <mu_ss, dk_s>          (impact response to news at lead s)
+      F[t, s] = <E_{t-1}, dD_s>, t>=1  (a date-0 distribution perturbation
+                                        dD_s = dLambda_s mu_ss, propagated
+                                        t-1 periods and measured)
+and accumulated along diagonals, J[t, s] = F[t, s] + J[t-1, s-1].
+
+The T x T assembly runs on host (it is T^2 scalars; trivial next to the
+device passes). The Jacobian is built ONCE at the stationary equilibrium
+and reused across Newton rounds AND across every scenario of a transition
+sweep — the shock only moves the residual, not the ss linearization
+(transition/mit.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.sim.distribution import (
+    distribution_step,
+    expectation_step,
+    young_lottery,
+)
+from aiyagari_tpu.transition.path import backward_policies
+from aiyagari_tpu.utils.firm import capital_demand_slope
+
+__all__ = ["fake_news_jacobian", "newton_jacobian"]
+
+
+def fake_news_jacobian(C_ss, k_ss, mu_ss, a_grid, s, P, *, r_ss, w_ss,
+                       w_slope, sigma, beta, amin, T: int) -> np.ndarray:
+    """J[t, s] = dA_t/dr_s at the stationary equilibrium (module docstring).
+
+    C_ss/k_ss [N, na] are the stationary consumption/asset policies, mu_ss
+    the stationary distribution, (r_ss, w_ss) the stationary prices and
+    w_slope = dw/dr along the firm FOC (the price link each column shocks
+    jointly). Returns a host np.float64 [T, T] matrix.
+    """
+    dt = a_grid.dtype
+    ones = jnp.ones((T,), dt)
+    sig_ext = jnp.full((T + 1,), sigma, dt)
+
+    def bw(r_ext_in, w_in):
+        # k_ts [T, N, na] under the given price path, ss terminal policy.
+        return backward_policies(C_ss, a_grid, s, P, r_ext_in, w_in,
+                                 beta * ones, sig_ext, amin * ones)[1]
+
+    r_primal = jnp.full((T + 1,), r_ss, dt)
+    w_primal = jnp.full((T,), w_ss, dt)
+    # Shock at the LAST in-window date: r_ext[T-1] (r_ext[T] is the terminal
+    # anchor and never perturbed), with the wage riding the FOC link.
+    dr = jnp.zeros((T + 1,), dt).at[T - 1].set(1.0)
+    dw = jnp.zeros((T,), dt).at[T - 1].set(jnp.asarray(w_slope, dt))
+
+    @jax.jit
+    def device_half():
+        _, dk_ts = jax.jvp(bw, (r_primal, w_primal), (dr, dw))
+        # dk_ts[t] = response at date t to the date-(T-1) shock = lead
+        # u = T-1-t; flip to index by lead.
+        dk_lead = jnp.flip(dk_ts, axis=0)                       # [T, N, na]
+
+        # Impact row: y[u] = <mu_ss, dk_u>. HIGHEST precision like every
+        # expectation matmul here: the TPU f32 default is a single bf16
+        # pass, and Jacobian error feeds straight into the Newton step.
+        y = jnp.einsum("uij,ij->u", dk_lead, mu_ss,
+                       precision=jax.lax.Precision.HIGHEST)
+
+        # Distribution perturbations: dD_u = d/dk [Lambda(k) mu_ss] . dk_u,
+        # one jvp of the push-forward per lead, vmapped.
+        def push(k):
+            idx, w_lo = young_lottery(k, a_grid)
+            return distribution_step(mu_ss, idx, w_lo, P)
+
+        dD = jax.vmap(
+            lambda tang: jax.jvp(push, (k_ss,), (tang,))[1])(dk_lead)
+
+        # Expectation functions E_0..E_{T-2} under stationary dynamics.
+        idx_ss, wlo_ss = young_lottery(k_ss, a_grid)
+
+        def exp_step(E, _):
+            return expectation_step(E, idx_ss, wlo_ss, P), E
+
+        _, E_stack = jax.lax.scan(exp_step, k_ss, None, length=T - 1)
+
+        F1 = jnp.einsum("tij,sij->ts", E_stack, dD,
+                        precision=jax.lax.Precision.HIGHEST)    # [T-1, T]
+        return y, F1
+
+    y, F1 = jax.device_get(device_half())
+    F = np.empty((T, T), np.float64)
+    F[0, :] = np.asarray(y, np.float64)
+    F[1:, :] = np.asarray(F1, np.float64)
+    # J[t, s] = F[t, s] + J[t-1, s-1]: accumulate down the diagonals.
+    J = F.copy()
+    for t in range(1, T):
+        J[t, 1:] += J[t - 1, :-1]
+    return J
+
+
+def newton_jacobian(J_A: np.ndarray, *, r_ss: float, labor: float,
+                    alpha: float, delta: float) -> np.ndarray:
+    """Jacobian of the market-clearing residual D_t = K_t - K_d(r_t)
+    (transition/mit.py) from the household-block Jacobian J_A = dA/dr:
+    K_{t+1} == A_t (path.forward_capital's mean-preservation identity) puts
+    J_A shifted down one row on the household side — row 0 is zero, K_0
+    being predetermined — and the firm side contributes the diagonal
+    -dK_d/dr at the stationary rate. Factor once, reuse every Newton round
+    and every sweep scenario."""
+    T = J_A.shape[0]
+    J_D = np.zeros((T, T), np.float64)
+    J_D[1:, :] = J_A[:-1, :]
+    J_D[np.diag_indices(T)] -= float(
+        capital_demand_slope(r_ss, labor, alpha, delta))
+    return J_D

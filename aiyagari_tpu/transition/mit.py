@@ -1,0 +1,496 @@
+"""End-to-end MIT-shock transition solver: perfect-foresight equilibrium
+price paths after a one-time unanticipated shock, anchored at the existing
+stationary solves on both ends.
+
+The unknown is the T-period interest-rate path (wages ride the firm FOC).
+Market clearing every period is the SAME condition the stationary closure
+bisects on, dated:
+
+    D_t(r) = K_t(r) - K_d(r_t, z_t) = 0,   t = 0..T-1,
+
+with K_t = E_{mu_t}[a] from the forward push (K_0 predetermined at the
+initial stationary capital) and K_d the firm demand curve at the shocked
+TFP. Two update rules (TransitionConfig.method):
+
+  "newton" — r <- r - J_D^{-1} D with J_D the sequence-space Jacobian built
+      ONCE at the stationary equilibrium by the fake-news algorithm
+      (transition/jacobian.py). Converges in a handful of rounds; the
+      factorized ss Jacobian is reused across rounds and across every
+      scenario of a sweep.
+  "damped" — the Boppart-Krusell-Mitman relaxation
+      r <- (1-damping) r + damping * r_implied(K), with r_implied the rate
+      at which the firm demands exactly the household-supplied capital.
+      Slower (geometric) but Jacobian-free; the parity of the two fixed
+      points is pinned by tests/test_transition.py.
+
+Every round is ONE fused device program (transition/path.transition_path);
+solve_transitions_sweep advances S shock scenarios in lockstep through the
+vmapped twin, shardable over a "scenarios" mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    MITShock,
+    SolverConfig,
+    TransitionConfig,
+)
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.sim.distribution import aggregate_capital
+from aiyagari_tpu.transition.jacobian import fake_news_jacobian, newton_jacobian
+from aiyagari_tpu.transition.path import (
+    transition_path,
+    transition_path_aggregates,
+    transition_path_batch,
+)
+from aiyagari_tpu.utils.firm import (
+    capital_demand,
+    r_from_capital,
+    wage_from_r,
+)
+
+__all__ = [
+    "TransitionResult",
+    "TransitionSweepResult",
+    "shock_paths",
+    "stationary_anchor",
+    "transition_jacobian",
+    "solve_transition",
+    "solve_transitions_sweep",
+]
+
+_SHOCK_PARAMS = ("tfp", "beta", "sigma", "borrowing_limit")
+
+# Host-side guard rails on candidate rate paths between rounds: capital
+# demand needs r > -delta, and far-above-stationary rates explode the
+# backward sweep's cash-on-hand. Transitional rates may legitimately exceed
+# the stationary 1/beta - 1 bound, so the ceiling is deliberately loose.
+_R_CEIL = 0.9
+
+
+@dataclasses.dataclass
+class TransitionResult:
+    """One converged (or round-capped) perfect-foresight transition."""
+
+    r_path: np.ndarray          # [T] equilibrium interest-rate path
+    w_path: np.ndarray          # [T] wages along the firm FOC
+    K_ts: np.ndarray            # [T+1] capital path (K_ts[0] = initial ss)
+    A_ts: np.ndarray            # [T] end-of-period asset supply
+    excess: np.ndarray          # [T] final market-clearing residual
+    max_excess_history: list    # per-round max |excess|
+    rounds: int
+    converged: bool
+    solve_seconds: float
+    method: str
+    shock: MITShock
+    T: int
+    r_ss: float
+    K_ss: float
+    ss: object                  # the anchoring EquilibriumResult
+    policies: object = None     # {"C_ts", "k_ts"} device arrays [T, N, na]
+    mu_T: object = None         # terminal distribution (device)
+    jacobian: object = None     # the Newton J_D, for reuse
+
+
+@dataclasses.dataclass
+class TransitionSweepResult:
+    """S lockstep transitions (one per shock scenario)."""
+
+    r_paths: np.ndarray         # [S, T]
+    K_ts: np.ndarray            # [S, T+1]
+    max_excess: np.ndarray      # [S] final max |residual| per scenario
+    converged: np.ndarray       # [S] bool
+    rounds: int                 # lockstep device rounds executed
+    scenarios: int
+    solve_seconds: float
+    transitions_per_sec: float
+    shocks: list                # the MITShock per scenario
+    method: str
+    T: int
+    r_ss: float
+    ss: object
+    jacobian: object = None
+
+
+def shock_paths(model: AiyagariModel, shock: MITShock, T: int) -> dict:
+    """Host [T] parameter paths for one MIT shock: the shocked parameter
+    follows x_ss + size * rho^t, everything else stays flat. Returns
+    {"z", "beta", "sigma", "amin"} float64 arrays, validated loudly."""
+    if shock.param not in _SHOCK_PARAMS:
+        raise ValueError(
+            f"unknown shock param {shock.param!r}; expected one of "
+            f"{_SHOCK_PARAMS}")
+    if not abs(shock.rho) < 1.0:
+        raise ValueError(
+            f"MIT shocks must be transitory (|rho| < 1, got {shock.rho}): "
+            "the transition starts and ends at the same stationary "
+            "equilibrium")
+    prefs = model.preferences
+    decay = shock.size * shock.rho ** np.arange(T, dtype=np.float64)
+    paths = {
+        "z": np.ones(T),
+        "beta": np.full(T, prefs.beta),
+        "sigma": np.full(T, prefs.sigma),
+        "amin": np.full(T, model.amin),
+    }
+    key = {"tfp": "z", "borrowing_limit": "amin"}.get(shock.param,
+                                                      shock.param)
+    paths[key] = paths[key] + decay
+    if np.any(paths["beta"] <= 0.0) or np.any(paths["beta"] >= 1.0):
+        raise ValueError(f"beta shock leaves (0, 1): size={shock.size}")
+    if np.any(paths["sigma"] <= 0.0):
+        raise ValueError(f"sigma shock leaves sigma > 0: size={shock.size}")
+    if np.any(paths["z"] <= 0.0):
+        raise ValueError(f"TFP shock leaves z > 0: size={shock.size}")
+    if np.any(paths["amin"] < model.amin - 1e-12):
+        raise ValueError(
+            "borrowing-limit shocks can only TIGHTEN the constraint "
+            f"(size >= 0, got {shock.size}): the asset grid starts at the "
+            "stationary limit, so a looser limit has no gridpoints")
+    return paths
+
+
+def _check_trans(trans: TransitionConfig) -> None:
+    if trans.method not in ("newton", "damped"):
+        raise ValueError(
+            f"unknown method {trans.method!r}; expected 'newton' or 'damped'")
+    if trans.max_iter < 1 or trans.T < 2:
+        raise ValueError(
+            f"TransitionConfig needs max_iter >= 1 and T >= 2; got "
+            f"max_iter={trans.max_iter}, T={trans.T}")
+
+
+def _check_anchor(ss) -> None:
+    if getattr(ss, "mu", None) is None:
+        raise ValueError(
+            "the stationary anchor must carry the Young-histogram "
+            "distribution (aggregation='distribution'); got mu=None")
+    if getattr(ss.solution, "policy_c", None) is None:
+        raise ValueError(
+            "the stationary anchor must carry an EGM consumption policy "
+            "(solve the anchor with method='egm')")
+
+
+def _as_model(model: Union[AiyagariModel, AiyagariConfig], dtype):
+    if isinstance(model, AiyagariConfig):
+        model = AiyagariModel.from_config(model, dtype)
+    if model.config.endogenous_labor:
+        raise NotImplementedError(
+            "transition dynamics currently cover the exogenous-labor "
+            "Aiyagari family (aggregate labor must stay constant along "
+            "the path)")
+    return model
+
+
+def stationary_anchor(model: AiyagariModel, *,
+                      solver: Optional[SolverConfig] = None,
+                      eq: Optional[EquilibriumConfig] = None):
+    """The stationary equilibrium both ends of the path are anchored at:
+    an EGM solve (the backward sweep needs the consumption policy as its
+    terminal condition) closed with the deterministic Young histogram (the
+    forward push needs mu_ss as its initial condition). Tighter-than-default
+    tolerances: anchor error is a floor on how flat the flat-path identity
+    can be."""
+    from aiyagari_tpu.equilibrium.bisection import (
+        solve_equilibrium_distribution,
+    )
+
+    solver = solver or SolverConfig(method="egm", tol=1e-9, max_iter=5000)
+    if solver.method != "egm":
+        raise ValueError(
+            "transition solves need method='egm' stationary anchors (the "
+            "backward sweep iterates the EGM operator from the terminal "
+            f"consumption policy); got solver.method={solver.method!r}")
+    eq = eq or EquilibriumConfig(max_iter=48, tol=1e-8)
+    return solve_equilibrium_distribution(model, solver=solver, eq=eq)
+
+
+def transition_jacobian(model: AiyagariModel, ss, T: int) -> np.ndarray:
+    """The Newton matrix J_D for this (model, stationary anchor, horizon):
+    fake-news household Jacobian + firm diagonal (transition/jacobian.py)."""
+    tech = model.config.technology
+    prefs = model.preferences
+    w_ss = float(wage_from_r(ss.r, tech.alpha, tech.delta))
+    # dw/dr along the FOC link at the stationary rate.
+    w_slope = -tech.alpha / (1.0 - tech.alpha) * w_ss / (ss.r + tech.delta)
+    J_A = fake_news_jacobian(
+        ss.solution.policy_c, ss.solution.policy_k, ss.mu,
+        model.a_grid, model.s, model.P,
+        r_ss=ss.r, w_ss=w_ss, w_slope=w_slope,
+        sigma=prefs.sigma, beta=prefs.beta, amin=model.amin, T=T)
+    return newton_jacobian(J_A, r_ss=ss.r, labor=model.labor_raw,
+                           alpha=tech.alpha, delta=tech.delta)
+
+
+def _device_paths(model: AiyagariModel, r_path, paths, r_ss):
+    """(r_ext, w_path, beta_path, sigma_ext, amin_path) device arrays for
+    one round's path program, from the host rate path + shock paths."""
+    tech = model.config.technology
+    dt = model.dtype
+    w = wage_from_r(r_path, tech.alpha, tech.delta, paths["z"])
+    r_ext = np.concatenate([r_path, [r_ss]])
+    sig_ext = np.concatenate([paths["sigma"],
+                              [model.preferences.sigma]])
+    return (jnp.asarray(r_ext, dt), jnp.asarray(w, dt),
+            jnp.asarray(paths["beta"], dt), jnp.asarray(sig_ext, dt),
+            jnp.asarray(paths["amin"], dt))
+
+
+def solve_transition(
+    model: Union[AiyagariModel, AiyagariConfig],
+    shock: MITShock,
+    *,
+    trans: TransitionConfig = TransitionConfig(),
+    solver: Optional[SolverConfig] = None,
+    eq: Optional[EquilibriumConfig] = None,
+    ss=None,
+    jacobian: Optional[np.ndarray] = None,
+    keep_policies: bool = True,
+    on_iteration: Optional[Callable] = None,
+    dtype=jnp.float64,
+) -> TransitionResult:
+    """Solve one perfect-foresight MIT-shock transition (module docstring).
+
+    `ss` (a distribution-closure EquilibriumResult) and `jacobian` (the
+    Newton J_D) can be passed in to amortize the anchors across calls —
+    solve_transitions_sweep does exactly that. The per-round max excess
+    demand lands in max_excess_history (and flows through on_iteration),
+    the acceptance telemetry ISSUE 2 names.
+    """
+    t0 = time.perf_counter()
+    model = _as_model(model, dtype)
+    _check_trans(trans)
+    T = int(trans.T)
+    if ss is None:
+        ss = stationary_anchor(model, solver=solver, eq=eq)
+    _check_anchor(ss)
+    tech = model.config.technology
+    r_ss = float(ss.r)
+    K_ss = float(aggregate_capital(ss.mu, model.a_grid))
+    paths = shock_paths(model, shock, T)
+
+    if trans.method == "newton" and jacobian is None:
+        jacobian = transition_jacobian(model, ss, T)
+
+    r_path = np.full(T, r_ss)
+    out = None
+    K_ts = D = None
+    hist: list = []
+    converged = False
+    rounds = 0
+    for rnd in range(trans.max_iter):
+        it_t0 = time.perf_counter()
+        dev = _device_paths(model, r_path, paths, r_ss)
+        # Aggregates-only program per round (the update reads K_ts alone);
+        # the policy stacks are materialized once below, at the final path.
+        out = transition_path_aggregates(ss.solution.policy_c, ss.mu,
+                                         model.a_grid, model.s, model.P,
+                                         *dev)
+        K_ts = np.asarray(jax.device_get(out["K_ts"]), np.float64)
+        D = K_ts[:T] - capital_demand(r_path, model.labor_raw, tech.alpha,
+                                      tech.delta, paths["z"])
+        rounds = rnd + 1
+        max_d = float(np.max(np.abs(D)))
+        hist.append(max_d)
+        if on_iteration is not None:
+            on_iteration({"round": rnd, "max_excess": max_d,
+                          "seconds": time.perf_counter() - it_t0})
+        if np.isfinite(max_d) and max_d < trans.tol:
+            converged = True
+            break
+        if not np.isfinite(max_d):
+            raise FloatingPointError(
+                f"transition path diverged at round {rnd} (non-finite "
+                "excess demand); try method='damped' or a smaller shock")
+        if rnd == trans.max_iter - 1:
+            # Round cap: keep the path the final evaluation actually used —
+            # a last update would pair a never-evaluated r_path with this
+            # round's K_ts/excess, handing the caller mutually inconsistent
+            # diagnostics.
+            break
+        if trans.method == "newton":
+            r_path = r_path - np.linalg.solve(jacobian, D)
+        else:
+            r_implied = r_from_capital(
+                np.maximum(K_ts[:T], 1e-10), model.labor_raw, tech.alpha,
+                tech.delta, paths["z"])
+            r_path = ((1.0 - trans.damping) * r_path
+                      + trans.damping * r_implied)
+        r_path = np.clip(r_path, -tech.delta + 1e-3, _R_CEIL)
+
+    policies = None
+    if keep_policies:
+        # One full evaluation at the final (already-evaluated) path for the
+        # dated policy stacks the round loop deliberately never returns.
+        full = transition_path(ss.solution.policy_c, ss.mu, model.a_grid,
+                               model.s, model.P,
+                               *_device_paths(model, r_path, paths, r_ss))
+        policies = {"C_ts": full["C_ts"], "k_ts": full["k_ts"]}
+    return TransitionResult(
+        r_path=r_path,
+        w_path=np.asarray(wage_from_r(r_path, tech.alpha, tech.delta,
+                                      paths["z"])),
+        K_ts=K_ts,
+        A_ts=np.asarray(jax.device_get(out["A_ts"]), np.float64),
+        excess=D,
+        max_excess_history=hist,
+        rounds=rounds,
+        converged=converged,
+        solve_seconds=time.perf_counter() - t0,
+        method=trans.method,
+        shock=shock,
+        T=T,
+        r_ss=r_ss,
+        K_ss=K_ss,
+        ss=ss,
+        policies=policies,
+        mu_T=out["mu_T"],
+        jacobian=jacobian,
+    )
+
+
+def solve_transitions_sweep(
+    model: Union[AiyagariModel, AiyagariConfig],
+    shocks: Sequence[MITShock],
+    *,
+    trans: TransitionConfig = TransitionConfig(),
+    solver: Optional[SolverConfig] = None,
+    eq: Optional[EquilibriumConfig] = None,
+    ss=None,
+    jacobian: Optional[np.ndarray] = None,
+    mesh=None,
+    on_iteration: Optional[Callable] = None,
+    dtype=jnp.float64,
+) -> TransitionSweepResult:
+    """Solve S MIT-shock scenarios in lockstep: every round evaluates ALL
+    scenarios' candidate price paths through ONE vmapped backward+forward
+    program (transition/path.transition_path_batch).
+
+    Scenarios share the base economy — one stationary anchor, one fake-news
+    Jacobian (the ss linearization is shock-independent), S right-hand
+    sides per Newton round. They may shock DIFFERENT parameters: each
+    scenario is lowered to its four [T] parameter paths, so a
+    tfp/beta/sigma/borrowing-limit mix batches through the same compiled
+    program. With `mesh` (carrying a "scenarios" axis), the stacked [S, T]
+    paths are placed sharded (parallel/mesh.shard_scenario_arrays) and the
+    rounds run scenario-parallel across devices. A converged scenario keeps
+    its path pinned so the program shape never changes. The per-scenario
+    fixed point is identical to running solve_transition one shock at a
+    time (pinned by tests/test_transition.py).
+    """
+    t0 = time.perf_counter()
+    model = _as_model(model, dtype)
+    _check_trans(trans)
+    shocks = list(shocks)
+    if not shocks:
+        raise ValueError("solve_transitions_sweep needs at least one shock")
+    T = int(trans.T)
+    S = len(shocks)
+    if ss is None:
+        ss = stationary_anchor(model, solver=solver, eq=eq)
+    _check_anchor(ss)
+    tech = model.config.technology
+    r_ss = float(ss.r)
+    if trans.method == "newton" and jacobian is None:
+        jacobian = transition_jacobian(model, ss, T)
+
+    all_paths = [shock_paths(model, sh, T) for sh in shocks]
+    stacked = {k: np.stack([p[k] for p in all_paths])
+               for k in ("z", "beta", "sigma", "amin")}
+
+    dt = model.dtype
+    sig_ext_s = np.concatenate(
+        [stacked["sigma"],
+         np.full((S, 1), model.preferences.sigma)], axis=1)
+    beta_dev = jnp.asarray(stacked["beta"], dt)
+    sig_dev = jnp.asarray(sig_ext_s, dt)
+    amin_dev = jnp.asarray(stacked["amin"], dt)
+    if mesh is not None:
+        from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
+
+        sharded = shard_scenario_arrays(
+            mesh, S, beta=beta_dev, sigma=sig_dev, amin=amin_dev)
+        beta_dev, sig_dev, amin_dev = (
+            sharded["beta"], sharded["sigma"], sharded["amin"])
+
+    def place(x):
+        x = jnp.asarray(x, dt)
+        if mesh is not None:
+            from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
+
+            x = shard_scenario_arrays(mesh, S, x=x)["x"]
+        return x
+
+    r_paths = np.full((S, T), r_ss)
+    conv = np.zeros(S, bool)
+    max_d = np.full(S, np.inf)
+    out = None
+    rounds = 0
+    for rnd in range(trans.max_iter):
+        it_t0 = time.perf_counter()
+        w_s = wage_from_r(r_paths, tech.alpha, tech.delta, stacked["z"])
+        r_ext_s = np.concatenate([r_paths, np.full((S, 1), r_ss)], axis=1)
+        out = transition_path_batch(
+            ss.solution.policy_c, ss.mu, model.a_grid, model.s, model.P,
+            place(r_ext_s), place(w_s), beta_dev, sig_dev, amin_dev)
+        K_s = np.asarray(jax.device_get(out["K_ts"]), np.float64)  # [S, T+1]
+        D = K_s[:, :T] - capital_demand(r_paths, model.labor_raw, tech.alpha,
+                                        tech.delta, stacked["z"])
+        rounds = rnd + 1
+        max_d = np.max(np.abs(D), axis=1)
+        conv = conv | (np.isfinite(max_d) & (max_d < trans.tol))
+        if on_iteration is not None:
+            on_iteration({"round": rnd,
+                          "max_excess": float(np.max(max_d)),
+                          "converged": int(np.sum(conv)),
+                          "seconds": time.perf_counter() - it_t0})
+        if conv.all():
+            break
+        if not np.all(np.isfinite(max_d)):
+            bad = [i for i in range(S) if not np.isfinite(max_d[i])]
+            raise FloatingPointError(
+                f"transition sweep diverged at round {rnd} for scenario(s) "
+                f"{bad}; try method='damped' or smaller shocks")
+        if rnd == trans.max_iter - 1:
+            # Round cap: keep the paths the final evaluation used — the
+            # same never-evaluated-update consistency rule as the single
+            # solve (converged scenarios are pinned either way).
+            break
+        if trans.method == "newton":
+            step = np.linalg.solve(jacobian, D.T).T            # [S, T]
+        else:
+            r_implied = r_from_capital(
+                np.maximum(K_s[:, :T], 1e-10), model.labor_raw,
+                tech.alpha, tech.delta, stacked["z"])
+            step = trans.damping * (r_paths - r_implied)
+        r_paths = np.where(conv[:, None], r_paths,
+                           np.clip(r_paths - step, -tech.delta + 1e-3,
+                                   _R_CEIL))
+
+    wall = time.perf_counter() - t0
+    return TransitionSweepResult(
+        r_paths=r_paths,
+        K_ts=np.asarray(jax.device_get(out["K_ts"]), np.float64),
+        max_excess=max_d,
+        converged=conv,
+        rounds=rounds,
+        scenarios=S,
+        solve_seconds=wall,
+        transitions_per_sec=S / wall if wall > 0 else float("inf"),
+        shocks=shocks,
+        method=trans.method,
+        T=T,
+        r_ss=r_ss,
+        ss=ss,
+        jacobian=jacobian,
+    )

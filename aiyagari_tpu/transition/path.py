@@ -1,0 +1,135 @@
+"""Transition-path evaluator: given a T-period price path, the household
+block's whole response as one fused device program.
+
+Two time scans back to back:
+
+  backward_policies — lax.scan (reverse=True) of the dated EGM operator
+      (ops/egm.egm_step_transition) from the terminal stationary consumption
+      policy: O(T) matmul+interp steps, each identical in shape, so XLA
+      compiles ONE loop body for any horizon. Time-varying preferences
+      (beta_t, sigma_t) and borrowing limits (amin_t) ride along as [T]
+      operand arrays — constant slices when unshocked — so every MIT-shock
+      flavor shares the same compiled program.
+
+  forward_capital — lax.scan of the Young-lottery push-forward
+      (sim/distribution.distribution_step) from the initial stationary
+      distribution, yielding the capital path K_t = E_{mu_t}[a] and the
+      end-of-period asset supply A_t = E_{mu_t}[policy_t].
+
+Both are wrapped in `transition_path`, the single jitted entry the outer
+solvers (transition/mit.py) and the scenario sweep vmap over. Everything is
+a traced operand; the program compiles once per (T, N, na) geometry.
+
+Timing conventions (the usual discrete-time Aiyagari dating):
+  * budget at t:  c_t + a_{t+1} = (1 + r_t) a_t + w_t s_t
+  * Euler at t:   u'_{sigma_t}(c_t) = beta_t (1+r_{t+1}) E_t u'_{sigma_{t+1}}(c_{t+1})
+so the price path enters as an EXTENDED rate path r_ext of length T+1 with
+r_ext[T] = the terminal stationary rate (the last Euler equation looks one
+period past the truncation horizon), while w/amin are length T. sigma is
+extended the same way. K_0 is predetermined at the initial stationary
+capital; A_{T-1} is the last asset choice the window determines.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.egm import egm_step_transition
+from aiyagari_tpu.sim.distribution import distribution_step, young_lottery
+
+__all__ = ["backward_policies", "forward_capital", "transition_path"]
+
+
+def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
+                      sigma_ext, amin_path):
+    """Backward EGM sweep over t = T-1 .. 0 from the terminal policy.
+
+    C_term [N, na] is the stationary consumption policy the path ends at
+    (period-T policy). r_ext/sigma_ext are [T+1] (module docstring);
+    w_path/beta_path/amin_path are [T]. Returns (C_ts, k_ts), each
+    [T, N, na] in FORWARD time order (C_ts[t] is the period-t policy).
+    """
+
+    def step(C_next, xs):
+        r_now, r_next, w_now, beta_now, sig_now, sig_next, amin_now = xs
+        C_now, k_now = egm_step_transition(
+            C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
+            sigma_now=sig_now, sigma_next=sig_next, beta_now=beta_now)
+        return C_now, (C_now, k_now)
+
+    xs = (r_ext[:-1], r_ext[1:], w_path, beta_path,
+          sigma_ext[:-1], sigma_ext[1:], amin_path)
+    _, (C_ts, k_ts) = jax.lax.scan(step, C_term, xs, reverse=True)
+    return C_ts, k_ts
+
+
+def forward_capital(mu0, k_ts, a_grid, P):
+    """Push the initial distribution forward through the time-varying
+    policies: mu_{t+1} = Lambda(k_ts[t]) mu_t.
+
+    Returns (K_ts [T+1], A_ts [T], mu_T): K_ts[t] = E_{mu_t}[a] is the
+    beginning-of-period capital stock (K_ts[0] is the predetermined initial
+    stationary capital), A_ts[t] = E_{mu_t}[k_ts[t]] the end-of-period
+    asset supply. Because the Young lottery is mean-preserving for policies
+    inside the grid (every k_ts is clipped into it), K_ts[t+1] == A_ts[t]
+    exactly — the identity the sequence-space Jacobian relies on.
+    """
+
+    def step(mu, k_t):
+        K_t = jnp.sum(mu * a_grid[None, :])
+        A_t = jnp.sum(mu * k_t)
+        idx, w_lo = young_lottery(k_t, a_grid)
+        mu_next = distribution_step(mu, idx, w_lo, P)
+        # Renormalize: f32 accumulation must not drift total mass over a
+        # long horizon (same policy as stationary_distribution's sweeps).
+        mu_next = mu_next / jnp.sum(mu_next)
+        return mu_next, (K_t, A_t)
+
+    mu_T, (K_ts, A_ts) = jax.lax.scan(step, mu0, k_ts)
+    K_ts = jnp.concatenate([K_ts, jnp.sum(mu_T * a_grid[None, :])[None]])
+    return K_ts, A_ts, mu_T
+
+
+@jax.jit
+def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
+                    sigma_ext, amin_path):
+    """Backward sweep + forward push as one jitted program.
+
+    Returns a dict: K_ts [T+1] (capital path, K_ts[0] predetermined),
+    A_ts [T] (asset supply), C_ts / k_ts [T, N, na] (dated policies),
+    mu_T [N, na] (terminal distribution — should be back near the
+    stationary one when T is long enough). The outer solvers compute
+    excess demand from K_ts on host (transition/mit.py).
+    """
+    C_ts, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
+                                   beta_path, sigma_ext, amin_path)
+    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
+    return {"K_ts": K_ts, "A_ts": A_ts, "C_ts": C_ts, "k_ts": k_ts,
+            "mu_T": mu_T}
+
+
+@jax.jit
+def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
+                               beta_path, sigma_ext, amin_path):
+    """transition_path without the [T, N, na] policy stacks in the output.
+
+    The round loops only read K_ts, and jit OUTPUTS cannot be dead-code-
+    eliminated — returning the policies would allocate ~T*N*na*2 buffers
+    per round purely to be dropped (at the framework's target grids that
+    is GBs per sweep round). The full twin above is evaluated ONCE at the
+    converged path when the caller wants the policies."""
+    _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
+                                beta_path, sigma_ext, amin_path)
+    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
+    return {"K_ts": K_ts, "A_ts": A_ts, "mu_T": mu_T}
+
+
+# vmapped twin for scenario sweeps: paths carry a leading [S] axis, the
+# model arrays and stationary anchors are shared. jit(vmap(...)) compiles
+# once per (S, T, N, na); the [S]-axis shards over a "scenarios" mesh axis
+# when the stacked paths were placed with parallel/mesh.shard_scenario_arrays.
+transition_path_batch = jax.jit(jax.vmap(
+    transition_path_aggregates,
+    in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0),
+))

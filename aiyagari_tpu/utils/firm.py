@@ -12,21 +12,43 @@ import numpy as np
 __all__ = [
     "wage_from_r",
     "capital_demand",
+    "capital_demand_slope",
+    "r_from_capital",
     "r_from_K",
     "w_from_K",
     "ks_price_tables",
 ]
 
 
-def wage_from_r(r, alpha: float, delta: float):
-    """w = (1-alpha) * (alpha/(r+delta))^(alpha/(1-alpha)) with z=L=1
-    (Aiyagari_VFI.m:67). Works on scalars or arrays of any backend."""
-    return (1.0 - alpha) * (alpha / (r + delta)) ** (alpha / (1.0 - alpha))
+def wage_from_r(r, alpha: float, delta: float, z=1.0):
+    """w = (1-alpha) * z^(1/(1-alpha)) * (alpha/(r+delta))^(alpha/(1-alpha))
+    with L=1 (Aiyagari_VFI.m:67 at the reference's z=1). Eliminating K/L
+    between the two firm FOCs keeps the z^(1/(1-alpha)) factor — the channel
+    a TFP path moves wages along a transition (transition/path.py). Works on
+    scalars or arrays of any backend."""
+    return ((1.0 - alpha) * z ** (1.0 / (1.0 - alpha))
+            * (alpha / (r + delta)) ** (alpha / (1.0 - alpha)))
 
 
-def capital_demand(r, labor: float, alpha: float, delta: float):
-    """K_d(r) = labor * (alpha/(r+delta))^(1/(1-alpha)) (Aiyagari_VFI.m:195)."""
-    return labor * (alpha / (r + delta)) ** (1.0 / (1.0 - alpha))
+def capital_demand(r, labor: float, alpha: float, delta: float, z=1.0):
+    """K_d(r) = labor * (alpha z/(r+delta))^(1/(1-alpha)) (Aiyagari_VFI.m:195
+    at z=1)."""
+    return labor * (alpha * z / (r + delta)) ** (1.0 / (1.0 - alpha))
+
+
+def capital_demand_slope(r, labor: float, alpha: float, delta: float, z=1.0):
+    """dK_d/dr = -K_d / ((1-alpha)(r+delta)) — the firm-side diagonal of the
+    transition Newton Jacobian (transition/jacobian.py)."""
+    return -capital_demand(r, labor, alpha, delta, z) / (
+        (1.0 - alpha) * (r + delta))
+
+
+def r_from_capital(K, labor: float, alpha: float, delta: float, z=1.0):
+    """Inverse of capital_demand: the rate at which the firm demands exactly
+    K — the gross marginal product (r_from_K) net of depreciation. The
+    implied-rate map of the damped (Boppart-Krusell-Mitman) transition
+    update."""
+    return r_from_K(K, labor, z, alpha) - delta
 
 
 def r_from_K(K, L, z, alpha: float):

@@ -331,6 +331,69 @@ def bench_aiyagari_vfi(grid_size: int, quick: bool) -> dict:
     }
 
 
+def _available_memory_bytes() -> int | None:
+    """Host MemAvailable in bytes, or None where /proc is unreadable."""
+    try:
+        with open("/proc/meminfo") as f:
+            for ln in f:
+                if ln.startswith("MemAvailable"):
+                    return int(ln.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _size_scale_grid(grid_scale: int, platform: str, itemsize: int) -> tuple[int, dict]:
+    """Shrink the north-star grid to what THIS host can hold (ISSUE 2
+    satellite: the round-5 battery died mid-run with a 208 GB
+    RESOURCE_EXHAUSTED inside bench_scale's solve on the CPU fallback,
+    taking every later metric with it). The dominant allocation on the
+    XLA:CPU route is the windowed power-grid inversion's materialized
+    compare buffer — measured 208.9e9 bytes at na=400k f64, and the window
+    width scales with na, so bytes ~= 7 * na * (na/43) * itemsize (which
+    reproduces the measurement). TPU executions fuse the window loop into
+    the kernel and never materialize that buffer, so sizing applies
+    off-TPU only; halve until the estimate fits in half of MemAvailable,
+    flooring at the --quick cap. The artifact records both the requested
+    and the sized grid so the workload change is explicit, and the
+    per-metric OOM guard in main() remains the backstop for allocations
+    this model does not see."""
+    if platform == "tpu":
+        return grid_scale, {}
+    fields: dict = {}
+    sized = grid_scale
+    # Throughput cap first: a CPU-fallback session is a degraded-but-
+    # recordable run (the north-star number is a TPU claim), and the
+    # windowed sweep costs ~2.3 ms per 1k gridpoints per solve on this
+    # class of host (measured: 22.5 s at 10k, 45.6 s at 20k) — the
+    # requested 400k would be ~15 min PER SOLVE in a battery that runs
+    # several, i.e. a guaranteed probe-timeout, which kills the later
+    # metrics exactly like the OOM did.
+    cpu_cap = 12_000
+    if sized > cpu_cap:
+        sized = cpu_cap
+        fields = {"grid_requested": grid_scale, "grid_sized": sized,
+                  "grid_sized_reason": "cpu-throughput"}
+    avail = _available_memory_bytes()
+    if avail is not None:
+        est = lambda na: 7.0 * na * (na / 43.0) * itemsize
+        budget = 0.5 * avail
+        while sized > 4_000 and est(sized) > budget:
+            sized //= 2
+        if "grid_sized" in fields and sized != fields["grid_sized"]:
+            fields.update(grid_sized=sized, grid_sized_reason="memory",
+                          mem_available_gb=round(avail / 1e9, 1),
+                          est_peak_gb_at_requested=
+                          round(est(grid_scale) / 1e9, 1))
+        elif not fields and sized != grid_scale:
+            fields = {"grid_requested": grid_scale, "grid_sized": sized,
+                      "grid_sized_reason": "memory",
+                      "mem_available_gb": round(avail / 1e9, 1),
+                      "est_peak_gb_at_requested":
+                          round(est(grid_scale) / 1e9, 1)}
+    return sized, fields
+
+
 def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
                 noise_floor_ulp: float | None = None,
                 pallas_inversion: bool = False) -> dict:
@@ -352,6 +415,8 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
     r, tol, max_iter = 0.04, 1e-5, 2000
     platform = jax.default_backend()
     dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    grid_scale, sized_fields = _size_scale_grid(
+        grid_scale, platform, jnp.dtype(dtype).itemsize)
     model = aiyagari_preset(grid_size=grid_scale, dtype=dtype)
     w = float(wage_from_r(r, model.config.technology.alpha, model.config.technology.delta))
 
@@ -376,8 +441,10 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
                 use_pallas=pallas_inversion,
             )
     else:
-        return _bench_scale_vfi(model, grid_scale, quick, r, w, tol, max_iter,
-                                noise_floor_ulp, platform, dtype)
+        out = _bench_scale_vfi(model, grid_scale, quick, r, w, tol, max_iter,
+                               noise_floor_ulp, platform, dtype)
+        out.update(sized_fields)
+        return out
 
     sol = run()
     float(sol.distance)   # compile+converge warmup, fenced
@@ -482,6 +549,7 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
         **den,
         **strict,
         **util,
+        **sized_fields,
     }
 
 
@@ -754,6 +822,80 @@ def bench_sweep(quick: bool, grid_size: int = 200) -> dict:
     else:
         out["vs_baseline"] = None
     return out
+
+
+def bench_transition(quick: bool, grid_size: int = 200, T: int = 150) -> dict:
+    """MIT-shock transition-path solver (transition/, the ISSUE 2 tentpole):
+    wall-clock and round count of the Newton solve — the sequence-space-
+    Jacobian update, each round ONE fused backward+forward device program —
+    against the damped (Boppart-Krusell-Mitman) fixed point on the same
+    shock/tolerance as its in-process baseline. The stationary anchor and
+    the fake-news Jacobian build are timed separately (both are one-off,
+    amortized over every shock studied on the same economy), and a lockstep
+    scenario sweep (dispatch.sweep_transitions) records
+    `sweep_transitions_per_sec` — the transition analogue of the GE sweep's
+    scenarios/sec axis."""
+    import jax
+
+    import aiyagari_tpu as at
+
+    if quick:
+        grid_size, T = min(grid_size, 60), min(T, 40)
+    platform = jax.default_backend()
+    backend = at.BackendConfig(
+        dtype="float32" if platform == "tpu" else "float64")
+    cfg = at.AiyagariConfig(
+        grid=at.GridSpecConfig(n_points=grid_size))
+    shock = at.MITShock(param="tfp", size=0.01, rho=0.9)
+    tol = 1e-5 if platform == "tpu" else 1e-7
+    tc = at.TransitionConfig(T=T, tol=tol, method="newton", max_iter=20)
+
+    t0 = time.perf_counter()
+    cold = at.solve_transition(cfg, shock, transition=tc, backend=backend,
+                               keep_policies=False)
+    t_cold = time.perf_counter() - t0
+    # Warm solve: ss + Jacobian amortized — the marginal cost per shock.
+    t0 = time.perf_counter()
+    res = at.solve_transition(cfg, shock, transition=tc, backend=backend,
+                              ss=cold.ss, jacobian=cold.jacobian,
+                              keep_policies=False)
+    t_newton = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    damped = at.solve_transition(
+        cfg, shock, backend=backend, ss=cold.ss, keep_policies=False,
+        transition=at.TransitionConfig(T=T, tol=tol, method="damped",
+                                       max_iter=500, damping=0.5))
+    t_damped = time.perf_counter() - t0
+
+    # Lockstep scenario sweep: a size x persistence grid of TFP shocks plus
+    # a discount-factor shock — the mixed-parameter batch the vmapped path
+    # program exists for.
+    shocks = [at.MITShock("tfp", sz, rh)
+              for sz in (0.005, 0.01) for rh in (0.8, 0.9, 0.95)]
+    shocks += [at.MITShock("beta", 0.002, 0.8), at.MITShock("sigma", 0.05, 0.8)]
+    if quick:
+        shocks = shocks[:4]
+    sw = at.sweep_transitions(cfg, shocks, transition=tc, backend=backend,
+                              ss=cold.ss, jacobian=cold.jacobian)
+
+    return {
+        "metric": f"transition_newton_T{T}_grid{grid_size}",
+        "value": round(t_newton, 4),
+        "unit": "seconds",
+        "vs_baseline": round(t_damped / t_newton, 2),
+        "baseline_seconds": round(t_damped, 4),
+        "baseline_source": "damped (BKM) update, same shock/tol (in-process)",
+        "newton_rounds": int(res.rounds),
+        "damped_rounds": int(damped.rounds),
+        "converged": bool(res.converged),
+        "damped_converged": bool(damped.converged),
+        "max_excess": float(res.max_excess_history[-1]),
+        "cold_seconds": round(t_cold, 4),   # incl. ss anchor + Jacobian
+        "sweep_transitions_per_sec": round(sw.transitions_per_sec, 3),
+        "sweep_scenarios": sw.scenarios,
+        "sweep_rounds": int(sw.rounds),
+        "sweep_converged": int(np.sum(np.asarray(sw.converged))),
+    }
 
 
 def _ks_panel_throughput(T: int, pop: int, *, reps: int, outer: int) -> dict:
@@ -1100,7 +1242,8 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--metric",
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
-                             "scale", "scale_vfi", "ge", "sweep"],
+                             "scale", "scale_vfi", "ge", "sweep",
+                             "transition"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -1129,7 +1272,28 @@ def main() -> int:
                     help="re-measure the NumPy VFI-400 denominator (7 runs, "
                          "median + spread + machine fingerprint) and freeze it "
                          "into BASELINE.json; run on an IDLE box")
+    ap.add_argument("--preset", choices=["ci"], default=None,
+                    help="'ci': tiny-grid CPU smoke battery (in-process, no "
+                         "device child) covering every bench code path that "
+                         "has previously broken a round — vfi, the "
+                         "multiscale+windowed-inversion scale solve, batched "
+                         "GE, the scenario sweep, and the transition solver "
+                         "— sized to finish in ~a minute. Invoked by the "
+                         "tier-1 smoke test (tests/test_bench_ci.py) so "
+                         "bench-breaking regressions like the round-5 OOM "
+                         "surface before a bench round does")
     args = ap.parse_args()
+
+    if args.preset == "ci":
+        # Tiny grids, forced CPU (in-process: the child/probe machinery is
+        # for real device sessions), quick timings. grid_scale=8000 still
+        # exercises the grid-sequenced ladder (> LADDER_MIN_FINE) AND the
+        # windowed power-grid inversion (> INVERSE_DENSE_CUTOFF) — the code
+        # paths behind the round-5 OOM — at ~MB-scale buffers.
+        args.platform = args.platform or "cpu"
+        args.quick = True
+        args.grid = min(args.grid, 100)
+        args.grid_scale = min(args.grid_scale, 8000)
 
     if args.refresh_baseline:
         # Pure-CPU measurement: never touch the TPU tunnel for this.
@@ -1183,6 +1347,7 @@ def main() -> int:
                                          args.noise_floor_ulp, False),
         "ge": lambda: bench_ge_batched(args.quick),
         "sweep": lambda: bench_sweep(args.quick),
+        "transition": lambda: bench_transition(args.quick),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
@@ -1190,11 +1355,32 @@ def main() -> int:
     # accuracy statistic into the artifact; scale_vfi last — the declared
     # north-star metric names VFI, so the artifact measures it at the
     # north-star scale too, not only the EGM carrier).
-    names = (("vfi", "ks", "ks_large", "scale", "ge", "sweep", "ks_fine",
-              "scale_vfi")
-             if args.metric == "all" else (args.metric,))
+    if args.preset == "ci":
+        # An explicit --metric narrows the ci battery to that one metric
+        # (still at ci sizes) instead of being silently ignored.
+        names = (("vfi", "scale", "ge", "sweep", "transition")
+                 if args.metric == "all" else (args.metric,))
+    elif args.metric == "all":
+        names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
+                 "transition", "ks_fine", "scale_vfi")
+    else:
+        names = (args.metric,)
     for name in names:
-        result = runners[name]()
+        try:
+            result = runners[name]()
+        except Exception as e:  # noqa: BLE001 — filtered to OOM below
+            # Per-metric OOM guard (ISSUE 2 satellite): an allocation the
+            # sizing model did not foresee must cost ONE metric, not the
+            # rest of the battery — emit a machine-readable skip record and
+            # keep going, exiting 0. Anything that is not an OOM (solver
+            # bugs, failed convergence asserts) still propagates loudly.
+            msg = f"{type(e).__name__}: {e}"
+            is_oom = (isinstance(e, MemoryError)
+                      or "RESOURCE_EXHAUSTED" in msg
+                      or "Out of memory" in msg)
+            if not is_oom:
+                raise
+            result = {"metric": name, "skipped": "oom", "error": msg[:300]}
         print(json.dumps(result), flush=True)
     return 0
 

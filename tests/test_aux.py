@@ -308,6 +308,72 @@ class TestCheckpoint:
         assert p.exists()
         assert not list(tmp_path.glob("topo.npz.proc*of*"))
 
+    def test_stale_single_file_removed_before_first_proc_write(self, tmp_path):
+        """ISSUE 2 satellite (ADVICE r5 ~:248): the single->multi topology
+        transition must unlink the stale single-process file BEFORE writing
+        the first proc file — a preemption between the two steps must leave
+        'no checkpoint' (fresh start) or a LOUD incomplete-set error, never
+        the stale file silently shadowing the newer proc state."""
+        from unittest import mock
+
+        from aiyagari_tpu.io_utils import checkpoint as ck
+
+        p = tmp_path / "order.npz"
+        ck.save_checkpoint(p, scalars={"it": 7}, arrays={"a": np.ones(3)})
+        assert p.exists()
+
+        # Preempt exactly between the cleanup and the proc write.
+        with mock.patch.object(ck, "_write_npz",
+                               side_effect=RuntimeError("preempted")):
+            with mock.patch.object(ck, "_process_topology",
+                                   return_value=(0, 2)):
+                with pytest.raises(RuntimeError, match="preempted"):
+                    ck.save_checkpoint(p, scalars={"it": 8},
+                                       arrays={"a": np.ones(3)})
+        # The stale pre-transition file is already gone: a resume starts
+        # fresh instead of silently regressing to iteration 7.
+        assert not p.exists()
+        assert ck.load_checkpoint(p) is None
+
+    def test_lazy_entries_refuse_concurrently_replaced_proc_file(self, tmp_path):
+        """ISSUE 2 satellite (ADVICE r5 ~:265): the merged multi-process
+        view reads shard data lazily, so a save that atomically replaces a
+        proc file AFTER the merge must not serve newer shards against the
+        older merged metadata — the lazy open re-verifies the save sequence
+        and raises."""
+        from unittest import mock
+
+        from aiyagari_tpu.io_utils import checkpoint as ck
+
+        p = tmp_path / "lazy.npz"
+        for pid in (0, 1):
+            ck._SAVE_COUNTS[str(p)] = 0
+            with mock.patch.object(ck, "_process_topology",
+                                   return_value=(pid, 2)):
+                ck.save_checkpoint(p, scalars={"it": 1},
+                                   arrays={"a": np.full(4, 1.0 + pid)})
+        sc, arrays = ck.load_checkpoint(p)
+        assert arrays.expected_seq == 1
+        np.testing.assert_array_equal(arrays["a"], np.ones(4))  # lazy read ok
+
+        # A concurrent save replaces process 0's file (newer sequence).
+        ck._SAVE_COUNTS[str(p)] = 5
+        with mock.patch.object(ck, "_process_topology",
+                               return_value=(0, 2)):
+            ck.save_checkpoint(p, scalars={"it": 2},
+                               arrays={"a": np.full(4, 9.0)})
+        with pytest.raises(ValueError, match="changed under the merged"):
+            arrays["a"]
+        # A fresh merge of a CONSISTENT generation works again.
+        ck._SAVE_COUNTS[str(p)] = 5
+        with mock.patch.object(ck, "_process_topology",
+                               return_value=(1, 2)):
+            ck.save_checkpoint(p, scalars={"it": 2},
+                               arrays={"a": np.full(4, 9.0)})
+        sc2, arrays2 = ck.load_checkpoint(p)
+        assert arrays2.expected_seq == 6
+        np.testing.assert_array_equal(arrays2["a"], np.full(4, 9.0))
+
     def test_bisection_resume(self, tmp_path):
         model = AiyagariModel.from_config(SMALL)
         solver = SolverConfig(method="egm")

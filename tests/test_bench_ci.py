@@ -1,0 +1,46 @@
+"""Tier-1 smoke for the benchmark harness: `bench.py --preset ci` (tiny-grid
+CPU battery) must exit 0 with one well-formed JSON record per metric.
+
+Why this exists (ISSUE 2 satellite): the round-5 bench round died mid-battery
+with a 208 GB RESOURCE_EXHAUSTED inside bench_scale — a bench-only code path
+no test exercised, so the regression was first seen in the round artifact.
+The ci preset runs every previously-broken bench path (the multiscale +
+windowed-inversion scale solve included) at ~MB scale, so a bench-breaking
+change fails HERE, in tier-1, before a bench round does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py")
+
+# The ci battery's metric set (bench.py main): one record each, in order.
+CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition")
+
+
+def test_bench_ci_preset_exits_zero_with_full_battery():
+    out = subprocess.run(
+        [sys.executable, BENCH, "--preset", "ci"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, (
+        f"bench.py --preset ci exited {out.returncode}\n"
+        f"stdout:\n{out.stdout[-2000:]}\nstderr:\n{out.stderr[-2000:]}")
+    records = [json.loads(l) for l in out.stdout.splitlines()
+               if l.startswith('{"metric"')]
+    assert len(records) == len(CI_METRICS), (
+        f"expected {len(CI_METRICS)} metric records, got {len(records)}:\n"
+        + out.stdout[-2000:])
+    for rec in records:
+        # Tiny grids must never OOM-skip; every record carries a real value.
+        assert "skipped" not in rec, f"ci metric skipped: {rec}"
+        assert isinstance(rec.get("value"), (int, float)), rec
+    # The transition record carries the ISSUE 2 acceptance telemetry.
+    tr = records[-1]
+    assert tr["metric"].startswith("transition_newton")
+    assert tr["newton_rounds"] >= 1 and tr["converged"]
+    assert tr["sweep_transitions_per_sec"] > 0

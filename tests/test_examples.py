@@ -77,6 +77,15 @@ def test_sweep_scenarios_example_smoke():
 
 
 @pytest.mark.slow
+def test_mit_shock_example_smoke(tmp_path):
+    stdout = _run_example("mit_shock.py", "--outdir", str(tmp_path))
+    m = re.search(r"newton rounds = (\d+)\s+converged = True", stdout)
+    assert m and int(m.group(1)) <= 10, stdout
+    assert re.search(r"transitions/sec", stdout), stdout
+    assert (tmp_path / "mit_shock_summary.json").exists()
+
+
+@pytest.mark.slow
 def test_krusell_smith_vfi_example_smoke(tmp_path):
     stdout = _run_example("krusell_smith_vfi.py", "--outdir", str(tmp_path))
     _check_ks(stdout)

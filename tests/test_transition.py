@@ -1,0 +1,261 @@
+"""Transition-dynamics subsystem (transition/): MIT-shock perfect-foresight
+paths.
+
+The correctness anchors, in dependency order: the fake-news sequence-space
+Jacobian must BE the derivative of the path map (finite differences); the
+flat path at the stationary equilibrium must stay flat (the two stationary
+anchors and the dated EGM operator agree); Newton and damped updates must
+find the SAME equilibrium path (two different iterations, one fixed point);
+and the lockstep scenario sweep must reproduce the one-at-a-time solves
+exactly (vmap is a batching transform, not a different algorithm).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import aiyagari_tpu as at
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.transition.mit import (
+    shock_paths,
+    stationary_anchor,
+    transition_jacobian,
+)
+from aiyagari_tpu.transition.path import transition_path
+from aiyagari_tpu.utils.firm import wage_from_r
+
+GRID = 64
+T = 40
+
+CFG = at.AiyagariConfig(grid=at.GridSpecConfig(n_points=GRID))
+SHOCK = at.MITShock(param="tfp", size=0.01, rho=0.8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return AiyagariModel.from_config(CFG, jnp.float64)
+
+
+@pytest.fixture(scope="module")
+def ss(model):
+    return stationary_anchor(model)
+
+
+@pytest.fixture(scope="module")
+def jac(model, ss):
+    return transition_jacobian(model, ss, T)
+
+
+def _flat_path_eval(model, ss, horizon):
+    """Evaluate the path program at constant stationary prices."""
+    prefs = model.preferences
+    tech = model.config.technology
+    r = float(ss.r)
+    w = float(wage_from_r(r, tech.alpha, tech.delta))
+    dt = model.dtype
+    return transition_path(
+        ss.solution.policy_c, ss.mu, model.a_grid, model.s, model.P,
+        jnp.full(horizon + 1, r, dt), jnp.full(horizon, w, dt),
+        jnp.full(horizon, prefs.beta, dt), jnp.full(horizon + 1, prefs.sigma, dt),
+        jnp.full(horizon, model.amin, dt))
+
+
+class TestFlatPathIdentity:
+    def test_capital_path_constant_at_stationary_equilibrium(self, model, ss):
+        """An economy at its stationary equilibrium stays there: backward
+        sweep from C_ss at flat ss prices reproduces C_ss, forward push of
+        mu_ss reproduces K_ss, period after period."""
+        out = _flat_path_eval(model, ss, T)
+        K_ts = np.asarray(out["K_ts"])
+        K_ss = float(np.sum(np.asarray(ss.mu) * np.asarray(model.a_grid)))
+        np.testing.assert_allclose(K_ts, K_ss, rtol=1e-6)
+        # The dated policies collapse to the stationary one (the transition
+        # EGM step reduces to the stationary step at flat prices).
+        dC = np.max(np.abs(np.asarray(out["C_ts"])
+                           - np.asarray(ss.solution.policy_c)))
+        assert dC < 1e-6
+
+    def test_zero_size_shock_converges_immediately(self, model, ss):
+        res = at.solve_transition(
+            CFG, at.MITShock(param="tfp", size=0.0, rho=0.5), ss=ss,
+            transition=at.TransitionConfig(T=T, tol=1e-6, method="damped",
+                                           max_iter=5))
+        # At most one corrective round: the initial flat path's residual is
+        # the stationary anchor's own discretization-level gap.
+        assert res.converged and res.rounds <= 2
+        np.testing.assert_allclose(res.K_ts, res.K_ss, rtol=1e-5)
+
+
+class TestJacobian:
+    def test_fake_news_matches_finite_differences(self, model, ss):
+        """The fake-news J_A[t, s] = dA_t/dr_s against central differences
+        of the actual path map (w riding the firm FOC in both, as in the
+        solver's round loop) — the one backward jvp + one forward pass must
+        BE the derivative, column by column."""
+        from aiyagari_tpu.transition.jacobian import fake_news_jacobian
+
+        prefs = model.preferences
+        tech = model.config.technology
+        Tj = 16
+        r_ssv = float(ss.r)
+        w_ss = float(wage_from_r(r_ssv, tech.alpha, tech.delta))
+        w_slope = -tech.alpha / (1 - tech.alpha) * w_ss / (r_ssv + tech.delta)
+        J_A = fake_news_jacobian(
+            ss.solution.policy_c, ss.solution.policy_k, ss.mu,
+            model.a_grid, model.s, model.P, r_ss=r_ssv, w_ss=w_ss,
+            w_slope=w_slope, sigma=prefs.sigma, beta=prefs.beta,
+            amin=model.amin, T=Tj)
+
+        def A_of(r_path):
+            dt = model.dtype
+            w = wage_from_r(np.asarray(r_path), tech.alpha, tech.delta)
+            out = transition_path(
+                ss.solution.policy_c, ss.mu, model.a_grid, model.s, model.P,
+                jnp.asarray(np.concatenate([r_path, [r_ssv]]), dt),
+                jnp.asarray(w, dt), jnp.full(Tj, prefs.beta, dt),
+                jnp.full(Tj + 1, prefs.sigma, dt),
+                jnp.full(Tj, model.amin, dt))
+            return np.asarray(out["A_ts"], np.float64)
+
+        eps = 1e-6
+        base = np.full(Tj, r_ssv)
+        for s_col in (0, 5, Tj - 1):
+            hi = base.copy(); hi[s_col] += eps
+            lo = base.copy(); lo[s_col] -= eps
+            fd = (A_of(hi) - A_of(lo)) / (2 * eps)
+            np.testing.assert_allclose(
+                J_A[:, s_col], fd, atol=5e-4 * max(1.0, np.abs(fd).max()),
+                rtol=5e-4,
+                err_msg=f"fake-news column {s_col} disagrees with FD")
+
+
+class TestNewtonDampedParity:
+    def test_same_equilibrium_path(self, model, ss, jac):
+        tc = at.TransitionConfig(T=T, tol=1e-8, method="newton", max_iter=20)
+        rn = at.solve_transition(CFG, SHOCK, transition=tc, ss=ss,
+                                 jacobian=jac)
+        rd = at.solve_transition(
+            CFG, SHOCK, ss=ss,
+            transition=at.TransitionConfig(T=T, tol=1e-8, method="damped",
+                                           max_iter=300, damping=0.5))
+        assert rn.converged and rd.converged
+        # Same residual root, two iterations: paths agree far below tol.
+        np.testing.assert_allclose(rn.r_path, rd.r_path, atol=1e-8)
+        np.testing.assert_allclose(rn.K_ts, rd.K_ts, atol=1e-7)
+        # The Newton rounds are what the sequence-space Jacobian buys.
+        assert rn.rounds < rd.rounds
+        # Per-round max excess demand is reported and decreasing.
+        assert len(rn.max_excess_history) == rn.rounds
+        assert rn.max_excess_history[-1] < 1e-8
+
+    def test_expansionary_tfp_economics(self, model, ss, jac):
+        tc = at.TransitionConfig(T=T, tol=1e-8, method="newton", max_iter=20)
+        res = at.solve_transition(CFG, SHOCK, transition=tc, ss=ss,
+                                  jacobian=jac)
+        # A positive TFP shock raises the impact return and builds capital
+        # above the stationary stock before decaying back to it.
+        assert res.r_path[0] > res.r_ss
+        assert np.max(res.K_ts) > res.K_ss * (1 + 1e-5)
+        np.testing.assert_allclose(res.K_ts[-1], res.K_ss, rtol=2e-3)
+        np.testing.assert_allclose(res.r_path[-1], res.r_ss, atol=1e-4)
+
+
+class TestSweep:
+    SHOCKS = [
+        at.MITShock("tfp", 0.01, 0.8),
+        at.MITShock("beta", 0.002, 0.7),
+        at.MITShock("borrowing_limit", 0.05, 0.5),
+    ]
+
+    def test_sweep_matches_serial(self, model, ss, jac):
+        """Lockstep sweep == one-at-a-time solves: the vmapped path program
+        and the shared ss Jacobian change the batching, not the per-scenario
+        iteration."""
+        tc = at.TransitionConfig(T=T, tol=1e-8, method="newton", max_iter=20)
+        sw = at.sweep_transitions(CFG, self.SHOCKS, transition=tc, ss=ss,
+                                  jacobian=jac)
+        assert bool(np.all(sw.converged))
+        assert sw.transitions_per_sec > 0
+        for i, sh in enumerate(self.SHOCKS):
+            serial = at.solve_transition(CFG, sh, transition=tc, ss=ss,
+                                         jacobian=jac)
+            np.testing.assert_allclose(sw.r_paths[i], serial.r_path,
+                                       atol=1e-10)
+            np.testing.assert_allclose(sw.K_ts[i], serial.K_ts, atol=1e-9)
+
+    def test_sweep_sharded_over_scenarios_mesh(self, model, ss, jac):
+        """The "scenarios" mesh axis (parallel/mesh.shard_scenario_arrays)
+        changes placement, not results: 4 scenarios over the 8-virtual-
+        device test mesh reproduce the unsharded sweep."""
+        shocks = self.SHOCKS + [at.MITShock("sigma", 0.05, 0.6)]
+        tc = at.TransitionConfig(T=T, tol=1e-8, method="newton", max_iter=20)
+        plain = at.sweep_transitions(CFG, shocks, transition=tc, ss=ss,
+                                     jacobian=jac)
+        sharded = at.sweep_transitions(
+            CFG, shocks, transition=tc, ss=ss, jacobian=jac,
+            backend=at.BackendConfig(mesh_axes=("scenarios",),
+                                     mesh_shape=(4,)))
+        np.testing.assert_allclose(sharded.r_paths, plain.r_paths,
+                                   atol=1e-12)
+        np.testing.assert_allclose(sharded.K_ts, plain.K_ts, atol=1e-12)
+
+    def test_dispatch_param_grids_and_errors(self, ss, jac):
+        tc = at.TransitionConfig(T=T, tol=1e-6, method="newton", max_iter=20)
+        sw = at.sweep_transitions(CFG, params=["tfp"], sizes=[0.005, 0.01],
+                                  rhos=[0.8], transition=tc, ss=ss,
+                                  jacobian=jac)
+        assert sw.scenarios == 2 and sw.r_paths.shape == (2, T)
+        with pytest.raises(ValueError, match="not both"):
+            at.sweep_transitions(CFG, self.SHOCKS, sizes=[0.01])
+        with pytest.raises(ValueError, match="needs scenarios"):
+            at.sweep_transitions(CFG)
+
+
+class TestRoundCapConsistency:
+    def test_capped_result_is_self_consistent(self, model, ss):
+        """A max_iter-capped result must pair the RETURNED r_path with the
+        K_ts/excess measured AT it (review pin): no trailing never-evaluated
+        update."""
+        import warnings
+
+        from aiyagari_tpu.utils.firm import capital_demand
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            res = at.solve_transition(
+                CFG, SHOCK, ss=ss,
+                transition=at.TransitionConfig(T=T, tol=1e-14,
+                                               method="damped", max_iter=3))
+        assert not res.converged and res.rounds == 3
+        tech = model.config.technology
+        paths = shock_paths(model, SHOCK, T)
+        D = res.K_ts[:T] - capital_demand(res.r_path, model.labor_raw,
+                                          tech.alpha, tech.delta, paths["z"])
+        np.testing.assert_allclose(D, res.excess, atol=1e-12)
+        assert abs(np.max(np.abs(D)) - res.max_excess_history[-1]) < 1e-12
+
+
+class TestValidation:
+    def test_shock_paths_guards(self, model):
+        with pytest.raises(ValueError, match="unknown shock param"):
+            shock_paths(model, at.MITShock(param="delta"), 10)
+        with pytest.raises(ValueError, match="transitory"):
+            shock_paths(model, at.MITShock(param="tfp", rho=1.0), 10)
+        with pytest.raises(ValueError, match="TIGHTEN"):
+            shock_paths(model, at.MITShock(param="borrowing_limit",
+                                           size=-0.1), 10)
+        with pytest.raises(ValueError, match="beta shock"):
+            shock_paths(model, at.MITShock(param="beta", size=0.1), 10)
+
+    def test_solver_guards(self, ss):
+        with pytest.raises(ValueError, match="newton.*or.*damped"):
+            at.solve_transition(
+                CFG, SHOCK, ss=ss,
+                transition=at.TransitionConfig(method="broyden"))
+        with pytest.raises(NotImplementedError, match="exogenous-labor"):
+            at.solve_transition(
+                at.AiyagariConfig(endogenous_labor=True), SHOCK)
+        with pytest.raises(ValueError, match="method='egm'"):
+            stationary_anchor(
+                AiyagariModel.from_config(CFG, jnp.float64),
+                solver=at.SolverConfig(method="vfi"))
