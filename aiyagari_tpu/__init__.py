@@ -11,6 +11,7 @@ Primary entry point: solve(model_config, method=..., backend=...).
 """
 
 from aiyagari_tpu.config import (
+    AccelConfig,
     ALMConfig,
     AiyagariConfig,
     BackendConfig,
@@ -78,6 +79,7 @@ __all__ = [
     "Technology",
     "IncomeProcess",
     "GridSpecConfig",
+    "AccelConfig",
     "SolverConfig",
     "SimConfig",
     "EquilibriumConfig",

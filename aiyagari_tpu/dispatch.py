@@ -75,6 +75,14 @@ def solve(
     distribution for Aiyagari, sim/distribution.py; distribution path along
     the aggregate shocks for Krusell-Smith, sim/ks_distribution.py — jax
     backend only).
+
+    SolverConfig(accel=AccelConfig(...)) opts the hot fixed points into
+    safeguarded Anderson/SQUAREM acceleration (ops/accel.py): every EGM
+    household route and the stationary-distribution iteration inside the GE
+    closures — same fixed points and stopping rules, measured ~2.5x fewer
+    EGM sweeps and ~5x fewer distribution sweeps at default tolerances
+    (docs/USAGE.md "Fixed-point acceleration"). The Krusell-Smith ALM outer
+    loop's analogue is ALMConfig(acceleration="anderson").
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)

@@ -20,6 +20,7 @@ import numpy as np
 
 from aiyagari_tpu.config import ALMConfig, BackendConfig, KrusellSmithConfig, SolverConfig
 from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+from aiyagari_tpu.ops.accel import host_anderson_step
 from aiyagari_tpu.ops.regression import alm_regression
 from aiyagari_tpu.sim.ks_distribution import (
     distribution_capital_path,
@@ -36,31 +37,12 @@ from aiyagari_tpu.solvers.ks_vfi import solve_ks_vfi
 __all__ = ["KSResult", "solve_krusell_smith"]
 
 
-def _anderson_step(Bs: list, Gs: list, damping: float, depth: int) -> np.ndarray:
-    """Safeguarded Anderson (type-II) mixing for the 4-coefficient ALM fixed
-    point B = G(B), where one G evaluation is a full household solve +
-    cross-section simulation + regression — the quantity worth economizing.
-
-    Solves the least-squares residual combination over the last `depth`
-    differences and extrapolates; falls back to the reference's damped update
-    when history is short, the LS problem is degenerate, or the extrapolated
-    step is wild (>10x the plain residual in sup norm — G is near-affine close
-    to the fixed point, so a huge step means the history is still nonlinear).
-    """
-    B_k, G_k = Bs[-1], Gs[-1]
-    damped = damping * G_k + (1.0 - damping) * B_k
-    m = min(depth, len(Bs) - 1)
-    if m < 1:
-        return damped
-    F = [g - b for b, g in zip(Bs, Gs)]
-    dF = np.stack([F[-1] - F[-1 - i] for i in range(1, m + 1)], axis=1)   # [4, m]
-    dG = np.stack([G_k - Gs[-1 - i] for i in range(1, m + 1)], axis=1)    # [4, m]
-    gamma, *_ = np.linalg.lstsq(dF, F[-1], rcond=None)
-    B_next = G_k - dG @ gamma
-    res = float(np.max(np.abs(F[-1])))
-    if not np.all(np.isfinite(B_next)) or float(np.max(np.abs(B_next - B_k))) > 10.0 * res:
-        return damped
-    return B_next
+# The 4-coefficient ALM fixed point's safeguarded Anderson update now lives
+# in the shared acceleration layer (ops/accel.host_anderson_step) next to
+# the device-side carry transformers, so the host and device safeguard
+# semantics cannot drift apart. Same algorithm, behavior pinned by
+# tests/test_ks.py and tests/test_accel.py.
+_anderson_step = host_anderson_step
 
 
 @dataclasses.dataclass

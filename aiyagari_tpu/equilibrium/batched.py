@@ -82,6 +82,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         solver.tol, solver.max_iter, solver.howard_steps, solver.relative_tol,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
+        solver.accel,
     )
 
 
@@ -105,7 +106,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     outer round of every solve reuses the same compiled executable.
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
-     dist_tol, dist_max_iter, periods, n_agents, discard) = knobs
+     dist_tol, dist_max_iter, periods, n_agents, discard, accel) = knobs
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -146,12 +147,12 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                 sol = solve_aiyagari_egm_labor(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     psi=psi, eta=eta, tol=tol, max_iter=max_iter,
-                    relative_tol=relative_tol, grid_power=0.0)
+                    relative_tol=relative_tol, grid_power=0.0, accel=accel)
             else:
                 sol = solve_aiyagari_egm(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, relative_tol=relative_tol,
-                    grid_power=0.0)
+                    grid_power=0.0, accel=accel)
             warm_out = sol.policy_c
 
         out = {"warm": warm_out, "sol": sol,
@@ -159,7 +160,8 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                "solver_distance": sol.distance}
         if aggregation == "distribution":
             dist_sol = stationary_distribution(
-                sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter)
+                sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
+                accel=accel)
             supply = aggregate_capital(dist_sol.mu, a_grid)
             out["mu"] = dist_sol.mu
         else:

@@ -135,6 +135,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                         max_iter=solver.max_iter,
                         grid_power=float(model.config.grid.power),
                         relative_tol=solver.relative_tol,
+                        accel=solver.accel,
                     )
                 else:
                     ladder_C0 = ladder_warm_start(
@@ -143,6 +144,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                         max_iter=solver.max_iter,
                         grid_power=float(model.config.grid.power),
                         relative_tol=solver.relative_tol,
+                        accel=solver.accel,
                     )
                 C0 = ladder_C0
             if C0 is None:
@@ -154,6 +156,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     eta=prefs.eta, tol=solver.tol, max_iter=solver.max_iter,
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
+                    accel=solver.accel,
                 )
             else:
                 sol = solve_aiyagari_egm_sharded(
@@ -162,6 +165,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     max_iter=solver.max_iter,
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
+                    accel=solver.accel,
                 )
             if not bool(sol.escaped):
                 return sol
@@ -195,6 +199,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     grid_power=model.config.grid.power,
                     relative_tol=solver.relative_tol,
                     progress_every=solver.progress_every,
+                    accel=solver.accel,
                 )
             from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
@@ -204,6 +209,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 max_iter=solver.max_iter, grid_power=model.config.grid.power,
                 relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
+                accel=solver.accel,
             )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
@@ -215,6 +221,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
                 grid_power=model.config.grid.power,
+                accel=solver.accel,
             )
         return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
@@ -225,6 +232,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             # f64 resolution, pinned by TestPowerGridInversion; _safe retries
             # on the generic route if the windows escape).
             grid_power=model.config.grid.power,
+            accel=solver.accel,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 
@@ -280,10 +288,12 @@ class _DistributionAggregator:
 
     checkpoint_tag = "_dist"
 
-    def __init__(self, model: AiyagariModel, dist_tol: float, dist_max_iter: int):
+    def __init__(self, model: AiyagariModel, dist_tol: float,
+                 dist_max_iter: int, accel=None):
         self.model = model
         self.dist_tol = dist_tol
         self.dist_max_iter = dist_max_iter
+        self.accel = accel
         self.series = None
         self.mu = None
 
@@ -323,6 +333,7 @@ class _DistributionAggregator:
         dist_sol = stationary_distribution(
             policy_k, self.model.a_grid, self.model.P,
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
+            accel=self.accel,
         )
         self.mu = dist_sol.mu
         supply = float(aggregate_capital(self.mu, self.model.a_grid))
@@ -512,7 +523,9 @@ def solve_equilibrium_distribution(
     weighted stats (utils/stats.py: weighted_gini etc.) over (a_grid, mu).
     """
     return _bisect(
-        model, _DistributionAggregator(model, dist_tol, dist_max_iter),
+        model,
+        _DistributionAggregator(model, dist_tol, dist_max_iter,
+                                accel=solver.accel),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,

@@ -1,0 +1,402 @@
+"""Fixed-point acceleration as pure carry-transformers for lax.while_loop
+bodies: windowed Anderson mixing and SQUAREM extrapolation.
+
+Every hot loop in the framework is a plain first-order fixed point x <- F(x):
+the EGM policy iteration contracts at rate beta per sweep (~290 cold sweeps
+at the shipped calibration), the Young stationary distribution power-iterates
+at the chain's subdominant-eigenvalue rate (hundreds to thousands of sweeps
+at tol 1e-10), and the Krusell-Smith ALM closes with a damped host update.
+Auclert et al. (2021, PAPERS.md) identify exactly these inner fixed points as
+the dominant cost of heterogeneous-agent pipelines; this module accelerates
+them WITHOUT touching the operator F or the stopping rule, so the solution
+and its convergence semantics are unchanged.
+
+Design constraints, in order:
+
+  * The accelerators are CARRY TRANSFORMERS, not loop drivers: a loop body
+    computes its plain image gx = F(x) exactly as before (the sweep, the
+    distance, the effective tolerance), then asks `accel_step(state, x, gx)`
+    what the NEXT iterate should be. One F evaluation per loop iteration for
+    both methods, so the solvers' reported `iterations` keep counting sweeps
+    and the telemetry stays honest.
+  * Everything is traceable and batchable: fixed-size ring-buffer history
+    (no dynamic shapes), an [m, m] regularized normal-equations solve (no
+    host round trips), and `jnp.where` selection for every safeguard — the
+    same code path runs under jit, vmap (equilibrium/batched.py), and
+    shard_map (solvers/egm_sharded.py, where `axis` makes the inner products
+    and sup-norms global via psum/pmax).
+  * SAFEGUARDED by construction: whenever the extrapolated residual fails
+    to decrease — grows past `safeguard_growth` times the previous one, the
+    tolerance that separates Anderson's normal transient non-monotonicity
+    from a genuinely bad proposal — the step falls back to the plain
+    (damped) update and the history restarts; non-finite or wild
+    extrapolations (sup-norm step beyond any contraction rate's legitimate
+    res/(1-rho) jump) fall back without restarting. The first `delay`
+    calls take the plain step and record nothing: a kinked operator's early
+    trajectory (EGM's moving constraint boundary) poisons the history's
+    linear model, and burning it in is measurably cheaper than
+    extrapolating through it. `AccelState.trips` counts the fallbacks, so
+    tests can assert the safeguard actually engaged on adversarial maps.
+  * Iterates with invariants re-project: `project_simplex` (clip negatives,
+    renormalize) keeps an accelerated distribution a distribution;
+    `project_floor` keeps an accelerated consumption policy strictly
+    positive (u'(c) = c^-sigma must stay evaluable).
+
+Anderson (type II, windowed): with residuals f_i = g_i - x_i and
+differences taken against the CURRENT iterate, solve the regularized
+least-squares problem
+
+    gamma* = argmin_gamma |f_k - dF gamma|^2 + lam |gamma|^2,
+    dF[j] = f_k - f_{k-j-1},   lam = regularization * tr(dF dF') + tiny,
+
+via its [m, m] normal equations, then propose
+
+    x_next = (x_k + damping * f_k) - gamma* @ (dX + damping * dF).
+
+With damping=1 this is the classic g_k - gamma @ dG update (the same
+formula as the ALM host path, host_anderson_step). SQUAREM (Varadhan &
+Roland 2008, scheme S3) runs a two-evaluation cycle through a phase
+counter: phase 0 stashes (x0, r = F(x0) - x0) and emits the plain image;
+phase 1 forms v = (F(x1) - x1) - r, the steplength
+alpha = -max(1, sqrt(<r,r>/<v,v>)), and proposes the squared-extrapolation
+iterate x0 - 2 alpha r + alpha^2 v (alpha = -1 reproduces the plain step
+exactly, so the clamp IS the minimal-step safeguard).
+
+When to prefer which: Anderson wins when the linearized operator has
+clustered or complex spectrum and a short history can interpolate it (EGM,
+the ALM coefficients); SQUAREM's scalar steplength is cheaper per sweep,
+needs no linear algebra, and is the steadier choice for nonnegative
+power-iteration operators (the stationary distribution) where Anderson's
+signed extrapolation fights the simplex projection hardest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "AccelState",
+    "accel_init",
+    "accel_step",
+    "fixed_point_iterate",
+    "host_anderson_step",
+    "project_floor",
+    "project_simplex",
+]
+
+# Explosion guard for the device path: a CORRECT accelerated step must move
+# ~res/(1-rho) — 25x the residual at the EGM calibration's rho=0.96, 100x at
+# a distribution chain's rho=0.99 — so the trust radius has to sit far above
+# any contraction rate's legitimate jump and only catch genuinely degenerate
+# least-squares extrapolations (it composes with the residual-decrease
+# safeguard, which catches merely-bad steps one sweep later).
+_WILD_STEP_FACTOR = 1e4
+# The ALM host path's tighter trust test (near-affine 4-coefficient G whose
+# damped reference update moves slowly; pre-existing behavior, pinned).
+_HOST_WILD_STEP_FACTOR = 10.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AccelState:
+    """Acceleration carry. For Anderson, hist_x/hist_g are [m, *x.shape]
+    ring buffers of past (iterate, image) pairs; for SQUAREM they are
+    [1, *x.shape] slots holding (x0, r) of the current two-eval cycle and
+    `head` is the cycle phase. `count` is the number of valid history
+    entries, `head` the next ring write position, `prev_res` the sup-norm
+    residual observed one call earlier (inf before the first), and `trips`
+    counts safeguard fallbacks (plain-step reversions)."""
+
+    hist_x: jax.Array
+    hist_g: jax.Array
+    head: jax.Array       # int32
+    count: jax.Array      # int32
+    prev_res: jax.Array   # scalar, x.dtype
+    trips: jax.Array      # int32
+    calls: jax.Array      # int32; accel_step invocations (delay gating)
+
+
+def _validate(accel) -> None:
+    if accel.method not in ("anderson", "squarem"):
+        raise ValueError(
+            f"unknown AccelConfig.method {accel.method!r}; expected "
+            "'anderson' or 'squarem'")
+    if accel.method == "anderson" and accel.memory < 1:
+        raise ValueError(
+            f"AccelConfig.memory must be >= 1, got {accel.memory}")
+    if not 0.0 < accel.damping <= 1.0:
+        raise ValueError(
+            f"AccelConfig.damping must be in (0, 1], got {accel.damping}")
+    if accel.method == "squarem" and accel.damping != 1.0:
+        # SQUAREM's cycle algebra assumes x1 = F(x0) EXACTLY (r = x1 - x0
+        # feeds the curvature estimate); a damped phase-0 emission would
+        # silently corrupt alpha. Refuse rather than ignore the knob.
+        raise ValueError(
+            "AccelConfig.damping applies to Anderson only; SQUAREM's "
+            f"two-eval cycle is undamped by construction (got {accel.damping})")
+    if accel.regularization < 0.0:
+        raise ValueError(
+            f"AccelConfig.regularization must be >= 0, got "
+            f"{accel.regularization}")
+    if accel.delay < 0:
+        raise ValueError(f"AccelConfig.delay must be >= 0, got {accel.delay}")
+    if accel.safeguard_growth < 1.0:
+        raise ValueError(
+            f"AccelConfig.safeguard_growth must be >= 1.0, got "
+            f"{accel.safeguard_growth}")
+
+
+def accel_init(x0, accel) -> AccelState:
+    """Initial acceleration carry for an iterate shaped like x0. Static in
+    everything but x0's shape/dtype, so it traces cleanly inside jit."""
+    _validate(accel)
+    m = accel.memory if accel.method == "anderson" else 1
+    z = jnp.zeros((m,) + x0.shape, x0.dtype)
+    return AccelState(
+        hist_x=z, hist_g=z, head=jnp.int32(0), count=jnp.int32(0),
+        prev_res=jnp.array(jnp.inf, x0.dtype), trips=jnp.int32(0),
+        calls=jnp.int32(0))
+
+
+def project_simplex(x, axis=None):
+    """Re-project an (extrapolated) distribution onto the simplex: clip
+    negatives, renormalize to unit mass. `axis` names a mapped mesh axis to
+    psum the mass over when x is a shard of the full distribution."""
+    x = jnp.maximum(x, 0.0)
+    total = jnp.sum(x)
+    if axis is not None:
+        total = jax.lax.psum(total, axis)
+    return x / jnp.maximum(total, jnp.finfo(x.dtype).tiny)
+
+
+def project_floor(floor_scale: float = 1e-8):
+    """Positivity projection for consumption-like iterates: clamp at
+    floor_scale * max|x| (pmax'd over `axis` when sharded). The floor sits
+    orders of magnitude below any interior consumption level, so it never
+    moves the fixed point — it only stops a transient Anderson overshoot
+    from handing u'(c) = c^-sigma a nonpositive consumption."""
+
+    def project(x, axis=None):
+        scale = jnp.max(jnp.abs(x))
+        if axis is not None:
+            scale = jax.lax.pmax(scale, axis)
+        return jnp.maximum(x, floor_scale * scale)
+
+    return project
+
+
+def _anderson_propose(state: AccelState, xf, gf, ff, accel, psum):
+    """The windowed type-II Anderson proposal on flattened iterates.
+    Returns (x_acc, step_sup) with invalid history rows masked out; the
+    regularized normal equations make the [m, m] solve well-posed at any
+    count (count=0 gives gamma=0, i.e. the plain damped step)."""
+    m = state.hist_x.shape[0]
+    hx = state.hist_x.reshape(m, -1)
+    hg = state.hist_g.reshape(m, -1)
+    hf = hg - hx
+    # Ring validity: the `count` most recently written slots. Slot j's age
+    # is (head - 1 - j) mod m; valid iff age < count.
+    age = jnp.mod(state.head - 1 - jnp.arange(m), m)
+    valid = (age < state.count)[:, None]
+    dF = jnp.where(valid, ff[None, :] - hf, 0.0)
+    dG = jnp.where(valid, gf[None, :] - hg, 0.0)
+    dX = jnp.where(valid, xf[None, :] - hx, 0.0)
+    A = psum(dF @ dF.T)                                        # [m, m]
+    b = psum(dF @ ff)                                          # [m]
+    lam = (jnp.asarray(accel.regularization, A.dtype) * jnp.trace(A)
+           + jnp.finfo(A.dtype).tiny)
+    gamma = jnp.linalg.solve(A + lam * jnp.eye(m, dtype=A.dtype), b)
+    beta = jnp.asarray(accel.damping, xf.dtype)
+    x_acc = (xf + beta * ff) - gamma @ (dX + beta * dF)
+    return x_acc
+
+
+def _push(state: AccelState, x, gx, *, restart, write) -> AccelState:
+    """Write (x, gx) into the ring at `head`; on restart the pair becomes
+    the ONLY valid entry (the history of a different trajectory segment
+    must not leak into the next extrapolation). With write=False (the
+    burn-in delay) the ring is untouched."""
+    m = state.hist_x.shape[0]
+    hist_x = jax.lax.dynamic_update_index_in_dim(state.hist_x, x, state.head, 0)
+    hist_g = jax.lax.dynamic_update_index_in_dim(state.hist_g, gx, state.head, 0)
+    count = jnp.where(restart, jnp.int32(1),
+                      jnp.minimum(state.count + 1, jnp.int32(m)))
+    head = jnp.mod(state.head + 1, m)
+    return dataclasses.replace(
+        state,
+        hist_x=jnp.where(write, hist_x, state.hist_x),
+        hist_g=jnp.where(write, hist_g, state.hist_g),
+        head=jnp.where(write, head, state.head),
+        count=jnp.where(write, count, state.count))
+
+
+def _anderson_step(state, x, gx, accel, psum, pmax, project, axis):
+    f = gx - x
+    res = pmax(jnp.max(jnp.abs(f)))
+    xf, gf, ff = x.reshape(-1), gx.reshape(-1), f.reshape(-1)
+    beta = jnp.asarray(accel.damping, x.dtype)
+    x_plain = xf + beta * ff
+    x_acc = _anderson_propose(state, xf, gf, ff, accel, psum)
+    active = state.calls >= accel.delay     # burn-in: plain steps, no history
+
+    # Safeguards. (1) Residual fails to decrease — grows past
+    # safeguard_growth times the previous one (the PREVIOUS proposal made
+    # things genuinely worse, not just transiently non-monotone): take the
+    # plain step and restart the history. NaN residuals (the windowed
+    # inversion's deliberate escape poison, or genuine divergence) compare
+    # False here, so they also select the plain step — and the caller's
+    # while_loop exits on the NaN distance exactly as for the unaccelerated
+    # solver. (2) Wild/non-finite extrapolation: plain step without a
+    # restart (the history is fine; this proposal was not).
+    growth = jnp.asarray(accel.safeguard_growth, res.dtype)
+    decreased = res < growth * state.prev_res
+    restart = ~decreased & (state.count > 0)
+    step_sup = pmax(jnp.max(jnp.abs(x_acc - xf)))
+    sane = jnp.isfinite(step_sup) & (step_sup <= _WILD_STEP_FACTOR * res)
+    use_acc = active & decreased & sane & (state.count > 0)
+    x_next = jnp.where(use_acc, x_acc, x_plain).reshape(x.shape)
+    if project is not None:
+        x_next = project(x_next, axis=axis)
+
+    tripped = active & (state.count > 0) & ~use_acc
+    state = _push(state, x, gx, restart=restart, write=active)
+    return x_next, dataclasses.replace(
+        state, prev_res=res, trips=state.trips + tripped.astype(jnp.int32),
+        calls=state.calls + 1)
+
+
+def _squarem_step(state, x, gx, accel, psum, pmax, project, axis):
+    f = gx - x
+    res = pmax(jnp.max(jnp.abs(f)))
+    active = state.calls >= accel.delay     # burn-in: plain steps, no cycles
+    phase1 = state.head > 0       # head doubles as the cycle phase
+    x0 = state.hist_x[0]
+    r = state.hist_g[0]
+    v = f - r
+    rr = psum(jnp.sum(r * r))
+    vv = psum(jnp.sum(v * v))
+    tiny = jnp.finfo(x.dtype).tiny
+    alpha = -jnp.sqrt(rr / jnp.maximum(vv, tiny))
+    alpha = jnp.minimum(alpha, jnp.asarray(-1.0, x.dtype))
+    x_sq = (x0 - 2.0 * alpha * r + alpha * alpha * v).reshape(x.shape)
+
+    # Phase-1 safeguards mirror the Anderson ones: the residual at x1 must
+    # not have grown past safeguard_growth times the previous cycle's, the
+    # extrapolation must be finite, and a degenerate curvature (vv ~ 0: F
+    # is locally affine with slope ~1, nothing to square) falls back to the
+    # plain image.
+    growth = jnp.asarray(accel.safeguard_growth, res.dtype)
+    decreased = res < growth * state.prev_res
+    step_sup = pmax(jnp.max(jnp.abs(x_sq - x)))
+    sane = (jnp.isfinite(step_sup) & (vv > tiny)
+            & (step_sup <= _WILD_STEP_FACTOR * jnp.maximum(res, tiny)))
+    extrapolate = phase1 & decreased & sane
+    x_next = jnp.where(extrapolate, x_sq, gx)
+    if project is not None:
+        x_next = project(x_next, axis=axis)
+
+    tripped = phase1 & ~extrapolate
+    # Phase 0 stashes this cycle's anchor (x0 = x, r = f); phase 1 clears
+    # it. prev_res only updates when a cycle completes, so the comparison
+    # is cycle-over-cycle, not the sawtooth within one.
+    stash = lambda buf, val: jnp.where(phase1 | ~active, jnp.zeros_like(buf),
+                                       val[None].astype(buf.dtype))
+    return x_next, dataclasses.replace(
+        state,
+        hist_x=stash(state.hist_x, x),
+        hist_g=stash(state.hist_g, f),
+        head=jnp.where(phase1 | ~active, jnp.int32(0), jnp.int32(1)),
+        count=state.count,
+        prev_res=jnp.where(phase1, res, state.prev_res),
+        trips=state.trips + tripped.astype(jnp.int32),
+        calls=state.calls + 1)
+
+
+def accel_step(state: AccelState, x, gx, *, accel, axis=None, project=None):
+    """One acceleration update: given the current iterate x and its plain
+    fixed-point image gx = F(x), return (x_next, new_state) where x_next is
+    the iterate the loop should carry forward.
+
+    Pure and shape-stable: composes inside lax.while_loop bodies, under
+    vmap, and under shard_map (pass `axis` so the least-squares inner
+    products psum and the safeguard sup-norms pmax over the mapped axis —
+    every device then computes the identical extrapolation). `project`
+    re-imposes an invariant on the proposed iterate (project_simplex for
+    distributions, project_floor for consumption policies); it is applied
+    to plain fallback steps too, where it is a no-op by construction.
+
+    The caller's stopping rule is untouched: it keeps measuring
+    dist = |gx - x|, the genuine fixed-point residual at the carried
+    iterate, so an accelerated solve that stops at dist < tol satisfies
+    exactly the same convergence certificate as the plain one.
+    """
+    _validate(accel)
+    psum = (lambda t: jax.lax.psum(t, axis)) if axis is not None else (lambda t: t)
+    pmax = (lambda t: jax.lax.pmax(t, axis)) if axis is not None else (lambda t: t)
+    if accel.method == "anderson":
+        return _anderson_step(state, x, gx, accel, psum, pmax, project, axis)
+    return _squarem_step(state, x, gx, accel, psum, pmax, project, axis)
+
+
+def fixed_point_iterate(step, x0, *, accel=None, tol, max_iter, project=None):
+    """Small generic driver: iterate x <- step(x) to a sup-norm fixed point
+    under optional acceleration, returning (x, iterations, distance, state).
+
+    This is the reference composition of accel_init/accel_step with a
+    lax.while_loop (the pattern the EGM and distribution solvers inline),
+    used by tests and available for new loops. `step` must be traceable.
+    """
+    st0 = accel_init(x0, accel) if accel is not None else None
+
+    def cond(carry):
+        _, dist, it, _ = carry
+        return (dist >= tol) & (it < max_iter)
+
+    def body(carry):
+        x, _, it, st = carry
+        gx = step(x)
+        dist = jnp.max(jnp.abs(gx - x))
+        if accel is None:
+            x_next = gx if project is None else project(gx)
+            return x_next, dist, it + 1, st
+        x_next, st = accel_step(st, x, gx, accel=accel, project=project)
+        return x_next, dist, it + 1, st
+
+    x, dist, it, st = jax.lax.while_loop(
+        cond, body, (x0, jnp.array(jnp.inf, x0.dtype), jnp.int32(0), st0))
+    return x, it, dist, st
+
+
+def host_anderson_step(Bs: list, Gs: list, damping: float, depth: int) -> np.ndarray:
+    """Safeguarded Anderson (type-II) mixing on HOST for small fixed points
+    whose map evaluation is a whole device pipeline — the Krusell-Smith ALM
+    coefficients B = G(B), where one G is a household solve + cross-section
+    simulation + regression (equilibrium/alm.py).
+
+    Solves the least-squares residual combination over the last `depth`
+    differences and extrapolates; falls back to the reference's damped update
+    when history is short, the LS problem is degenerate, or the extrapolated
+    step is wild (>10x the plain residual in sup norm — G is near-affine close
+    to the fixed point, so a huge step means the history is still nonlinear).
+    The same trust test as the device path's accel_step; NumPy lstsq instead
+    of regularized normal equations because a 4-coefficient host problem has
+    no conditioning or tracing constraints to design around.
+    """
+    B_k, G_k = Bs[-1], Gs[-1]
+    damped = damping * G_k + (1.0 - damping) * B_k
+    m = min(depth, len(Bs) - 1)
+    if m < 1:
+        return damped
+    F = [g - b for b, g in zip(Bs, Gs)]
+    dF = np.stack([F[-1] - F[-1 - i] for i in range(1, m + 1)], axis=1)   # [4, m]
+    dG = np.stack([G_k - Gs[-1 - i] for i in range(1, m + 1)], axis=1)    # [4, m]
+    gamma, *_ = np.linalg.lstsq(dF, F[-1], rcond=None)
+    B_next = G_k - dG @ gamma
+    res = float(np.max(np.abs(F[-1])))
+    if not np.all(np.isfinite(B_next)) or float(np.max(np.abs(B_next - B_k))) > _HOST_WILD_STEP_FACTOR * res:
+        return damped
+    return B_next

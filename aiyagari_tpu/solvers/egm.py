@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
 from aiyagari_tpu.ops.interp import prolong_power_grid
 from aiyagari_tpu.solvers._stopping import effective_tolerance
@@ -120,12 +121,12 @@ class EGMSolution:
     tol_effective: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(0.0))
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
-                       use_pallas: bool = False) -> EGMSolution:
+                       use_pallas: bool = False, accel=None) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
@@ -147,16 +148,27 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     orders of magnitude below it. No-op in f64 at any sane setting
     (eps ~ 2e-16) and at the reference's 400-point scale (the strict tol is
     reached before the band matters). The applied tolerance is returned as
-    EGMSolution.tol_effective; convergence checks must use it."""
+    EGMSolution.tol_effective; convergence checks must use it.
+
+    accel (an AccelConfig, static) opts into safeguarded Anderson/SQUAREM
+    acceleration of the fixed point (ops/accel.py): the loop body still runs
+    exactly one egm_step per iteration and stops on the same
+    dist = max|F(C) - C| criterion, but the NEXT iterate is the accelerated
+    proposal. The returned policies are always the SWEEP's output (the
+    image, with its budget-consistent policy_k), never the extrapolated
+    point — so the solution satisfies the stopping certificate identically
+    to the plain route."""
 
     tol_c = jnp.asarray(tol, C_init.dtype)
+    ast0 = accel_init(C_init, accel) if accel is not None else None
+    proj = project_floor()
 
     def cond(carry):
-        _, _, dist, it, _, tol_eff = carry
+        _, _, _, dist, it, _, tol_eff, _ = carry
         return (dist >= tol_eff) & (it < max_iter)
 
     def body(carry):
-        C, _, _, it, esc, _ = carry
+        C, _, _, _, it, esc, _, ast = carry
         C_new, policy_k, esc_new = egm_step(C, a_grid, s, P, r, w, amin,
                                             sigma=sigma, beta=beta,
                                             grid_power=grid_power,
@@ -168,11 +180,16 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
             tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
             relative_tol=relative_tol, dtype=C_init.dtype)
         device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
-        return C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff
+        if accel is None:
+            C_next = C_new
+        else:
+            C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
+        return C_next, C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff, ast
 
-    init = (C_init, jnp.zeros_like(C_init), jnp.array(jnp.inf, C_init.dtype),
-            jnp.int32(0), jnp.array(False), tol_c)
-    C, policy_k, dist, it, esc, tol_eff = jax.lax.while_loop(cond, body, init)
+    init = (C_init, C_init, jnp.zeros_like(C_init),
+            jnp.array(jnp.inf, C_init.dtype), jnp.int32(0), jnp.array(False),
+            tol_c, ast0)
+    _, C, policy_k, dist, it, esc, tol_eff, _ = jax.lax.while_loop(cond, body, init)
     return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff)
 
 
@@ -181,7 +198,7 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             relative_tol: bool = False, progress_every: int = 0,
                             grid_power: float = 0.0,
                             noise_floor_ulp: float = 0.0,
-                            use_pallas: bool = False) -> EGMSolution:
+                            use_pallas: bool = False, accel=None) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
@@ -199,41 +216,47 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              progress_every=progress_every,
                              grid_power=grid_power,
                              noise_floor_ulp=noise_floor_ulp,
-                             use_pallas=use_pallas)
+                             use_pallas=use_pallas, accel=accel)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
                                  relative_tol=relative_tol,
                                  progress_every=progress_every,
                                  grid_power=0.0,
-                                 noise_floor_ulp=noise_floor_ulp)
+                                 noise_floor_ulp=noise_floor_ulp, accel=accel)
     return sol
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                              psi, eta, tol: float, max_iter: int,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              grid_power: float = 0.0,
-                             noise_floor_ulp: float = 0.0) -> EGMSolution:
+                             noise_floor_ulp: float = 0.0,
+                             accel=None) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107). grid_power > 0 routes the
     consumption re-interpolation through the windowed value-interpolation
-    fast path; noise_floor_ulp is the f32 stopping-rule floor — both exactly
-    as in solve_aiyagari_egm (see its docstring)."""
+    fast path; noise_floor_ulp is the f32 stopping-rule floor; accel opts
+    into safeguarded fixed-point acceleration of the consumption iterate —
+    all exactly as in solve_aiyagari_egm (see its docstring). Only C is
+    accelerated: the labor/asset policies are closed-form per sweep, so
+    they stay consistent with the returned (sweep-output) C."""
     # Loop-invariant: the constrained-region static solution depends on
     # prices and the grid only, not the consumption iterate.
     c_con = constrained_consumption_labor(
         a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
     )
     tol_c = jnp.asarray(tol, C_init.dtype)
+    ast0 = accel_init(C_init, accel) if accel is not None else None
+    proj = project_floor()
 
     def cond(carry):
-        return (carry[3] >= carry[6]) & (carry[4] < max_iter)
+        return (carry[4] >= carry[7]) & (carry[5] < max_iter)
 
     def body(carry):
-        C, _, _, _, it, esc, _ = carry
+        C, _, _, _, _, it, esc, _, ast = carry
         C_new, policy_k, policy_l, esc_new = egm_step_labor(
             C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta,
             c_constrained=c_con, grid_power=grid_power, with_escape=True,
@@ -244,12 +267,18 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
             tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
             relative_tol=relative_tol, dtype=C_init.dtype)
         device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
-        return C_new, policy_k, policy_l, dist, it + 1, esc | esc_new, tol_eff
+        if accel is None:
+            C_next = C_new
+        else:
+            C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
+        return (C_next, C_new, policy_k, policy_l, dist, it + 1,
+                esc | esc_new, tol_eff, ast)
 
     z = jnp.zeros_like(C_init)
-    init = (C_init, z, z, jnp.array(jnp.inf, C_init.dtype), jnp.int32(0),
-            jnp.array(False), tol_c)
-    C, policy_k, policy_l, dist, it, esc, tol_eff = jax.lax.while_loop(cond, body, init)
+    init = (C_init, C_init, z, z, jnp.array(jnp.inf, C_init.dtype),
+            jnp.int32(0), jnp.array(False), tol_c, ast0)
+    _, C, policy_k, policy_l, dist, it, esc, tol_eff, _ = jax.lax.while_loop(
+        cond, body, init)
     return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff)
 
 
@@ -259,7 +288,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                   relative_tol: bool = False,
                                   progress_every: int = 0,
                                   grid_power: float = 0.0,
-                                  noise_floor_ulp: float = 0.0) -> EGMSolution:
+                                  noise_floor_ulp: float = 0.0,
+                                  accel=None) -> EGMSolution:
     """Host-level escape retry for the labor family (the exact analogue of
     solve_aiyagari_egm_safe: re-solve on the generic route only when the
     windowed fast path actually escaped)."""
@@ -269,7 +299,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                    relative_tol=relative_tol,
                                    progress_every=progress_every,
                                    grid_power=grid_power,
-                                   noise_floor_ulp=noise_floor_ulp)
+                                   noise_floor_ulp=noise_floor_ulp,
+                                   accel=accel)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
                                        sigma=sigma, beta=beta, psi=psi, eta=eta,
@@ -277,7 +308,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                        relative_tol=relative_tol,
                                        progress_every=progress_every,
                                        grid_power=0.0,
-                                       noise_floor_ulp=noise_floor_ulp)
+                                       noise_floor_ulp=noise_floor_ulp,
+                                       accel=accel)
     return sol
 
 
@@ -310,12 +342,12 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
 @partial(jax.jit, static_argnames=("sizes", "lo", "hi", "sigma", "beta",
                                    "tol", "max_iter", "relative_tol",
                                    "progress_every", "grid_power",
-                                   "noise_floor_ulp", "use_pallas"))
+                                   "noise_floor_ulp", "use_pallas", "accel"))
 def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                       hi: float, sigma: float, beta: float, tol: float,
                       max_iter: int, relative_tol: bool, progress_every: int,
                       grid_power: float, noise_floor_ulp: float,
-                      use_pallas: bool) -> EGMSolution:
+                      use_pallas: bool, accel=None) -> EGMSolution:
     """The whole fast-path stage ladder traced as ONE device program:
     stage solve -> prolong -> next stage, unrolled over the static `sizes`
     tuple. Why one program: each separately-jitted stage costs a ~100 ms
@@ -346,7 +378,7 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  progress_every=progress_every,
                                  grid_power=grid_power,
                                  noise_floor_ulp=noise_floor_ulp,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, accel=accel)
         esc = esc | sol.escaped
     return dataclasses.replace(sol, escaped=esc)
 
@@ -376,7 +408,7 @@ def _penultimate_warm_start(a_grid, grid_power: float, solve_coarse):
 
 def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                       tol: float, max_iter: int, grid_power: float,
-                      relative_tol: bool = False):
+                      relative_tol: bool = False, accel=None):
     """Converge the multiscale ladder's PENULTIMATE stage and prolong its
     consumption policy to the full grid — the warm start the mesh route
     feeds solve_aiyagari_egm_sharded, so the sharded fine solve runs a warm
@@ -388,13 +420,13 @@ def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
         lambda coarse: solve_aiyagari_egm_multiscale(
             coarse, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
             max_iter=max_iter, grid_power=grid_power,
-            relative_tol=relative_tol))
+            relative_tol=relative_tol, accel=accel))
 
 
 def ladder_warm_start_labor(a_grid, s, P, r, w, amin, *, sigma: float,
                             beta: float, psi: float, eta: float, tol: float,
                             max_iter: int, grid_power: float,
-                            relative_tol: bool = False):
+                            relative_tol: bool = False, accel=None):
     """ladder_warm_start for the endogenous-labor family: the penultimate
     stage runs the labor multiscale ladder and only the consumption policy
     is prolonged (the labor/asset policies are closed-form per sweep,
@@ -405,7 +437,7 @@ def ladder_warm_start_labor(a_grid, s, P, r, w, amin, *, sigma: float,
         lambda coarse: solve_aiyagari_egm_labor_multiscale(
             coarse, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi,
             eta=eta, tol=tol, max_iter=max_iter, grid_power=grid_power,
-            relative_tol=relative_tol))
+            relative_tol=relative_tol, accel=accel))
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -416,7 +448,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   relative_tol: bool = False,
                                   progress_every: int = 0,
                                   noise_floor_ulp: float = 0.0,
-                                  use_pallas: bool = False) -> EGMSolution:
+                                  use_pallas: bool = False,
+                                  accel=None) -> EGMSolution:
     """Grid-sequenced EGM: solve on a coarse grid first, prolong the
     consumption policy to each finer grid, and re-converge there.
 
@@ -463,7 +496,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                             progress_every=progress_every,
                             grid_power=grid_power,
                             noise_floor_ulp=noise_floor_ulp,
-                            use_pallas=use_pallas)
+                            use_pallas=use_pallas, accel=accel)
     sol = _fetch_scalars(sol)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
@@ -475,7 +508,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                 C, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
                 max_iter=max_iter, relative_tol=relative_tol,
                 progress_every=progress_every, grid_power=0.0,
-                noise_floor_ulp=noise_floor_ulp))
+                noise_floor_ulp=noise_floor_ulp, accel=accel))
     return sol
 
 
@@ -487,7 +520,8 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                                         refine_factor: int = LADDER_REFINE,
                                         relative_tol: bool = False,
                                         progress_every: int = 0,
-                                        noise_floor_ulp: float = 0.0) -> EGMSolution:
+                                        noise_floor_ulp: float = 0.0,
+                                        accel=None) -> EGMSolution:
     """Grid-sequenced EGM for the endogenous-labor family — the same nested
     iteration as solve_aiyagari_egm_multiscale (see its docstring for the
     rationale and escape handling). Only the consumption policy C is
@@ -516,7 +550,7 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                 eta=eta, tol=tol, max_iter=max_iter,
                 relative_tol=relative_tol, progress_every=progress_every,
                 grid_power=grid_power if fast else 0.0,
-                noise_floor_ulp=noise_floor_ulp))
+                noise_floor_ulp=noise_floor_ulp, accel=accel))
 
     sol = run_ladder(fast=True)
     if bool(sol.escaped):

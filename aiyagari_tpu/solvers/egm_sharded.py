@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
+from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.ops.egm import constrained_consumption_labor
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
@@ -70,9 +71,17 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                                noise_floor_ulp: float = 0.0,
                                capacity: float = DEFAULT_CAPACITY,
                                pad: int = 8,
-                               axis: str = "grid") -> EGMSolution:
+                               axis: str = "grid",
+                               accel=None) -> EGMSolution:
     """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
     knots resident per device (module docstring).
+
+    accel opts into safeguarded fixed-point acceleration exactly as in the
+    single-device solver; the acceleration's least-squares inner products
+    psum over mesh[axis] and its safeguard sup-norms pmax, so every device
+    computes the identical extrapolation coefficients and the accelerated
+    sharded trajectory matches the single-device accelerated one up to the
+    same matmul-reassociation bound as the plain route.
 
     Same stopping rule, escape contract, and trajectory as the single-device
     windowed fast path (solvers/egm.solve_aiyagari_egm with grid_power>0):
@@ -115,7 +124,7 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
     run = _egm_program(mesh, axis, N, na, lo, hi, float(grid_power),
                        float(capacity), int(pad), float(sigma), float(beta),
                        float(tol), int(max_iter), bool(relative_tol),
-                       float(noise_floor_ulp), jnp.dtype(dtype).name)
+                       float(noise_floor_ulp), jnp.dtype(dtype).name, accel)
     C, policy_k, dist, it, esc, tol_eff = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
@@ -127,13 +136,14 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
-                 noise_floor_ulp: float, dtype_name: str):
+                 noise_floor_ulp: float, dtype_name: str, accel=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
     tol_c = jnp.asarray(tol, dtype)
     neg = jnp.array(-jnp.inf, dtype)
+    proj = project_floor()
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
@@ -167,11 +177,11 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                 return C_new, policy_k, esc
 
             def cond(carry):
-                _, _, dist, it, _, tol_eff = carry
+                _, _, _, dist, it, _, tol_eff, _ = carry
                 return (dist >= tol_eff) & (it < max_iter)
 
             def body(carry):
-                C, _, _, it, esc, _ = carry
+                C, _, _, _, it, esc, _, ast = carry
                 C_new, policy_k, esc_new = sweep(C)
                 diff = jnp.abs(C_new - C)
                 # Same criterion family as solve_aiyagari_egm: relative
@@ -184,11 +194,21 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
                     noise_floor_ulp=noise_floor_ulp,
                     relative_tol=relative_tol, dtype=dtype)
-                return C_new, policy_k, dist, it + 1, esc | (esc_new > 0), tol_eff
+                if accel is None:
+                    C_next = C_new
+                else:
+                    # Global extrapolation on local shards: inner products
+                    # psum, safeguard norms pmax (accel_step's axis hook).
+                    C_next, ast = accel_step(ast, C, C_new, accel=accel,
+                                             axis=axis, project=proj)
+                return (C_next, C_new, policy_k, dist, it + 1,
+                        esc | (esc_new > 0), tol_eff, ast)
 
-            init = (C0, jnp.zeros_like(C0), jnp.array(jnp.inf, dtype),
-                    jnp.int32(0), jnp.array(False), tol_c)
-            return jax.lax.while_loop(cond, body, init)
+            ast0 = accel_init(C0, accel) if accel is not None else None
+            init = (C0, C0, jnp.zeros_like(C0), jnp.array(jnp.inf, dtype),
+                    jnp.int32(0), jnp.array(False), tol_c, ast0)
+            out = jax.lax.while_loop(cond, body, init)
+            return out[1:7]
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
@@ -199,7 +219,7 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, tol, max_iter,
                                           relative_tol, noise_floor_ulp,
-                                          dtype_name)
+                                          dtype_name, accel)
     return cached_program(_EGM_PROGRAMS, key, build)
 
 
@@ -214,7 +234,8 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                                      noise_floor_ulp: float = 0.0,
                                      capacity: float = DEFAULT_CAPACITY,
                                      pad: int = 8,
-                                     axis: str = "grid") -> EGMSolution:
+                                     axis: str = "grid",
+                                     accel=None) -> EGMSolution:
     """solve_aiyagari_egm_labor with the grid axis sharded over mesh[axis]
     and the endogenous (knot, consumption) pairs resident per device — the
     labor-family form of solve_aiyagari_egm_sharded, generalizing the ring
@@ -261,7 +282,8 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                              float(capacity), int(pad), float(sigma),
                              float(beta), float(psi), float(eta), float(tol),
                              int(max_iter), bool(relative_tol),
-                             float(noise_floor_ulp), jnp.dtype(dtype).name)
+                             float(noise_floor_ulp), jnp.dtype(dtype).name,
+                             accel)
     C, policy_k, policy_l, dist, it, esc, tol_eff = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
@@ -274,13 +296,14 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                        power: float, capacity: float, pad: int, sigma: float,
                        beta: float, psi: float, eta: float, tol: float,
                        max_iter: int, relative_tol: bool,
-                       noise_floor_ulp: float, dtype_name: str):
+                       noise_floor_ulp: float, dtype_name: str, accel=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     dtype = jnp.dtype(dtype_name)
     span = hi - lo
     tol_c = jnp.asarray(tol, dtype)
     neg = jnp.array(-jnp.inf, dtype)
+    proj = project_floor()
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
@@ -339,11 +362,11 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                 return g_c, policy_k, policy_l, esc
 
             def cond(carry):
-                _, _, _, dist, it, _, tol_eff = carry
+                _, _, _, _, dist, it, _, tol_eff, _ = carry
                 return (dist >= tol_eff) & (it < max_iter)
 
             def body(carry):
-                C, _, _, _, it, esc, _ = carry
+                C, _, _, _, _, it, esc, _, ast = carry
                 C_new, policy_k, policy_l, esc_new = sweep(C)
                 diff = jnp.abs(C_new - C)
                 local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
@@ -353,13 +376,20 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
                     noise_floor_ulp=noise_floor_ulp,
                     relative_tol=relative_tol, dtype=dtype)
-                return (C_new, policy_k, policy_l, dist, it + 1,
-                        esc | (esc_new > 0), tol_eff)
+                if accel is None:
+                    C_next = C_new
+                else:
+                    C_next, ast = accel_step(ast, C, C_new, accel=accel,
+                                             axis=axis, project=proj)
+                return (C_next, C_new, policy_k, policy_l, dist, it + 1,
+                        esc | (esc_new > 0), tol_eff, ast)
 
             z = jnp.zeros_like(C0)
-            init = (C0, z, z, jnp.array(jnp.inf, dtype), jnp.int32(0),
-                    jnp.array(False), tol_c)
-            return jax.lax.while_loop(cond, body, init)
+            ast0 = accel_init(C0, accel) if accel is not None else None
+            init = (C0, C0, z, z, jnp.array(jnp.inf, dtype), jnp.int32(0),
+                    jnp.array(False), tol_c, ast0)
+            out = jax.lax.while_loop(cond, body, init)
+            return out[1:8]
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
@@ -371,5 +401,5 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, psi, eta, tol,
                                           max_iter, relative_tol,
-                                          noise_floor_ulp, dtype_name)
+                                          noise_floor_ulp, dtype_name, accel)
     return cached_program(_EGM_LABOR_PROGRAMS, key, build)

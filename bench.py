@@ -396,7 +396,8 @@ def _size_scale_grid(grid_scale: int, platform: str, itemsize: int) -> tuple[int
 
 def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
                 noise_floor_ulp: float | None = None,
-                pallas_inversion: bool = False) -> dict:
+                pallas_inversion: bool = False,
+                accel: bool = False) -> dict:
     """The BASELINE.json north star: a 1000x-finer asset grid than the
     reference's 400 points at equal wall-clock. Solves the household problem
     on `grid_scale` points with an O(na)-per-sweep solver — the
@@ -429,7 +430,13 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
     if scale_solver == "egm":
         # Grid-sequenced: coarse-grid stages cost microseconds and leave the
         # final grid only ~10 sweeps from its fixed point (vs ~290 cold).
+        # --accel additionally runs every ladder stage under safeguarded
+        # Anderson mixing (ops/accel.py, shipped defaults) — same fixed
+        # point, fewer sweeps per stage.
+        from aiyagari_tpu.config import AccelConfig
         from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
+
+        accel_cfg = AccelConfig() if accel else None
 
         def run():
             return solve_aiyagari_egm_multiscale(
@@ -439,6 +446,7 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
                 grid_power=model.config.grid.power,
                 noise_floor_ulp=noise_floor_ulp,
                 use_pallas=pallas_inversion,
+                accel=accel_cfg,
             )
     else:
         out = _bench_scale_vfi(model, grid_scale, quick, r, w, tol, max_iter,
@@ -546,6 +554,8 @@ def bench_scale(grid_scale: int, quick: bool, scale_solver: str = "vfi",
         "unit": "seconds",
         "vs_baseline": round(t_np / t_scale, 2),
         "baseline_seconds": round(t_np, 4),
+        "accel": bool(accel),
+        "final_stage_sweeps": sweeps,
         **den,
         **strict,
         **util,
@@ -898,6 +908,108 @@ def bench_transition(quick: bool, grid_size: int = 200, T: int = 150) -> dict:
     }
 
 
+def bench_accel(quick: bool, grid_size: int = 400) -> dict:
+    """Fixed-point acceleration telemetry (ISSUE 3): the same cold EGM
+    household solve and Young stationary-distribution solve run PLAIN and
+    ACCELERATED (safeguarded Anderson carry transformers, ops/accel.py),
+    reporting per-solve ITERATION COUNTS next to the walls so the speedup is
+    measured, not asserted. value = accelerated EGM+distribution wall;
+    vs_baseline = plain wall / accelerated wall. The structural claim is the
+    sweep-count pair — >=2x fewer EGM sweeps and >=3x fewer distribution
+    sweeps at the default tolerances — which tests/test_bench_ci.py asserts
+    (accelerated <= plain) on the tiny-grid ci battery, so acceleration
+    regressions fail tier-1 rather than silently rotting."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import AccelConfig
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.sim.distribution import stationary_distribution
+    from aiyagari_tpu.solvers.egm import (
+        initial_consumption_guess,
+        solve_aiyagari_egm,
+    )
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    if quick:
+        grid_size = min(grid_size, 100)
+    r, tol, max_iter = 0.04, 1e-5, 2000
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    w = float(wage_from_r(r, model.config.technology.alpha,
+                          model.config.technology.delta))
+    C0 = initial_consumption_guess(model.a_grid, model.s, r, w)
+    accel = AccelConfig()          # anderson, the shipped default knobs
+    kw = dict(sigma=model.preferences.sigma, beta=model.preferences.beta,
+              tol=tol, max_iter=max_iter)
+
+    def egm_run(acc):
+        return solve_aiyagari_egm(C0, model.a_grid, model.s, model.P, r, w,
+                                  model.amin, accel=acc, **kw)
+
+    def timed(fn):
+        sol = fn()
+        float(sol.distance)            # compile + converge warmup, fenced
+        best = np.inf
+        for _ in range(1 if quick else 3):
+            t0 = time.perf_counter()
+            sol = fn()
+            float(sol.distance)        # scalar transfer = timing fence
+            best = min(best, time.perf_counter() - t0)
+        return sol, best
+
+    egm_plain, t_egm_plain = timed(lambda: egm_run(None))
+    egm_accel, t_egm_accel = timed(lambda: egm_run(accel))
+    assert float(egm_plain.distance) < tol and float(egm_accel.distance) < tol
+
+    # Distribution tolerance is dtype-aware: 1e-10 sits AT the f32 sweep's
+    # roundoff floor (eps * |mu| ~ 1e-10 at mu ~ 1e-3), where the power
+    # iteration can plateau without crossing it — on the TPU f32 route the
+    # comparison runs at 1e-7, well above the noise band, and the ratio
+    # claim is unchanged (sweep counts scale with log(tol)/log(rate) for
+    # both routes alike).
+    dist_tol = 1e-10 if jnp.finfo(dtype).eps < 1e-10 else 1e-7
+
+    def dist_run(acc):
+        return stationary_distribution(egm_plain.policy_k, model.a_grid,
+                                       model.P, tol=dist_tol,
+                                       max_iter=20_000, accel=acc)
+
+    dist_plain, t_dist_plain = timed(lambda: dist_run(None))
+    dist_accel, t_dist_accel = timed(lambda: dist_run(accel))
+    # BOTH routes must actually converge — a max_iter'd plain baseline
+    # would silently inflate dist_sweep_ratio instead of failing loudly.
+    assert float(dist_plain.distance) < dist_tol, "plain distribution failed"
+    assert float(dist_accel.distance) < dist_tol, "accelerated distribution failed"
+
+    t_plain = t_egm_plain + t_dist_plain
+    t_accel = t_egm_accel + t_dist_accel
+    ep, ea = int(egm_plain.iterations), int(egm_accel.iterations)
+    dp, da = int(dist_plain.iterations), int(dist_accel.iterations)
+    return {
+        "metric": f"accel_fixed_point_grid{grid_size}",
+        "value": round(t_accel, 4),
+        "unit": "seconds",
+        "vs_baseline": round(t_plain / t_accel, 2),
+        "baseline_seconds": round(t_plain, 4),
+        "baseline_source": "plain first-order iteration, same solves (in-process)",
+        "accel_method": accel.method,
+        "accel_memory": accel.memory,
+        "accel_delay": accel.delay,
+        "egm_sweeps_plain": ep,
+        "egm_sweeps_accel": ea,
+        "egm_sweep_ratio": round(ep / max(ea, 1), 2),
+        "egm_seconds_plain": round(t_egm_plain, 4),
+        "egm_seconds_accel": round(t_egm_accel, 4),
+        "dist_sweeps_plain": dp,
+        "dist_sweeps_accel": da,
+        "dist_sweep_ratio": round(dp / max(da, 1), 2),
+        "dist_seconds_plain": round(t_dist_plain, 4),
+        "dist_seconds_accel": round(t_dist_accel, 4),
+    }
+
+
 def _ks_panel_throughput(T: int, pop: int, *, reps: int, outer: int) -> dict:
     """One K-S panel throughput measurement at (T, pop): chain `reps` full
     panel simulations inside ONE jitted program — each repetition's initial
@@ -1243,7 +1355,7 @@ def main() -> int:
     ap.add_argument("--metric",
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
                              "scale", "scale_vfi", "ge", "sweep",
-                             "transition"],
+                             "transition", "accel"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -1268,6 +1380,10 @@ def main() -> int:
     ap.add_argument("--pallas-inversion", action="store_true",
                     help="route the scale metric's EGM grid inversion through "
                          "the fused Pallas kernel (ops/pallas_inverse.py)")
+    ap.add_argument("--accel", action="store_true",
+                    help="run the scale metric's EGM ladder stages under "
+                         "safeguarded Anderson mixing (ops/accel.py, shipped "
+                         "defaults); EGM scale solver only")
     ap.add_argument("--refresh-baseline", action="store_true",
                     help="re-measure the NumPy VFI-400 denominator (7 runs, "
                          "median + spread + machine fingerprint) and freeze it "
@@ -1342,12 +1458,14 @@ def main() -> int:
         "ks_large": lambda: bench_ks_agents_large(args.quick),
         "ks_fine": lambda: bench_ks_fine(args.quick),
         "scale": lambda: bench_scale(args.grid_scale, args.quick, args.scale_solver,
-                                     args.noise_floor_ulp, args.pallas_inversion),
+                                     args.noise_floor_ulp, args.pallas_inversion,
+                                     args.accel),
         "scale_vfi": lambda: bench_scale(args.grid_scale, args.quick, "vfi",
                                          args.noise_floor_ulp, False),
         "ge": lambda: bench_ge_batched(args.quick),
         "sweep": lambda: bench_sweep(args.quick),
         "transition": lambda: bench_transition(args.quick),
+        "accel": lambda: bench_accel(args.quick),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
@@ -1358,11 +1476,11 @@ def main() -> int:
     if args.preset == "ci":
         # An explicit --metric narrows the ci battery to that one metric
         # (still at ci sizes) instead of being silently ignored.
-        names = (("vfi", "scale", "ge", "sweep", "transition")
+        names = (("vfi", "scale", "ge", "sweep", "transition", "accel")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
-                 "transition", "ks_fine", "scale_vfi")
+                 "transition", "accel", "ks_fine", "scale_vfi")
     else:
         names = (args.metric,)
     for name in names:

@@ -18,7 +18,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
                      "bench.py")
 
 # The ci battery's metric set (bench.py main): one record each, in order.
-CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition")
+CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery():
@@ -40,7 +40,20 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-1]
+    tr = records[-2]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
+    # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
+    # iteration counts for the plain and accelerated routes, with
+    # accelerated <= plain — an acceleration regression fails tier-1 here.
+    ac = records[-1]
+    assert ac["metric"].startswith("accel_fixed_point")
+    assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
+    assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
+    # The headline acceptance ratios (>=2x EGM, >=3x distribution) hold
+    # with margin even at the ci preset's tiny grid; gate slightly below
+    # them so timing-independent sweep-count regressions still fail loudly
+    # without flaking on a calibration wiggle.
+    assert ac["egm_sweep_ratio"] >= 1.8, ac
+    assert ac["dist_sweep_ratio"] >= 2.5, ac
