@@ -12,6 +12,7 @@ Primary entry point: solve(model_config, method=..., backend=...).
 
 from aiyagari_tpu.config import (
     AccelConfig,
+    PrecisionLadderConfig,
     ALMConfig,
     AiyagariConfig,
     BackendConfig,
@@ -80,6 +81,7 @@ __all__ = [
     "IncomeProcess",
     "GridSpecConfig",
     "AccelConfig",
+    "PrecisionLadderConfig",
     "SolverConfig",
     "SimConfig",
     "EquilibriumConfig",

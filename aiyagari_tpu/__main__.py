@@ -31,10 +31,12 @@ def main(argv=None) -> int:
                          "by this image's TPU plugin; use this flag)")
     ap.add_argument("--f64", action="store_true", help="force float64")
     ap.add_argument("--dtype", choices=["float32", "float64", "mixed"], default=None,
-                    help="dtype policy (overrides --f64): 'mixed' (K-S only) "
-                         "runs the household solve + regression in f64 and the "
-                         "cross-section scan in native f32 — the TPU-native "
-                         "path to the reference's 1e-6 ALM tolerance")
+                    help="dtype policy (overrides --f64): 'mixed' runs the "
+                         "Aiyagari family through the mixed-precision solve "
+                         "ladder (f32 hot sweeps, error-controlled f64 polish "
+                         "— ops/precision.py) and Krusell-Smith through the "
+                         "measured component split (f64 solve + regression, "
+                         "native-f32 cross-section scan)")
     ap.add_argument("--grid", type=int, default=400, help="asset grid points (Aiyagari)")
     ap.add_argument("--periods", type=int, default=10_000, help="simulation length (Aiyagari)")
     ap.add_argument("--agents", type=int, default=1, help="simulated households (Aiyagari)")
@@ -94,8 +96,6 @@ def main(argv=None) -> int:
     # the solve entry points enable x64 locally via config.precision_scope.
     use_f64 = args.f64 or (jax.default_backend() == "cpu") or args.model == "ks"
     dtype = args.dtype or ("float64" if use_f64 else "float32")
-    if dtype == "mixed" and args.model != "ks":
-        ap.error("--dtype mixed applies to the Krusell-Smith outer loop only")
     if dtype in ("float64", "mixed"):
         jax.config.update("jax_enable_x64", True)
     backend = BackendConfig(dtype=dtype)
@@ -115,12 +115,18 @@ def main(argv=None) -> int:
                 endogenous_labor=True,
                 grid=GridSpecConfig(n_points=args.grid),
             )
+        # "mixed" = the mixed-precision solve ladder (ops/precision.py):
+        # the model is built at the f64 reference dtype and the solvers run
+        # f32 hot stages with an error-controlled f64 polish.
+        from aiyagari_tpu.ops.precision import ladder_for_dtype
+
+        ladder = ladder_for_dtype(backend.dtype)
         model = AiyagariModel.from_config(
-            cfg, jnp.float64 if backend.dtype == "float64" else jnp.float32
+            cfg, jnp.float32 if backend.dtype == "float32" else jnp.float64
         )
         res = solve_equilibrium(
             model,
-            solver=SolverConfig(method=args.method),
+            solver=SolverConfig(method=args.method, ladder=ladder),
             sim=SimConfig(periods=args.periods, n_agents=args.agents, seed=args.seed),
             eq=EquilibriumConfig(),
             on_iteration=sink,

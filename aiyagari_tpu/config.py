@@ -13,6 +13,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+# Owned by ops/precision.py (the ladder policy + stage planner live beside
+# the kernels they steer); re-exported here because it is user-facing config
+# exactly like AccelConfig. The module keeps its jax imports lazy, so
+# importing it here does not drag jax into config-module import time.
+from aiyagari_tpu.ops.precision import PrecisionLadderConfig
+
 __all__ = [
     "HouseholdPreferences",
     "Technology",
@@ -22,6 +28,7 @@ __all__ = [
     "KSShockProcess",
     "KrusellSmithConfig",
     "AccelConfig",
+    "PrecisionLadderConfig",
     "SolverConfig",
     "SimConfig",
     "EquilibriumConfig",
@@ -230,6 +237,17 @@ class SolverConfig:
                                       # distribution power iteration
                                       # (AccelConfig docstring); None keeps
                                       # the reference first-order trajectory
+    ladder: Optional[PrecisionLadderConfig] = None
+                                      # mixed-precision solve ladder
+                                      # (ops/precision.py): hot-dtype early
+                                      # sweeps with an error-controlled
+                                      # switch to a full-precision polish,
+                                      # across the EGM/VFI households, the
+                                      # stationary distribution, and the
+                                      # transition rounds. None = every
+                                      # stage at BackendConfig.dtype;
+                                      # dispatch.solve() injects the default
+                                      # ladder for dtype="mixed".
 
 
 @dataclasses.dataclass(frozen=True)
@@ -360,16 +378,31 @@ class BackendConfig:
     f32 — pinned by test_precision — and bench.py selects f32 on TPU
     explicitly, as does the CLI).
 
-    dtype="mixed" (Krusell-Smith outer loop only) assigns each component
-    the cheapest dtype that preserves the 1e-6 ALM tolerance, from v5e
-    measurements (equilibrium/alm.py design note): the household solve and
-    the regression run in f64 — the solve is op-latency-bound at the
-    reference scale, so f64 there costs nothing, and it is where the f32
-    noise (sub-cell policy jitter) actually originates — while the
-    1,100-step cross-section scan, 18x slower in emulated f64, runs in
-    native f32 (its rounding is a fixed O(eps) bias in a deterministic
-    map, not compounding noise). A stall detector falls back to the f64
-    simulation if the bias floor ever exceeds tol.
+    dtype="mixed" assigns each component the cheapest precision that
+    preserves the reference tolerance, per model family:
+
+      * Aiyagari family (and the transition solver): the mixed-precision
+        SOLVE LADDER (ops/precision.py) — every hot fixed point (EGM/VFI
+        sweeps, the Young distribution iteration, the transition rounds)
+        runs its early, inaccuracy-tolerant iterations in f32 (bf16 matmul
+        precision on TPU for the expectation/push-forward contractions),
+        detects when the residual reaches that dtype's ulp noise floor
+        (solvers/_stopping.effective_tolerance), then switches the carry to
+        f64 ONCE and polishes to the reference tolerance. dispatch.solve()
+        injects the default ladder into SolverConfig.ladder; pass an
+        explicit PrecisionLadderConfig there to tune stage dtypes / switch
+        threshold / matmul precision. Backends where x64 cannot be enabled
+        reject the ladder loudly instead of silently polishing in f32.
+
+      * Krusell-Smith outer loop: the component policy measured on a v5e
+        (equilibrium/alm.py design note): household solve and regression in
+        f64 — the solve is op-latency-bound at the reference scale, so f64
+        there costs nothing, and it is where the f32 noise (sub-cell policy
+        jitter) actually originates — while the 1,100-step cross-section
+        scan, 18x slower in emulated f64, runs in native f32 (its rounding
+        is a fixed O(eps) bias in a deterministic map, not compounding
+        noise). A stall detector falls back to the f64 simulation if the
+        bias floor ever exceeds tol.
     """
 
     backend: str = "jax"              # {"jax", "numpy"}
@@ -396,7 +429,8 @@ def precision_scope(dtype: str):
     """
     import jax
 
-    # "mixed" needs x64 available for its f64 simulation/regression half.
+    # "mixed" needs x64 available for its f64 half (the ladder's polish
+    # stages on the Aiyagari side, the solve/regression on the K-S side).
     if dtype in ("float64", "mixed") and not jax.config.jax_enable_x64:
         # jax >= 0.6 exposes the scoped switch at top level; 0.4.x only in
         # jax.experimental. Same context manager either way.

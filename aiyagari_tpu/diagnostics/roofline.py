@@ -35,12 +35,27 @@ __all__ = [
     "ChipPeaks",
     "KernelCost",
     "CHIP_PEAKS",
+    "achieved_bandwidth_gbs",
+    "distribution_sweep_cost",
+    "dtype_itemsize",
     "vfi_sweep_cost",
     "vfi_slab_cost",
     "egm_sweep_cost",
     "panel_step_cost",
     "utilization",
 ]
+
+
+def dtype_itemsize(dtype) -> int:
+    """Bytes per element of a dtype (name, numpy/jnp dtype, or jax array
+    dtype) — the dtype-aware knob every cost model's `itemsize` parameter
+    takes. One helper so the bench's per-LADDER-STAGE bytes accounting
+    (ops/precision.py stages) cannot drift from the cost models: pass
+    dtype_itemsize(stage.dtype) and the same analytic byte counts price f64
+    polish sweeps at 8 B/elem and f32/bf16 hot sweeps at 4/2."""
+    import numpy as np
+
+    return int(np.dtype(dtype).itemsize)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,6 +170,41 @@ def egm_sweep_cost(N: int, na: int, itemsize: int = 4,
     else:
         vpu += 3.0 * N * float(na) * na
     return KernelCost(mxu, vpu, bytes_)
+
+
+def distribution_sweep_cost(N: int, na: int, itemsize: int = 8) -> KernelCost:
+    """One Young push-forward sweep (sim/distribution.distribution_step +
+    the per-sweep renormalization): the lottery scatter-add along the asset
+    axis, the [N,N]x[N,na] income-mixing matmul, and the sum/divide mass
+    renormalization.
+
+    HBM model: the scatter reads mu + w_lo and writes mu_a (idx is int32,
+    counted at 4 B regardless of the float itemsize), the matmul reads mu_a
+    and writes mu_new, and the renormalize + distance reductions stream
+    mu_new and the previous iterate once more — ~7 float [N, na] streams
+    plus the int index stream. VPU: 2 multiplies + 2 adds per cell for the
+    lottery, ~3 ops/cell for normalize + the sup-norm distance. This is the
+    memory-bound profile the mixed-precision ladder's f32 stage halves —
+    the bench prices each LADDER STAGE with its own itemsize
+    (dtype_itemsize) and reports achieved GB/s per stage."""
+    cells = float(N) * na
+    mxu = 2.0 * N * N * na
+    vpu = 7.0 * cells
+    bytes_ = itemsize * 7.0 * cells + 4.0 * cells   # + int32 idx stream
+    return KernelCost(mxu, vpu, bytes_)
+
+
+def achieved_bandwidth_gbs(cost: KernelCost | None,
+                           seconds: float) -> float | None:
+    """Achieved memory bandwidth, GB/s, of `cost`'s modeled bytes moved in
+    `seconds` — an ABSOLUTE number (unlike utilization's %-of-peak, it
+    needs no chip model, so CPU-host bench runs report it too). Since the
+    model bytes are analytic lower bounds (module docstring), this is a
+    conservative achieved figure. None when the cost is unmodeled or the
+    timing is degenerate."""
+    if cost is None or seconds <= 0:
+        return None
+    return cost.hbm_bytes / seconds / 1e9
 
 
 def panel_step_cost(population: int, ns: int = 4, nk: int = 100,

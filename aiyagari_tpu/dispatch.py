@@ -37,7 +37,26 @@ __all__ = ["solve", "sweep", "solve_transition", "sweep_transitions"]
 
 
 def _dtype_of(backend: BackendConfig):
-    return jnp.float64 if backend.dtype == "float64" else jnp.float32
+    # "mixed" builds the model in f64: the ladder's polish stage is the
+    # certified reference dtype, and the hot stages cast DOWN per stage
+    # (ops/precision.py) — building in f32 would clamp the whole ladder.
+    return jnp.float64 if backend.dtype in ("float64", "mixed") else jnp.float32
+
+
+def _with_ladder(solver: Optional[SolverConfig], method: str,
+                 backend: BackendConfig) -> SolverConfig:
+    """Resolve the solver config's precision ladder against the backend
+    dtype: dtype="mixed" injects the default ladder (ops/precision.
+    ladder_for_dtype, the single owner of that mapping) unless the caller
+    already set SolverConfig.ladder explicitly."""
+    from aiyagari_tpu.ops.precision import ladder_for_dtype
+
+    solver = solver or SolverConfig(method=method)
+    if solver.ladder is None:
+        ladder = ladder_for_dtype(backend.dtype)
+        if ladder is not None:
+            solver = dataclasses.replace(solver, ladder=ladder)
+    return solver
 
 
 def solve(
@@ -83,6 +102,16 @@ def solve(
     EGM sweeps and ~5x fewer distribution sweeps at default tolerances
     (docs/USAGE.md "Fixed-point acceleration"). The Krusell-Smith ALM outer
     loop's analogue is ALMConfig(acceleration="anderson").
+
+    BackendConfig(dtype="mixed") opts the Aiyagari family into the
+    mixed-precision solve ladder (ops/precision.py; docs/USAGE.md "Mixed
+    precision"): f32 hot sweeps with an error-controlled switch to an f64
+    polish across the household solvers and the stationary distribution,
+    final results parity-pinned to the pure-f64 reference
+    (tests/test_precision_ladder.py). Tune it via SolverConfig(
+    ladder=PrecisionLadderConfig(...)); backends without x64 reject it
+    loudly. For Krusell-Smith, "mixed" keeps the measured component policy
+    (BackendConfig docstring).
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -109,15 +138,15 @@ def solve(
         )
 
     if isinstance(model, AiyagariConfig):
-        if backend.dtype == "mixed":
-            raise ValueError(
-                "dtype='mixed' applies to the Krusell-Smith outer loop only; "
-                "Aiyagari solves converge natively in f32 (test_precision)"
-            )
-        solver = solver or SolverConfig(method=method)
+        solver = _with_ladder(solver, method, backend)
         sim = sim or SimConfig()
         equilibrium = equilibrium or EquilibriumConfig()
         if backend.backend == "numpy":
+            if backend.dtype == "mixed" or solver.ladder is not None:
+                raise ValueError(
+                    "the mixed-precision solve ladder (dtype='mixed' / "
+                    "SolverConfig.ladder) requires backend='jax'; the numpy "
+                    "reference backend is single-dtype by design")
             if aggregation != "simulation":
                 raise ValueError("aggregation='distribution' requires backend='jax'")
             if equilibrium.batch >= 2:
@@ -146,6 +175,15 @@ def solve(
 
                 mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
             with precision_scope(backend.dtype):
+                if solver.ladder is not None:
+                    # Loud guard, BEFORE any solve: a backend configuration
+                    # that cannot represent the polish dtype must reject the
+                    # ladder instead of silently polishing in f32
+                    # (ops/precision.require_x64; precision_scope has
+                    # already enabled x64 where that is possible).
+                    from aiyagari_tpu.ops.precision import require_x64
+
+                    require_x64(solver.ladder)
                 m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
                 if equilibrium.batch >= 2:
                     # Opt-in batched GE (equilibrium/batched.py): B candidate
@@ -288,7 +326,7 @@ def sweep(
     method = method or (solver.method if solver is not None else "vfi")
     if method not in ("vfi", "egm"):
         raise ValueError(f"unknown method {method!r}; expected 'vfi' or 'egm'")
-    solver = solver or SolverConfig(method=method)
+    solver = _with_ladder(solver, method, backend)
     sim = sim or SimConfig()
     equilibrium = equilibrium or EquilibriumConfig()
     if aggregation not in ("simulation", "distribution"):
@@ -328,6 +366,10 @@ def sweep(
 
         mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
     with precision_scope(backend.dtype):
+        if solver.ladder is not None:
+            from aiyagari_tpu.ops.precision import require_x64
+
+            require_x64(solver.ladder)
         models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
                   for c in configs]
         batch = stack_scenarios(models, mesh=mesh)
@@ -344,10 +386,21 @@ def _transition_backend(backend: Union[str, BackendConfig]) -> BackendConfig:
     if backend.backend != "jax":
         raise ValueError("transition solves require backend='jax' (the "
                          "path evaluator is a fused device scan)")
-    if backend.dtype == "mixed":
-        raise ValueError("dtype='mixed' applies to the Krusell-Smith outer "
-                         "loop only")
     return backend
+
+
+def _transition_ladder(backend: BackendConfig, solver: Optional[SolverConfig]):
+    """The ROUND-LOOP ladder for a transition solve: dtype='mixed' (or an
+    explicit SolverConfig.ladder) hands transition/mit.py the ladder; the
+    stationary anchoring solve inherits it through `solver` as usual."""
+    from aiyagari_tpu.ops.precision import ladder_for_dtype, require_x64
+
+    ladder = solver.ladder if solver is not None else None
+    if ladder is None:
+        ladder = ladder_for_dtype(backend.dtype)
+    if ladder is not None:
+        require_x64(ladder)
+    return ladder
 
 
 def solve_transition(
@@ -385,7 +438,8 @@ def solve_transition(
 
     with precision_scope(backend.dtype):
         result = _solve(model, shock, trans=transition, solver=solver,
-                        eq=equilibrium, dtype=_dtype_of(backend), **kwargs)
+                        eq=equilibrium, dtype=_dtype_of(backend),
+                        ladder=_transition_ladder(backend, solver), **kwargs)
     enforce_convergence(
         result.converged, on_nonconvergence, "MIT-shock transition path",
         iterations=result.rounds,
@@ -453,4 +507,4 @@ def sweep_transitions(
     with precision_scope(backend.dtype):
         return _sweep(model, shocks, trans=transition, solver=solver,
                       eq=equilibrium, mesh=mesh, dtype=_dtype_of(backend),
-                      **kwargs)
+                      ladder=_transition_ladder(backend, solver), **kwargs)

@@ -82,7 +82,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         solver.tol, solver.max_iter, solver.howard_steps, solver.relative_tol,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
-        solver.accel,
+        solver.accel, solver.ladder,
     )
 
 
@@ -106,7 +106,8 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     outer round of every solve reuses the same compiled executable.
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
-     dist_tol, dist_max_iter, periods, n_agents, discard, accel) = knobs
+     dist_tol, dist_max_iter, periods, n_agents, discard, accel,
+     ladder) = knobs
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -126,12 +127,13 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                 sol = solve_aiyagari_vfi_labor(
                     warm, a_grid, labor_grid, s, P, r, w, sigma=sigma,
                     beta=beta, psi=psi, eta=eta, tol=tol, max_iter=max_iter,
-                    howard_steps=howard_steps, relative_tol=relative_tol)
+                    howard_steps=howard_steps, relative_tol=relative_tol,
+                    ladder=ladder)
             else:
                 sol = solve_aiyagari_vfi(
                     warm, a_grid, s, P, r, w, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, howard_steps=howard_steps,
-                    relative_tol=relative_tol)
+                    relative_tol=relative_tol, ladder=ladder)
             warm_out = sol.v
         else:
             from aiyagari_tpu.solvers.egm import (
@@ -147,12 +149,13 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                 sol = solve_aiyagari_egm_labor(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     psi=psi, eta=eta, tol=tol, max_iter=max_iter,
-                    relative_tol=relative_tol, grid_power=0.0, accel=accel)
+                    relative_tol=relative_tol, grid_power=0.0, accel=accel,
+                    ladder=ladder)
             else:
                 sol = solve_aiyagari_egm(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, relative_tol=relative_tol,
-                    grid_power=0.0, accel=accel)
+                    grid_power=0.0, accel=accel, ladder=ladder)
             warm_out = sol.policy_c
 
         out = {"warm": warm_out, "sol": sol,
@@ -161,7 +164,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
         if aggregation == "distribution":
             dist_sol = stationary_distribution(
                 sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
-                accel=accel)
+                accel=accel, ladder=ladder)
             supply = aggregate_capital(dist_sol.mu, a_grid)
             out["mu"] = dist_sol.mu
         else:

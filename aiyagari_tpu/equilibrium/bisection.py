@@ -92,6 +92,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
                 tol=solver.tol, max_iter=solver.max_iter, howard_steps=solver.howard_steps,
                 relative_tol=solver.relative_tol, progress_every=solver.progress_every,
+                ladder=solver.ladder,
             )
         return solve_aiyagari_vfi(
             v0, model.a_grid, model.s, model.P, r, w,
@@ -99,6 +100,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             max_iter=solver.max_iter, howard_steps=solver.howard_steps,
             block_size=block_size, relative_tol=solver.relative_tol,
             use_pallas=solver.use_pallas, progress_every=solver.progress_every,
+            ladder=solver.ladder,
         )
     if solver.method == "egm":
         from aiyagari_tpu.parallel.ring import ring_slab_fits
@@ -135,7 +137,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                         max_iter=solver.max_iter,
                         grid_power=float(model.config.grid.power),
                         relative_tol=solver.relative_tol,
-                        accel=solver.accel,
+                        accel=solver.accel, ladder=solver.ladder,
                     )
                 else:
                     ladder_C0 = ladder_warm_start(
@@ -144,7 +146,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                         max_iter=solver.max_iter,
                         grid_power=float(model.config.grid.power),
                         relative_tol=solver.relative_tol,
-                        accel=solver.accel,
+                        accel=solver.accel, ladder=solver.ladder,
                     )
                 C0 = ladder_C0
             if C0 is None:
@@ -156,7 +158,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     eta=prefs.eta, tol=solver.tol, max_iter=solver.max_iter,
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
-                    accel=solver.accel,
+                    accel=solver.accel, ladder=solver.ladder,
                 )
             else:
                 sol = solve_aiyagari_egm_sharded(
@@ -165,7 +167,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     max_iter=solver.max_iter,
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
-                    accel=solver.accel,
+                    accel=solver.accel, ladder=solver.ladder,
                 )
             if not bool(sol.escaped):
                 return sol
@@ -199,7 +201,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     grid_power=model.config.grid.power,
                     relative_tol=solver.relative_tol,
                     progress_every=solver.progress_every,
-                    accel=solver.accel,
+                    accel=solver.accel, ladder=solver.ladder,
                 )
             from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
@@ -209,7 +211,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 max_iter=solver.max_iter, grid_power=model.config.grid.power,
                 relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
-                accel=solver.accel,
+                accel=solver.accel, ladder=solver.ladder,
             )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
@@ -221,7 +223,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 tol=solver.tol, max_iter=solver.max_iter, relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
                 grid_power=model.config.grid.power,
-                accel=solver.accel,
+                accel=solver.accel, ladder=solver.ladder,
             )
         return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
@@ -232,7 +234,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             # f64 resolution, pinned by TestPowerGridInversion; _safe retries
             # on the generic route if the windows escape).
             grid_power=model.config.grid.power,
-            accel=solver.accel,
+            accel=solver.accel, ladder=solver.ladder,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 
@@ -289,11 +291,12 @@ class _DistributionAggregator:
     checkpoint_tag = "_dist"
 
     def __init__(self, model: AiyagariModel, dist_tol: float,
-                 dist_max_iter: int, accel=None):
+                 dist_max_iter: int, accel=None, ladder=None):
         self.model = model
         self.dist_tol = dist_tol
         self.dist_max_iter = dist_max_iter
         self.accel = accel
+        self.ladder = ladder
         self.series = None
         self.mu = None
 
@@ -333,7 +336,7 @@ class _DistributionAggregator:
         dist_sol = stationary_distribution(
             policy_k, self.model.a_grid, self.model.P,
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
-            accel=self.accel,
+            accel=self.accel, ladder=self.ladder,
         )
         self.mu = dist_sol.mu
         supply = float(aggregate_capital(self.mu, self.model.a_grid))
@@ -525,7 +528,7 @@ def solve_equilibrium_distribution(
     return _bisect(
         model,
         _DistributionAggregator(model, dist_tol, dist_max_iter,
-                                accel=solver.accel),
+                                accel=solver.accel, ladder=solver.ladder),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,

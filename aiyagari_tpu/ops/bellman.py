@@ -35,17 +35,23 @@ def _neg_inf(dtype):
     return jnp.array(-jnp.inf, dtype)
 
 
-def expectation(P, v, beta: float):
-    """EV = beta * P @ v at HIGHEST precision. The TPU default f32 matmul is
-    a single bf16 pass — measured 0.5 absolute error on values O(100), which
-    a Howard-accelerated fixed point amplifies by ~1/(1-beta) and never
-    converges below. These [N,N]x[N,na] matmuls are a negligible share of
-    sweep cost, so the 6-pass f32 form is free insurance."""
-    return beta * jnp.matmul(P, v, precision=jax.lax.Precision.HIGHEST)
+def expectation(P, v, beta: float, precision=jax.lax.Precision.HIGHEST):
+    """EV = beta * P @ v, HIGHEST precision by default. The TPU default f32
+    matmul is a single bf16 pass — measured 0.5 absolute error on values
+    O(100), which a Howard-accelerated fixed point amplifies by ~1/(1-beta)
+    and never converges below. These [N,N]x[N,na] matmuls are a negligible
+    share of sweep cost, so the 6-pass f32 form is free insurance.
+
+    `precision` is overridable for the mixed-precision ladder's HOT stages
+    only (ops/precision.py): there the residual sits far above the bf16
+    error band and the relaxed contraction rides the MXU peak; pass None for
+    the backend default. Polish stages keep HIGHEST."""
+    return beta * jnp.matmul(P, v, precision=precision)
 
 
 def bellman_step(v, a_grid, s, P, r, w, *, sigma, beta, block_size: int = 0,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False,
+                 precision=jax.lax.Precision.HIGHEST):
     """One application of the Bellman operator, exogenous labor.
 
     v [N, na] -> (v_new [N, na], policy_idx [N, na] int32).
@@ -77,7 +83,7 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma, beta, block_size: int = 0,
         return _bellman_step_pallas(v, a_grid, s, P, r, w, sigma=sigma_static,
                                     beta=beta)
     return _bellman_step_xla(v, a_grid, s, P, r, w, sigma, beta,
-                             block_size=block_size)
+                             block_size=block_size, precision=precision)
 
 
 @partial(jax.jit, static_argnames=("sigma",))
@@ -92,10 +98,11 @@ def _bellman_step_pallas(v, a_grid, s, P, r, w, *, sigma: float, beta):
     )
 
 
-@partial(jax.jit, static_argnames=("block_size",))
-def _bellman_step_xla(v, a_grid, s, P, r, w, sigma, beta, *, block_size: int):
+@partial(jax.jit, static_argnames=("block_size", "precision"))
+def _bellman_step_xla(v, a_grid, s, P, r, w, sigma, beta, *, block_size: int,
+                      precision=jax.lax.Precision.HIGHEST):
     N, na = v.shape
-    EV = expectation(P, v, beta)                          # [N, na']
+    EV = expectation(P, v, beta, precision=precision)                          # [N, na']
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
 
     def block_scores(ap_vals, ev_vals):
@@ -147,12 +154,13 @@ def choice_utility_tensor(a_grid, s, r, w, *, sigma, dtype=None):
     ).astype(dtype)
 
 
-@jax.jit
-def bellman_step_precomputed(v, U, P, *, beta):
+@partial(jax.jit, static_argnames=("precision",))
+def bellman_step_precomputed(v, U, P, *, beta,
+                             precision=jax.lax.Precision.HIGHEST):
     """Bellman sweep given the precomputed choice-utility tensor: one MXU
     matmul (EV) + a broadcast add + a trailing-axis max. Identical fixed point
     to bellman_step (pinned by test_solvers), ~3x less per-sweep compute."""
-    EV = expectation(P, v, beta)
+    EV = expectation(P, v, beta, precision=precision)
     q = U + EV[:, None, :]
     return jnp.max(q, axis=-1), jnp.argmax(q, axis=-1).astype(jnp.int32)
 
@@ -173,21 +181,23 @@ def labor_choice_utility_tensor(a_grid, labor_grid, s, r, w, *, sigma,
     return (u - labor_disutility(labor_grid, psi, eta)[:, None, None, None]).astype(dtype)
 
 
-@jax.jit
-def bellman_step_labor_precomputed(v, U4, P, *, beta):
+@partial(jax.jit, static_argnames=("precision",))
+def bellman_step_labor_precomputed(v, U4, P, *, beta,
+                                   precision=jax.lax.Precision.HIGHEST):
     """Endogenous-labor Bellman sweep from the precomputed [nl, N, na, na']
     joint-choice tensor: EV matmul + broadcast add + one flattened argmax over
     (l, a'). Same fixed point and tie order as bellman_step_labor."""
     nl, N, na, nap = U4.shape
-    EV = expectation(P, v, beta)                                 # [N, na']
+    EV = expectation(P, v, beta, precision=precision)                                 # [N, na']
     q = U4 + EV[None, :, None, :]                                # [nl, N, na, na']
     flat = q.transpose(1, 2, 0, 3).reshape(N, na, nl * nap)      # l-major choice
     best_flat = jnp.argmax(flat, axis=-1).astype(jnp.int32)
     return jnp.max(flat, axis=-1), best_flat % nap, best_flat // nap
 
 
-@jax.jit
-def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, eta):
+@partial(jax.jit, static_argnames=("precision",))
+def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta,
+                       psi, eta, precision=jax.lax.Precision.HIGHEST):
     """One Bellman application with a joint (labor x a') discrete choice.
 
     v [N, na] -> (v_new, policy_a_idx, policy_l_idx).
@@ -198,7 +208,7 @@ def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, e
     one [N, na, na'] block per labor point.
     """
     N, na = v.shape
-    EV = expectation(P, v, beta)                           # [N, na']
+    EV = expectation(P, v, beta, precision=precision)                           # [N, na']
     base = (1.0 + r) * a_grid[None, :]                     # [N=1 broadcast, na]
 
     def per_labor(carry, l_val):
@@ -228,22 +238,24 @@ def bellman_step_labor(v, a_grid, labor_grid, s, P, r, w, *, sigma, beta, psi, e
     return best, best_a, best_l
 
 
-@jax.jit
-def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma, beta):
+@partial(jax.jit, static_argnames=("precision",))
+def howard_eval_step(v, policy_idx, a_grid, s, P, r, w, *, sigma, beta,
+                     precision=jax.lax.Precision.HIGHEST):
     """Policy-evaluation sweep at a fixed discrete policy (Howard acceleration):
     v <- u(c_pol) + beta * (P @ v) gathered at the policy indices."""
-    EV = expectation(P, v, beta)                           # [N, na']
+    EV = expectation(P, v, beta, precision=precision)                           # [N, na']
     ap = a_grid[policy_idx]                                # [N, na]
     c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - ap
     u = crra_utility(jnp.maximum(c, 1e-300), sigma)
     return u + jnp.take_along_axis(EV, policy_idx, axis=1)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("precision",))
 def howard_eval_step_labor(v, policy_a_idx, policy_l_idx, a_grid, labor_grid, s, P, r, w, *,
-                           sigma, beta, psi, eta):
+                           sigma, beta, psi, eta,
+                           precision=jax.lax.Precision.HIGHEST):
     """Howard evaluation sweep for the endogenous-labor discrete policy."""
-    EV = expectation(P, v, beta)
+    EV = expectation(P, v, beta, precision=precision)
     ap = a_grid[policy_a_idx]
     lv = labor_grid[policy_l_idx]
     c = (1.0 + r) * a_grid[None, :] + w * lv * s[:, None] - ap

@@ -30,10 +30,11 @@ __all__ = ["egm_step", "egm_step_labor", "egm_step_transition",
            "constrained_consumption_labor"]
 
 
-@partial(jax.jit, static_argnames=("grid_power", "with_escape", "use_pallas"))
+@partial(jax.jit, static_argnames=("grid_power", "with_escape", "use_pallas",
+                                   "matmul_precision"))
 def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
              grid_power: float = 0.0, with_escape: bool = False,
-             use_pallas: bool = False):
+             use_pallas: bool = False, matmul_precision: str = "highest"):
     """One EGM policy update, exogenous labor.
 
     C [N, na] (consumption policy on the exogenous grid) ->
@@ -60,8 +61,16 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     (solvers/egm.solve_aiyagari_egm_safe does). Jitted callers that cannot
     host-retry should pass grid_power=0.0, the generic sort-based exact
     route.
+
+    matmul_precision relaxes the Euler-expectation contraction for the
+    mixed-precision ladder's hot stages (ops/precision.py: "default" is the
+    TPU bf16 MXU path); the reference value "highest" keeps the historical
+    pinned-HIGHEST behavior.
     """
-    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)        # [N, na]
+    from aiyagari_tpu.ops.precision import matmul_precision_of
+
+    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta,
+                                  precision=matmul_precision_of(matmul_precision))  # [N, na]
     c_next = crra_marginal_inverse(RHS, sigma)                    # [N, na]
     a_hat = (c_next + a_grid[None, :] - w * s[:, None]) / (1.0 + r)
 
@@ -105,9 +114,10 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     return C_new, policy_k
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("matmul_precision",))
 def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
-                        *, sigma_now, sigma_next, beta_now):
+                        *, sigma_now, sigma_next, beta_now,
+                        matmul_precision: str = "highest"):
     """One backward EGM step along a perfect-foresight transition path
     (transition/path.py): the stationary egm_step generalized to prices and
     preferences that differ between today and tomorrow.
@@ -130,10 +140,15 @@ def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
     generic sort-free exact inversion route is offered (the stationary
     kernel's windowed power-grid fast path needs a host-level escape retry
     that a fused time scan cannot perform — the same contract that keeps
-    equilibrium/batched.py on grid_power=0).
+    equilibrium/batched.py on grid_power=0). matmul_precision relaxes the
+    expectation contraction for the mixed-precision ladder's hot rounds
+    (transition/mit.py), exactly as in egm_step.
     """
+    from aiyagari_tpu.ops.precision import matmul_precision_of
+
     RHS = (1.0 + r_next) * expectation(P, crra_marginal(C_next, sigma_next),
-                                       beta_now)                    # [N, na]
+                                       beta_now,
+                                       precision=matmul_precision_of(matmul_precision))  # [N, na]
     c_endo = crra_marginal_inverse(RHS, sigma_now)                  # [N, na]
     a_hat = (c_endo + a_grid[None, :] - w_now * s[:, None]) / (1.0 + r_now)
     # Same f32 monotonicity insurance as egm_step (exact no-op in f64).
@@ -166,10 +181,12 @@ def constrained_consumption_labor(a_grid, s, r, w, amin, *, sigma,
     return c_con
 
 
-@partial(jax.jit, static_argnames=("grid_power", "with_escape"))
+@partial(jax.jit, static_argnames=("grid_power", "with_escape",
+                                   "matmul_precision"))
 def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma, beta,
                    psi, eta, c_constrained=None,
-                   grid_power: float = 0.0, with_escape: bool = False):
+                   grid_power: float = 0.0, with_escape: bool = False,
+                   matmul_precision: str = "highest"):
     """One EGM policy update with endogenous labor via the closed-form
     intratemporal FOC l = ((w s u'(c))/psi)^(1/eta).
 
@@ -190,9 +207,14 @@ def egm_step_labor(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     interp_monotone_power_grid) — the same TPU fast path (and NaN-poisoning
     escape contract) as the exogenous family's grid inversion, generalized
     to tabulated values using the consumption policy's monotonicity in a'.
+    matmul_precision relaxes the expectation contraction for ladder hot
+    stages, exactly as in egm_step.
     """
+    from aiyagari_tpu.ops.precision import matmul_precision_of
+
     ws = w * s[:, None]                                            # [N, 1]
-    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta)
+    RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta,
+                                  precision=matmul_precision_of(matmul_precision))
     c_next = crra_marginal_inverse(RHS, sigma)
     l_endo = labor_foc_inverse(ws * crra_marginal(c_next, sigma), psi, eta)   # :86
     a_hat = (c_next + a_grid[None, :] - ws * l_endo) / (1.0 + r)              # :87

@@ -54,12 +54,23 @@ def bucket_index(x: jnp.ndarray, q: jnp.ndarray, hi_clip: int | None = None) -> 
     if n <= _COMPARE_ALL_MAX:
         idx = jnp.sum(x <= q[..., None], axis=-1).astype(jnp.int32) - 1
     else:
-        # method='sort' counts by co-sorting knots and queries — one bitonic
-        # sort (~0.4 ms at 40k knots on a v5e) instead of log2(n) SERIAL
-        # gather rounds (~2 ms each, ~33 ms total at 40k: measured with
-        # chained on-device timing; 'scan_unrolled' was the dominant cost of
-        # an entire EGM sweep).
-        idx = jnp.searchsorted(x, q, side="right", method="sort").astype(jnp.int32) - 1
+        # Platform-split above the compare-all cutoff, both directions
+        # measured (BENCHMARKS.md round 7):
+        #   * TPU: method='sort' counts by co-sorting knots and queries —
+        #     one bitonic sort (~0.4 ms at 40k knots on a v5e) instead of
+        #     log2(n) SERIAL gather rounds (~2 ms each, ~33 ms total at
+        #     40k; 'scan_unrolled' was the dominant cost of an entire EGM
+        #     sweep).
+        #   * CPU: the exact opposite — the host executes the binary
+        #     search's scalar gathers in nanoseconds, while the sort route
+        #     costs 20x more (30 ms vs 1.4 ms for 28k queries over 4k
+        #     knots; it was the dominant cost of a CPU EGM sweep). The
+        #     branch is a trace-time host decision, so each backend
+        #     compiles only its own route. Only CPU takes 'scan': any
+        #     accelerator (GPU included, unmeasured) keeps the sort route —
+        #     serial gather rounds are the documented accelerator pathology.
+        method = "scan" if jax.default_backend() == "cpu" else "sort"
+        idx = jnp.searchsorted(x, q, side="right", method=method).astype(jnp.int32) - 1
     return jnp.clip(idx, 0, hi)
 
 

@@ -1,0 +1,237 @@
+"""Mixed-precision solve ladder: the shared policy behind `dtype="mixed"`.
+
+Every hot fixed point in the framework — EGM sweeps, Howard/VFI evaluation,
+the Young distribution push-forward, the transition backward/forward scans —
+is HBM-bandwidth-bound on TPU (diagnostics/roofline.py: 819 GB/s vs 197 bf16
+TFLOP/s on a v5e), so halving bytes-per-element is a direct ~2x on the
+memory-bound roofline. The early iterations of a contraction do not need the
+final tolerance's precision: a residual at 1e-2 is equally well measured in
+f32 and f64, and the iterate they produce is discarded anyway. What the low
+dtype CANNOT do is finish — below its own rounding band the sup-norm residual
+wanders without converging (the measured f32 noise floor behind
+`solvers/_stopping.effective_tolerance`).
+
+The ladder therefore runs each solve as a short STAGE SEQUENCE, one
+`lax.while_loop` per stage (never per-step dtype branching — the loop body
+stays a single-dtype program XLA can fuse):
+
+  1. hot stage(s): iterate in a narrow dtype (f32 by default; matmul
+     contractions at the stage's configured precision — DEFAULT on TPU f32 is
+     bf16, which is exactly the MXU-peak regime) until the residual reaches
+     that dtype's noise floor, `switch_ulp * eps(dtype) * max|iterate|`
+     (or the target tolerance, whichever is larger);
+  2. polish stage: cast the carry up ONCE at the stage boundary, restart any
+     acceleration history (a stale f32 residual history poisons the f64
+     normal equations — ops/accel.py safeguard-restart semantics), and run
+     the ordinary full-precision loop to the reference tolerance. The
+     polish measures the true residual at the cast iterate, so a laddered
+     solve that stops at dist < tol satisfies exactly the same convergence
+     certificate as the pure-f64 one.
+
+Why this is safe: the switch criterion is RESIDUAL-based, not iterate-based.
+When the hot stage stops at residual ~ floor32, the polish starts from an
+iterate whose true f64 residual is at most floor32 + O(eps32 * |x|) — the
+f64 stage then walks log(floor/tol)/log(1/rho) sweeps instead of the full
+log(d0/tol)/log(1/rho), and every sweep saved by the hot stage ran at the
+narrow dtype's bandwidth.
+
+One config (`PrecisionLadderConfig`) and one stage planner (`stage_specs`)
+serve all five solver families (solvers/egm.py, solvers/egm_sharded.py,
+solvers/vfi.py, sim/distribution.py, transition/mit.py), so the ladder
+semantics cannot drift per route. The config is frozen/hashable and rides
+jit static args directly, like AccelConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+__all__ = [
+    "PrecisionLadderConfig",
+    "StageSpec",
+    "default_ladder",
+    "hot_only",
+    "ladder_for_dtype",
+    "matmul_precision_of",
+    "plan_stages",
+    "require_x64",
+    "stage_specs",
+    "validate_ladder",
+]
+
+_STAGE_DTYPES = ("bfloat16", "float32", "float64")
+_MATMUL_PRECISIONS = ("default", "high", "highest")
+# Widening order for the strictly-widening stage check.
+_WIDTH = {"bfloat16": 0, "float32": 1, "float64": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionLadderConfig:
+    """Mixed-precision solve ladder policy (module docstring).
+
+    stage_dtypes — the dtype of each stage's while_loop carry, strictly
+        widening left to right. The LAST entry is the reference dtype the
+        returned solution certifies its tolerance in; earlier entries are
+        the inaccuracy-tolerant hot stages. A single-entry ladder runs the
+        whole solve at that dtype (no switch) — useful for pinning that a
+        hot stage never silently upcasts (tests/test_precision_ladder.py).
+    switch_ulp — the switch criterion as a multiple of the stage dtype's
+        noise floor: a hot stage stops when its residual reaches
+        max(tol, switch_ulp * eps(stage dtype) * max|iterate|). 24 is the
+        measured f32 sup-norm wander band at fine grids (6-16 ulp observed;
+        solvers/egm.py noise_floor_ulp rationale) — small enough to hand the
+        polish a near-converged iterate, large enough that the hot loop
+        always exits instead of wandering below its own resolution.
+    matmul_precision — per-stage precision for the solver-owned matmul
+        contractions (the EGM/Bellman expectation, the distribution
+        push-forward): one of "default" / "high" / "highest" per stage.
+        "default" in an f32 hot stage is the TPU bf16 MXU path (~3 decimal
+        digits below f32 — fine while the residual sits above the switch
+        floor; ops/interp.py:194 measured the loss); the polish stage keeps
+        "highest" so the certified stage is bit-identical in semantics to
+        the pure full-precision solver.
+    """
+
+    stage_dtypes: Tuple[str, ...] = ("float32", "float64")
+    switch_ulp: float = 24.0
+    matmul_precision: Tuple[str, ...] = ("default", "highest")
+
+
+@dataclasses.dataclass(frozen=True)
+class StageSpec:
+    """One planned ladder stage: the carry dtype, the noise-floor multiple
+    the stage's stopping rule applies (0.0 = strict tol; hot stages carry
+    switch_ulp, the final stage the caller's own floor), and the matmul
+    precision name for the stage's contractions."""
+
+    dtype: str
+    noise_floor_ulp: float
+    matmul_precision: str
+    is_final: bool
+
+
+def validate_ladder(ladder: PrecisionLadderConfig) -> None:
+    if not ladder.stage_dtypes:
+        raise ValueError("PrecisionLadderConfig.stage_dtypes must be non-empty")
+    for d in ladder.stage_dtypes:
+        if d not in _STAGE_DTYPES:
+            raise ValueError(
+                f"unknown stage dtype {d!r}; expected one of {_STAGE_DTYPES}")
+    widths = [_WIDTH[d] for d in ladder.stage_dtypes]
+    if any(b <= a for a, b in zip(widths, widths[1:])):
+        raise ValueError(
+            "PrecisionLadderConfig.stage_dtypes must be strictly widening "
+            f"(narrow hot sweeps -> wide polish); got {ladder.stage_dtypes}")
+    if len(ladder.matmul_precision) != len(ladder.stage_dtypes):
+        raise ValueError(
+            "PrecisionLadderConfig.matmul_precision needs one entry per "
+            f"stage; got {len(ladder.matmul_precision)} for "
+            f"{len(ladder.stage_dtypes)} stage(s)")
+    for p in ladder.matmul_precision:
+        if p not in _MATMUL_PRECISIONS:
+            raise ValueError(
+                f"unknown matmul precision {p!r}; expected one of "
+                f"{_MATMUL_PRECISIONS}")
+    if not ladder.switch_ulp > 0.0:
+        raise ValueError(
+            f"PrecisionLadderConfig.switch_ulp must be > 0 (the hot stage "
+            f"must stop ABOVE its own rounding band), got {ladder.switch_ulp}")
+
+
+def default_ladder() -> PrecisionLadderConfig:
+    """The shipped `dtype="mixed"` policy: f32 hot sweeps (bf16 matmul on
+    TPU via "default"), error-controlled switch at 24 ulp, f64 polish at
+    HIGHEST matmul precision."""
+    return PrecisionLadderConfig()
+
+
+def ladder_for_dtype(dtype: str):
+    """BackendConfig.dtype -> ladder: "mixed" gets the default ladder,
+    every explicit single dtype gets None (no ladder)."""
+    return default_ladder() if dtype == "mixed" else None
+
+
+def require_x64(ladder: PrecisionLadderConfig) -> None:
+    """Loud guard for backends/configurations that cannot represent the
+    ladder's polish dtype: with jax's x64 mode off, float64 arrays silently
+    canonicalize to f32 (with only a UserWarning), and a "mixed" solve would
+    then POLISH IN F32 while claiming an f64-certified tolerance. Raise
+    instead — the caller should enter config.precision_scope("mixed") (the
+    dispatch layer does) or enable x64."""
+    import jax.dtypes
+    import jax.numpy as jnp
+
+    for d in ladder.stage_dtypes:
+        if jax.dtypes.canonicalize_dtype(jnp.dtype(d)) != jnp.dtype(d):
+            raise RuntimeError(
+                f"precision ladder stage dtype {d!r} is unavailable on this "
+                "backend configuration (jax canonicalizes it to "
+                f"{jax.dtypes.canonicalize_dtype(jnp.dtype(d))!s}); enable "
+                "x64 (config.precision_scope('mixed') does) instead of "
+                "silently polishing in a narrower dtype")
+
+
+def matmul_precision_of(name: str):
+    """Map a per-stage matmul-precision name to jax.lax.Precision. "default"
+    returns None — the framework's convention for "let the op's own default
+    stand" (jnp.matmul(None) = the backend default, bf16-based on TPU f32)."""
+    import jax
+
+    return {"default": None,
+            "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}[name]
+
+
+def stage_specs(ladder: PrecisionLadderConfig,
+                noise_floor_ulp: float = 0.0) -> Tuple[StageSpec, ...]:
+    """Plan the ladder's stages for one solve. Hot (non-final) stages stop at
+    max(tol, switch_ulp * eps(stage dtype) * max|x|) — the error-controlled
+    switch; the final stage applies the CALLER's own noise_floor_ulp (0.0 =
+    the strict reference criterion), so a laddered solve certifies exactly
+    what the un-laddered solver would. Called at trace time by every ladder
+    route; also performs the x64 availability guard, so a polish stage that
+    would silently truncate fails loudly everywhere."""
+    validate_ladder(ladder)
+    require_x64(ladder)
+    n = len(ladder.stage_dtypes)
+    return tuple(
+        StageSpec(
+            dtype=d,
+            noise_floor_ulp=(float(noise_floor_ulp) if i == n - 1
+                             else max(float(noise_floor_ulp),
+                                      float(ladder.switch_ulp))),
+            matmul_precision=ladder.matmul_precision[i],
+            is_final=(i == n - 1),
+        )
+        for i, d in enumerate(ladder.stage_dtypes)
+    )
+
+
+def plan_stages(ladder, fallback_dtype,
+                noise_floor_ulp: float = 0.0) -> Tuple[StageSpec, ...]:
+    """stage_specs with a None-ladder fallback: one final stage at
+    `fallback_dtype` with the caller's own noise floor and the historical
+    "highest" matmul precision — so every solver loop is written ONCE over
+    the stage tuple and the un-laddered route stays the exact reference
+    program."""
+    if ladder is None:
+        import jax.numpy as jnp
+
+        return (StageSpec(dtype=jnp.dtype(fallback_dtype).name,
+                          noise_floor_ulp=float(noise_floor_ulp),
+                          matmul_precision="highest", is_final=True),)
+    return stage_specs(ladder, noise_floor_ulp)
+
+
+def hot_only(ladder):
+    """The ladder truncated to its FIRST (hot) stage, as a single-stage
+    ladder — what the multiscale warm stages run: their product is a warm
+    start for a finer grid, not a certified solution, so polishing it in
+    f64 would buy accuracy the prolongation immediately discards. None
+    passes through (no ladder anywhere)."""
+    if ladder is None or len(ladder.stage_dtypes) == 1:
+        return ladder
+    return dataclasses.replace(
+        ladder, stage_dtypes=ladder.stage_dtypes[:1],
+        matmul_precision=ladder.matmul_precision[:1])

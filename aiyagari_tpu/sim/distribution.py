@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_simplex
 from aiyagari_tpu.ops.interp import bucket_index
+from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
 
 __all__ = [
@@ -46,6 +47,13 @@ class DistributionSolution:
     mu: jax.Array           # [N, na], nonnegative, sums to 1
     iterations: jax.Array   # scalar int32
     distance: jax.Array     # scalar, final sup-norm of the update
+    # Mixed-precision ladder telemetry (ops/precision.py; 0 when no ladder
+    # ran): sweeps executed in the hot (pre-polish) stages and the residual
+    # at which the dtype switch fired (cf. EGMSolution).
+    hot_iterations: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0, jnp.int32))
+    switch_distance: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0.0))
 
 
 def young_lottery(policy_k, a_grid):
@@ -63,12 +71,18 @@ def young_lottery(policy_k, a_grid):
     return idx, w_lo
 
 
-def distribution_step(mu, idx, w_lo, P):
+def distribution_step(mu, idx, w_lo, P, precision=jax.lax.Precision.HIGHEST):
     """One forward iteration of the distribution: move asset mass through the
     policy lottery (scatter-add along the asset axis), then mix income states
     through P' (one matmul).
 
     mu'[m, l] = sum_{i,j} P[i, m] * mu[i, j] * lottery(j -> l)
+
+    HIGHEST precision by default: the bf16 default would leak mass at ~1e-3.
+    The mixed-precision ladder's HOT stages (ops/precision.py) may relax
+    `precision` — they renormalize every sweep and their residual target
+    sits far above the leak, while the f64 POLISH stage always keeps
+    HIGHEST, so the certified mass-conservation contract is unchanged.
     """
     rows = jnp.broadcast_to(jnp.arange(mu.shape[0])[:, None], mu.shape)
     mu_a = (
@@ -76,8 +90,7 @@ def distribution_step(mu, idx, w_lo, P):
         .at[rows, idx].add(mu * w_lo)
         .at[rows, idx + 1].add(mu * (1.0 - w_lo))
     )
-    # HIGHEST precision: the bf16 default would leak mass at ~1e-3
-    return jnp.matmul(P.T, mu_a, precision=jax.lax.Precision.HIGHEST)
+    return jnp.matmul(P.T, mu_a, precision=precision)
 
 
 def expectation_step(f, idx, w_lo, P):
@@ -99,11 +112,11 @@ def expectation_step(f, idx, w_lo, P):
     return w_lo * g[rows, idx] + (1.0 - w_lo) * g[rows, idx + 1]
 
 
-@partial(jax.jit, static_argnames=("noise_floor_ulp", "accel"))
+@partial(jax.jit, static_argnames=("noise_floor_ulp", "accel", "ladder"))
 def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                             max_iter=10_000, mu_init=None,
                             noise_floor_ulp: float = 0.0,
-                            accel=None) -> DistributionSolution:
+                            accel=None, ladder=None) -> DistributionSolution:
     """Iterate distribution_step to a sup-norm fixed point on device.
 
     The whole loop is one lax.while_loop program; the host sees only the
@@ -126,41 +139,77 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     always the plain image of the final sweep, satisfying the same
     fixed-point certificate as the unaccelerated solve. Measured ~5x fewer
     sweeps at the reference calibration's tol 1e-10.
+
+    ladder (a PrecisionLadderConfig, static) opts into the mixed-precision
+    solve ladder (ops/precision.py): the hot stages power-iterate in the
+    narrow dtype (lottery weights, P, and the carry all cast; the
+    push-forward matmul at the stage's configured precision) until the
+    residual reaches max(tol, switch_ulp * eps * max|mu|), then the carry
+    casts up ONCE, re-normalizes on the simplex (the cast must not carry a
+    hot-dtype mass defect into the certified stage), the acceleration
+    history restarts, and the f64 polish — with the HIGHEST-precision
+    mass-conservation matmul this solver always had — runs to the reference
+    tolerance. Mass error after the polish stays at f64 roundoff
+    (< 1e-12; pinned by tests/test_precision_ladder.py).
     """
     N, na = policy_k.shape
     if mu_init is None:
-        mu = jnp.full((N, na), 1.0 / (N * na), policy_k.dtype)
+        mu0 = jnp.full((N, na), 1.0 / (N * na), policy_k.dtype)
     else:
-        mu = mu_init / jnp.sum(mu_init)
+        mu0 = mu_init / jnp.sum(mu_init)
     idx, w_lo = young_lottery(policy_k, a_grid)
-    tol_c = jnp.asarray(tol, mu.dtype)
     max_it = jnp.asarray(max_iter, jnp.int32)
-    ast0 = accel_init(mu, accel) if accel is not None else None
+    stages = plan_stages(ladder, mu0.dtype, noise_floor_ulp)
 
-    def cond(carry):
-        _, _, dist, it, tol_eff, _ = carry
-        return (dist >= tol_eff) & (it < max_it)
+    def run_stage(spec, mu_in, it0):
+        dt = jnp.dtype(spec.dtype)
+        # "highest" for final/no-ladder stages (the historical pinned
+        # precision); a hot stage's configured relaxation otherwise.
+        prec = matmul_precision_of(spec.matmul_precision)
+        # Simplex re-normalization AT the cast: a narrow-dtype mass defect
+        # must not enter the wider stage as bias.
+        mu = mu_in.astype(dt)
+        mu = mu / jnp.sum(mu)
+        w_lo_d, P_d = w_lo.astype(dt), P.astype(dt)
+        tol_c = jnp.asarray(tol, dt)
+        ast0 = accel_init(mu, accel) if accel is not None else None
 
-    def body(carry):
-        mu, _, _, it, _, ast = carry
-        mu_new = distribution_step(mu, idx, w_lo, P)
-        mu_new = mu_new / jnp.sum(mu_new)
-        dist = jnp.max(jnp.abs(mu_new - mu))
-        tol_eff = effective_tolerance(
-            tol_c, jnp.max(jnp.abs(mu_new)), noise_floor_ulp=noise_floor_ulp,
-            relative_tol=False, dtype=mu.dtype)
-        if accel is None:
-            mu_next = mu_new
-        else:
-            mu_next, ast = accel_step(ast, mu, mu_new, accel=accel,
-                                      project=project_simplex)
-        return mu_next, mu_new, dist, it + 1, tol_eff, ast
+        def cond(carry):
+            _, _, dist, it, tol_eff, _ = carry
+            return (dist >= tol_eff) & (it < max_it)
 
-    _, mu, dist, it, _, _ = jax.lax.while_loop(
-        cond, body,
-        (mu, mu, jnp.array(jnp.inf, mu.dtype), jnp.int32(0), tol_c, ast0)
-    )
-    return DistributionSolution(mu, it, dist)
+        def body(carry):
+            mu, _, _, it, _, ast = carry
+            mu_new = distribution_step(mu, idx, w_lo_d, P_d, precision=prec)
+            mu_new = mu_new / jnp.sum(mu_new)
+            dist = jnp.max(jnp.abs(mu_new - mu))
+            tol_eff = effective_tolerance(
+                tol_c, jnp.max(jnp.abs(mu_new)),
+                noise_floor_ulp=spec.noise_floor_ulp,
+                relative_tol=False, dtype=dt)
+            if accel is None:
+                mu_next = mu_new
+            else:
+                mu_next, ast = accel_step(ast, mu, mu_new, accel=accel,
+                                          project=project_simplex)
+            return mu_next, mu_new, dist, it + 1, tol_eff, ast
+
+        _, mu, dist, it, _, _ = jax.lax.while_loop(
+            cond, body,
+            (mu, mu, jnp.array(jnp.inf, dt), it0, tol_c, ast0)
+        )
+        return mu, dist, it
+
+    mu, it = mu0, jnp.int32(0)
+    hot_it = jnp.int32(0)
+    switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    dist = None
+    for spec in stages:
+        mu, dist, it = run_stage(spec, mu, it)
+        if not spec.is_final:
+            hot_it = it
+            switch_dist = dist.astype(switch_dist.dtype)
+    return DistributionSolution(mu, it, dist, hot_it, switch_dist)
 
 
 def aggregate_capital(mu, a_grid):

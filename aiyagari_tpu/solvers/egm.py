@@ -15,6 +15,7 @@ from aiyagari_tpu.diagnostics.progress import device_progress
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
 from aiyagari_tpu.ops.interp import prolong_power_grid
+from aiyagari_tpu.ops.precision import hot_only, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
 
 # Multigrid ladder defaults, shared with the mesh warm-start route
@@ -119,14 +120,23 @@ class EGMSolution:
     # ulp-noise floor was engaged (solve_aiyagari_egm noise_floor_ulp).
     # Convergence checks should compare distance against THIS, not tol.
     tol_effective: jax.Array = dataclasses.field(default_factory=lambda: jnp.array(0.0))
+    # Mixed-precision ladder telemetry (ops/precision.py; 0 when no ladder
+    # ran): sweeps executed in the hot (pre-polish) stages — `iterations`
+    # keeps counting ALL sweeps, so polish sweeps = iterations -
+    # hot_iterations — and the residual at which the dtype switch fired.
+    hot_iterations: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0, jnp.int32))
+    switch_distance: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0.0))
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
-                       use_pallas: bool = False, accel=None) -> EGMSolution:
+                       use_pallas: bool = False, accel=None,
+                       ladder=None) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
@@ -157,40 +167,78 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     proposal. The returned policies are always the SWEEP's output (the
     image, with its budget-consistent policy_k), never the extrapolated
     point — so the solution satisfies the stopping certificate identically
-    to the plain route."""
+    to the plain route.
 
-    tol_c = jnp.asarray(tol, C_init.dtype)
-    ast0 = accel_init(C_init, accel) if accel is not None else None
+    ladder (a PrecisionLadderConfig, static) opts into the mixed-precision
+    solve ladder (ops/precision.py): the early sweeps run in the ladder's
+    hot dtype (f32 by default, matmul contraction at the stage's configured
+    precision — bf16 MXU on TPU) inside their own while_loop until the
+    residual reaches max(tol, switch_ulp * eps * max|C|), then the carry is
+    cast up ONCE, the acceleration history restarts (stale hot-dtype
+    residuals would poison the polish's normal equations), and the ordinary
+    full-precision loop finishes to the reference criterion. `iterations`
+    counts ALL sweeps; the hot-stage share and the residual at the switch
+    are returned as EGMSolution.hot_iterations / .switch_distance. With
+    relative_tol the criterion is already scale-free and the hot stage
+    simply runs to tol."""
+
+    stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def cond(carry):
-        _, _, _, dist, it, _, tol_eff, _ = carry
-        return (dist >= tol_eff) & (it < max_iter)
+    def run_stage(spec, C0, pk0, it0, esc0):
+        dt = jnp.dtype(spec.dtype)
+        Cd = C0.astype(dt)
+        ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
+        rd, wd, amind = (jnp.asarray(x).astype(dt) for x in (r, w, amin))
+        sig, bet = jnp.asarray(sigma).astype(dt), jnp.asarray(beta).astype(dt)
+        tol_c = jnp.asarray(tol, dt)
+        ast0 = accel_init(Cd, accel) if accel is not None else None
 
-    def body(carry):
-        C, _, _, _, it, esc, _, ast = carry
-        C_new, policy_k, esc_new = egm_step(C, a_grid, s, P, r, w, amin,
-                                            sigma=sigma, beta=beta,
-                                            grid_power=grid_power,
-                                            with_escape=True,
-                                            use_pallas=use_pallas)
-        diff = jnp.abs(C_new - C)
-        dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
-        tol_eff = effective_tolerance(
-            tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
-            relative_tol=relative_tol, dtype=C_init.dtype)
-        device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
-        if accel is None:
-            C_next = C_new
-        else:
-            C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
-        return C_next, C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff, ast
+        def cond(carry):
+            _, _, _, dist, it, _, tol_eff, _ = carry
+            return (dist >= tol_eff) & (it < max_iter)
 
-    init = (C_init, C_init, jnp.zeros_like(C_init),
-            jnp.array(jnp.inf, C_init.dtype), jnp.int32(0), jnp.array(False),
-            tol_c, ast0)
-    _, C, policy_k, dist, it, esc, tol_eff, _ = jax.lax.while_loop(cond, body, init)
-    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff)
+        def body(carry):
+            C, _, _, _, it, esc, _, ast = carry
+            C_new, policy_k, esc_new = egm_step(
+                C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
+                grid_power=grid_power, with_escape=True,
+                use_pallas=use_pallas,
+                matmul_precision=spec.matmul_precision)
+            diff = jnp.abs(C_new - C)
+            dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+            tol_eff = effective_tolerance(
+                tol_c, jnp.max(jnp.abs(C_new)),
+                noise_floor_ulp=spec.noise_floor_ulp,
+                relative_tol=relative_tol, dtype=dt)
+            device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
+            if accel is None:
+                C_next = C_new
+            else:
+                C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
+            return C_next, C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff, ast
+
+        init = (Cd, Cd, pk0.astype(dt), jnp.array(jnp.inf, dt), it0, esc0,
+                tol_c, ast0)
+        out = jax.lax.while_loop(cond, body, init)
+        # (image C, policy_k, dist, it, esc, tol_eff) — the image, not the
+        # accelerated carry, crosses the stage boundary: it is the certified
+        # sweep output the stopping rule measured.
+        return out[1], out[2], out[3], out[4], out[5], out[6]
+
+    C, policy_k = C_init, jnp.zeros_like(C_init)
+    it, esc = jnp.int32(0), jnp.array(False)
+    hot_it = jnp.int32(0)
+    switch_dist = jnp.array(0.0, stages[-1].dtype)
+    dist = tol_eff = None
+    for spec in stages:
+        C, policy_k, dist, it, esc, tol_eff = run_stage(spec, C, policy_k,
+                                                        it, esc)
+        if not spec.is_final:
+            hot_it = it
+            switch_dist = dist.astype(switch_dist.dtype)
+    return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
+                       hot_it, switch_dist)
 
 
 def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
@@ -198,7 +246,8 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             relative_tol: bool = False, progress_every: int = 0,
                             grid_power: float = 0.0,
                             noise_floor_ulp: float = 0.0,
-                            use_pallas: bool = False, accel=None) -> EGMSolution:
+                            use_pallas: bool = False, accel=None,
+                            ladder=None) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
@@ -216,70 +265,100 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              progress_every=progress_every,
                              grid_power=grid_power,
                              noise_floor_ulp=noise_floor_ulp,
-                             use_pallas=use_pallas, accel=accel)
+                             use_pallas=use_pallas, accel=accel, ladder=ladder)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
                                  relative_tol=relative_tol,
                                  progress_every=progress_every,
                                  grid_power=0.0,
-                                 noise_floor_ulp=noise_floor_ulp, accel=accel)
+                                 noise_floor_ulp=noise_floor_ulp, accel=accel,
+                                 ladder=ladder)
     return sol
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                              psi, eta, tol: float, max_iter: int,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              grid_power: float = 0.0,
                              noise_floor_ulp: float = 0.0,
-                             accel=None) -> EGMSolution:
+                             accel=None, ladder=None) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107). grid_power > 0 routes the
     consumption re-interpolation through the windowed value-interpolation
     fast path; noise_floor_ulp is the f32 stopping-rule floor; accel opts
-    into safeguarded fixed-point acceleration of the consumption iterate —
-    all exactly as in solve_aiyagari_egm (see its docstring). Only C is
-    accelerated: the labor/asset policies are closed-form per sweep, so
-    they stay consistent with the returned (sweep-output) C."""
-    # Loop-invariant: the constrained-region static solution depends on
-    # prices and the grid only, not the consumption iterate.
-    c_con = constrained_consumption_labor(
-        a_grid, s, r, w, amin, sigma=sigma, psi=psi, eta=eta
-    )
-    tol_c = jnp.asarray(tol, C_init.dtype)
-    ast0 = accel_init(C_init, accel) if accel is not None else None
+    into safeguarded fixed-point acceleration of the consumption iterate;
+    ladder opts into the mixed-precision solve ladder (hot-dtype sweeps,
+    error-controlled switch, full-precision polish) — all exactly as in
+    solve_aiyagari_egm (see its docstring). Only C is accelerated: the
+    labor/asset policies are closed-form per sweep, so they stay consistent
+    with the returned (sweep-output) C. The constrained-region static
+    solution is rebuilt per ladder stage (it is loop-invariant but
+    dtype-dependent)."""
+    stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def cond(carry):
-        return (carry[4] >= carry[7]) & (carry[5] < max_iter)
-
-    def body(carry):
-        C, _, _, _, _, it, esc, _, ast = carry
-        C_new, policy_k, policy_l, esc_new = egm_step_labor(
-            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi, eta=eta,
-            c_constrained=c_con, grid_power=grid_power, with_escape=True,
+    def run_stage(spec, C0, pk0, pl0, it0, esc0):
+        dt = jnp.dtype(spec.dtype)
+        Cd = C0.astype(dt)
+        ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
+        rd, wd, amind = (jnp.asarray(x).astype(dt) for x in (r, w, amin))
+        sig, bet, psid, etad = (jnp.asarray(x).astype(dt)
+                                for x in (sigma, beta, psi, eta))
+        # Loop-invariant: the constrained-region static solution depends on
+        # prices and the grid only, not the consumption iterate.
+        c_con = constrained_consumption_labor(
+            ag, sd, rd, wd, amind, sigma=sig, psi=psid, eta=etad
         )
-        diff = jnp.abs(C_new - C)
-        dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
-        tol_eff = effective_tolerance(
-            tol_c, jnp.max(jnp.abs(C_new)), noise_floor_ulp=noise_floor_ulp,
-            relative_tol=relative_tol, dtype=C_init.dtype)
-        device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
-        if accel is None:
-            C_next = C_new
-        else:
-            C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
-        return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                esc | esc_new, tol_eff, ast)
+        tol_c = jnp.asarray(tol, dt)
+        ast0 = accel_init(Cd, accel) if accel is not None else None
+
+        def cond(carry):
+            return (carry[4] >= carry[7]) & (carry[5] < max_iter)
+
+        def body(carry):
+            C, _, _, _, _, it, esc, _, ast = carry
+            C_new, policy_k, policy_l, esc_new = egm_step_labor(
+                C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
+                psi=psid, eta=etad, c_constrained=c_con,
+                grid_power=grid_power, with_escape=True,
+                matmul_precision=spec.matmul_precision,
+            )
+            diff = jnp.abs(C_new - C)
+            dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
+            tol_eff = effective_tolerance(
+                tol_c, jnp.max(jnp.abs(C_new)),
+                noise_floor_ulp=spec.noise_floor_ulp,
+                relative_tol=relative_tol, dtype=dt)
+            device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
+            if accel is None:
+                C_next = C_new
+            else:
+                C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
+            return (C_next, C_new, policy_k, policy_l, dist, it + 1,
+                    esc | esc_new, tol_eff, ast)
+
+        init = (Cd, Cd, pk0.astype(dt), pl0.astype(dt),
+                jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0)
+        out = jax.lax.while_loop(cond, body, init)
+        return out[1], out[2], out[3], out[4], out[5], out[6], out[7]
 
     z = jnp.zeros_like(C_init)
-    init = (C_init, C_init, z, z, jnp.array(jnp.inf, C_init.dtype),
-            jnp.int32(0), jnp.array(False), tol_c, ast0)
-    _, C, policy_k, policy_l, dist, it, esc, tol_eff, _ = jax.lax.while_loop(
-        cond, body, init)
-    return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff)
+    C, policy_k, policy_l = C_init, z, z
+    it, esc = jnp.int32(0), jnp.array(False)
+    hot_it = jnp.int32(0)
+    switch_dist = jnp.array(0.0, stages[-1].dtype)
+    dist = tol_eff = None
+    for spec in stages:
+        C, policy_k, policy_l, dist, it, esc, tol_eff = run_stage(
+            spec, C, policy_k, policy_l, it, esc)
+        if not spec.is_final:
+            hot_it = it
+            switch_dist = dist.astype(switch_dist.dtype)
+    return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
+                       hot_it, switch_dist)
 
 
 def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
@@ -289,7 +368,7 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                   progress_every: int = 0,
                                   grid_power: float = 0.0,
                                   noise_floor_ulp: float = 0.0,
-                                  accel=None) -> EGMSolution:
+                                  accel=None, ladder=None) -> EGMSolution:
     """Host-level escape retry for the labor family (the exact analogue of
     solve_aiyagari_egm_safe: re-solve on the generic route only when the
     windowed fast path actually escaped)."""
@@ -300,7 +379,7 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                    progress_every=progress_every,
                                    grid_power=grid_power,
                                    noise_floor_ulp=noise_floor_ulp,
-                                   accel=accel)
+                                   accel=accel, ladder=ladder)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
                                        sigma=sigma, beta=beta, psi=psi, eta=eta,
@@ -309,19 +388,33 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                        progress_every=progress_every,
                                        grid_power=0.0,
                                        noise_floor_ulp=noise_floor_ulp,
-                                       accel=accel)
+                                       accel=accel, ladder=ladder)
     return sol
+
+
+def _warm_stage_knobs(ladder, noise_floor_ulp: float):
+    """(ladder, noise_floor_ulp) for a multiscale WARM stage: the hot-only
+    truncation of the full ladder, stopped at the hot dtype's switch floor.
+    A warm stage's product is a prolongation input, not a certified
+    solution — polishing it in the wide dtype would buy accuracy the next
+    stage's re-convergence immediately discards (the ISSUE-4 "warm stages
+    are the natural f32 citizens" wiring)."""
+    if ladder is None:
+        return None, noise_floor_ulp
+    return hot_only(ladder), max(float(noise_floor_ulp),
+                                 float(ladder.switch_ulp))
 
 
 def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
                  grid_power: float, solve_stage) -> EGMSolution:
     """Host-level stage loop shared by the generic-route retry and the
     labor-family ladders: initial guess on the coarsest grid, per-stage
-    solve via `solve_stage(C, grid)`, analytic prolongation between stages
-    (final stage on the CALLER's grid array, bitwise), per-stage escape
-    flags OR-ed on device, and one batched scalar fetch at the end. One
-    body, so the ladder protocol cannot drift between its host users (the
-    fast path is the separately-traced _egm_ladder_fused)."""
+    solve via `solve_stage(C, grid, final)`, analytic prolongation between
+    stages (final stage on the CALLER's grid array, bitwise), per-stage
+    escape flags OR-ed on device, and one batched scalar fetch at the end.
+    One body, so the ladder protocol cannot drift between its host users
+    (the fast path is the separately-traced _egm_ladder_fused). The `final`
+    flag lets stages pick precision-ladder knobs (_warm_stage_knobs)."""
     from aiyagari_tpu.utils.grids import stage_grid
 
     dtype = a_grid.dtype
@@ -330,11 +423,11 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
     sol = None
     esc = jnp.array(False)
     for i, n in enumerate(sizes):
-        g = a_grid if i == len(sizes) - 1 else stage_grid(n, lo, hi,
-                                                          grid_power, dtype)
+        final = i == len(sizes) - 1
+        g = a_grid if final else stage_grid(n, lo, hi, grid_power, dtype)
         if i > 0:
             C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
-        sol = solve_stage(C, g)
+        sol = solve_stage(C, g, final)
         esc = esc | sol.escaped
     return _fetch_scalars(dataclasses.replace(sol, escaped=esc))
 
@@ -342,12 +435,13 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
 @partial(jax.jit, static_argnames=("sizes", "lo", "hi", "sigma", "beta",
                                    "tol", "max_iter", "relative_tol",
                                    "progress_every", "grid_power",
-                                   "noise_floor_ulp", "use_pallas", "accel"))
+                                   "noise_floor_ulp", "use_pallas", "accel",
+                                   "ladder"))
 def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                       hi: float, sigma: float, beta: float, tol: float,
                       max_iter: int, relative_tol: bool, progress_every: int,
                       grid_power: float, noise_floor_ulp: float,
-                      use_pallas: bool, accel=None) -> EGMSolution:
+                      use_pallas: bool, accel=None, ladder=None) -> EGMSolution:
     """The whole fast-path stage ladder traced as ONE device program:
     stage solve -> prolong -> next stage, unrolled over the static `sizes`
     tuple. Why one program: each separately-jitted stage costs a ~100 ms
@@ -364,11 +458,16 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
     sol = None
     esc = jnp.array(False)
     for i, n in enumerate(sizes):
+        final = i == len(sizes) - 1
         # The final stage uses the CALLER's grid array (bitwise — the
         # analytic rebuild could differ from the model builder's by an ulp);
-        # intermediate grids are rebuilt analytically on device.
-        g = a_grid if i == len(sizes) - 1 else stage_grid(n, lo, hi,
-                                                          grid_power, dtype)
+        # intermediate grids are rebuilt analytically on device. Under a
+        # precision ladder the warm stages run hot-only (f32 citizens,
+        # stopped at the switch floor); the final stage runs the full
+        # hot->polish ladder (_warm_stage_knobs).
+        g = a_grid if final else stage_grid(n, lo, hi, grid_power, dtype)
+        st_ladder, st_floor = ((ladder, noise_floor_ulp) if final
+                               else _warm_stage_knobs(ladder, noise_floor_ulp))
         if i > 0:
             C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
         sol = solve_aiyagari_egm(C, g, s, P, r, w, amin,
@@ -377,8 +476,9 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  relative_tol=relative_tol,
                                  progress_every=progress_every,
                                  grid_power=grid_power,
-                                 noise_floor_ulp=noise_floor_ulp,
-                                 use_pallas=use_pallas, accel=accel)
+                                 noise_floor_ulp=st_floor,
+                                 use_pallas=use_pallas, accel=accel,
+                                 ladder=st_ladder)
         esc = esc | sol.escaped
     return dataclasses.replace(sol, escaped=esc)
 
@@ -408,36 +508,46 @@ def _penultimate_warm_start(a_grid, grid_power: float, solve_coarse):
 
 def ladder_warm_start(a_grid, s, P, r, w, amin, *, sigma: float, beta: float,
                       tol: float, max_iter: int, grid_power: float,
-                      relative_tol: bool = False, accel=None):
+                      relative_tol: bool = False, accel=None, ladder=None):
     """Converge the multiscale ladder's PENULTIMATE stage and prolong its
     consumption policy to the full grid — the warm start the mesh route
     feeds solve_aiyagari_egm_sharded, so the sharded fine solve runs a warm
     handful of sweeps instead of ~290 cold full-size ones (the same nested
     iteration solve_aiyagari_egm_multiscale performs internally). Returns
-    None when there is nothing coarser to solve (_penultimate_warm_start)."""
-    return _penultimate_warm_start(
+    None when there is nothing coarser to solve (_penultimate_warm_start).
+    Under a precision ladder the whole warm-start product runs hot-only
+    (its consumer re-converges and polishes on the fine grid anyway); the
+    prolonged policy is cast back to the caller's grid dtype."""
+    wl, wf = _warm_stage_knobs(ladder, 0.0)
+    C0 = _penultimate_warm_start(
         a_grid, grid_power,
         lambda coarse: solve_aiyagari_egm_multiscale(
             coarse, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
             max_iter=max_iter, grid_power=grid_power,
-            relative_tol=relative_tol, accel=accel))
+            relative_tol=relative_tol, noise_floor_ulp=wf, accel=accel,
+            ladder=wl))
+    return None if C0 is None else C0.astype(a_grid.dtype)
 
 
 def ladder_warm_start_labor(a_grid, s, P, r, w, amin, *, sigma: float,
                             beta: float, psi: float, eta: float, tol: float,
                             max_iter: int, grid_power: float,
-                            relative_tol: bool = False, accel=None):
+                            relative_tol: bool = False, accel=None,
+                            ladder=None):
     """ladder_warm_start for the endogenous-labor family: the penultimate
     stage runs the labor multiscale ladder and only the consumption policy
     is prolonged (the labor/asset policies are closed-form per sweep,
     solve_aiyagari_egm_labor_multiscale's rationale). Feeds
     solve_aiyagari_egm_labor_sharded's warm start in the mesh route."""
-    return _penultimate_warm_start(
+    wl, wf = _warm_stage_knobs(ladder, 0.0)
+    C0 = _penultimate_warm_start(
         a_grid, grid_power,
         lambda coarse: solve_aiyagari_egm_labor_multiscale(
             coarse, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi,
             eta=eta, tol=tol, max_iter=max_iter, grid_power=grid_power,
-            relative_tol=relative_tol, accel=accel))
+            relative_tol=relative_tol, noise_floor_ulp=wf, accel=accel,
+            ladder=wl))
+    return None if C0 is None else C0.astype(a_grid.dtype)
 
 
 def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
@@ -449,7 +559,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   progress_every: int = 0,
                                   noise_floor_ulp: float = 0.0,
                                   use_pallas: bool = False,
-                                  accel=None) -> EGMSolution:
+                                  accel=None, ladder=None) -> EGMSolution:
     """Grid-sequenced EGM: solve on a coarse grid first, prolong the
     consumption policy to each finer grid, and re-converge there.
 
@@ -496,19 +606,23 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                             progress_every=progress_every,
                             grid_power=grid_power,
                             noise_floor_ulp=noise_floor_ulp,
-                            use_pallas=use_pallas, accel=accel)
+                            use_pallas=use_pallas, accel=accel, ladder=ladder)
     sol = _fetch_scalars(sol)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
     if bool(sol.escaped):
-        sol = _host_ladder(
-            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
-            grid_power=grid_power,
-            solve_stage=lambda C, g: solve_aiyagari_egm(
+        def retry_stage(C, g, final):
+            st_ladder, st_floor = ((ladder, noise_floor_ulp) if final else
+                                   _warm_stage_knobs(ladder, noise_floor_ulp))
+            return solve_aiyagari_egm(
                 C, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
                 max_iter=max_iter, relative_tol=relative_tol,
                 progress_every=progress_every, grid_power=0.0,
-                noise_floor_ulp=noise_floor_ulp, accel=accel))
+                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder)
+
+        sol = _host_ladder(
+            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
+            grid_power=grid_power, solve_stage=retry_stage)
     return sol
 
 
@@ -521,7 +635,7 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                                         relative_tol: bool = False,
                                         progress_every: int = 0,
                                         noise_floor_ulp: float = 0.0,
-                                        accel=None) -> EGMSolution:
+                                        accel=None, ladder=None) -> EGMSolution:
     """Grid-sequenced EGM for the endogenous-labor family — the same nested
     iteration as solve_aiyagari_egm_multiscale (see its docstring for the
     rationale and escape handling). Only the consumption policy C is
@@ -542,15 +656,19 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
     sizes = stage_sizes(n_final, coarsest, refine_factor)
 
     def run_ladder(fast: bool) -> EGMSolution:
-        return _host_ladder(
-            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
-            grid_power=grid_power,
-            solve_stage=lambda C, g: solve_aiyagari_egm_labor(
+        def stage(C, g, final):
+            st_ladder, st_floor = ((ladder, noise_floor_ulp) if final else
+                                   _warm_stage_knobs(ladder, noise_floor_ulp))
+            return solve_aiyagari_egm_labor(
                 C, g, s, P, r, w, amin, sigma=sigma, beta=beta, psi=psi,
                 eta=eta, tol=tol, max_iter=max_iter,
                 relative_tol=relative_tol, progress_every=progress_every,
                 grid_power=grid_power if fast else 0.0,
-                noise_floor_ulp=noise_floor_ulp, accel=accel))
+                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder)
+
+        return _host_ladder(
+            a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
+            grid_power=grid_power, solve_stage=stage)
 
     sol = run_ladder(fast=True)
     if bool(sol.escaped):

@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.ops.egm import constrained_consumption_labor
+from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint
 from aiyagari_tpu.parallel.ring import (
     DEFAULT_CAPACITY,
@@ -72,7 +73,7 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                                capacity: float = DEFAULT_CAPACITY,
                                pad: int = 8,
                                axis: str = "grid",
-                               accel=None) -> EGMSolution:
+                               accel=None, ladder=None) -> EGMSolution:
     """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
     knots resident per device (module docstring).
 
@@ -82,6 +83,14 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
     computes the identical extrapolation coefficients and the accelerated
     sharded trajectory matches the single-device accelerated one up to the
     same matmul-reassociation bound as the plain route.
+
+    ladder opts into the mixed-precision solve ladder exactly as in the
+    single-device solver (solvers/egm.solve_aiyagari_egm docstring): the
+    hot stages run INSIDE the same shard_map program with every carry,
+    ring slab, and collective at the hot dtype (halving the per-sweep ICI
+    neighbor traffic too), the stopping sup-norms stay pmax'd so all
+    devices switch dtype in lockstep at the identical residual, and the
+    acceleration history restarts at the cast boundary on every device.
 
     Same stopping rule, escape contract, and trajectory as the single-device
     windowed fast path (solvers/egm.solve_aiyagari_egm with grid_power>0):
@@ -124,26 +133,27 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
     run = _egm_program(mesh, axis, N, na, lo, hi, float(grid_power),
                        float(capacity), int(pad), float(sigma), float(beta),
                        float(tol), int(max_iter), bool(relative_tol),
-                       float(noise_floor_ulp), jnp.dtype(dtype).name, accel)
-    C, policy_k, dist, it, esc, tol_eff = run(
+                       float(noise_floor_ulp), jnp.dtype(dtype).name, accel,
+                       ladder)
+    C, policy_k, dist, it, esc, tol_eff, hot_it, sw_dist = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
     return _fetch_scalars(
-        EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff))
+        EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
+                    hot_it, sw_dist))
 
 
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
-                 noise_floor_ulp: float, dtype_name: str, accel=None):
+                 noise_floor_ulp: float, dtype_name: str, accel=None,
+                 ladder=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
-    dtype = jnp.dtype(dtype_name)
     span = hi - lo
-    tol_c = jnp.asarray(tol, dtype)
-    neg = jnp.array(-jnp.inf, dtype)
     proj = project_floor()
+    stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
@@ -152,74 +162,102 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
             # expression as _finish_inverse's g_of, so the sharded and
             # unsharded routes interpolate onto bitwise-identical queries.
             j = dev * na_loc + jnp.arange(na_loc)
-            q = lo + span * (j.astype(dtype) / (na - 1)) ** power
 
-            def sweep(C):
-                # ops/egm.egm_step steps 1-6 on the local shard; see its
-                # docstring for the operator and the cummax/clip rationale.
-                RHS = (1.0 + r) * expectation(Pm, crra_marginal(C, sigma), beta)
-                c_next = crra_marginal_inverse(RHS, sigma)
-                a_hat = (c_next + a_loc[None, :] - w * s[:, None]) / (1.0 + r)
-                # Global cummax = local cummax + cross-device prefix of the
-                # shard tails (max is associative: bitwise-equal to the
-                # unsharded lax.cummax over the full row).
-                a_hat = jax.lax.cummax(a_hat, axis=1)
-                tails = jax.lax.all_gather(a_hat[:, -1], axis)       # [D, N]
-                mask = (jnp.arange(D) < dev)[:, None]
-                pref = jnp.max(jnp.where(mask, tails, neg), axis=0)  # [N]
-                a_hat = jnp.maximum(a_hat, pref[:, None])
-                out, esc = ring_inverse_local(
-                    a_hat, q, axis=axis, D=D, n_k=na, n_q=na,
-                    lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
-                )
-                policy_k = jnp.clip(out, amin, hi)
-                C_new = (1.0 + r) * a_loc[None, :] + w * s[:, None] - policy_k
-                return C_new, policy_k, esc
+            def run_stage(spec, C_in, pk_in, it0, esc0):
+                dt = jnp.dtype(spec.dtype)
+                prec = matmul_precision_of(spec.matmul_precision)
+                a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
+                r_d, w_d, am_d = r.astype(dt), w.astype(dt), amin.astype(dt)
+                q = lo + span * (j.astype(dt) / (na - 1)) ** power
+                tol_c = jnp.asarray(tol, dt)
+                neg = jnp.array(-jnp.inf, dt)
 
-            def cond(carry):
-                _, _, _, dist, it, _, tol_eff, _ = carry
-                return (dist >= tol_eff) & (it < max_iter)
+                def sweep(C):
+                    # ops/egm.egm_step steps 1-6 on the local shard; see its
+                    # docstring for the operator and the cummax/clip rationale.
+                    RHS = (1.0 + r_d) * expectation(
+                        P_d, crra_marginal(C, sigma), beta, precision=prec)
+                    c_next = crra_marginal_inverse(RHS, sigma)
+                    a_hat = (c_next + a_l[None, :] - w_d * s_d[:, None]) / (1.0 + r_d)
+                    # Global cummax = local cummax + cross-device prefix of the
+                    # shard tails (max is associative: bitwise-equal to the
+                    # unsharded lax.cummax over the full row).
+                    a_hat = jax.lax.cummax(a_hat, axis=1)
+                    tails = jax.lax.all_gather(a_hat[:, -1], axis)       # [D, N]
+                    mask = (jnp.arange(D) < dev)[:, None]
+                    pref = jnp.max(jnp.where(mask, tails, neg), axis=0)  # [N]
+                    a_hat = jnp.maximum(a_hat, pref[:, None])
+                    out, esc = ring_inverse_local(
+                        a_hat, q, axis=axis, D=D, n_k=na, n_q=na,
+                        lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
+                    )
+                    policy_k = jnp.clip(out, am_d, hi)
+                    C_new = (1.0 + r_d) * a_l[None, :] + w_d * s_d[:, None] - policy_k
+                    return C_new, policy_k, esc
 
-            def body(carry):
-                C, _, _, _, it, esc, _, ast = carry
-                C_new, policy_k, esc_new = sweep(C)
-                diff = jnp.abs(C_new - C)
-                # Same criterion family as solve_aiyagari_egm: relative
-                # sup-norm when asked, else absolute (+ optional floor).
-                local = (jnp.max(diff / (jnp.abs(C) + 1e-10))
-                         if relative_tol else jnp.max(diff))
-                dist = jax.lax.pmax(local, axis)
-                # Sup-norm pmax'd so the effective tolerance is global.
-                tol_eff = effective_tolerance(
-                    tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
-                    noise_floor_ulp=noise_floor_ulp,
-                    relative_tol=relative_tol, dtype=dtype)
-                if accel is None:
-                    C_next = C_new
-                else:
-                    # Global extrapolation on local shards: inner products
-                    # psum, safeguard norms pmax (accel_step's axis hook).
-                    C_next, ast = accel_step(ast, C, C_new, accel=accel,
-                                             axis=axis, project=proj)
-                return (C_next, C_new, policy_k, dist, it + 1,
-                        esc | (esc_new > 0), tol_eff, ast)
+                def cond(carry):
+                    _, _, _, dist, it, _, tol_eff, _ = carry
+                    return (dist >= tol_eff) & (it < max_iter)
 
-            ast0 = accel_init(C0, accel) if accel is not None else None
-            init = (C0, C0, jnp.zeros_like(C0), jnp.array(jnp.inf, dtype),
-                    jnp.int32(0), jnp.array(False), tol_c, ast0)
-            out = jax.lax.while_loop(cond, body, init)
-            return out[1:7]
+                def body(carry):
+                    C, _, _, _, it, esc, _, ast = carry
+                    C_new, policy_k, esc_new = sweep(C)
+                    diff = jnp.abs(C_new - C)
+                    # Same criterion family as solve_aiyagari_egm: relative
+                    # sup-norm when asked, else absolute (+ optional floor).
+                    loc = (jnp.max(diff / (jnp.abs(C) + 1e-10))
+                           if relative_tol else jnp.max(diff))
+                    dist = jax.lax.pmax(loc, axis)
+                    # Sup-norm pmax'd so the effective tolerance is global —
+                    # under a ladder every device therefore switches dtype
+                    # at the same sweep.
+                    tol_eff = effective_tolerance(
+                        tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
+                        noise_floor_ulp=spec.noise_floor_ulp,
+                        relative_tol=relative_tol, dtype=dt)
+                    if accel is None:
+                        C_next = C_new
+                    else:
+                        # Global extrapolation on local shards: inner products
+                        # psum, safeguard norms pmax (accel_step's axis hook).
+                        C_next, ast = accel_step(ast, C, C_new, accel=accel,
+                                                 axis=axis, project=proj)
+                    return (C_next, C_new, policy_k, dist, it + 1,
+                            esc | (esc_new > 0), tol_eff, ast)
+
+                # Fresh acceleration history per stage: a stale hot-dtype
+                # residual history would poison the polish's normal
+                # equations (ops/accel.py restart semantics).
+                Cd = C_in.astype(dt)
+                ast0 = accel_init(Cd, accel) if accel is not None else None
+                init = (Cd, Cd, pk_in.astype(dt), jnp.array(jnp.inf, dt),
+                        it0, esc0, tol_c, ast0)
+                out = jax.lax.while_loop(cond, body, init)
+                return out[1], out[2], out[3], out[4], out[5], out[6]
+
+            C, pk = C0, jnp.zeros_like(C0)
+            it, esc = jnp.int32(0), jnp.array(False)
+            hot_it = jnp.int32(0)
+            sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+            dist = tol_eff = None
+            for spec in stages:
+                C, pk, dist, it, esc, tol_eff = run_stage(spec, C, pk, it, esc)
+                if not spec.is_final:
+                    hot_it = it
+                    sw = dist.astype(sw.dtype)
+            return C, pk, dist, it, esc, tol_eff, hot_it, sw
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
-            out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P()),
+            out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P(),
+                       P(), P()),
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, tol, max_iter,
                                           relative_tol, noise_floor_ulp,
-                                          dtype_name, accel)
+                                          dtype_name, accel, ladder)
     return cached_program(_EGM_PROGRAMS, key, build)
 
 
@@ -235,7 +273,7 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                                      capacity: float = DEFAULT_CAPACITY,
                                      pad: int = 8,
                                      axis: str = "grid",
-                                     accel=None) -> EGMSolution:
+                                     accel=None, ladder=None) -> EGMSolution:
     """solve_aiyagari_egm_labor with the grid axis sharded over mesh[axis]
     and the endogenous (knot, consumption) pairs resident per device — the
     labor-family form of solve_aiyagari_egm_sharded, generalizing the ring
@@ -283,123 +321,149 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                              float(beta), float(psi), float(eta), float(tol),
                              int(max_iter), bool(relative_tol),
                              float(noise_floor_ulp), jnp.dtype(dtype).name,
-                             accel)
-    C, policy_k, policy_l, dist, it, esc, tol_eff = run(
+                             accel, ladder)
+    C, policy_k, policy_l, dist, it, esc, tol_eff, hot_it, sw_dist = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
     return _fetch_scalars(
-        EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff))
+        EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
+                    hot_it, sw_dist))
 
 
 def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                        power: float, capacity: float, pad: int, sigma: float,
                        beta: float, psi: float, eta: float, tol: float,
                        max_iter: int, relative_tol: bool,
-                       noise_floor_ulp: float, dtype_name: str, accel=None):
+                       noise_floor_ulp: float, dtype_name: str, accel=None,
+                       ladder=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
-    dtype = jnp.dtype(dtype_name)
     span = hi - lo
-    tol_c = jnp.asarray(tol, dtype)
-    neg = jnp.array(-jnp.inf, dtype)
     proj = project_floor()
+    stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
             dev = jax.lax.axis_index(axis)
             j = dev * na_loc + jnp.arange(na_loc)
-            q = lo + span * (j.astype(dtype) / (na - 1)) ** power
-            ws = w * s[:, None]                                   # [N, 1]
-            # Loop-invariant constrained-region solution on the local grid
-            # slice (elementwise in a_grid — no communication).
-            c_con = constrained_consumption_labor(
-                a_loc, s, r, w, amin, sigma=sigma, psi=psi, eta=eta)
 
-            def sweep(C):
-                # ops/egm.egm_step_labor on the local shard; see its
-                # docstring for the operator and the reference quirks kept.
-                RHS = (1.0 + r) * expectation(Pm, crra_marginal(C, sigma), beta)
-                c_next = crra_marginal_inverse(RHS, sigma)
-                l_endo = labor_foc_inverse(
-                    ws * crra_marginal(c_next, sigma), psi, eta)      # :86
-                a_hat = (c_next + a_loc[None, :] - ws * l_endo) / (1.0 + r)
-                # Global cummax on BOTH arrays: local cummax + cross-device
-                # prefix of the shard tails (associative, bitwise-equal to
-                # the unsharded row cummax). One stacked all_gather also
-                # carries the global first endogenous knot for the
-                # constrained region (device 0's head is prefix-free).
-                a_hat = jax.lax.cummax(a_hat, axis=1)
-                c_next = jax.lax.cummax(c_next, axis=1)
-                packed = jnp.stack(
-                    [a_hat[:, -1], c_next[:, -1], a_hat[:, 0]])   # [3, N]
-                g = jax.lax.all_gather(packed, axis)              # [D, 3, N]
-                mask = (jnp.arange(D) < dev)[:, None]
-                a_hat = jnp.maximum(
-                    a_hat, jnp.max(jnp.where(mask, g[:, 0], neg), axis=0)[:, None])
-                c_next = jnp.maximum(
-                    c_next, jnp.max(jnp.where(mask, g[:, 1], neg), axis=0)[:, None])
-                first_knot = g[0, 2]                              # [N]
-                g_c, esc = ring_interp_local(
-                    a_hat, c_next, q, axis=axis, D=D, n_k=na, n_q=na,
-                    lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
-                )
-                # Constrained region + the reference's sequencing quirks,
-                # exactly as ops/egm.egm_step_labor (its comments) — against
-                # the CALLER's grid shard, as the single-device route
-                # compares a_grid, not the analytic rebuild.
-                g_c = jnp.where(a_loc[None, :] < first_knot[:, None], c_con, g_c)
-                g_c = jnp.where(a_loc[None, :] < amin, amin, g_c)         # :91
-                # The constrained-region overwrite is FINITE, so it would
-                # partially un-poison an escaped sweep — re-poison to keep
-                # the whole-solution NaN contract of the exogenous route.
-                g_c = jnp.where(esc > 0, jnp.nan, g_c)
-                policy_l = labor_foc_inverse(
-                    ws * crra_marginal(g_c, sigma), psi, eta)             # :95
-                policy_k = jnp.clip(
-                    (1.0 + r) * a_loc[None, :] + ws * policy_l - g_c,
-                    0.0, hi)                                              # :99
-                return g_c, policy_k, policy_l, esc
+            def run_stage(spec, C_in, pk_in, pl_in, it0, esc0):
+                dt = jnp.dtype(spec.dtype)
+                prec = matmul_precision_of(spec.matmul_precision)
+                a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
+                r_d, w_d, am_d = r.astype(dt), w.astype(dt), amin.astype(dt)
+                q = lo + span * (j.astype(dt) / (na - 1)) ** power
+                tol_c = jnp.asarray(tol, dt)
+                neg = jnp.array(-jnp.inf, dt)
+                ws = w_d * s_d[:, None]                               # [N, 1]
+                # Loop-invariant constrained-region solution on the local
+                # grid slice (elementwise in a_grid — no communication);
+                # rebuilt per stage: loop-invariant but dtype-dependent.
+                c_con = constrained_consumption_labor(
+                    a_l, s_d, r_d, w_d, am_d, sigma=sigma, psi=psi, eta=eta)
 
-            def cond(carry):
-                _, _, _, _, dist, it, _, tol_eff, _ = carry
-                return (dist >= tol_eff) & (it < max_iter)
+                def sweep(C):
+                    # ops/egm.egm_step_labor on the local shard; see its
+                    # docstring for the operator and the reference quirks kept.
+                    RHS = (1.0 + r_d) * expectation(
+                        P_d, crra_marginal(C, sigma), beta, precision=prec)
+                    c_next = crra_marginal_inverse(RHS, sigma)
+                    l_endo = labor_foc_inverse(
+                        ws * crra_marginal(c_next, sigma), psi, eta)      # :86
+                    a_hat = (c_next + a_l[None, :] - ws * l_endo) / (1.0 + r_d)
+                    # Global cummax on BOTH arrays: local cummax + cross-device
+                    # prefix of the shard tails (associative, bitwise-equal to
+                    # the unsharded row cummax). One stacked all_gather also
+                    # carries the global first endogenous knot for the
+                    # constrained region (device 0's head is prefix-free).
+                    a_hat = jax.lax.cummax(a_hat, axis=1)
+                    c_next = jax.lax.cummax(c_next, axis=1)
+                    packed = jnp.stack(
+                        [a_hat[:, -1], c_next[:, -1], a_hat[:, 0]])   # [3, N]
+                    g = jax.lax.all_gather(packed, axis)              # [D, 3, N]
+                    mask = (jnp.arange(D) < dev)[:, None]
+                    a_hat = jnp.maximum(
+                        a_hat, jnp.max(jnp.where(mask, g[:, 0], neg), axis=0)[:, None])
+                    c_next = jnp.maximum(
+                        c_next, jnp.max(jnp.where(mask, g[:, 1], neg), axis=0)[:, None])
+                    first_knot = g[0, 2]                              # [N]
+                    g_c, esc = ring_interp_local(
+                        a_hat, c_next, q, axis=axis, D=D, n_k=na, n_q=na,
+                        lo=lo, hi=hi, power=power, capacity=capacity, pad=pad,
+                    )
+                    # Constrained region + the reference's sequencing quirks,
+                    # exactly as ops/egm.egm_step_labor (its comments) — against
+                    # the CALLER's grid shard, as the single-device route
+                    # compares a_grid, not the analytic rebuild.
+                    g_c = jnp.where(a_l[None, :] < first_knot[:, None], c_con, g_c)
+                    g_c = jnp.where(a_l[None, :] < am_d, am_d, g_c)       # :91
+                    # The constrained-region overwrite is FINITE, so it would
+                    # partially un-poison an escaped sweep — re-poison to keep
+                    # the whole-solution NaN contract of the exogenous route.
+                    g_c = jnp.where(esc > 0, jnp.nan, g_c)
+                    policy_l = labor_foc_inverse(
+                        ws * crra_marginal(g_c, sigma), psi, eta)         # :95
+                    policy_k = jnp.clip(
+                        (1.0 + r_d) * a_l[None, :] + ws * policy_l - g_c,
+                        0.0, hi)                                          # :99
+                    return g_c, policy_k, policy_l, esc
 
-            def body(carry):
-                C, _, _, _, _, it, esc, _, ast = carry
-                C_new, policy_k, policy_l, esc_new = sweep(C)
-                diff = jnp.abs(C_new - C)
-                local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
-                           if relative_tol else jnp.max(diff))
-                dist = jax.lax.pmax(local_d, axis)
-                tol_eff = effective_tolerance(
-                    tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
-                    noise_floor_ulp=noise_floor_ulp,
-                    relative_tol=relative_tol, dtype=dtype)
-                if accel is None:
-                    C_next = C_new
-                else:
-                    C_next, ast = accel_step(ast, C, C_new, accel=accel,
-                                             axis=axis, project=proj)
-                return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                        esc | (esc_new > 0), tol_eff, ast)
+                def cond(carry):
+                    _, _, _, _, dist, it, _, tol_eff, _ = carry
+                    return (dist >= tol_eff) & (it < max_iter)
+
+                def body(carry):
+                    C, _, _, _, _, it, esc, _, ast = carry
+                    C_new, policy_k, policy_l, esc_new = sweep(C)
+                    diff = jnp.abs(C_new - C)
+                    local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
+                               if relative_tol else jnp.max(diff))
+                    dist = jax.lax.pmax(local_d, axis)
+                    tol_eff = effective_tolerance(
+                        tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
+                        noise_floor_ulp=spec.noise_floor_ulp,
+                        relative_tol=relative_tol, dtype=dt)
+                    if accel is None:
+                        C_next = C_new
+                    else:
+                        C_next, ast = accel_step(ast, C, C_new, accel=accel,
+                                                 axis=axis, project=proj)
+                    return (C_next, C_new, policy_k, policy_l, dist, it + 1,
+                            esc | (esc_new > 0), tol_eff, ast)
+
+                Cd = C_in.astype(dt)
+                ast0 = accel_init(Cd, accel) if accel is not None else None
+                init = (Cd, Cd, pk_in.astype(dt), pl_in.astype(dt),
+                        jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0)
+                out = jax.lax.while_loop(cond, body, init)
+                return (out[1], out[2], out[3], out[4], out[5], out[6],
+                        out[7])
 
             z = jnp.zeros_like(C0)
-            ast0 = accel_init(C0, accel) if accel is not None else None
-            init = (C0, C0, z, z, jnp.array(jnp.inf, dtype), jnp.int32(0),
-                    jnp.array(False), tol_c, ast0)
-            out = jax.lax.while_loop(cond, body, init)
-            return out[1:8]
+            C, pk, pl = C0, z, z
+            it, esc = jnp.int32(0), jnp.array(False)
+            hot_it = jnp.int32(0)
+            sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+            dist = tol_eff = None
+            for spec in stages:
+                C, pk, pl, dist, it, esc, tol_eff = run_stage(
+                    spec, C, pk, pl, it, esc)
+                if not spec.is_final:
+                    hot_it = it
+                    sw = dist.astype(sw.dtype)
+            return C, pk, pl, dist, it, esc, tol_eff, hot_it, sw
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(None, axis),
-                       P(), P(), P(), P()),
+                       P(), P(), P(), P(), P(), P()),
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, psi, eta, tol,
                                           max_iter, relative_tol,
-                                          noise_floor_ulp, dtype_name, accel)
+                                          noise_floor_ulp, dtype_name, accel,
+                                          ladder)
     return cached_program(_EGM_LABOR_PROGRAMS, key, build)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
 from aiyagari_tpu.ops.bellman import (
     expectation,
@@ -65,65 +66,111 @@ class VFISolution:
     # needs both, since an EGM-warm-started solve is almost all evaluation.
     eval_sweeps: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.array(0, jnp.int32))
+    # Mixed-precision ladder telemetry (ops/precision.py; 0 when no ladder
+    # ran): sweeps executed in the hot (pre-polish) stages, and the value
+    # residual at which the dtype switch fired (cf. EGMSolution).
+    hot_iterations: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0, jnp.int32))
+    switch_distance: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.array(0.0))
 
 
 def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                              tol: float, max_iter: int, howard_steps: int = 0,
                              block_size: int = 0, relative_tol: bool = False,
-                             use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
-
-    def eval_sweeps(v, idx):
-        if howard_steps <= 0:
-            return v
-
-        def body(v, _):
-            return howard_eval_step(v, idx, a_grid, s, P, r, w, sigma=sigma, beta=beta), None
-
-        v, _ = jax.lax.scan(body, v, None, length=howard_steps)
-        return v
-
-    def cond(carry):
-        _, _, dist, it = carry
-        return (dist >= tol) & (it < max_iter)
-
-    # Dense path: the masked choice-utility tensor is loop-invariant, so
-    # compute it once here and keep only EV + add + max inside the while_loop
-    # (choice_utility_tensor docstring). Blocked/Pallas paths keep the fused
-    # per-sweep form — at their scales the [N, na, na'] tensor is the thing
-    # that must NOT be materialized.
+                             use_pallas: bool = False, progress_every: int = 0,
+                             noise_floor_ulp: float = 0.0,
+                             ladder=None) -> VFISolution:
+    stages = plan_stages(ladder, v_init.dtype, noise_floor_ulp)
     na = v_init.shape[1]
     dense = block_size <= 0 or block_size >= na
-    U = (choice_utility_tensor(a_grid, s, r, w, sigma=sigma, dtype=v_init.dtype)
-         if dense and not use_pallas else None)
 
-    def body(carry):
-        v, idx, _, it = carry
-        if U is not None:
-            v_new, idx = bellman_step_precomputed(v, U, P, beta=beta)
-        else:
-            v_new, idx = bellman_step(v, a_grid, s, P, r, w, sigma=sigma, beta=beta,
-                                      block_size=block_size, use_pallas=use_pallas)
-        diff = jnp.abs(v_new - v)
-        dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
-        device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
-        v_new = eval_sweeps(v_new, idx)
-        return v_new, idx, dist, it + 1
+    def run_stage(spec, v0, idx0, it0):
+        dt = jnp.dtype(spec.dtype)
+        # None = backend default; the ladder's hot stages may relax the
+        # expectation contraction (bf16 MXU on TPU), the final/no-ladder
+        # stage keeps the historical HIGHEST pin.
+        prec = (matmul_precision_of(spec.matmul_precision)
+                or jax.lax.Precision.DEFAULT)
+        ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
+        rd, wd = jnp.asarray(r).astype(dt), jnp.asarray(w).astype(dt)
+        sig, bet = jnp.asarray(sigma).astype(dt), jnp.asarray(beta).astype(dt)
+        tol_c = jnp.asarray(tol, dt)
 
-    init = (
-        v_init,
-        jnp.zeros(v_init.shape, jnp.int32),
-        jnp.array(jnp.inf, v_init.dtype),
-        jnp.int32(0),
-    )
-    v, idx, dist, it = jax.lax.while_loop(cond, body, init)
-    policy_k = a_grid[idx]
-    policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] - policy_k
+        def eval_sweeps(v, idx):
+            if howard_steps <= 0:
+                return v
+
+            def body(v, _):
+                return howard_eval_step(v, idx, ag, sd, Pd, rd, wd,
+                                        sigma=sig, beta=bet,
+                                        precision=prec), None
+
+            v, _ = jax.lax.scan(body, v, None, length=howard_steps)
+            return v
+
+        def cond(carry):
+            _, _, dist, it, tol_eff = carry
+            return (dist >= tol_eff) & (it < max_iter)
+
+        # Dense path: the masked choice-utility tensor is loop-invariant, so
+        # compute it once here (per ladder stage: loop-invariant but
+        # dtype-dependent — the hot stage's HALF-WIDTH U tensor is exactly
+        # the HBM-bytes saving the ladder exists for) and keep only
+        # EV + add + max inside the while_loop (choice_utility_tensor
+        # docstring). Blocked/Pallas paths keep the fused per-sweep form —
+        # at their scales the [N, na, na'] tensor is the thing that must NOT
+        # be materialized.
+        U = (choice_utility_tensor(ag, sd, rd, wd, sigma=sig, dtype=dt)
+             if dense and not use_pallas else None)
+
+        def body(carry):
+            v, idx, _, it, _ = carry
+            if U is not None:
+                v_new, idx = bellman_step_precomputed(v, U, Pd, beta=bet,
+                                                      precision=prec)
+            else:
+                v_new, idx = bellman_step(v, ag, sd, Pd, rd, wd, sigma=sig,
+                                          beta=bet, block_size=block_size,
+                                          use_pallas=use_pallas,
+                                          precision=prec)
+            diff = jnp.abs(v_new - v)
+            dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
+            tol_eff = effective_tolerance(
+                tol_c, jnp.max(jnp.abs(v_new)),
+                noise_floor_ulp=spec.noise_floor_ulp,
+                relative_tol=relative_tol, dtype=dt)
+            device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
+            v_new = eval_sweeps(v_new, idx)
+            return v_new, idx, dist, it + 1, tol_eff
+
+        init = (v0.astype(dt), idx0, jnp.array(jnp.inf, dt), it0, tol_c)
+        return jax.lax.while_loop(cond, body, init)
+
+    v, idx = v_init, jnp.zeros(v_init.shape, jnp.int32)
+    it = jnp.int32(0)
+    hot_it = jnp.int32(0)
+    switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    dist = tol_eff = None
+    for spec in stages:
+        v, idx, dist, it, tol_eff = run_stage(spec, v, idx, it)
+        if not spec.is_final:
+            hot_it = it
+            switch_dist = dist.astype(switch_dist.dtype)
+    dt_f = jnp.dtype(stages[-1].dtype)
+    ag_f = a_grid.astype(dt_f)
+    policy_k = ag_f[idx]
+    policy_c = ((1.0 + jnp.asarray(r).astype(dt_f)) * ag_f[None, :]
+                + jnp.asarray(w).astype(dt_f) * s.astype(dt_f)[:, None]
+                - policy_k)
     return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it,
-                       dist, jnp.asarray(tol, v.dtype))
+                       dist, tol_eff, hot_iterations=hot_it,
+                       switch_distance=switch_dist)
 
 
 _VFI_STATIC = ("tol", "max_iter", "howard_steps", "block_size",
-               "relative_tol", "use_pallas", "progress_every")
+               "relative_tol", "use_pallas", "progress_every",
+               "noise_floor_ulp", "ladder")
 # Default program: sigma/beta are TRACED operands, so (a) a batch of scenarios
 # differing only in preferences compiles once, and (b) the whole solve vmaps
 # over (r, sigma, beta, ...) — the batched-GE requirement. The Pallas route
@@ -138,7 +185,9 @@ _solve_vfi_static_prefs = partial(
 def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
                        tol: float, max_iter: int, howard_steps: int = 0,
                        block_size: int = 0, relative_tol: bool = False,
-                       use_pallas: bool = False, progress_every: int = 0) -> VFISolution:
+                       use_pallas: bool = False, progress_every: int = 0,
+                       noise_floor_ulp: float = 0.0,
+                       ladder=None) -> VFISolution:
     """Iterate the Bellman operator to a sup-norm fixed point.
 
     Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
@@ -153,12 +202,29 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
     equilibrium/batched.py builds its excess-demand kernel on exactly this).
     Exception: use_pallas=True requires concrete Python floats for them, since
     the fused Pallas kernel specializes on sigma at compile time.
+
+    ladder (a PrecisionLadderConfig, static) opts into the mixed-precision
+    solve ladder: the early Bellman/Howard sweeps run in the hot dtype
+    against a HALF-WIDTH precomputed choice-utility tensor (the U read is
+    the dense sweep's dominant HBM term — diagnostics/roofline.
+    vfi_sweep_cost), switch at max(tol, switch_ulp * eps * max|v|), then
+    the full-precision loop polishes to the reference criterion
+    (solvers/egm.solve_aiyagari_egm's ladder semantics, applied to the
+    value iterate). noise_floor_ulp is the f32 stopping-rule floor of the
+    FINAL stage (solvers/_stopping.effective_tolerance; 0 = strict).
+    Incompatible with use_pallas (the fused kernel bakes one dtype in).
     """
+    if ladder is not None and use_pallas:
+        raise ValueError(
+            "the mixed-precision ladder cannot route through the fused "
+            "Pallas Bellman kernel (it specializes one dtype at compile "
+            "time); drop use_pallas or ladder")
     fn = _solve_vfi_static_prefs if use_pallas else _solve_vfi_traced
     return fn(v_init, a_grid, s, P, r, w, sigma, beta, tol=tol,
               max_iter=max_iter, howard_steps=howard_steps,
               block_size=block_size, relative_tol=relative_tol,
-              use_pallas=use_pallas, progress_every=progress_every)
+              use_pallas=use_pallas, progress_every=progress_every,
+              noise_floor_ulp=noise_floor_ulp, ladder=ladder)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
@@ -828,64 +894,107 @@ def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
         warm_policy_k=egm_solution.policy_k)
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every", "noise_floor_ulp", "ladder"))
 def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                              beta, psi, eta, tol: float,
                              max_iter: int, howard_steps: int = 0,
                              relative_tol: bool = False,
-                             progress_every: int = 0) -> VFISolution:
+                             progress_every: int = 0,
+                             noise_floor_ulp: float = 0.0,
+                             ladder=None) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
     (Aiyagari_Endogenous_Labor_VFI.m:64-122). Preference scalars are traced
-    operands (vmap/scenario-batch compatible), like solve_aiyagari_vfi."""
-
-    def eval_sweeps(v, a_idx, l_idx):
-        if howard_steps <= 0:
-            return v
-
-        def body(v, _):
-            return howard_eval_step_labor(
-                v, a_idx, l_idx, a_grid, labor_grid, s, P, r, w,
-                sigma=sigma, beta=beta, psi=psi, eta=eta,
-            ), None
-
-        v, _ = jax.lax.scan(body, v, None, length=howard_steps)
-        return v
-
-    def cond(carry):
-        return (carry[3] >= tol) & (carry[4] < max_iter)
-
-    # Hoist the loop-invariant [nl, N, na, na'] joint-choice utility when it
-    # fits comfortably in HBM (reference scale: 10x7x400x400 f64 = 90 MB);
-    # beyond that fall back to the scanned per-labor form. Peak per-sweep
-    # memory is ~3x U4 (q = U4 + EV, plus the transpose copy for the flat
-    # argmax), so the cap budgets U4 itself at 128 MB.
+    operands (vmap/scenario-batch compatible), like solve_aiyagari_vfi —
+    whose ladder/noise_floor_ulp semantics apply here verbatim (the hot
+    stage's half-width [nl, N, na, na'] U4 tensor is the dominant HBM
+    saving)."""
+    stages = plan_stages(ladder, v_init.dtype, noise_floor_ulp)
     N, na = v_init.shape
     nl = labor_grid.shape[0]
-    U4 = None
-    if nl * N * na * na * jnp.dtype(v_init.dtype).itemsize <= 128 * 1024 ** 2:
-        U4 = labor_choice_utility_tensor(a_grid, labor_grid, s, r, w,
-                                         sigma=sigma, psi=psi, eta=eta,
-                                         dtype=v_init.dtype)
 
-    def body(carry):
-        v, a_idx, l_idx, _, it = carry
-        if U4 is not None:
-            v_new, a_idx, l_idx = bellman_step_labor_precomputed(v, U4, P, beta=beta)
-        else:
-            v_new, a_idx, l_idx = bellman_step_labor(
-                v, a_grid, labor_grid, s, P, r, w, sigma=sigma, beta=beta, psi=psi, eta=eta
-            )
-        diff = jnp.abs(v_new - v)
-        dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
-        device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)
-        v_new = eval_sweeps(v_new, a_idx, l_idx)
-        return v_new, a_idx, l_idx, dist, it + 1
+    def run_stage(spec, v0, a_idx0, l_idx0, it0):
+        dt = jnp.dtype(spec.dtype)
+        prec = (matmul_precision_of(spec.matmul_precision)
+                or jax.lax.Precision.DEFAULT)
+        ag, lg = a_grid.astype(dt), labor_grid.astype(dt)
+        sd, Pd = s.astype(dt), P.astype(dt)
+        rd, wd = jnp.asarray(r).astype(dt), jnp.asarray(w).astype(dt)
+        sig, bet, psid, etad = (jnp.asarray(x).astype(dt)
+                                for x in (sigma, beta, psi, eta))
+        tol_c = jnp.asarray(tol, dt)
+
+        def eval_sweeps(v, a_idx, l_idx):
+            if howard_steps <= 0:
+                return v
+
+            def body(v, _):
+                return howard_eval_step_labor(
+                    v, a_idx, l_idx, ag, lg, sd, Pd, rd, wd,
+                    sigma=sig, beta=bet, psi=psid, eta=etad,
+                    precision=prec,
+                ), None
+
+            v, _ = jax.lax.scan(body, v, None, length=howard_steps)
+            return v
+
+        def cond(carry):
+            return (carry[3] >= carry[5]) & (carry[4] < max_iter)
+
+        # Hoist the loop-invariant [nl, N, na, na'] joint-choice utility when
+        # it fits comfortably in HBM (reference scale: 10x7x400x400 f64 =
+        # 90 MB); beyond that fall back to the scanned per-labor form. Peak
+        # per-sweep memory is ~3x U4 (q = U4 + EV, plus the transpose copy
+        # for the flat argmax), so the cap budgets U4 itself at 128 MB —
+        # per stage dtype, so a hot f32 stage fits twice the grid.
+        U4 = None
+        if nl * N * na * na * jnp.dtype(dt).itemsize <= 128 * 1024 ** 2:
+            U4 = labor_choice_utility_tensor(ag, lg, sd, rd, wd,
+                                             sigma=sig, psi=psid, eta=etad,
+                                             dtype=dt)
+
+        def body(carry):
+            v, a_idx, l_idx, _, it, _ = carry
+            if U4 is not None:
+                v_new, a_idx, l_idx = bellman_step_labor_precomputed(
+                    v, U4, Pd, beta=bet, precision=prec)
+            else:
+                v_new, a_idx, l_idx = bellman_step_labor(
+                    v, ag, lg, sd, Pd, rd, wd, sigma=sig, beta=bet,
+                    psi=psid, eta=etad, precision=prec
+                )
+            diff = jnp.abs(v_new - v)
+            dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
+            tol_eff = effective_tolerance(
+                tol_c, jnp.max(jnp.abs(v_new)),
+                noise_floor_ulp=spec.noise_floor_ulp,
+                relative_tol=relative_tol, dtype=dt)
+            device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)
+            v_new = eval_sweeps(v_new, a_idx, l_idx)
+            return v_new, a_idx, l_idx, dist, it + 1, tol_eff
+
+        init = (v0.astype(dt), a_idx0, l_idx0, jnp.array(jnp.inf, dt), it0,
+                tol_c)
+        return jax.lax.while_loop(cond, body, init)
 
     zeros_i = jnp.zeros(v_init.shape, jnp.int32)
-    init = (v_init, zeros_i, zeros_i, jnp.array(jnp.inf, v_init.dtype), jnp.int32(0))
-    v, a_idx, l_idx, dist, it = jax.lax.while_loop(cond, body, init)
-    policy_k = a_grid[a_idx]
-    policy_l = labor_grid[l_idx]
-    policy_c = (1.0 + r) * a_grid[None, :] + w * s[:, None] * policy_l - policy_k
+    v, a_idx, l_idx = v_init, zeros_i, zeros_i
+    it = jnp.int32(0)
+    hot_it = jnp.int32(0)
+    switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    dist = tol_eff = None
+    for spec in stages:
+        v, a_idx, l_idx, dist, it, tol_eff = run_stage(spec, v, a_idx,
+                                                       l_idx, it)
+        if not spec.is_final:
+            hot_it = it
+            switch_dist = dist.astype(switch_dist.dtype)
+    dt_f = jnp.dtype(stages[-1].dtype)
+    ag_f, lg_f = a_grid.astype(dt_f), labor_grid.astype(dt_f)
+    policy_k = ag_f[a_idx]
+    policy_l = lg_f[l_idx]
+    policy_c = ((1.0 + jnp.asarray(r).astype(dt_f)) * ag_f[None, :]
+                + jnp.asarray(w).astype(dt_f) * s.astype(dt_f)[:, None]
+                * policy_l - policy_k)
     return VFISolution(v, a_idx, policy_k, policy_c, policy_l, it, dist,
-                       jnp.asarray(tol, v.dtype))
+                       tol_eff, hot_iterations=hot_it,
+                       switch_distance=switch_dist)

@@ -100,6 +100,13 @@ class TransitionResult:
     policies: object = None     # {"C_ts", "k_ts"} device arrays [T, N, na]
     mu_T: object = None         # terminal distribution (device)
     jacobian: object = None     # the Newton J_D, for reuse
+    # Mixed-precision ladder telemetry (ops/precision.py; 0/0.0 when no
+    # ladder ran): rounds whose path evaluation ran in a hot dtype —
+    # counted whether or not the switch fired, so a round-capped all-hot
+    # solve reports them honestly — and the max excess demand at which the
+    # dtype switch fired (0.0 = the switch never fired).
+    hot_rounds: int = 0
+    switch_excess: float = 0.0
 
 
 @dataclasses.dataclass
@@ -120,6 +127,10 @@ class TransitionSweepResult:
     r_ss: float
     ss: object
     jacobian: object = None
+    # Mixed-precision ladder telemetry (lockstep: all scenarios share one
+    # program dtype, so the switch is global over the batch).
+    hot_rounds: int = 0
+    switch_excess: float = 0.0
 
 
 def shock_paths(model: AiyagariModel, shock: MITShock, T: int) -> dict:
@@ -232,11 +243,13 @@ def transition_jacobian(model: AiyagariModel, ss, T: int) -> np.ndarray:
                            alpha=tech.alpha, delta=tech.delta)
 
 
-def _device_paths(model: AiyagariModel, r_path, paths, r_ss):
+def _device_paths(model: AiyagariModel, r_path, paths, r_ss, dtype=None):
     """(r_ext, w_path, beta_path, sigma_ext, amin_path) device arrays for
-    one round's path program, from the host rate path + shock paths."""
+    one round's path program, from the host rate path + shock paths.
+    `dtype` overrides the model dtype (the mixed-precision ladder's hot
+    rounds evaluate the whole path program in the hot dtype)."""
     tech = model.config.technology
-    dt = model.dtype
+    dt = model.dtype if dtype is None else dtype
     w = wage_from_r(r_path, tech.alpha, tech.delta, paths["z"])
     r_ext = np.concatenate([r_path, [r_ss]])
     sig_ext = np.concatenate([paths["sigma"],
@@ -244,6 +257,48 @@ def _device_paths(model: AiyagariModel, r_path, paths, r_ss):
     return (jnp.asarray(r_ext, dt), jnp.asarray(w, dt),
             jnp.asarray(paths["beta"], dt), jnp.asarray(sig_ext, dt),
             jnp.asarray(paths["amin"], dt))
+
+
+def _stage_dtype_names(model: AiyagariModel, ladder) -> tuple:
+    """The round loop's dtype schedule: the ladder's stage dtypes, or the
+    model dtype alone. The ladder's availability guard runs here (a polish
+    stage that would silently truncate must fail loudly)."""
+    if ladder is None:
+        return (jnp.dtype(model.dtype).name,)
+    from aiyagari_tpu.ops.precision import require_x64, validate_ladder
+
+    validate_ladder(ladder)
+    require_x64(ladder)
+    return tuple(ladder.stage_dtypes)
+
+
+def _stage_matmul_precision(ladder, stage: int) -> str:
+    """The stage's matmul-precision name for the path program's Euler
+    expectation (ops/egm.egm_step_transition): the ladder's per-stage
+    configuration, or the historical 'highest' pin without a ladder."""
+    return "highest" if ladder is None else ladder.matmul_precision[stage]
+
+
+class _StageAnchors:
+    """Per-dtype cache of the stationary anchors (terminal policy, initial
+    distribution, model arrays) the path program consumes — cast once per
+    ladder stage, with the distribution re-normalized on the simplex at the
+    cast (a hot-dtype mass defect must not bias the certified rounds)."""
+
+    def __init__(self, model: AiyagariModel, ss):
+        self.model, self.ss = model, ss
+        self._cache: dict = {}
+
+    def get(self, dt_name: str):
+        if dt_name not in self._cache:
+            dt = jnp.dtype(dt_name)
+            mu = self.ss.mu.astype(dt)
+            mu = mu / jnp.sum(mu)
+            self._cache[dt_name] = (
+                self.ss.solution.policy_c.astype(dt), mu,
+                self.model.a_grid.astype(dt), self.model.s.astype(dt),
+                self.model.P.astype(dt))
+        return self._cache[dt_name]
 
 
 def solve_transition(
@@ -258,6 +313,7 @@ def solve_transition(
     keep_policies: bool = True,
     on_iteration: Optional[Callable] = None,
     dtype=jnp.float64,
+    ladder=None,
 ) -> TransitionResult:
     """Solve one perfect-foresight MIT-shock transition (module docstring).
 
@@ -266,6 +322,18 @@ def solve_transition(
     solve_transitions_sweep does exactly that. The per-round max excess
     demand lands in max_excess_history (and flows through on_iteration),
     the acceptance telemetry ISSUE 2 names.
+
+    ladder (a PrecisionLadderConfig) opts the ROUND LOOP into the
+    mixed-precision solve ladder (ops/precision.py; dispatch routes
+    BackendConfig(dtype="mixed") here): early rounds evaluate the whole
+    backward/forward path program (transition/path.py) with anchors, model
+    arrays, and price paths cast to the hot dtype — the per-round cost is
+    two T-step scans over [N, na] arrays, squarely bandwidth-bound — until
+    the max excess demand reaches max(tol, switch_ulp * eps(hot) *
+    max|K_ts|), then the SAME candidate path is re-evaluated at the next
+    dtype and the loop continues to tol there. Newton/damped updates are
+    host-f64 either way; convergence is only ever declared from a
+    final-dtype evaluation, so the certificate matches the pure-f64 solve.
     """
     t0 = time.perf_counter()
     model = _as_model(model, dtype)
@@ -282,6 +350,12 @@ def solve_transition(
     if trans.method == "newton" and jacobian is None:
         jacobian = transition_jacobian(model, ss, T)
 
+    stage_names = _stage_dtype_names(model, ladder)
+    anchors = _StageAnchors(model, ss)
+    stage = 0
+    hot_rounds = 0
+    switch_excess = 0.0
+
     r_path = np.full(T, r_ss)
     out = None
     K_ts = D = None
@@ -290,22 +364,43 @@ def solve_transition(
     rounds = 0
     for rnd in range(trans.max_iter):
         it_t0 = time.perf_counter()
-        dev = _device_paths(model, r_path, paths, r_ss)
+        dt_name = stage_names[stage]
+        dev = _device_paths(model, r_path, paths, r_ss,
+                            dtype=jnp.dtype(dt_name))
         # Aggregates-only program per round (the update reads K_ts alone);
         # the policy stacks are materialized once below, at the final path.
-        out = transition_path_aggregates(ss.solution.policy_c, ss.mu,
-                                         model.a_grid, model.s, model.P,
-                                         *dev)
+        out = transition_path_aggregates(
+            *anchors.get(dt_name), *dev,
+            matmul_precision=_stage_matmul_precision(ladder, stage))
         K_ts = np.asarray(jax.device_get(out["K_ts"]), np.float64)
         D = K_ts[:T] - capital_demand(r_path, model.labor_raw, tech.alpha,
                                       tech.delta, paths["z"])
         rounds = rnd + 1
+        if stage < len(stage_names) - 1:
+            # Telemetry counts every round EVALUATED hot, whether or not
+            # the switch ever fires (a round-capped all-hot solve must not
+            # report hot_rounds=0).
+            hot_rounds = rounds
         max_d = float(np.max(np.abs(D)))
         hist.append(max_d)
         if on_iteration is not None:
             on_iteration({"round": rnd, "max_excess": max_d,
+                          "dtype": dt_name,
                           "seconds": time.perf_counter() - it_t0})
-        if np.isfinite(max_d) and max_d < trans.tol:
+        if stage < len(stage_names) - 1 and np.isfinite(max_d):
+            # Error-controlled switch: the hot evaluation has reached its
+            # own noise floor (in units of K, the excess-demand scale) —
+            # re-evaluate the SAME path at the next dtype before trusting
+            # any further comparison against tol.
+            floor = (float(ladder.switch_ulp)
+                     * float(jnp.finfo(jnp.dtype(dt_name)).eps)
+                     * float(np.max(np.abs(K_ts))))
+            if max_d < max(trans.tol, floor):
+                switch_excess = max_d
+                stage += 1
+                continue
+        if (np.isfinite(max_d) and max_d < trans.tol
+                and stage == len(stage_names) - 1):
             converged = True
             break
         if not np.isfinite(max_d):
@@ -356,6 +451,8 @@ def solve_transition(
         policies=policies,
         mu_T=out["mu_T"],
         jacobian=jacobian,
+        hot_rounds=hot_rounds,
+        switch_excess=switch_excess,
     )
 
 
@@ -371,6 +468,7 @@ def solve_transitions_sweep(
     mesh=None,
     on_iteration: Optional[Callable] = None,
     dtype=jnp.float64,
+    ladder=None,
 ) -> TransitionSweepResult:
     """Solve S MIT-shock scenarios in lockstep: every round evaluates ALL
     scenarios' candidate price paths through ONE vmapped backward+forward
@@ -387,6 +485,12 @@ def solve_transitions_sweep(
     its path pinned so the program shape never changes. The per-scenario
     fixed point is identical to running solve_transition one shock at a
     time (pinned by tests/test_transition.py).
+
+    ladder runs the lockstep round loop through the mixed-precision solve
+    ladder exactly as in solve_transition, with ONE program dtype for the
+    whole batch (the switch is global: it fires when every scenario's max
+    excess demand has reached the hot dtype's noise floor, and scenarios
+    are only marked converged from final-dtype evaluations).
     """
     t0 = time.perf_counter()
     model = _as_model(model, dtype)
@@ -408,28 +512,35 @@ def solve_transitions_sweep(
     stacked = {k: np.stack([p[k] for p in all_paths])
                for k in ("z", "beta", "sigma", "amin")}
 
-    dt = model.dtype
     sig_ext_s = np.concatenate(
         [stacked["sigma"],
          np.full((S, 1), model.preferences.sigma)], axis=1)
-    beta_dev = jnp.asarray(stacked["beta"], dt)
-    sig_dev = jnp.asarray(sig_ext_s, dt)
-    amin_dev = jnp.asarray(stacked["amin"], dt)
-    if mesh is not None:
-        from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
 
-        sharded = shard_scenario_arrays(
-            mesh, S, beta=beta_dev, sigma=sig_dev, amin=amin_dev)
-        beta_dev, sig_dev, amin_dev = (
-            sharded["beta"], sharded["sigma"], sharded["amin"])
+    stage_names = _stage_dtype_names(model, ladder)
+    anchors = _StageAnchors(model, ss)
+    stage = 0
+    hot_rounds = 0
+    switch_excess = 0.0
 
-    def place(x):
+    def place(x, dt):
         x = jnp.asarray(x, dt)
         if mesh is not None:
             from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
 
             x = shard_scenario_arrays(mesh, S, x=x)["x"]
         return x
+
+    # Per-stage-dtype cache of the placed scenario parameter paths (the
+    # loop-invariant operands; the price paths are re-placed per round).
+    _params: dict = {}
+
+    def stage_params(dt_name: str):
+        if dt_name not in _params:
+            dt = jnp.dtype(dt_name)
+            _params[dt_name] = (place(stacked["beta"], dt),
+                                place(sig_ext_s, dt),
+                                place(stacked["amin"], dt))
+        return _params[dt_name]
 
     r_paths = np.full((S, T), r_ss)
     conv = np.zeros(S, bool)
@@ -438,22 +549,44 @@ def solve_transitions_sweep(
     rounds = 0
     for rnd in range(trans.max_iter):
         it_t0 = time.perf_counter()
+        dt_name = stage_names[stage]
+        dt = jnp.dtype(dt_name)
+        beta_dev, sig_dev, amin_dev = stage_params(dt_name)
         w_s = wage_from_r(r_paths, tech.alpha, tech.delta, stacked["z"])
         r_ext_s = np.concatenate([r_paths, np.full((S, 1), r_ss)], axis=1)
         out = transition_path_batch(
-            ss.solution.policy_c, ss.mu, model.a_grid, model.s, model.P,
-            place(r_ext_s), place(w_s), beta_dev, sig_dev, amin_dev)
+            *anchors.get(dt_name),
+            place(r_ext_s, dt), place(w_s, dt), beta_dev, sig_dev, amin_dev,
+            matmul_precision=_stage_matmul_precision(ladder, stage))
         K_s = np.asarray(jax.device_get(out["K_ts"]), np.float64)  # [S, T+1]
         D = K_s[:, :T] - capital_demand(r_paths, model.labor_raw, tech.alpha,
                                         tech.delta, stacked["z"])
         rounds = rnd + 1
+        final_stage = stage == len(stage_names) - 1
+        if not final_stage:
+            # Count every hot-evaluated round (single-solve rationale).
+            hot_rounds = rounds
         max_d = np.max(np.abs(D), axis=1)
-        conv = conv | (np.isfinite(max_d) & (max_d < trans.tol))
+        if final_stage:
+            # Scenarios are only marked converged from final-dtype
+            # evaluations — a hot-stage residual certifies nothing.
+            conv = conv | (np.isfinite(max_d) & (max_d < trans.tol))
         if on_iteration is not None:
             on_iteration({"round": rnd,
                           "max_excess": float(np.max(max_d)),
                           "converged": int(np.sum(conv)),
+                          "dtype": dt_name,
                           "seconds": time.perf_counter() - it_t0})
+        if not final_stage and np.all(np.isfinite(max_d)):
+            floor = (float(ladder.switch_ulp)
+                     * float(jnp.finfo(dt).eps)
+                     * float(np.max(np.abs(K_s))))
+            if float(np.max(max_d)) < max(trans.tol, floor):
+                # Global switch: every scenario's residual is at the hot
+                # noise floor — re-evaluate the SAME paths wider.
+                switch_excess = float(np.max(max_d))
+                stage += 1
+                continue
         if conv.all():
             break
         if not np.all(np.isfinite(max_d)):
@@ -493,4 +626,6 @@ def solve_transitions_sweep(
         r_ss=r_ss,
         ss=ss,
         jacobian=jacobian,
+        hot_rounds=hot_rounds,
+        switch_excess=switch_excess,
     )

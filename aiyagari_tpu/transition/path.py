@@ -18,7 +18,11 @@ Two time scans back to back:
 
 Both are wrapped in `transition_path`, the single jitted entry the outer
 solvers (transition/mit.py) and the scenario sweep vmap over. Everything is
-a traced operand; the program compiles once per (T, N, na) geometry.
+a traced operand; the program compiles once per (T, N, na) geometry — and
+per dtype: the scans are dtype-generic, so the mixed-precision ladder
+(ops/precision.py, routed by transition/mit.py's round loop) evaluates its
+hot rounds by handing this module f32-cast anchors/paths (one extra compile,
+half the bytes per scan step) and its polish rounds the f64 originals.
 
 Timing conventions (the usual discrete-time Aiyagari dating):
   * budget at t:  c_t + a_{t+1} = (1 + r_t) a_t + w_t s_t
@@ -32,6 +36,8 @@ capital; A_{T-1} is the last asset choice the window determines.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -42,20 +48,23 @@ __all__ = ["backward_policies", "forward_capital", "transition_path"]
 
 
 def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
-                      sigma_ext, amin_path):
+                      sigma_ext, amin_path, matmul_precision: str = "highest"):
     """Backward EGM sweep over t = T-1 .. 0 from the terminal policy.
 
     C_term [N, na] is the stationary consumption policy the path ends at
     (period-T policy). r_ext/sigma_ext are [T+1] (module docstring);
     w_path/beta_path/amin_path are [T]. Returns (C_ts, k_ts), each
     [T, N, na] in FORWARD time order (C_ts[t] is the period-t policy).
+    matmul_precision (static) relaxes the per-step Euler expectation for
+    the ladder's hot rounds (ops/egm.egm_step_transition).
     """
 
     def step(C_next, xs):
         r_now, r_next, w_now, beta_now, sig_now, sig_next, amin_now = xs
         C_now, k_now = egm_step_transition(
             C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
-            sigma_now=sig_now, sigma_next=sig_next, beta_now=beta_now)
+            sigma_now=sig_now, sigma_next=sig_next, beta_now=beta_now,
+            matmul_precision=matmul_precision)
         return C_now, (C_now, k_now)
 
     xs = (r_ext[:-1], r_ext[1:], w_path, beta_path,
@@ -91,9 +100,9 @@ def forward_capital(mu0, k_ts, a_grid, P):
     return K_ts, A_ts, mu_T
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("matmul_precision",))
 def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
-                    sigma_ext, amin_path):
+                    sigma_ext, amin_path, matmul_precision: str = "highest"):
     """Backward sweep + forward push as one jitted program.
 
     Returns a dict: K_ts [T+1] (capital path, K_ts[0] predetermined),
@@ -103,15 +112,17 @@ def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
     excess demand from K_ts on host (transition/mit.py).
     """
     C_ts, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
-                                   beta_path, sigma_ext, amin_path)
+                                   beta_path, sigma_ext, amin_path,
+                                   matmul_precision=matmul_precision)
     K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
     return {"K_ts": K_ts, "A_ts": A_ts, "C_ts": C_ts, "k_ts": k_ts,
             "mu_T": mu_T}
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("matmul_precision",))
 def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
-                               beta_path, sigma_ext, amin_path):
+                               beta_path, sigma_ext, amin_path,
+                               matmul_precision: str = "highest"):
     """transition_path without the [T, N, na] policy stacks in the output.
 
     The round loops only read K_ts, and jit OUTPUTS cannot be dead-code-
@@ -120,16 +131,29 @@ def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
     is GBs per sweep round). The full twin above is evaluated ONCE at the
     converged path when the caller wants the policies."""
     _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
-                                beta_path, sigma_ext, amin_path)
+                                beta_path, sigma_ext, amin_path,
+                                matmul_precision=matmul_precision)
     K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
     return {"K_ts": K_ts, "A_ts": A_ts, "mu_T": mu_T}
 
 
 # vmapped twin for scenario sweeps: paths carry a leading [S] axis, the
 # model arrays and stationary anchors are shared. jit(vmap(...)) compiles
-# once per (S, T, N, na); the [S]-axis shards over a "scenarios" mesh axis
-# when the stacked paths were placed with parallel/mesh.shard_scenario_arrays.
-transition_path_batch = jax.jit(jax.vmap(
-    transition_path_aggregates,
-    in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0),
-))
+# once per (S, T, N, na) and per matmul precision (the ladder's hot rounds
+# relax it); the [S]-axis shards over a "scenarios" mesh axis when the
+# stacked paths were placed with parallel/mesh.shard_scenario_arrays.
+_PATH_BATCH_CACHE: dict = {}
+
+
+def transition_path_batch(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s,
+                          sigma_s, amin_s, matmul_precision: str = "highest"):
+    fn = _PATH_BATCH_CACHE.get(matmul_precision)
+    if fn is None:
+        fn = jax.jit(jax.vmap(
+            lambda *a: transition_path_aggregates(
+                *a, matmul_precision=matmul_precision),
+            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0),
+        ))
+        _PATH_BATCH_CACHE[matmul_precision] = fn
+    return fn(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s, sigma_s,
+              amin_s)

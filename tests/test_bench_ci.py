@@ -18,7 +18,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
                      "bench.py")
 
 # The ci battery's metric set (bench.py main): one record each, in order.
-CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel")
+CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
+              "precision")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery():
@@ -40,14 +41,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-2]
+    tr = records[-3]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-1]
+    ac = records[-2]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -57,3 +58,21 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
     # without flaking on a calibration wiggle.
     assert ac["egm_sweep_ratio"] >= 1.8, ac
     assert ac["dist_sweep_ratio"] >= 2.5, ac
+    # The precision record carries the ISSUE 4 acceptance telemetry. The
+    # structural (timing-free) claims first: the ladder actually laddered —
+    # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
+    # certified the reference tolerance with machine-precision mass.
+    pr = records[-1]
+    assert pr["metric"].startswith("precision_ladder")
+    assert pr["egm_sweeps_f32_stage"] > 0
+    assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
+    assert pr["egm_sweeps_f64_polish"] > 0
+    assert pr["dist_sweeps_f32_stage"] > 0
+    assert pr["dist_sweeps_f64_polish"] > 0
+    assert pr["dist_mass_error_after_polish"] < 1e-12
+    # CPU floor guard on ladder OVERHEAD: the laddered wall must stay
+    # within 1.1x of the pure-f64 wall even on a host where f32 sweeps buy
+    # nothing (XLA:CPU's scatter/searchsorted price both dtypes alike) —
+    # a regression that makes the ladder pay for its casts/extra stage
+    # fails here before a bench round ships it.
+    assert pr["value"] <= 1.1 * pr["baseline_seconds"], pr

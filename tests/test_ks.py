@@ -351,12 +351,30 @@ class TestALMConvergence:
         assert n32 == mixed.iterations   # f32 sim carried every round
         assert mixed.solution.k_opt.dtype == jnp.float64
 
-    def test_mixed_rejected_for_aiyagari(self):
+    def test_mixed_routes_aiyagari_to_the_ladder(self):
+        # dtype="mixed" used to be rejected for the Aiyagari family; since
+        # the mixed-precision solve ladder (ops/precision.py) it ROUTES:
+        # dispatch injects the default ladder into SolverConfig.ladder and
+        # the solve runs f32 hot sweeps + f64 polish. Routing (not the
+        # numerics — tests/test_precision_ladder.py owns those) is pinned
+        # here; the numpy backend still rejects loudly (no ladder there).
         from aiyagari_tpu import solve as _solve
-        from aiyagari_tpu.config import AiyagariConfig, BackendConfig
+        from aiyagari_tpu.config import (
+            AiyagariConfig,
+            BackendConfig,
+            EquilibriumConfig,
+            GridSpecConfig,
+        )
 
-        with pytest.raises(ValueError, match="mixed"):
-            _solve(AiyagariConfig(), backend=BackendConfig(dtype="mixed"))
+        res = _solve(AiyagariConfig(grid=GridSpecConfig(n_points=60)),
+                     method="egm", backend=BackendConfig(dtype="mixed"),
+                     equilibrium=EquilibriumConfig(max_iter=2, tol=1e-3),
+                     aggregation="distribution", on_nonconvergence="ignore")
+        assert res.solution.policy_c.dtype == jnp.float64
+        assert int(res.solution.hot_iterations) > 0
+        with pytest.raises(ValueError, match="backend='jax'"):
+            _solve(AiyagariConfig(),
+                   backend=BackendConfig(backend="numpy", dtype="mixed"))
 
     def test_unknown_dtype_rejected(self):
         from aiyagari_tpu.config import BackendConfig

@@ -1,0 +1,386 @@
+"""Mixed-precision solve ladder (ISSUE 4, ops/precision.py): dtype
+preservation in the hot stages, error-controlled switch mechanics, and
+parity of `dtype="mixed"` solves against the pure-f64 reference.
+
+Three contracts pinned here:
+
+  1. DTYPE PRESERVATION — a hot (f32) stage's carries, interp outputs, and
+     acceleration history buffers stay f32 end to end: the classic JAX
+     weak-type scalar-promotion leak would silently upcast the whole loop
+     to f64 and the "mixed" solve would quietly pay full-precision
+     bandwidth. Exercised for the single-device, sharded, and labor EGM
+     variants plus VFI and the accel ring buffers, via single-stage
+     ("float32",) ladders whose outputs are directly inspectable.
+  2. SWITCH MECHANICS — the ladder actually ladders: the f32 stage runs a
+     positive number of sweeps, STOPS before the pure-f64 solve's total
+     (it exits at the f32 noise floor, not at tol), and hands a positive
+     residual to a polish stage that runs to the reference criterion.
+  3. PARITY — final policies/values/distributions from dtype="mixed" sit
+     within the stopping-rule noise cone of the pure-f64 solve (the
+     test_precision noise-cone bound: both iterates are within their own
+     tolerance of the fixed point, amplified by 1/(1-beta)), the
+     distribution's mass error after the f64 polish is < 1e-12, and the
+     GE/transition dispatch routes land on the f64 equilibrium.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import AccelConfig, PrecisionLadderConfig, SolverConfig
+from aiyagari_tpu.models.aiyagari import aiyagari_labor_preset, aiyagari_preset
+from aiyagari_tpu.ops.precision import (
+    default_ladder,
+    hot_only,
+    plan_stages,
+    require_x64,
+    stage_specs,
+    validate_ladder,
+)
+from aiyagari_tpu.solvers.egm import (
+    initial_consumption_guess,
+    solve_aiyagari_egm,
+    solve_aiyagari_egm_labor,
+)
+from aiyagari_tpu.utils.firm import wage_from_r
+
+TOL = 1e-6   # below the f32 switch floor at these calibrations, so the hot
+             # stage exits at its noise floor and the polish has real work —
+             # the regime the ladder exists for.
+
+F32_ONLY = PrecisionLadderConfig(stage_dtypes=("float32",),
+                                 matmul_precision=("default",))
+
+
+def _problem(n=160):
+    m = aiyagari_preset(grid_size=n, dtype=jnp.float64)
+    w = float(wage_from_r(0.04, m.config.technology.alpha,
+                          m.config.technology.delta))
+    C0 = initial_consumption_guess(m.a_grid, m.s, 0.04, w)
+    kw = dict(sigma=m.preferences.sigma, beta=m.preferences.beta,
+              tol=TOL, max_iter=3000)
+    return m, w, C0, kw
+
+
+@pytest.fixture(scope="module")
+def egm_pair():
+    m, w, C0, kw = _problem()
+    plain = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin, **kw)
+    mixed = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                               ladder=default_ladder(), **kw)
+    return m, plain, mixed
+
+
+class TestDtypePreservation:
+    """Single-stage f32 ladders: every float output must come back f32 —
+    a weak-type f64 leak anywhere in the loop body would surface here."""
+
+    def _assert_f32(self, sol):
+        for name in ("policy_c", "policy_k", "policy_l", "distance",
+                     "tol_effective"):
+            leaf = getattr(sol, name)
+            assert leaf.dtype == jnp.float32, f"{name} upcast to {leaf.dtype}"
+
+    def test_egm_hot_stage_stays_f32(self):
+        m, w, C0, kw = _problem(120)
+        sol = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                                 ladder=F32_ONLY, **kw)
+        self._assert_f32(sol)
+        assert float(sol.distance) < TOL * 20   # converged near its floor
+
+    def test_egm_labor_hot_stage_stays_f32(self):
+        ml = aiyagari_labor_preset(grid_size=100, dtype=jnp.float64)
+        wl = float(wage_from_r(0.04, ml.config.technology.alpha,
+                               ml.config.technology.delta))
+        C0 = initial_consumption_guess(ml.a_grid, ml.s, 0.04, wl)
+        p = ml.preferences
+        sol = solve_aiyagari_egm_labor(
+            C0, ml.a_grid, ml.s, ml.P, 0.04, wl, ml.amin,
+            sigma=p.sigma, beta=p.beta, psi=p.psi, eta=p.eta,
+            tol=TOL, max_iter=2000, ladder=F32_ONLY)
+        self._assert_f32(sol)
+
+    def test_egm_sharded_hot_stage_stays_f32(self):
+        # Dtype preservation is per-sweep, so a handful of capped sweeps on
+        # the 8-virtual-device mesh pins it without a full converged solve.
+        from aiyagari_tpu.parallel.mesh import make_mesh
+        from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+
+        m, w, C0, kw = _problem(4096)
+        kw = dict(kw, max_iter=5, grid_power=float(m.config.grid.power))
+        mesh = make_mesh(("grid",))
+        sol = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                         0.04, w, m.amin, ladder=F32_ONLY,
+                                         **kw)
+        self._assert_f32(sol)
+
+    def test_vfi_hot_stage_stays_f32(self):
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
+
+        m, w, _, kw = _problem(120)
+        v0 = jnp.zeros((m.P.shape[0], 120))
+        sol = solve_aiyagari_vfi(v0, m.a_grid, m.s, m.P, 0.04, w,
+                                 ladder=F32_ONLY, **kw)
+        assert sol.v.dtype == jnp.float32
+        self._assert_f32(sol)
+
+    def test_interp_outputs_stay_f32(self):
+        # The EGM kernel itself (expectation matmul + inversion interp,
+        # ops/interp.py): f32 in -> f32 out, on both inversion routes.
+        from aiyagari_tpu.ops.egm import egm_step
+
+        m, w, C0, _ = _problem(120)
+        C32 = C0.astype(jnp.float32)
+        for gp in (0.0, float(m.config.grid.power)):
+            C_new, pk = egm_step(
+                C32, m.a_grid.astype(jnp.float32), m.s.astype(jnp.float32),
+                m.P.astype(jnp.float32), jnp.float32(0.04), jnp.float32(w),
+                jnp.float32(m.amin), sigma=jnp.float32(5.0),
+                beta=jnp.float32(0.96), grid_power=gp,
+                matmul_precision="default")
+            assert C_new.dtype == jnp.float32, f"grid_power={gp}"
+            assert pk.dtype == jnp.float32, f"grid_power={gp}"
+
+    def test_accel_history_stays_f32(self):
+        # The acceleration ring buffers must live at the stage dtype — an
+        # upcast history would both waste the hot stage's bandwidth saving
+        # and smuggle f64 into the extrapolated carry.
+        from aiyagari_tpu.ops.accel import accel_init, accel_step
+
+        accel = AccelConfig(delay=0)
+        x = jnp.linspace(1.0, 2.0, 64, dtype=jnp.float32)
+        st = accel_init(x, accel)
+        assert st.hist_x.dtype == jnp.float32
+        assert st.hist_g.dtype == jnp.float32
+        assert st.prev_res.dtype == jnp.float32
+        for _ in range(3):
+            gx = 0.5 * x + 0.25
+            x, st = accel_step(st, x, gx, accel=accel)
+        assert x.dtype == jnp.float32
+        assert st.hist_x.dtype == jnp.float32
+        assert st.hist_g.dtype == jnp.float32
+        assert st.prev_res.dtype == jnp.float32
+
+    def test_accelerated_egm_ladder_carries_stay_f32(self):
+        # accel + single-stage f32 ladder composed: the solver's own loop
+        # (accel_step inside the while_loop body) must not upcast either.
+        m, w, C0, kw = _problem(120)
+        sol = solve_aiyagari_egm(C0, m.a_grid, m.s, m.P, 0.04, w, m.amin,
+                                 ladder=F32_ONLY, accel=AccelConfig(), **kw)
+        self._assert_f32(sol)
+
+
+class TestSwitchMechanics:
+    def test_switch_fires_and_polish_runs(self, egm_pair):
+        _, plain, mixed = egm_pair
+        hot = int(mixed.hot_iterations)
+        total = int(mixed.iterations)
+        assert hot > 0, "f32 stage never ran"
+        assert hot < int(plain.iterations), (
+            "f32 stage ran to the full f64 sweep count — the noise-floor "
+            "switch never fired")
+        assert total > hot, "f64 polish never ran"
+        assert float(mixed.switch_distance) > TOL
+        assert float(mixed.distance) < TOL
+        assert mixed.policy_c.dtype == jnp.float64
+
+    def test_distribution_switch_and_mass(self, egm_pair):
+        from aiyagari_tpu.sim.distribution import stationary_distribution
+
+        m, plain, _ = egm_pair
+        dtol = 1e-11
+        p64 = stationary_distribution(plain.policy_k, m.a_grid, m.P,
+                                      tol=dtol, max_iter=50_000)
+        mix = stationary_distribution(plain.policy_k, m.a_grid, m.P,
+                                      tol=dtol, max_iter=50_000,
+                                      ladder=default_ladder())
+        assert int(mix.hot_iterations) > 0
+        assert int(mix.iterations) > int(mix.hot_iterations)
+        assert int(mix.hot_iterations) < int(p64.iterations)
+        assert float(mix.distance) < dtol
+        assert mix.mu.dtype == jnp.float64
+        # Mass conservation after the f64 polish: the satellite's < 1e-12.
+        assert abs(float(jnp.sum(mix.mu)) - 1.0) < 1e-12
+        assert float(jnp.max(jnp.abs(mix.mu - p64.mu))) < 1e-9
+
+    def test_multiscale_warm_stages_run_hot(self):
+        # The multiscale ladder under "mixed": warm stages are f32 citizens
+        # (hot-only), the final stage still polishes — so the final solution
+        # is f64 with a fired switch.
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
+
+        m, w, _, kw = _problem(2048)
+        sol = solve_aiyagari_egm_multiscale(
+            m.a_grid, m.s, m.P, 0.04, w, m.amin,
+            grid_power=float(m.config.grid.power), ladder=default_ladder(),
+            **kw)
+        assert sol.policy_c.dtype == jnp.float64
+        assert int(sol.hot_iterations) > 0
+        assert float(sol.distance) < TOL
+
+
+class TestLadderParity:
+    def test_egm_policy_parity(self, egm_pair):
+        m, plain, mixed = egm_pair
+        # Noise-cone bound (test_precision rationale): both solves stop
+        # within their own tolerance of the same fixed point.
+        bound = 2 * TOL / (1.0 - m.preferences.beta)
+        gap = float(jnp.max(jnp.abs(mixed.policy_c - plain.policy_c)))
+        assert gap < bound, f"policy gap {gap} vs noise-cone bound {bound}"
+
+    def test_egm_labor_parity(self):
+        ml = aiyagari_labor_preset(grid_size=100, dtype=jnp.float64)
+        wl = float(wage_from_r(0.04, ml.config.technology.alpha,
+                               ml.config.technology.delta))
+        C0 = initial_consumption_guess(ml.a_grid, ml.s, 0.04, wl)
+        p = ml.preferences
+        kw = dict(sigma=p.sigma, beta=p.beta, psi=p.psi, eta=p.eta,
+                  tol=TOL, max_iter=3000)
+        plain = solve_aiyagari_egm_labor(C0, ml.a_grid, ml.s, ml.P, 0.04,
+                                         wl, ml.amin, **kw)
+        mixed = solve_aiyagari_egm_labor(C0, ml.a_grid, ml.s, ml.P, 0.04,
+                                         wl, ml.amin,
+                                         ladder=default_ladder(), **kw)
+        bound = 2 * TOL / (1.0 - p.beta)
+        assert float(jnp.max(jnp.abs(mixed.policy_c - plain.policy_c))) < bound
+        assert float(jnp.max(jnp.abs(mixed.policy_l - plain.policy_l))) < bound
+
+    def test_vfi_parity(self):
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
+
+        m, w, _, kw = _problem(120)
+        v0 = jnp.zeros((m.P.shape[0], 120))
+        plain = solve_aiyagari_vfi(v0, m.a_grid, m.s, m.P, 0.04, w, **kw)
+        mixed = solve_aiyagari_vfi(v0, m.a_grid, m.s, m.P, 0.04, w,
+                                   ladder=default_ladder(), **kw)
+        assert int(mixed.hot_iterations) > 0
+        bound = 2 * TOL / (1.0 - m.preferences.beta)
+        assert float(jnp.max(jnp.abs(mixed.v - plain.v))) < bound
+        # The discrete policy is exactly stable under the polish.
+        assert int(jnp.max(jnp.abs(mixed.policy_idx - plain.policy_idx))) <= 1
+
+    def test_sharded_parity(self):
+        from aiyagari_tpu.parallel.mesh import make_mesh
+        from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
+
+        m, w, C0, kw = _problem(4096)
+        kw = dict(kw, grid_power=float(m.config.grid.power))
+        mesh = make_mesh(("grid",))
+        plain = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                           0.04, w, m.amin, **kw)
+        mixed = solve_aiyagari_egm_sharded(mesh, C0, m.a_grid, m.s, m.P,
+                                           0.04, w, m.amin,
+                                           ladder=default_ladder(), **kw)
+        assert int(mixed.hot_iterations) > 0
+        assert int(mixed.hot_iterations) < int(plain.iterations)
+        bound = 2 * TOL / (1.0 - m.preferences.beta)
+        assert float(jnp.max(jnp.abs(mixed.policy_c - plain.policy_c))) < bound
+
+    def test_ge_dispatch_parity(self):
+        # End-to-end dtype="mixed" through solve(): same bisection path as
+        # the f64 reference (the excess-demand signs it sees are identical,
+        # so the bracket walk — and therefore r — matches exactly).
+        import aiyagari_tpu as at
+
+        cfg = at.AiyagariConfig(grid=at.GridSpecConfig(n_points=100))
+        eq = at.EquilibriumConfig(max_iter=8, tol=1e-3)
+        f64 = at.solve(cfg, method="egm",
+                       backend=at.BackendConfig(dtype="float64"),
+                       equilibrium=eq, aggregation="distribution",
+                       on_nonconvergence="ignore")
+        mix = at.solve(cfg, method="egm",
+                       backend=at.BackendConfig(dtype="mixed"),
+                       equilibrium=eq, aggregation="distribution",
+                       on_nonconvergence="ignore")
+        assert abs(mix.r - f64.r) < 1e-8
+        assert abs(mix.capital - f64.capital) < 1e-4
+
+    def test_transition_dispatch_parity(self):
+        import aiyagari_tpu as at
+
+        cfg = at.AiyagariConfig(grid=at.GridSpecConfig(n_points=80))
+        shock = at.MITShock(param="tfp", size=0.01, rho=0.8)
+        tc = at.TransitionConfig(T=30, tol=1e-6, method="newton",
+                                 max_iter=20)
+        plain = at.solve_transition(cfg, shock, transition=tc,
+                                    keep_policies=False)
+        mixed = at.solve_transition(
+            cfg, shock, transition=tc, keep_policies=False,
+            backend=at.BackendConfig(dtype="mixed"),
+            ss=plain.ss, jacobian=plain.jacobian)
+        assert mixed.converged
+        assert mixed.hot_rounds >= 1
+        assert mixed.switch_excess > 0.0
+        assert float(np.max(np.abs(mixed.r_path - plain.r_path))) < 1e-7
+
+
+class TestConfigAndGuards:
+    def test_validate_rejects_bad_configs(self):
+        for bad in (
+            PrecisionLadderConfig(stage_dtypes=()),
+            PrecisionLadderConfig(stage_dtypes=("float16", "float64")),
+            PrecisionLadderConfig(stage_dtypes=("float64", "float32"),
+                                  matmul_precision=("default", "highest")),
+            PrecisionLadderConfig(stage_dtypes=("float32", "float64"),
+                                  matmul_precision=("default",)),
+            PrecisionLadderConfig(matmul_precision=("bf16!", "highest")),
+            PrecisionLadderConfig(switch_ulp=0.0),
+        ):
+            with pytest.raises(ValueError):
+                validate_ladder(bad)
+
+    def test_stage_plan_floors(self):
+        specs = stage_specs(default_ladder(), noise_floor_ulp=4.0)
+        assert [s.dtype for s in specs] == ["float32", "float64"]
+        # Hot stage: the switch floor (>= the caller's); final: the caller's.
+        assert specs[0].noise_floor_ulp == 24.0
+        assert specs[1].noise_floor_ulp == 4.0
+        assert specs[0].is_final is False and specs[1].is_final is True
+        # plan_stages fallback: one final stage at the carry dtype.
+        (only,) = plan_stages(None, jnp.float32, 7.0)
+        assert only.dtype == "float32" and only.noise_floor_ulp == 7.0
+        assert only.is_final
+
+    def test_hot_only_truncation(self):
+        h = hot_only(default_ladder())
+        assert h.stage_dtypes == ("float32",)
+        assert h.matmul_precision == ("default",)
+        assert hot_only(None) is None
+        assert hot_only(h) is h
+
+    def test_require_x64_rejects_without_x64(self):
+        enable_x64 = getattr(jax, "enable_x64", None)
+        if enable_x64 is None:
+            from jax.experimental import enable_x64
+        with enable_x64(False):
+            with pytest.raises(RuntimeError, match="x64"):
+                require_x64(default_ladder())
+            # A pure-f32 ladder needs no x64 and must pass.
+            require_x64(F32_ONLY)
+
+    def test_pallas_route_rejects_ladder(self):
+        from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
+
+        m, w, _, kw = _problem(64)
+        v0 = jnp.zeros((m.P.shape[0], 64))
+        with pytest.raises(ValueError, match="Pallas"):
+            solve_aiyagari_vfi(v0, m.a_grid, m.s, m.P, 0.04, w,
+                               use_pallas=True, ladder=default_ladder(),
+                               **dict(kw, sigma=5.0, beta=0.96))
+
+    def test_numpy_backend_rejects_mixed(self):
+        import aiyagari_tpu as at
+
+        with pytest.raises(ValueError, match="backend='jax'"):
+            at.solve(at.AiyagariConfig(), method="vfi",
+                     backend=at.BackendConfig(backend="numpy", dtype="mixed"))
+
+    def test_solver_config_carries_ladder(self):
+        # The config object is frozen/hashable (jit-static) and reachable
+        # from SolverConfig — the path every GE closure inherits it by.
+        sv = SolverConfig(method="egm", ladder=default_ladder())
+        hash(sv.ladder)
+        assert dataclasses.replace(sv, ladder=None).ladder is None
