@@ -248,6 +248,21 @@ class SolverConfig:
                                       # stage at BackendConfig.dtype;
                                       # dispatch.solve() injects the default
                                       # ladder for dtype="mixed".
+    pushforward: str = "auto"         # DistributionBackend for the Young
+                                      # lottery push-forward in every
+                                      # cross-section hot path — the
+                                      # stationary distribution, the K-S
+                                      # histogram closure, and the
+                                      # transition forward push
+                                      # (ops/pushforward.py): "auto"
+                                      # (scatter-free monotone-transpose
+                                      # with a compiled-in scatter
+                                      # fallback), "scatter" (the `.at[]`
+                                      # reference), "banded" (per-policy
+                                      # block-band operator applied as
+                                      # batched MXU matmuls), or "pallas"
+                                      # (the fused TPU kernel,
+                                      # ops/pallas_pushforward.py)
 
 
 @dataclasses.dataclass(frozen=True)

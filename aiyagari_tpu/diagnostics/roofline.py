@@ -172,25 +172,66 @@ def egm_sweep_cost(N: int, na: int, itemsize: int = 4,
     return KernelCost(mxu, vpu, bytes_)
 
 
-def distribution_sweep_cost(N: int, na: int, itemsize: int = 8) -> KernelCost:
-    """One Young push-forward sweep (sim/distribution.distribution_step +
-    the per-sweep renormalization): the lottery scatter-add along the asset
-    axis, the [N,N]x[N,na] income-mixing matmul, and the sum/divide mass
-    renormalization.
+def distribution_sweep_cost(N: int, na: int, itemsize: int = 8,
+                            route: str = "scatter",
+                            band_width: int = 256) -> KernelCost:
+    """One Young push-forward sweep (ops/pushforward.py, any
+    DistributionBackend, + the per-sweep renormalization): the lottery push
+    along the asset axis, the [N,N]x[N,na] income-mixing matmul, and the
+    sum/divide mass renormalization.
 
-    HBM model: the scatter reads mu + w_lo and writes mu_a (idx is int32,
-    counted at 4 B regardless of the float itemsize), the matmul reads mu_a
-    and writes mu_new, and the renormalize + distance reductions stream
-    mu_new and the previous iterate once more — ~7 float [N, na] streams
-    plus the int index stream. VPU: 2 multiplies + 2 adds per cell for the
-    lottery, ~3 ops/cell for normalize + the sup-norm distance. This is the
-    memory-bound profile the mixed-precision ladder's f32 stage halves —
-    the bench prices each LADDER STAGE with its own itemsize
-    (dtype_itemsize) and reports achieved GB/s per stage."""
+    Shared terms: the mixing matmul reads mu_a and writes mu_new, and the
+    renormalize + distance reductions stream mu_new and the previous
+    iterate once more. `route` prices the lottery push itself:
+
+      * "scatter"   — reads mu + w_lo + the int32 idx stream (counted at
+        4 B regardless of the float itemsize) and writes mu_a; 2 multiplies
+        + 2 adds per cell. ~7 float [N, na] streams total — the memory-
+        bound profile the mixed-precision ladder's f32 stage halves.
+      * "transpose" — two cumsum passes over the leg products (read +
+        write each), the bounds gathers (na log2(na) compares at plan
+        build, amortized to ~log2(na)/sweep for per-step plans, counted
+        here), and the gather/diff assembly: ~9 float streams and
+        (6 + log2(na)) VPU ops per cell. No scatter anywhere.
+      * "banded"    — the block-band apply: the dominant HBM term is the
+        [N, na, band_width] band itself streamed once per sweep (it cannot
+        stay resident at fine grids), plus the gathered source windows;
+        MXU FLOPs are 2 * N * na * band_width for the band contraction on
+        top of the mixing matmul. Trades bytes for MXU residency — the
+        TPU-favorable exchange, honest-priced here so achieved GB/s does
+        not flatter it.
+      * "pallas"    — the fused kernel: mu/w_lo/idx read once, the mixed
+        tile written once (~4 float streams + idx — the minimal-HBM
+        route), but the in-VMEM compare-accumulate is dense over each
+        overlapping [block_src, block_l] chunk: ~6 ops x 2 overlapping
+        chunks x block_src (= 256) per OUTPUT cell under the monotone
+        overlap model. The kernel deliberately trades VPU compares for
+        zero scatter and minimal HBM traffic; the model says so.
+
+    The bench prices each LADDER STAGE with its own itemsize
+    (dtype_itemsize) and reports achieved GB/s per route and stage."""
+    import math
+
     cells = float(N) * na
     mxu = 2.0 * N * N * na
-    vpu = 7.0 * cells
-    bytes_ = itemsize * 7.0 * cells + 4.0 * cells   # + int32 idx stream
+    if route == "scatter":
+        vpu = 7.0 * cells
+        bytes_ = itemsize * 7.0 * cells + 4.0 * cells   # + int32 idx stream
+    elif route == "transpose":
+        vpu = (6.0 + math.log2(max(na, 2))) * cells
+        bytes_ = itemsize * 9.0 * cells + 4.0 * cells
+    elif route == "banded":
+        bw = float(min(max(band_width, 1), na))
+        mxu += 2.0 * cells * bw
+        vpu = 5.0 * cells
+        bytes_ = itemsize * (cells * bw     # the band, streamed per sweep
+                             + bw / 128.0 * cells   # window gathers per tile
+                             + 6.0 * cells)
+    elif route == "pallas":
+        vpu = (5.0 + 6.0 * 2.0 * 256.0) * cells   # dense in-VMEM compares
+        bytes_ = itemsize * 4.0 * cells + 4.0 * cells
+    else:
+        raise ValueError(f"unknown pushforward route {route!r}")
     return KernelCost(mxu, vpu, bytes_)
 
 

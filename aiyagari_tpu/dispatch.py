@@ -51,7 +51,13 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
     already set SolverConfig.ladder explicitly."""
     from aiyagari_tpu.ops.precision import ladder_for_dtype
 
+    from aiyagari_tpu.ops.pushforward import resolve_backend
+
     solver = solver or SolverConfig(method=method)
+    # Reject DistributionBackend typos HERE, before any compile: the knob
+    # is a jit static arg deep inside the closures, where an unknown name
+    # would otherwise surface as a mid-solve trace error.
+    resolve_backend(solver.pushforward)
     if solver.ladder is None:
         ladder = ladder_for_dtype(backend.dtype)
         if ladder is not None:
@@ -147,6 +153,11 @@ def solve(
                     "the mixed-precision solve ladder (dtype='mixed' / "
                     "SolverConfig.ladder) requires backend='jax'; the numpy "
                     "reference backend is single-dtype by design")
+            if solver.pushforward not in ("auto", "scatter"):
+                raise ValueError(
+                    "SolverConfig.pushforward scatter-free backends require "
+                    "backend='jax'; the numpy reference backend has only "
+                    "the scatter formulation")
             if aggregation != "simulation":
                 raise ValueError("aggregation='distribution' requires backend='jax'")
             if equilibrium.batch >= 2:
@@ -226,6 +237,13 @@ def solve(
     if isinstance(model, KrusellSmithConfig):
         if aggregation == "distribution" and backend.backend != "jax":
             raise ValueError("aggregation='distribution' requires backend='jax'")
+        if solver is not None:
+            # Same loud DistributionBackend typo rejection as the Aiyagari
+            # branch — the knob reaches the histogram closure's jit static
+            # args (equilibrium/alm.py).
+            from aiyagari_tpu.ops.pushforward import resolve_backend
+
+            resolve_backend(solver.pushforward)
         alm = alm or ALMConfig()
         from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
 
@@ -394,7 +412,10 @@ def _transition_ladder(backend: BackendConfig, solver: Optional[SolverConfig]):
     explicit SolverConfig.ladder) hands transition/mit.py the ladder; the
     stationary anchoring solve inherits it through `solver` as usual."""
     from aiyagari_tpu.ops.precision import ladder_for_dtype, require_x64
+    from aiyagari_tpu.ops.pushforward import resolve_backend
 
+    if solver is not None:
+        resolve_backend(solver.pushforward)   # loud typo rejection pre-solve
     ladder = solver.ladder if solver is not None else None
     if ladder is None:
         ladder = ladder_for_dtype(backend.dtype)

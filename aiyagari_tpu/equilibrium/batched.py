@@ -82,7 +82,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         solver.tol, solver.max_iter, solver.howard_steps, solver.relative_tol,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
-        solver.accel, solver.ladder,
+        solver.accel, solver.ladder, solver.pushforward,
     )
 
 
@@ -107,7 +107,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
      dist_tol, dist_max_iter, periods, n_agents, discard, accel,
-     ladder) = knobs
+     ladder, pushforward) = knobs
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -164,7 +164,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
         if aggregation == "distribution":
             dist_sol = stationary_distribution(
                 sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
-                accel=accel, ladder=ladder)
+                accel=accel, ladder=ladder, pushforward=pushforward)
             supply = aggregate_capital(dist_sol.mu, a_grid)
             out["mu"] = dist_sol.mu
         else:

@@ -291,12 +291,14 @@ class _DistributionAggregator:
     checkpoint_tag = "_dist"
 
     def __init__(self, model: AiyagariModel, dist_tol: float,
-                 dist_max_iter: int, accel=None, ladder=None):
+                 dist_max_iter: int, accel=None, ladder=None,
+                 pushforward: str = "auto"):
         self.model = model
         self.dist_tol = dist_tol
         self.dist_max_iter = dist_max_iter
         self.accel = accel
         self.ladder = ladder
+        self.pushforward = pushforward
         self.series = None
         self.mu = None
 
@@ -337,6 +339,7 @@ class _DistributionAggregator:
             policy_k, self.model.a_grid, self.model.P,
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
             accel=self.accel, ladder=self.ladder,
+            pushforward=self.pushforward,
         )
         self.mu = dist_sol.mu
         supply = float(aggregate_capital(self.mu, self.model.a_grid))
@@ -528,7 +531,8 @@ def solve_equilibrium_distribution(
     return _bisect(
         model,
         _DistributionAggregator(model, dist_tol, dist_max_iter,
-                                accel=solver.accel, ladder=solver.ladder),
+                                accel=solver.accel, ladder=solver.ladder,
+                                pushforward=solver.pushforward),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,

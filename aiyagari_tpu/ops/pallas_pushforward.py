@@ -1,0 +1,121 @@
+"""Fused TPU (Pallas) kernel for the Young-lottery push-forward: lottery
+split + per-bucket segment accumulation + the P' income mixing in one
+VMEM-tiled pass (the "pallas" DistributionBackend of ops/pushforward.py;
+XLA fallbacks: the scatter/transpose/banded routes there).
+
+Formulation: the output is tiled over target buckets (grid = target tiles
+of `block_l` lanes). Each program owns one [N, block_l] accumulator tile in
+VMEM scratch and scans the source axis in `block_src`-wide chunks,
+accumulating both lottery legs by compare-select — never a scatter, and no
+HBM round trip for any intermediate. Before the dense compare, the chunk's
+idx min/max gate a @pl.when skip (the pallas_inverse chunk-skipping trick):
+for a monotone policy each target tile overlaps only ~(block_src +
+block_l)/na of the source axis, so the dense [N, block_src, block_l]
+compare-reduce runs on ~2 chunks per program instead of all of them. The
+skip is exact for ANY policy — a non-monotone lottery just skips less — so
+unlike the transpose/banded XLA routes this kernel needs no monotonicity
+fallback at all. The program ends by mixing income states through P' on the
+MXU ([N, N] x [N, block_l], HIGHEST precision — the same mass-conservation
+contract as the scatter route) and writing the finished tile.
+
+interpret=True runs the Pallas interpreter off-TPU (CPU tests, tier-1
+parity pins) exactly like pallas_bellman / pallas_inverse; the route stays
+opt-in for solvers until validated on real hardware (the pallas_inverse
+round-2 lesson: Mosaic lowerings must be cross-checked on chip first).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["lottery_step_pallas"]
+
+
+def _kernel(mu_ref, w_ref, idx_ref, P_ref, out_ref, acc_ref, *,
+            block_l: int, block_src: int, n_chunks: int):
+    t = pl.program_id(0)
+    l0 = t * block_l
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Static unroll over source chunks (Mosaic rejects dynamically indexed
+    # sublane loads; the pallas_inverse pattern).
+    for c in range(n_chunks):
+        sl = slice(c * block_src, (c + 1) * block_src)
+        idx_c = idx_ref[:, sl]                       # [N, CH] int32
+        lo_c = jnp.min(idx_c)
+        hi_c = jnp.max(idx_c) + 1                    # HI-leg bucket reach
+
+        # A chunk touches this target tile iff some idx lands in
+        # [l0 - 1, l0 + block_l); everything else skips the dense compare
+        # entirely. MUST be @pl.when predication, not lax.cond — cond with
+        # vector carries lowers to selects that execute both branches
+        # (measured 10x on-chip in the pallas_inverse rewrite).
+        @pl.when(jnp.logical_and(hi_c >= l0, lo_c < l0 + block_l))
+        def _():
+            mu_c = mu_ref[:, sl]
+            w_c = w_ref[:, sl]
+            tgt = l0 + jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, block_l), 2)
+            ic = idx_c[:, :, None]
+            lo_leg = jnp.where(ic == tgt, (mu_c * w_c)[:, :, None], 0.0)
+            hi_leg = jnp.where(ic + 1 == tgt,
+                               (mu_c * (1.0 - w_c))[:, :, None], 0.0)
+            acc_ref[...] += jnp.sum(lo_leg + hi_leg, axis=1)
+
+    # Income mixing fused into the same pass: out = P.T @ acc on the MXU,
+    # HIGHEST precision (the scatter route's pinned contract — a bf16 pass
+    # would leak mass at ~1e-3).
+    out_ref[...] = jax.lax.dot_general(
+        P_ref[...], acc_ref[...],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=acc_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_l", "block_src", "interpret"))
+def lottery_step_pallas(mu, idx, w_lo, P, *, block_l: int = 256,
+                        block_src: int = 256, interpret: bool = False):
+    """One fused cross-section sweep, mu'[m, l] = sum_{i,j} P[i, m] *
+    mu[i, j] * lottery(j -> l). mu/w_lo [N, na]; idx [N, na] buckets from
+    sim/distribution.young_lottery; P [N, N] row-stochastic. Returns
+    mu' [N, na], bit-for-bit the same operator as the scatter reference up
+    to float summation order (pinned by tests/test_pushforward.py in
+    interpret mode)."""
+    N, na = mu.shape
+    tl = min(block_l, max(na, 1))
+    ch = min(block_src, tl)
+    if tl % ch:
+        raise ValueError(
+            f"block_src {block_src} must divide block_l {block_l}")
+    nt = -(-na // tl)
+    nap = nt * tl
+
+    # Pad: mass/weights with zeros (inert contributions), idx edge-padded
+    # so a padded lane never widens a chunk's [min, max] skip gate.
+    mu_p = jnp.pad(mu, ((0, 0), (0, nap - na)))
+    w_p = jnp.pad(w_lo, ((0, 0), (0, nap - na)))
+    idx_p = jnp.pad(idx.astype(jnp.int32), ((0, 0), (0, nap - na)),
+                    mode="edge")
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_l=tl, block_src=ch,
+                          n_chunks=nap // ch),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((N, nap), lambda t: (0, 0)),
+            pl.BlockSpec((N, nap), lambda t: (0, 0)),
+            pl.BlockSpec((N, nap), lambda t: (0, 0)),
+            pl.BlockSpec((N, N), lambda t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((N, tl), lambda t: (0, t)),
+        out_shape=jax.ShapeDtypeStruct((N, nap), mu.dtype),
+        scratch_shapes=[pltpu.VMEM((N, tl), mu.dtype)],
+        interpret=interpret,
+    )(mu_p, w_p, idx_p, P.astype(mu.dtype))
+    return out[:, :na]

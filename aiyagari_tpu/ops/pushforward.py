@@ -1,0 +1,392 @@
+"""Scatter-free distribution push-forward: the lottery step's backend layer.
+
+The Young-lottery cross-section update (sim/distribution.distribution_step)
+moves asset mass through the policy lottery and then mixes income states.
+Its reference formulation is a scatter-add (`.at[].add`) along the asset
+axis — and XLA lowers scatters SERIALLY on TPU (and at ~120 ns/element on
+the CPU host, BENCHMARKS.md round 7), which is exactly the wrong primitive
+for an operator applied thousands of times per solve. This module owns the
+equivalent scatter-free formulations and the `DistributionBackend` knob
+selecting between them:
+
+  "scatter"   — the reference `.at[].add` route, kept for parity pins.
+  "transpose" — the monotone-lottery transpose: when the asset policy is
+                monotone in assets, `idx` is sorted within each income row,
+                so every scatter bucket is a CONTIGUOUS source segment.
+                Segment sums of contiguous segments are cumsum differences:
+                two exclusive cumsums + one searchsorted bound table + two
+                gathers replace the scatter entirely — O(na log na), fully
+                vectorized, exact mass conservation by telescoping.
+  "banded"    — the two-leg lottery operator materialized ONCE per policy
+                as a dense block band: targets tile into `band_block`-wide
+                tiles, each tile's (contiguous, by monotonicity) source
+                window pads to a static `band_width`, and each sweep is one
+                batched [1, bw] x [bw, tb] matmul per tile — MXU-resident
+                work instead of a scatter, amortizing the build across the
+                thousands of sweeps of a stationary solve.
+  "pallas"    — the fused TPU kernel (ops/pallas_pushforward.py): lottery
+                split + segment accumulation + the P' income mixing in one
+                VMEM-tiled pass (interpret mode off-TPU, like
+                pallas_bellman / pallas_inverse).
+  "auto"      — the shipped default: "transpose" (wins or ties the scatter
+                wall on every platform measured; the TPU-only routes stay
+                opt-in until validated on hardware, the pallas_inverse
+                lesson).
+
+Validity and the loud fallback: the transpose and banded routes require the
+per-row monotonicity of `idx` (EGM/VFI savings policies are monotone in
+assets; clipping preserves it). Monotonicity is a data property, so the
+check compiles INTO the program at plan-build time: the plan carries an
+`ok` flag and `apply_pushforward` routes through `lax.cond`, falling back
+banded -> transpose -> scatter, with a `jax.debug.print` warning emitted
+from the traced program when a fallback fires (set
+`aiyagari_tpu.ops.pushforward.WARN_ON_FALLBACK = False` to silence it in
+adversarial tests). Results are therefore ALWAYS correct — a non-monotone
+policy degrades to the reference route instead of corrupting mass.
+
+The adjoint contract: every backend computes the SAME linear operator
+L(idx, w_lo, P) — only the summation order differs — so the gather-form
+adjoint `sim/distribution.expectation_step` satisfies
+`<f, L mu> == <L' f, mu>` against every backend to float roundoff. The
+sequence-space fake-news Jacobian (transition/jacobian.py) relies on that
+pairing; tests/test_pushforward.py pins it per backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "BACKENDS",
+    "DEFAULT_BAND_BLOCK",
+    "DEFAULT_BAND_WIDTH",
+    "PushforwardPlan",
+    "resolve_backend",
+    "lottery_scatter",
+    "plan_pushforward",
+    "apply_pushforward",
+    "pushforward_step",
+    "shard_banded_plan",
+]
+
+BACKENDS = ("auto", "scatter", "transpose", "banded", "pallas")
+
+# Banded-route geometry: targets per tile and the static source-window
+# width each tile's (contiguous) source segment pads to. 128 matches the
+# MXU tile edge; 2x headroom covers the window spill of a near-45-degree
+# policy. Tiles whose true window exceeds band_width invalidate the plan
+# (flat policy regions — e.g. a wide borrowing-constrained set mapping one
+# bucket — can concentrate many sources on few targets), which routes the
+# apply to the transpose fallback instead of truncating mass.
+DEFAULT_BAND_BLOCK = 128
+DEFAULT_BAND_WIDTH = 256
+
+# Emit a jax.debug.print from the traced program when a scatter-free route
+# falls back (non-monotone policy / band overflow). Module-level so tests
+# that build adversarial lotteries on purpose can silence it. Read at TRACE
+# time: the flag's value is baked into each compiled program, so set it
+# BEFORE the first trace of the plan you care about — flipping it later
+# affects newly traced programs only, not jit-cache hits.
+WARN_ON_FALLBACK = True
+
+
+def resolve_backend(backend: Optional[str]) -> str:
+    """Validate a DistributionBackend name and resolve "auto".
+
+    "auto" resolves to "transpose" on every platform: it is scatter-free,
+    needs no per-policy build, wins or ties the scatter wall on the CPU
+    host (BENCH_r08), and its TPU lowering is plain cumsum/gather HLO. The
+    banded and pallas routes stay explicit opt-ins until validated on real
+    hardware (the pallas_inverse round-2 lesson: fused TPU routes must be
+    cross-checked on chip before any solver defaults to them).
+    """
+    if backend is None:
+        backend = "auto"
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown distribution backend {backend!r}; expected one of "
+            f"{BACKENDS}")
+    if backend == "auto":
+        return "transpose"
+    return backend
+
+
+def lottery_scatter(mass, idx, w_lo, n_out: Optional[int] = None):
+    """The reference scatter-add asset push: split each source cell's mass
+    between its bracketing gridpoints. mass/idx/w_lo [N, m] -> [N, n_out]
+    (n_out defaults to m). This is the parity-pin route every scatter-free
+    backend is checked against — and the shared single-point deposit helper
+    (sim/ks_distribution.initial_distribution), so one edge-clipping
+    contract covers every lottery entry."""
+    n_out = mass.shape[-1] if n_out is None else n_out
+    rows = jnp.broadcast_to(jnp.arange(mass.shape[0])[:, None], mass.shape)
+    out = jnp.zeros(mass.shape[:-1] + (n_out,), mass.dtype)
+    return (out.at[rows, idx].add(mass * w_lo)
+               .at[rows, idx + 1].add(mass * (1.0 - w_lo)))
+
+
+def _segment_bounds(idx, na: int):
+    """bounds[i, l] = #{j : idx[i, j] < l} for l = 0..na, one count per row.
+    For row-wise sorted idx (monotone policy) the sources scattering into
+    bucket l as the LO leg occupy exactly [bounds[l], bounds[l+1]) — the
+    contiguous-segment fact the transpose and banded routes are built on.
+
+    Searchsorted method is the ops/interp.bucket_index platform split:
+    jnp.searchsorted's default 'scan' lowers to log2(na) SERIAL gather
+    rounds on accelerators (the documented TPU pathology — and this runs
+    per scan STEP in the KS/transition paths, where the plan rebuilds each
+    period), so only the CPU host takes 'scan'; accelerators co-sort."""
+    targets = jnp.arange(na + 1, dtype=idx.dtype)
+    method = "scan" if jax.default_backend() == "cpu" else "sort"
+    return jax.vmap(
+        lambda row: jnp.searchsorted(row, targets, side="left", method=method)
+    )(idx)
+
+
+def _is_monotone(idx):
+    return jnp.all(idx[:, 1:] >= idx[:, :-1])
+
+
+def _transpose_push(mu, w_lo, bounds):
+    """Scatter-free asset push for row-wise sorted idx: per-leg segment
+    sums as exclusive-cumsum differences gathered at the bucket bounds.
+
+    Exactly conservative: summing the per-bucket differences telescopes
+    back to the full cumsum, so total mass is preserved to the same
+    roundoff as the scatter's own accumulation."""
+    na = mu.shape[-1]
+    zero = jnp.zeros(mu.shape[:-1] + (1,), mu.dtype)
+    s_lo = jnp.concatenate([zero, jnp.cumsum(mu * w_lo, axis=-1)], axis=-1)
+    s_hi = jnp.concatenate([zero, jnp.cumsum(mu * (1.0 - w_lo), axis=-1)],
+                           axis=-1)
+    g_lo = jnp.take_along_axis(s_lo, bounds, axis=-1)        # [N, na+1]
+    g_hi = jnp.take_along_axis(s_hi, bounds, axis=-1)
+    m_lo = g_lo[:, 1:] - g_lo[:, :-1]                        # mass w/ idx == l
+    m_hi = g_hi[:, 1:] - g_hi[:, :-1]
+    # The HI leg lands one bucket up: bucket l receives the idx == l-1 mass.
+    # m_hi[:, na-1] (idx == na-1) cannot occur — bucket_index clips to na-2.
+    return m_lo + jnp.concatenate([zero, m_hi[:, :-1]], axis=-1)
+
+
+def _band_geometry(na: int, band_block: Optional[int], band_width: Optional[int]):
+    tb = min(DEFAULT_BAND_BLOCK if band_block is None else int(band_block), na)
+    nt = -(-na // tb)
+    if nt == 1:
+        # Single tile: the band IS the dense per-row transfer operator.
+        return tb, 1, na
+    bw = DEFAULT_BAND_WIDTH if band_width is None else int(band_width)
+    return tb, nt, min(max(bw, tb), na)
+
+
+def _build_band(idx, w_lo, bounds, tb: int, nt: int, bw: int):
+    """Materialize the two-diagonal lottery operator as a dense block band
+    [N, nt, bw, tb]: tile t covers targets [t*tb, (t+1)*tb); its sources
+    (idx in [t*tb - 1, (t+1)*tb - 1], contiguous under monotonicity) start
+    at starts[i, t] and pad to the static width bw. Returns
+    (band, starts, fits) with fits the scalar validity flag (every tile's
+    true window within bw). Built from gathers and compares only — the one
+    place the operator's structure is paid for, amortized across every
+    subsequent matmul sweep."""
+    N, na = idx.shape
+    l0 = jnp.arange(nt, dtype=idx.dtype) * tb                     # [nt]
+    # Sources for tile t: idx in [l0-1, l0+tb-1] -> j in
+    # [bounds[l0-1], bounds[min(l0+tb, na)]) (bounds[-1] == bounds[0] == 0:
+    # no idx < 0 exists, so the clip is exact, not an approximation).
+    starts = jnp.take_along_axis(
+        bounds, jnp.broadcast_to(jnp.clip(l0 - 1, 0, na)[None, :], (N, nt)),
+        axis=-1)                                                  # [N, nt]
+    ends = jnp.take_along_axis(
+        bounds, jnp.broadcast_to(jnp.clip(l0 + tb, 0, na)[None, :], (N, nt)),
+        axis=-1)
+    fits = jnp.max(ends - starts) <= bw
+
+    j = starts[:, :, None] + jnp.arange(bw, dtype=idx.dtype)[None, None, :]
+    in_range = j < na                                             # [N, nt, bw]
+    jc = jnp.minimum(j, na - 1)
+    rows = jnp.arange(N)[:, None, None]
+    idx_w = idx[rows, jc]                                         # [N, nt, bw]
+    wlo_w = w_lo[rows, jc]
+    tgt = l0[None, :, None, None] + jnp.arange(tb, dtype=idx.dtype)[None, None, None, :]
+    hit_lo = (idx_w[..., None] == tgt) & in_range[..., None]
+    hit_hi = (idx_w[..., None] + 1 == tgt) & in_range[..., None]
+    band = (jnp.where(hit_lo, wlo_w[..., None], 0.0)
+            + jnp.where(hit_hi, 1.0 - wlo_w[..., None], 0.0)).astype(w_lo.dtype)
+    return band, starts, fits
+
+
+def _banded_push(mu, band, starts, precision):
+    """Apply the block band: gather each tile's source window and contract
+    it against the tile's [bw, tb] operator block — one batched matmul per
+    tile, the MXU-resident formulation of the lottery."""
+    na = mu.shape[-1]
+    return _banded_push_padded(mu, band, starts, precision, na)[:, :na]
+
+
+@dataclasses.dataclass(frozen=True)
+class PushforwardPlan:
+    """A lottery (idx, w_lo) compiled for one backend: the per-policy
+    precomputation (segment bounds, block band) paid once and reused by
+    every `apply_pushforward` sweep. Closed over by the solver loops, never
+    carried through them — `kind` stays a static Python string."""
+
+    kind: str
+    idx: jax.Array
+    w_lo: jax.Array
+    bounds: Optional[jax.Array] = None        # [N, na+1] (transpose/banded)
+    band: Optional[jax.Array] = None          # [N, nt, bw, tb] (banded)
+    starts: Optional[jax.Array] = None        # [N, nt] (banded)
+    monotone: Optional[jax.Array] = None      # scalar bool
+    ok: Optional[jax.Array] = None            # scalar bool: primary route valid
+
+
+def _warn_fallback(pred, route: str):
+    if not WARN_ON_FALLBACK:
+        return
+    jax.lax.cond(
+        pred,
+        lambda: jax.debug.print(
+            "pushforward: {} route invalid for this policy "
+            "(non-monotone lottery or band overflow) — falling back to the "
+            "reference formulation for correctness", route),
+        lambda: None)
+
+
+def plan_pushforward(idx, w_lo, *, backend: str = "auto",
+                     band_block: Optional[int] = None,
+                     band_width: Optional[int] = None) -> PushforwardPlan:
+    """Compile a lottery for `backend` (module docstring). The returned
+    plan is policy-specific: rebuild it when (idx, w_lo) change (the scan
+    paths do this per step; the stationary loop hoists it)."""
+    kind = resolve_backend(backend)
+    if kind == "scatter":
+        return PushforwardPlan("scatter", idx, w_lo)
+    if kind == "pallas":
+        return PushforwardPlan("pallas", idx, w_lo)
+    na = idx.shape[-1]
+    bounds = _segment_bounds(idx, na)
+    mono = _is_monotone(idx)
+    if kind == "transpose":
+        _warn_fallback(jnp.logical_not(mono), "transpose")
+        return PushforwardPlan("transpose", idx, w_lo, bounds=bounds,
+                               monotone=mono, ok=mono)
+    tb, nt, bw = _band_geometry(na, band_block, band_width)
+    band, starts, fits = _build_band(idx, w_lo, bounds, tb, nt, bw)
+    ok = jnp.logical_and(mono, fits)
+    _warn_fallback(jnp.logical_not(ok), "banded")
+    return PushforwardPlan("banded", idx, w_lo, bounds=bounds, band=band,
+                           starts=starts, monotone=mono, ok=ok)
+
+
+def apply_pushforward(plan: PushforwardPlan, mu, P,
+                      precision=jax.lax.Precision.HIGHEST):
+    """One cross-section sweep under the plan's backend:
+    mu'[m, l] = sum_{i,j} P[i, m] * mu[i, j] * lottery(j -> l).
+
+    Invalid primary routes degrade through lax.cond — banded -> transpose
+    -> scatter — so the result is the same operator regardless (the
+    branches all compute L mu; only cost differs). The income mixing keeps
+    the caller's matmul `precision` exactly as the scatter route always
+    did (HIGHEST outside the precision ladder's hot stages) — EXCEPT the
+    pallas route, whose fused kernel pins HIGHEST mixing unconditionally:
+    the ladder's relaxed hot-stage precision is deliberately not threaded
+    into the kernel (mass conservation inside one fused pass is cheaper
+    than a renormalizing round trip), so that route is HIGHEST-only."""
+    if plan.kind == "pallas":
+        from aiyagari_tpu.ops.pallas_pushforward import lottery_step_pallas
+
+        interpret = jax.default_backend() != "tpu"
+        return lottery_step_pallas(mu, plan.idx, plan.w_lo, P,
+                                   interpret=interpret)
+    if plan.kind == "scatter":
+        mu_a = lottery_scatter(mu, plan.idx, plan.w_lo)
+    elif plan.kind == "transpose":
+        mu_a = jax.lax.cond(
+            plan.ok,
+            lambda m: _transpose_push(m, plan.w_lo, plan.bounds),
+            lambda m: lottery_scatter(m, plan.idx, plan.w_lo),
+            mu)
+    elif plan.kind == "banded":
+        mu_a = jax.lax.cond(
+            plan.ok,
+            lambda m: _banded_push(m, plan.band, plan.starts, precision),
+            lambda m: jax.lax.cond(
+                plan.monotone,
+                lambda x: _transpose_push(x, plan.w_lo, plan.bounds),
+                lambda x: lottery_scatter(x, plan.idx, plan.w_lo),
+                m),
+            mu)
+    else:  # pragma: no cover - plan kinds are produced by plan_pushforward
+        raise ValueError(f"unknown plan kind {plan.kind!r}")
+    return jnp.matmul(P.T, mu_a, precision=precision)
+
+
+def pushforward_step(mu, idx, w_lo, P, *, backend: str = "auto",
+                     precision=jax.lax.Precision.HIGHEST,
+                     band_block: Optional[int] = None,
+                     band_width: Optional[int] = None):
+    """Plan + apply in one call — the per-step form the scan bodies use
+    (KS histogram path, transition forward push), where the policy and
+    hence the plan changes every period."""
+    plan = plan_pushforward(idx, w_lo, backend=backend,
+                            band_block=band_block, band_width=band_width)
+    return apply_pushforward(plan, mu, P, precision=precision)
+
+
+def shard_banded_plan(plan: PushforwardPlan, mesh, P):
+    """Grid-axis sharded application of a banded plan: the block band's
+    tile axis splits over the mesh's "grid" axis (each device owns nt/D
+    target tiles and their [bw, tb] operator blocks), mu and P replicate
+    (windows may read across tile boundaries, so the source side cannot
+    shard without halos), and each device emits its own target tiles — no
+    collective at all until the caller reduces. Built on the
+    parallel/mesh.shard_map version shim (jax is pinned at 0.4.x here;
+    never import new-API symbols directly).
+
+    Returns apply(mu) -> mu' with mu' sharded over its asset axis. Valid
+    banded plans only (the cond fallback would need the full lottery on
+    every device, defeating the sharding) — callers check `plan.ok` via
+    a host fetch before opting in."""
+    from jax.sharding import PartitionSpec as Pspec
+
+    from aiyagari_tpu.parallel.mesh import GRID_AXIS, shard_map
+
+    if plan.kind != "banded":
+        raise ValueError("shard_banded_plan requires a 'banded' plan")
+    na = plan.idx.shape[-1]
+
+    def local(mu, band, starts, Pt):
+        out = _banded_push_padded(mu, band, starts,
+                                  jax.lax.Precision.HIGHEST, na)
+        return jnp.matmul(Pt.T, out, precision=jax.lax.Precision.HIGHEST)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(Pspec(), Pspec(None, GRID_AXIS, None, None),
+                  Pspec(None, GRID_AXIS), Pspec()),
+        out_specs=Pspec(None, GRID_AXIS),
+    )
+
+    def apply(mu):
+        out = fn(mu, plan.band, plan.starts, P)
+        return out[:, :na]
+
+    return apply
+
+
+def _banded_push_padded(mu, band, starts, precision, na: int):
+    """_banded_push without the trailing [:, :na] slice — the sharded apply
+    keeps the tile-padded [N, nt*tb] layout so the output partitions evenly
+    over the grid axis; the caller slices after reassembly."""
+    N = mu.shape[0]
+    _, nt, bw, tb = band.shape
+    j = starts[:, :, None] + jnp.arange(bw)[None, None, :]
+    # Out-of-range window lanes carry a zero operator column (the build
+    # masks them), so the clipped gather duplicates are inert.
+    jc = jnp.minimum(j, na - 1)
+    mu_w = mu[jnp.arange(N)[:, None, None], jc]
+    out = jnp.einsum("itb,itbc->itc", mu_w, band, precision=precision)
+    return out.reshape(N, nt * tb)

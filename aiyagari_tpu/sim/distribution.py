@@ -6,11 +6,13 @@ The reference approximates the stationary wealth distribution by Monte-Carlo —
 a 10,000-period single-household time average (Aiyagari_VFI.m:94-129, quirk 8
 in SURVEY.md §3.6) — which is noisy (the GE bisection chases simulation error)
 and serial in time. The histogram method replaces it with a deterministic
-fixed-point iteration whose hot ops are a scatter-add over the asset axis and
-one [N,N]@[N,na] matmul per sweep (MXU-resident), converging to machine
-precision in hundreds of sweeps with no RNG at all. The reference has no
-analogue; this is a capability the framework adds because the TPU makes it
-cheap.
+fixed-point iteration whose hot ops are the lottery push-forward over the
+asset axis — scatter-free by default via ops/pushforward.py's monotone-
+transpose route; the `.at[].add` scatter kept as the "scatter" parity
+backend — and one [N,N]@[N,na] matmul per sweep (MXU-resident), converging
+to machine precision in hundreds of sweeps with no RNG at all. The
+reference has no analogue; this is a capability the framework adds because
+the TPU makes it cheap.
 
 Distribution layout: mu[N, na], mu[i, j] = mass of households in income state
 i holding assets a_grid[j]; sums to 1.
@@ -19,6 +21,7 @@ i holding assets a_grid[j]; sums to 1.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -27,6 +30,11 @@ import jax.numpy as jnp
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_simplex
 from aiyagari_tpu.ops.interp import bucket_index
 from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
+from aiyagari_tpu.ops.pushforward import (
+    apply_pushforward,
+    plan_pushforward,
+    pushforward_step,
+)
 from aiyagari_tpu.solvers._stopping import effective_tolerance
 
 __all__ = [
@@ -56,6 +64,12 @@ class DistributionSolution:
         default_factory=lambda: jnp.array(0.0))
 
 
+# Loud diagnosis of degenerate lottery brackets (duplicate adjacent grid
+# knots): opt-in via env var because the check prints from inside traced
+# hot loops — the CLAMP below is always on either way.
+_LOTTERY_DEBUG = bool(os.environ.get("AIYAGARI_DEBUG_LOTTERY", ""))
+
+
 def young_lottery(policy_k, a_grid):
     """Split each continuous policy value a' = policy_k[i, j] between its
     bracketing gridpoints (Young 2010's lottery): returns (idx, w_lo) with
@@ -63,20 +77,51 @@ def young_lottery(policy_k, a_grid):
 
     Policies at or beyond the grid edges collapse onto the edge point
     (w_lo clipped), so no mass ever leaves the grid.
+
+    Zero-width brackets: a grid with duplicate/degenerate adjacent knots
+    makes (hi - policy_k) / (hi - lo) a 0/0 — NaN mass that would poison
+    every downstream sweep silently. The denominator is clamped and the
+    bracket's whole mass collapses onto the (single) knot value, which is
+    exact: both endpoints ARE the policy value there. Set
+    AIYAGARI_DEBUG_LOTTERY=1 to also emit a runtime jax.debug.print when
+    any degenerate bracket is hit (regression: tests/test_pushforward.py).
     """
     idx = bucket_index(a_grid, policy_k)
     lo = a_grid[idx]
     hi = a_grid[idx + 1]
-    w_lo = jnp.clip((hi - policy_k) / (hi - lo), 0.0, 1.0)
+    span = hi - lo
+    degenerate = span <= 0.0
+    w_lo = jnp.clip((hi - policy_k) / jnp.where(degenerate, 1.0, span),
+                    0.0, 1.0)
+    w_lo = jnp.where(degenerate, 1.0, w_lo)
+    if _LOTTERY_DEBUG:
+        jax.lax.cond(
+            jnp.any(degenerate),
+            lambda: jax.debug.print(
+                "young_lottery: degenerate zero-width bracket(s) hit — the "
+                "asset grid has duplicate adjacent knots; mass collapsed "
+                "onto the duplicated point"),
+            lambda: None)
     return idx, w_lo
 
 
-def distribution_step(mu, idx, w_lo, P, precision=jax.lax.Precision.HIGHEST):
+def distribution_step(mu, idx, w_lo, P, precision=jax.lax.Precision.HIGHEST,
+                      backend: str = "auto"):
     """One forward iteration of the distribution: move asset mass through the
-    policy lottery (scatter-add along the asset axis), then mix income states
-    through P' (one matmul).
+    policy lottery, then mix income states through P' (one matmul).
 
     mu'[m, l] = sum_{i,j} P[i, m] * mu[i, j] * lottery(j -> l)
+
+    `backend` selects the push-forward formulation (ops/pushforward.py):
+    "auto" (default) runs the scatter-free monotone-transpose route — the
+    lottery's scatter buckets are contiguous source segments for a monotone
+    policy, computed with two cumsums and a gather at the bucket bounds —
+    with a compiled-in fallback to the "scatter" reference when the policy
+    is not monotone; "banded"/"pallas" are the MXU/fused alternatives.
+    Every backend evaluates the SAME linear operator (summation order is
+    the only difference), so parity against "scatter" holds to float
+    roundoff and the expectation_step adjoint pairing below is preserved
+    for all of them.
 
     HIGHEST precision by default: the bf16 default would leak mass at ~1e-3.
     The mixed-precision ladder's HOT stages (ops/precision.py) may relax
@@ -84,13 +129,8 @@ def distribution_step(mu, idx, w_lo, P, precision=jax.lax.Precision.HIGHEST):
     sits far above the leak, while the f64 POLISH stage always keeps
     HIGHEST, so the certified mass-conservation contract is unchanged.
     """
-    rows = jnp.broadcast_to(jnp.arange(mu.shape[0])[:, None], mu.shape)
-    mu_a = (
-        jnp.zeros_like(mu)
-        .at[rows, idx].add(mu * w_lo)
-        .at[rows, idx + 1].add(mu * (1.0 - w_lo))
-    )
-    return jnp.matmul(P.T, mu_a, precision=precision)
+    return pushforward_step(mu, idx, w_lo, P, backend=backend,
+                            precision=precision)
 
 
 def expectation_step(f, idx, w_lo, P):
@@ -106,17 +146,24 @@ def expectation_step(f, idx, w_lo, P):
     from f = policy gives E[policy k periods ahead | state today] under the
     stationary dynamics — one gather + one matmul per period, the forward
     pass's whole cost.
+
+    This gather form is ALREADY scatter-free and stays the single adjoint
+    implementation for every DistributionBackend: all backends evaluate
+    the same operator L, so the pairing holds against each of them to
+    float roundoff (pinned per backend by tests/test_pushforward.py).
     """
     g = jnp.matmul(P, f, precision=jax.lax.Precision.HIGHEST)   # [N, na]
     rows = jnp.broadcast_to(jnp.arange(f.shape[0])[:, None], idx.shape)
     return w_lo * g[rows, idx] + (1.0 - w_lo) * g[rows, idx + 1]
 
 
-@partial(jax.jit, static_argnames=("noise_floor_ulp", "accel", "ladder"))
+@partial(jax.jit, static_argnames=("noise_floor_ulp", "accel", "ladder",
+                                   "pushforward"))
 def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                             max_iter=10_000, mu_init=None,
                             noise_floor_ulp: float = 0.0,
-                            accel=None, ladder=None) -> DistributionSolution:
+                            accel=None, ladder=None,
+                            pushforward: str = "auto") -> DistributionSolution:
     """Iterate distribution_step to a sup-norm fixed point on device.
 
     The whole loop is one lax.while_loop program; the host sees only the
@@ -151,6 +198,14 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     mass-conservation matmul this solver always had — runs to the reference
     tolerance. Mass error after the polish stays at f64 roundoff
     (< 1e-12; pinned by tests/test_precision_ladder.py).
+
+    pushforward (a DistributionBackend name, static) selects the sweep's
+    push-forward formulation (ops/pushforward.py; default "auto" = the
+    scatter-free monotone-transpose route with a compiled-in scatter
+    fallback). The per-policy plan — segment bounds, or the banded route's
+    block-band operator — is built ONCE per ladder stage and reused by
+    every sweep of that stage's while_loop, which is where the scatter-free
+    routes earn their keep: thousands of applications of one lottery.
     """
     N, na = policy_k.shape
     if mu_init is None:
@@ -171,6 +226,9 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
         mu = mu_in.astype(dt)
         mu = mu / jnp.sum(mu)
         w_lo_d, P_d = w_lo.astype(dt), P.astype(dt)
+        # Per-stage plan (the band/bounds cast with the stage dtype),
+        # hoisted out of the while_loop: one lottery, thousands of sweeps.
+        plan = plan_pushforward(idx, w_lo_d, backend=pushforward)
         tol_c = jnp.asarray(tol, dt)
         ast0 = accel_init(mu, accel) if accel is not None else None
 
@@ -180,7 +238,7 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
 
         def body(carry):
             mu, _, _, it, _, ast = carry
-            mu_new = distribution_step(mu, idx, w_lo_d, P_d, precision=prec)
+            mu_new = apply_pushforward(plan, mu, P_d, precision=prec)
             mu_new = mu_new / jnp.sum(mu_new)
             dist = jnp.max(jnp.abs(mu_new - mu))
             tol_eff = effective_tolerance(

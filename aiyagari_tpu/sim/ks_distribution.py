@@ -10,7 +10,9 @@ the grid: per period the whole cross-section moves with one policy lookup
 k at all), a two-point lottery scatter, and a 2x2 employment mixing whose
 conditional matrices (eps_trans) by construction reproduce u(z) each period
 exactly. Deterministic, RNG-free, and O(nk) per period instead of
-O(population).
+O(population). The per-period lottery push runs on the scatter-free
+DistributionBackend layer (ops/pushforward.py) like every other
+cross-section path; `pushforward` selects the route.
 
 The reference has no analogue; this closure is selected with
 solve(..., aggregation="distribution") / solve_krusell_smith(closure=
@@ -25,7 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.models.krusell_smith import state_index
-from aiyagari_tpu.sim.distribution import distribution_step, young_lottery
+from aiyagari_tpu.ops.pushforward import lottery_scatter, pushforward_step
+from aiyagari_tpu.sim.distribution import young_lottery
 
 __all__ = ["initial_distribution", "distribution_capital_path"]
 
@@ -33,18 +36,24 @@ __all__ = ["initial_distribution", "distribution_capital_path"]
 def initial_distribution(k_grid, K_grid, u0, dtype):
     """Histogram matching the panel simulator's start: everyone at
     k = K_grid[0] (snapped onto k_grid by the lottery), unemployed with
-    probability u0."""
-    nk = k_grid.shape[0]
+    probability u0.
+
+    The point mass deposits through the SHARED lottery helper
+    (young_lottery + ops/pushforward.lottery_scatter) rather than bespoke
+    scalar `.at[]` scatters, so it inherits the same edge-clipping contract
+    as every other lottery entry: a start point at (or beyond) the top of
+    k_grid collapses onto the last knot with total mass exactly 1 instead
+    of writing out of bounds (tests/test_pushforward.py pins the edge)."""
     point = jnp.full((1, 1), K_grid[0], dtype)
     idx, w_lo = young_lottery(point, k_grid)
-    k_mass = jnp.zeros((nk,), dtype).at[idx[0, 0]].add(w_lo[0, 0])
-    k_mass = k_mass.at[idx[0, 0] + 1].add(1.0 - w_lo[0, 0])
+    k_mass = lottery_scatter(jnp.ones((1, 1), dtype), idx, w_lo,
+                             k_grid.shape[0])[0]
     return jnp.stack([(1.0 - u0) * k_mass, u0 * k_mass])   # [2, nk], eps 0=employed
 
 
-@partial(jax.jit, static_argnames=("T",))
+@partial(jax.jit, static_argnames=("T", "pushforward"))
 def distribution_capital_path(k_opt, k_grid, K_grid, z_path, eps_trans, mu_init, *,
-                              T: int):
+                              T: int, pushforward: str = "auto"):
     """Deterministic aggregate-capital path under policy k_opt [ns, nK, nk].
 
     mu_init [2, nk]: mass over (eps, k) with eps 0=employed (the ks_panel
@@ -75,16 +84,18 @@ def distribution_capital_path(k_opt, k_grid, K_grid, z_path, eps_trans, mu_init,
         kp = pol_at_K[s_rows]                                                # [2, nk]
         K_next = jnp.sum(mu * kp)
         idx, w_lo = young_lottery(kp, k_grid)
-        # Same lottery-scatter + chain-mixing kernel as the Aiyagari
-        # stationary iteration, with the (z_t -> z_{t+1}) conditional
-        # employment chain in the role of P.
-        mu_next = distribution_step(mu, idx, w_lo, eps_trans[z_t, z_next])
+        # Same lottery push-forward + chain-mixing kernel as the Aiyagari
+        # stationary iteration (ops/pushforward.py; `pushforward` selects
+        # the backend, scatter-free by default), with the (z_t -> z_{t+1})
+        # conditional employment chain in the role of P.
+        mu_next = pushforward_step(mu, idx, w_lo, eps_trans[z_t, z_next],
+                                   backend=pushforward)
         return (mu_next, K_next), K_t
 
     # NOT unrolled: the agent panel's scan gains +8% from unroll=8
-    # (sim/ks_panel._panel_scan), but this scatter-heavy body measured
-    # only ~2% (148.8 -> 146.1 ms at reference scale, within noise) —
-    # not worth the 8x body compile.
+    # (sim/ks_panel._panel_scan), but this lottery-push body (scatter-heavy
+    # before the ops/pushforward rewrite) measured only ~2% (148.8 -> 146.1
+    # ms at reference scale, within noise) — not worth the 8x body compile.
     (mu, K_last), K_head = jax.lax.scan(
         step, (mu_init, jnp.sum(mu_init * k_grid[None, :])),
         (z_path[:-1], z_path[1:]),

@@ -40,11 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from aiyagari_tpu.sim.distribution import (
-    distribution_step,
-    expectation_step,
-    young_lottery,
-)
+from aiyagari_tpu.ops.pushforward import pushforward_step
+from aiyagari_tpu.sim.distribution import expectation_step, young_lottery
 from aiyagari_tpu.transition.path import backward_policies
 from aiyagari_tpu.utils.firm import capital_demand_slope
 
@@ -52,13 +49,22 @@ __all__ = ["fake_news_jacobian", "newton_jacobian"]
 
 
 def fake_news_jacobian(C_ss, k_ss, mu_ss, a_grid, s, P, *, r_ss, w_ss,
-                       w_slope, sigma, beta, amin, T: int) -> np.ndarray:
+                       w_slope, sigma, beta, amin, T: int,
+                       pushforward: str = "auto") -> np.ndarray:
     """J[t, s] = dA_t/dr_s at the stationary equilibrium (module docstring).
 
     C_ss/k_ss [N, na] are the stationary consumption/asset policies, mu_ss
     the stationary distribution, (r_ss, w_ss) the stationary prices and
     w_slope = dw/dr along the firm FOC (the price link each column shocks
     jointly). Returns a host np.float64 [T, T] matrix.
+
+    pushforward selects the DistributionBackend of the forward-pass
+    push-forward whose jvp builds the distribution perturbations dD_u
+    (ops/pushforward.py; the scatter-free routes are jvp-transparent —
+    cumsum/gather/matmul primitives all carry exact tangents, and the
+    monotonicity cond differentiates through the taken branch). The adjoint
+    expectation functions keep the gather-form expectation_step, whose
+    pairing <f, L mu> == <L' f, mu> holds against every backend.
     """
     dt = a_grid.dtype
     ones = jnp.ones((T,), dt)
@@ -93,7 +99,8 @@ def fake_news_jacobian(C_ss, k_ss, mu_ss, a_grid, s, P, *, r_ss, w_ss,
         # one jvp of the push-forward per lead, vmapped.
         def push(k):
             idx, w_lo = young_lottery(k, a_grid)
-            return distribution_step(mu_ss, idx, w_lo, P)
+            return pushforward_step(mu_ss, idx, w_lo, P,
+                                    backend=pushforward)
 
         dD = jax.vmap(
             lambda tang: jax.jvp(push, (k_ss,), (tang,))[1])(dk_lead)

@@ -226,7 +226,15 @@ def stationary_anchor(model: AiyagariModel, *,
     return solve_equilibrium_distribution(model, solver=solver, eq=eq)
 
 
-def transition_jacobian(model: AiyagariModel, ss, T: int) -> np.ndarray:
+def _pushforward_of(solver: Optional[SolverConfig]) -> str:
+    """The DistributionBackend the transition programs run their forward
+    Young pushes on (ops/pushforward.py), from SolverConfig like accel and
+    the ladder; None keeps the shipped scatter-free default."""
+    return solver.pushforward if solver is not None else "auto"
+
+
+def transition_jacobian(model: AiyagariModel, ss, T: int,
+                        pushforward: str = "auto") -> np.ndarray:
     """The Newton matrix J_D for this (model, stationary anchor, horizon):
     fake-news household Jacobian + firm diagonal (transition/jacobian.py)."""
     tech = model.config.technology
@@ -238,7 +246,8 @@ def transition_jacobian(model: AiyagariModel, ss, T: int) -> np.ndarray:
         ss.solution.policy_c, ss.solution.policy_k, ss.mu,
         model.a_grid, model.s, model.P,
         r_ss=ss.r, w_ss=w_ss, w_slope=w_slope,
-        sigma=prefs.sigma, beta=prefs.beta, amin=model.amin, T=T)
+        sigma=prefs.sigma, beta=prefs.beta, amin=model.amin, T=T,
+        pushforward=pushforward)
     return newton_jacobian(J_A, r_ss=ss.r, labor=model.labor_raw,
                            alpha=tech.alpha, delta=tech.delta)
 
@@ -346,9 +355,10 @@ def solve_transition(
     r_ss = float(ss.r)
     K_ss = float(aggregate_capital(ss.mu, model.a_grid))
     paths = shock_paths(model, shock, T)
+    pushforward = _pushforward_of(solver)
 
     if trans.method == "newton" and jacobian is None:
-        jacobian = transition_jacobian(model, ss, T)
+        jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
 
     stage_names = _stage_dtype_names(model, ladder)
     anchors = _StageAnchors(model, ss)
@@ -371,7 +381,8 @@ def solve_transition(
         # the policy stacks are materialized once below, at the final path.
         out = transition_path_aggregates(
             *anchors.get(dt_name), *dev,
-            matmul_precision=_stage_matmul_precision(ladder, stage))
+            matmul_precision=_stage_matmul_precision(ladder, stage),
+            pushforward=pushforward)
         K_ts = np.asarray(jax.device_get(out["K_ts"]), np.float64)
         D = K_ts[:T] - capital_demand(r_path, model.labor_raw, tech.alpha,
                                       tech.delta, paths["z"])
@@ -429,7 +440,8 @@ def solve_transition(
         # dated policy stacks the round loop deliberately never returns.
         full = transition_path(ss.solution.policy_c, ss.mu, model.a_grid,
                                model.s, model.P,
-                               *_device_paths(model, r_path, paths, r_ss))
+                               *_device_paths(model, r_path, paths, r_ss),
+                               pushforward=pushforward)
         policies = {"C_ts": full["C_ts"], "k_ts": full["k_ts"]}
     return TransitionResult(
         r_path=r_path,
@@ -505,8 +517,9 @@ def solve_transitions_sweep(
     _check_anchor(ss)
     tech = model.config.technology
     r_ss = float(ss.r)
+    pushforward = _pushforward_of(solver)
     if trans.method == "newton" and jacobian is None:
-        jacobian = transition_jacobian(model, ss, T)
+        jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
 
     all_paths = [shock_paths(model, sh, T) for sh in shocks]
     stacked = {k: np.stack([p[k] for p in all_paths])
@@ -557,7 +570,8 @@ def solve_transitions_sweep(
         out = transition_path_batch(
             *anchors.get(dt_name),
             place(r_ext_s, dt), place(w_s, dt), beta_dev, sig_dev, amin_dev,
-            matmul_precision=_stage_matmul_precision(ladder, stage))
+            matmul_precision=_stage_matmul_precision(ladder, stage),
+            pushforward=pushforward)
         K_s = np.asarray(jax.device_get(out["K_ts"]), np.float64)  # [S, T+1]
         D = K_s[:, :T] - capital_demand(r_paths, model.labor_raw, tech.alpha,
                                         tech.delta, stacked["z"])

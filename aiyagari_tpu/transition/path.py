@@ -12,7 +12,8 @@ Two time scans back to back:
       flavor shares the same compiled program.
 
   forward_capital — lax.scan of the Young-lottery push-forward
-      (sim/distribution.distribution_step) from the initial stationary
+      (ops/pushforward.pushforward_step, scatter-free by default; the
+      `pushforward` knob selects the backend) from the initial stationary
       distribution, yielding the capital path K_t = E_{mu_t}[a] and the
       end-of-period asset supply A_t = E_{mu_t}[policy_t].
 
@@ -42,7 +43,8 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.ops.egm import egm_step_transition
-from aiyagari_tpu.sim.distribution import distribution_step, young_lottery
+from aiyagari_tpu.ops.pushforward import pushforward_step
+from aiyagari_tpu.sim.distribution import young_lottery
 
 __all__ = ["backward_policies", "forward_capital", "transition_path"]
 
@@ -73,7 +75,7 @@ def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
     return C_ts, k_ts
 
 
-def forward_capital(mu0, k_ts, a_grid, P):
+def forward_capital(mu0, k_ts, a_grid, P, pushforward: str = "auto"):
     """Push the initial distribution forward through the time-varying
     policies: mu_{t+1} = Lambda(k_ts[t]) mu_t.
 
@@ -82,14 +84,17 @@ def forward_capital(mu0, k_ts, a_grid, P):
     stationary capital), A_ts[t] = E_{mu_t}[k_ts[t]] the end-of-period
     asset supply. Because the Young lottery is mean-preserving for policies
     inside the grid (every k_ts is clipped into it), K_ts[t+1] == A_ts[t]
-    exactly — the identity the sequence-space Jacobian relies on.
+    exactly — the identity the sequence-space Jacobian relies on, and one
+    every DistributionBackend preserves (`pushforward` selects the route;
+    scatter-free by default, ops/pushforward.py — the plan rebuilds per
+    step because the policy is dated).
     """
 
     def step(mu, k_t):
         K_t = jnp.sum(mu * a_grid[None, :])
         A_t = jnp.sum(mu * k_t)
         idx, w_lo = young_lottery(k_t, a_grid)
-        mu_next = distribution_step(mu, idx, w_lo, P)
+        mu_next = pushforward_step(mu, idx, w_lo, P, backend=pushforward)
         # Renormalize: f32 accumulation must not drift total mass over a
         # long horizon (same policy as stationary_distribution's sweeps).
         mu_next = mu_next / jnp.sum(mu_next)
@@ -100,9 +105,10 @@ def forward_capital(mu0, k_ts, a_grid, P):
     return K_ts, A_ts, mu_T
 
 
-@partial(jax.jit, static_argnames=("matmul_precision",))
+@partial(jax.jit, static_argnames=("matmul_precision", "pushforward"))
 def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
-                    sigma_ext, amin_path, matmul_precision: str = "highest"):
+                    sigma_ext, amin_path, matmul_precision: str = "highest",
+                    pushforward: str = "auto"):
     """Backward sweep + forward push as one jitted program.
 
     Returns a dict: K_ts [T+1] (capital path, K_ts[0] predetermined),
@@ -114,15 +120,17 @@ def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
     C_ts, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
                                    beta_path, sigma_ext, amin_path,
                                    matmul_precision=matmul_precision)
-    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
+    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P,
+                                       pushforward=pushforward)
     return {"K_ts": K_ts, "A_ts": A_ts, "C_ts": C_ts, "k_ts": k_ts,
             "mu_T": mu_T}
 
 
-@partial(jax.jit, static_argnames=("matmul_precision",))
+@partial(jax.jit, static_argnames=("matmul_precision", "pushforward"))
 def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
                                beta_path, sigma_ext, amin_path,
-                               matmul_precision: str = "highest"):
+                               matmul_precision: str = "highest",
+                               pushforward: str = "auto"):
     """transition_path without the [T, N, na] policy stacks in the output.
 
     The round loops only read K_ts, and jit OUTPUTS cannot be dead-code-
@@ -133,7 +141,8 @@ def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
     _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
                                 beta_path, sigma_ext, amin_path,
                                 matmul_precision=matmul_precision)
-    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P)
+    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P,
+                                       pushforward=pushforward)
     return {"K_ts": K_ts, "A_ts": A_ts, "mu_T": mu_T}
 
 
@@ -146,14 +155,17 @@ _PATH_BATCH_CACHE: dict = {}
 
 
 def transition_path_batch(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s,
-                          sigma_s, amin_s, matmul_precision: str = "highest"):
-    fn = _PATH_BATCH_CACHE.get(matmul_precision)
+                          sigma_s, amin_s, matmul_precision: str = "highest",
+                          pushforward: str = "auto"):
+    key = (matmul_precision, pushforward)
+    fn = _PATH_BATCH_CACHE.get(key)
     if fn is None:
         fn = jax.jit(jax.vmap(
             lambda *a: transition_path_aggregates(
-                *a, matmul_precision=matmul_precision),
+                *a, matmul_precision=matmul_precision,
+                pushforward=pushforward),
             in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0),
         ))
-        _PATH_BATCH_CACHE[matmul_precision] = fn
+        _PATH_BATCH_CACHE[key] = fn
     return fn(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s, sigma_s,
               amin_s)

@@ -1242,6 +1242,142 @@ def bench_precision(quick: bool, grid_size: int = 4000) -> dict:
     return record
 
 
+def bench_pushforward(quick: bool, grid_size: int = 4000) -> dict:
+    """Distribution push-forward backend walls (ISSUE 5): the SAME
+    fixed-sweep Young stationary-distribution program run on every
+    DistributionBackend (ops/pushforward.py) — scatter reference,
+    monotone-transpose, banded block-matmul, fused Pallas — interleaved
+    round-robin per the BENCHMARKS.md methodology (ratios need both sides
+    sampled under the same host drift), with the per-route achieved GB/s
+    from the round-7 roofline helpers (distribution_sweep_cost now prices
+    each route's own bytes/FLOPs) and converged-mu parity against the
+    scatter reference. value = best scatter-free per-sweep wall;
+    vs_baseline = scatter per-sweep wall / value. Off-TPU the Pallas route
+    runs the INTERPRETER — a correctness vehicle, not a perf route — so it
+    is timed at a reduced sweep count, flagged `interpreted`, and excluded
+    from the best-scatter-free claim (tests/test_bench_ci.py gates the
+    claim on the CPU host at ci sizes). The full run freezes
+    BENCH_r08_pushforward.json."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.diagnostics.roofline import (
+        achieved_bandwidth_gbs,
+        distribution_sweep_cost,
+        dtype_itemsize,
+    )
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.ops.pushforward import DEFAULT_BAND_WIDTH
+    from aiyagari_tpu.sim.distribution import stationary_distribution
+    from aiyagari_tpu.solvers.egm import (
+        initial_consumption_guess,
+        solve_aiyagari_egm,
+    )
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    if quick:
+        grid_size = min(grid_size, 200)
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    N = int(model.P.shape[0])
+    r = 0.04
+    w = float(wage_from_r(r, model.config.technology.alpha,
+                          model.config.technology.delta))
+    C0 = initial_consumption_guess(model.a_grid, model.s, r, w)
+    sol = solve_aiyagari_egm(C0, model.a_grid, model.s, model.P, r, w,
+                             model.amin, sigma=model.preferences.sigma,
+                             beta=model.preferences.beta, tol=1e-5,
+                             max_iter=2000)
+    assert float(sol.distance) < 1e-5
+
+    routes = ("scatter", "transpose", "banded", "pallas")
+    # Fixed-sweep programs (tol=0.0 runs the loop to exactly max_iter):
+    # the same while_loop the solvers execute, identical sweep counts per
+    # route, so the interleaved ratio isolates the push-forward kernel.
+    K = 60 if quick else 300
+    K_by_route = {rt: K for rt in routes}
+    if platform != "tpu":
+        K_by_route["pallas"] = 3 if quick else 5
+
+    def run(rt):
+        return stationary_distribution(
+            sol.policy_k, model.a_grid, model.P, tol=0.0,
+            max_iter=K_by_route[rt], pushforward=rt)
+
+    best = {rt: np.inf for rt in routes}
+    for rt in routes:
+        float(run(rt).distance)            # compile + warmup, fenced
+    for _ in range(2 if quick else 4):
+        for rt in routes:                  # round-robin: shared drift
+            t0 = time.perf_counter()
+            float(run(rt).distance)        # scalar transfer = timing fence
+            best[rt] = min(best[rt], time.perf_counter() - t0)
+    per_sweep = {rt: best[rt] / K_by_route[rt] for rt in routes}
+
+    # Converged-mu parity pins against the scatter reference (the
+    # acceptance contract: scatter-free defaults with parity pinned).
+    dist_tol = 1e-10 if jnp.finfo(dtype).eps < 1e-10 else 1e-7
+
+    def conv(rt, mu_init=None):
+        return stationary_distribution(
+            sol.policy_k, model.a_grid, model.P, tol=dist_tol,
+            max_iter=20_000, mu_init=mu_init, pushforward=rt)
+
+    ref = conv("scatter")
+    assert float(ref.distance) < dist_tol
+
+    def parity_of(rt):
+        # The interpreted Pallas route off-TPU costs ~40 ms/sweep — seed
+        # its solve AT the reference fixed point (a handful of sweeps to
+        # re-certify) instead of paying ~1,200 interpreter sweeps for the
+        # same parity pin.
+        seed = ref.mu if (rt == "pallas" and platform != "tpu") else None
+        return float(jnp.max(jnp.abs(conv(rt, seed).mu - ref.mu)))
+
+    parity = {rt: parity_of(rt) for rt in routes[1:]}
+
+    item = dtype_itemsize(dtype)
+    route_recs = {}
+    for rt in routes:
+        cost = distribution_sweep_cost(N, grid_size, item, route=rt,
+                                       band_width=DEFAULT_BAND_WIDTH)
+        gbs = achieved_bandwidth_gbs(cost, per_sweep[rt])
+        route_recs[rt] = {
+            "wall_per_sweep_us": round(per_sweep[rt] * 1e6, 3),
+            "sweeps_timed": K_by_route[rt],
+            "achieved_gbs": None if gbs is None else round(gbs, 2),
+            "parity_vs_scatter": parity.get(rt),
+            "interpreted": rt == "pallas" and platform != "tpu",
+        }
+
+    scatter_ps = per_sweep["scatter"]
+    contenders = {rt: per_sweep[rt] for rt in ("transpose", "banded")}
+    if platform == "tpu":
+        contenders["pallas"] = per_sweep["pallas"]
+    best_route = min(contenders, key=contenders.get)
+    record = {
+        "metric": f"pushforward_sweep_grid{grid_size}",
+        "value": round(contenders[best_route], 8),
+        "unit": "seconds_per_sweep",
+        "vs_baseline": round(scatter_ps / contenders[best_route], 2),
+        "baseline_seconds": round(scatter_ps, 8),
+        "baseline_source": "scatter-add reference route, same program "
+                           "(in-process, interleaved)",
+        "platform": platform,
+        "dtype": "float64" if item == 8 else "float32",
+        "best_scatter_free_route": best_route,
+        "routes": route_recs,
+    }
+    if not quick:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_r08_pushforward.json")
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    return record
+
+
 def _ks_panel_throughput(T: int, pop: int, *, reps: int, outer: int) -> dict:
     """One K-S panel throughput measurement at (T, pop): chain `reps` full
     panel simulations inside ONE jitted program — each repetition's initial
@@ -1587,7 +1723,8 @@ def main() -> int:
     ap.add_argument("--metric",
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
                              "scale", "scale_vfi", "ge", "sweep",
-                             "transition", "accel", "precision"],
+                             "transition", "accel", "precision",
+                             "pushforward"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -1699,6 +1836,7 @@ def main() -> int:
         "transition": lambda: bench_transition(args.quick),
         "accel": lambda: bench_accel(args.quick),
         "precision": lambda: bench_precision(args.quick),
+        "pushforward": lambda: bench_pushforward(args.quick),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
@@ -1710,11 +1848,12 @@ def main() -> int:
         # An explicit --metric narrows the ci battery to that one metric
         # (still at ci sizes) instead of being silently ignored.
         names = (("vfi", "scale", "ge", "sweep", "transition", "accel",
-                  "precision")
+                  "precision", "pushforward")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
-                 "transition", "accel", "precision", "ks_fine", "scale_vfi")
+                 "transition", "accel", "precision", "pushforward",
+                 "ks_fine", "scale_vfi")
     else:
         names = (args.metric,)
     for name in names:

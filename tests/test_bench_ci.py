@@ -19,7 +19,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
-              "precision")
+              "precision", "pushforward")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery():
@@ -41,14 +41,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-3]
+    tr = records[-4]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-2]
+    ac = records[-3]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -62,7 +62,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-1]
+    pr = records[-2]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -76,3 +76,23 @@ def test_bench_ci_preset_exits_zero_with_full_battery():
     # a regression that makes the ladder pay for its casts/extra stage
     # fails here before a bench round ships it.
     assert pr["value"] <= 1.1 * pr["baseline_seconds"], pr
+    # The pushforward record carries the ISSUE 5 acceptance telemetry:
+    # every DistributionBackend present in one valid JSON record, each
+    # scatter-free route parity-pinned against the scatter reference, and
+    # the no-regression floor — the best scatter-free route must be <=
+    # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
+    # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
+    # so the gate has wide margin against host drift).
+    pw = records[-1]
+    assert pw["metric"].startswith("pushforward_sweep")
+    assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
+    for name, route in pw["routes"].items():
+        assert route["wall_per_sweep_us"] > 0, (name, route)
+        if name != "scatter":
+            assert route["parity_vs_scatter"] < 1e-12, (name, route)
+    # The Pallas interpreter is a correctness vehicle off-TPU, never the
+    # perf claim; the best-route fields must reflect that.
+    assert pw["routes"]["pallas"]["interpreted"] is True
+    assert pw["best_scatter_free_route"] in ("transpose", "banded")
+    assert pw["vs_baseline"] >= 1.0, pw
+    assert pw["value"] <= pw["baseline_seconds"], pw

@@ -1,0 +1,392 @@
+"""Tests for the scatter-free distribution push-forward layer (ISSUE 5):
+ops/pushforward.py's DistributionBackend routes — scatter reference,
+monotone-transpose, banded block-matmul, fused Pallas (interpret mode on
+this CPU suite) — pinned against each other across all four hot
+cross-section paths (plain Aiyagari, endogenous labor, the K-S histogram
+closure, the transition forward push), plus the adjoint identity every
+backend must preserve for the fake-news Jacobian, the loud monotonicity/
+band-overflow fallbacks, the young_lottery zero-width-bracket guard, and
+the shared-helper contract of ks_distribution.initial_distribution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import aiyagari_tpu.ops.pushforward as pf
+from aiyagari_tpu.config import AiyagariConfig, GridSpecConfig, SolverConfig
+from aiyagari_tpu.equilibrium.bisection import solve_household
+from aiyagari_tpu.models.aiyagari import AiyagariModel, aiyagari_preset
+from aiyagari_tpu.ops.pushforward import (
+    BACKENDS,
+    apply_pushforward,
+    lottery_scatter,
+    plan_pushforward,
+    pushforward_step,
+    resolve_backend,
+    shard_banded_plan,
+)
+from aiyagari_tpu.sim.distribution import (
+    distribution_step,
+    expectation_step,
+    stationary_distribution,
+    young_lottery,
+)
+
+SCATTER_FREE = ("transpose", "banded", "pallas")
+
+
+@pytest.fixture(scope="module")
+def solved_small():
+    model = aiyagari_preset(grid_size=80)
+    sol = solve_household(model, 0.03, solver=SolverConfig(method="egm"))
+    idx, w_lo = young_lottery(sol.policy_k, model.a_grid)
+    N, na = sol.policy_k.shape
+    mu = jnp.full((N, na), 1.0 / (N * na))
+    return model, sol, idx, w_lo, mu
+
+
+@pytest.fixture(scope="module")
+def labor_solved():
+    cfg = AiyagariConfig(endogenous_labor=True,
+                         grid=GridSpecConfig(n_points=60))
+    model = AiyagariModel.from_config(cfg)
+    sol = solve_household(model, 0.03, solver=SolverConfig(method="egm"))
+    idx, w_lo = young_lottery(sol.policy_k, model.a_grid)
+    N, na = sol.policy_k.shape
+    mu = jnp.full((N, na), 1.0 / (N * na))
+    return model, idx, w_lo, mu
+
+
+class TestBackendParity:
+    """Every backend is the SAME linear operator; only summation order may
+    differ, so agreement holds to f64 ulp bands (the Pallas route runs the
+    interpreter here — the tier-1 interpret-equality pin)."""
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_step_parity_plain(self, solved_small, backend):
+        model, _, idx, w_lo, mu = solved_small
+        ref = pushforward_step(mu, idx, w_lo, model.P, backend="scatter")
+        out = pushforward_step(mu, idx, w_lo, model.P, backend=backend)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-14)
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_step_parity_labor(self, labor_solved, backend):
+        model, idx, w_lo, mu = labor_solved
+        ref = pushforward_step(mu, idx, w_lo, model.P, backend="scatter")
+        out = pushforward_step(mu, idx, w_lo, model.P, backend=backend)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-14)
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_mass_conservation_per_step(self, solved_small, backend):
+        model, _, idx, w_lo, mu = solved_small
+        out = pushforward_step(mu, idx, w_lo, model.P, backend=backend)
+        assert float(out.sum()) == pytest.approx(1.0, abs=1e-13)
+        # The transpose route's cumsum differences may round individual
+        # buckets a hair below zero (O(eps) cancellation); nothing larger.
+        assert float(out.min()) >= -1e-15
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_adjoint_identity(self, solved_small, backend):
+        """<f, L mu> == <L' f, mu> for EVERY backend — the pairing the
+        sequence-space fake-news Jacobian (transition/jacobian.py) relies
+        on; expectation_step is the single gather-form adjoint."""
+        model, _, idx, w_lo, mu = solved_small
+        rng = np.random.default_rng(11)
+        f = jnp.asarray(rng.normal(size=mu.shape))
+        lhs = float(jnp.sum(
+            f * pushforward_step(mu, idx, w_lo, model.P, backend=backend)))
+        rhs = float(jnp.sum(expectation_step(f, idx, w_lo, model.P) * mu))
+        assert lhs == pytest.approx(rhs, rel=1e-12, abs=1e-14)
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_stationary_distribution_parity(self, solved_small, backend):
+        model, sol, _, _, _ = solved_small
+        ref = stationary_distribution(sol.policy_k, model.a_grid, model.P,
+                                      tol=1e-11, max_iter=20_000,
+                                      pushforward="scatter")
+        out = stationary_distribution(sol.policy_k, model.a_grid, model.P,
+                                      tol=1e-11, max_iter=20_000,
+                                      pushforward=backend)
+        assert float(out.distance) < 1e-11
+        np.testing.assert_allclose(np.asarray(out.mu), np.asarray(ref.mu),
+                                   atol=1e-10)
+        assert float(out.mu.sum()) == pytest.approx(1.0, abs=1e-12)
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_ks_histogram_path_parity(self, backend):
+        from aiyagari_tpu.config import KrusellSmithConfig
+        from aiyagari_tpu.models.krusell_smith import KrusellSmithModel
+        from aiyagari_tpu.sim.ks_distribution import (
+            distribution_capital_path,
+            initial_distribution,
+        )
+        from aiyagari_tpu.sim.ks_panel import simulate_aggregate_shocks
+
+        cfg = KrusellSmithConfig(k_size=50)
+        m = KrusellSmithModel.from_config(cfg, jnp.float64)
+        z = simulate_aggregate_shocks(m.pz, jax.random.PRNGKey(3), T=120)
+        mu0 = initial_distribution(m.k_grid, m.K_grid,
+                                   cfg.shocks.u_good, jnp.float64)
+        k_opt = 0.9 * jnp.broadcast_to(
+            m.k_grid[None, None, :], (4, cfg.K_size, cfg.k_size))
+        K_ref, mu_ref = distribution_capital_path(
+            k_opt, m.k_grid, m.K_grid, z, m.eps_trans, mu0, T=120,
+            pushforward="scatter")
+        K_out, mu_out = distribution_capital_path(
+            k_opt, m.k_grid, m.K_grid, z, m.eps_trans, mu0, T=120,
+            pushforward=backend)
+        np.testing.assert_allclose(np.asarray(K_out), np.asarray(K_ref),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(mu_out), np.asarray(mu_ref),
+                                   atol=1e-12)
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_transition_forward_parity(self, solved_small, backend):
+        from aiyagari_tpu.transition.path import forward_capital
+
+        model, sol, _, _, mu = solved_small
+        # A dated-policy stack: the stationary policy progressively damped
+        # toward the grid midpoint — monotone each period, all distinct.
+        T = 12
+        mid = 0.5 * (model.a_grid[0] + model.a_grid[-1])
+        lam = jnp.linspace(0.0, 0.3, T)[:, None, None]
+        k_ts = (1.0 - lam) * sol.policy_k[None] + lam * mid
+        K_ref, A_ref, muT_ref = forward_capital(mu, k_ts, model.a_grid,
+                                                model.P, "scatter")
+        K_out, A_out, muT_out = forward_capital(mu, k_ts, model.a_grid,
+                                                model.P, backend)
+        np.testing.assert_allclose(np.asarray(K_out), np.asarray(K_ref),
+                                   rtol=1e-11)
+        np.testing.assert_allclose(np.asarray(A_out), np.asarray(A_ref),
+                                   rtol=1e-11)
+        np.testing.assert_allclose(np.asarray(muT_out), np.asarray(muT_ref),
+                                   atol=1e-13)
+        # The mean-preservation identity K_{t+1} == A_t survives every
+        # backend (the sequence-space Jacobian relies on it).
+        np.testing.assert_allclose(np.asarray(K_out[1:]), np.asarray(A_out),
+                                   atol=1e-12)
+
+    def test_fake_news_jacobian_backend_parity(self):
+        from aiyagari_tpu.transition.mit import (
+            stationary_anchor,
+            transition_jacobian,
+        )
+
+        model = aiyagari_preset(grid_size=40)
+        ss = stationary_anchor(model)
+        J_ref = transition_jacobian(model, ss, 16, pushforward="scatter")
+        J_tr = transition_jacobian(model, ss, 16, pushforward="transpose")
+        np.testing.assert_allclose(J_tr, J_ref, atol=1e-10)
+
+
+class TestFallbacks:
+    """Non-monotone lotteries and band overflows must degrade to the
+    reference result (cond fallback), never corrupt mass."""
+
+    @pytest.fixture(autouse=True)
+    def _quiet(self, monkeypatch):
+        # These tests build adversarial lotteries ON PURPOSE; silence the
+        # loud fallback print without touching the shipped default.
+        monkeypatch.setattr(pf, "WARN_ON_FALLBACK", False)
+
+    @pytest.fixture(scope="class")
+    def non_monotone(self, solved_small):
+        model, _, idx, w_lo, mu = solved_small
+        perm = np.random.default_rng(5).permutation(idx.shape[1])
+        return model, idx[:, perm], w_lo[:, perm], mu
+
+    @pytest.mark.parametrize("backend", SCATTER_FREE)
+    def test_non_monotone_matches_scatter(self, non_monotone, backend):
+        model, idx, w_lo, mu = non_monotone
+        assert not bool(jnp.all(idx[:, 1:] >= idx[:, :-1]))
+        ref = pushforward_step(mu, idx, w_lo, model.P, backend="scatter")
+        out = pushforward_step(mu, idx, w_lo, model.P, backend=backend)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-14)
+
+    def test_plan_flags_non_monotone(self, non_monotone):
+        _, idx, w_lo, _ = non_monotone
+        plan = plan_pushforward(idx, w_lo, backend="transpose")
+        assert not bool(plan.ok)
+
+    def test_band_overflow_falls_back(self, solved_small):
+        """A flat policy (every source in one bucket) overflows any narrow
+        band; the apply must route to the transpose fallback and still
+        match the scatter reference."""
+        model, _, idx, w_lo, mu = solved_small
+        idx_flat = jnp.zeros_like(idx)
+        w_flat = jnp.full_like(w_lo, 0.25)
+        plan = plan_pushforward(idx_flat, w_flat, backend="banded",
+                                band_block=8, band_width=16)
+        assert not bool(plan.ok) and bool(plan.monotone)
+        ref = pushforward_step(mu, idx_flat, w_flat, model.P,
+                               backend="scatter")
+        out = apply_pushforward(plan, mu, model.P)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-14)
+
+    def test_stationary_distribution_non_monotone_policy(self, solved_small):
+        """End to end: a (weird but valid) non-monotone policy through the
+        default scatter-free stationary solve still converges to the
+        scatter fixed point — the fallback is wired inside the loop."""
+        model, sol, _, _, _ = solved_small
+        pol = jnp.flip(sol.policy_k, axis=-1)
+        ref = stationary_distribution(pol, model.a_grid, model.P,
+                                      tol=1e-10, pushforward="scatter")
+        out = stationary_distribution(pol, model.a_grid, model.P,
+                                      tol=1e-10, pushforward="auto")
+        np.testing.assert_allclose(np.asarray(out.mu), np.asarray(ref.mu),
+                                   atol=1e-10)
+
+
+class TestLotteryZeroWidthGuard:
+    """ISSUE 5 satellite: duplicate adjacent knots used to make
+    (hi - policy) / (hi - lo) a 0/0 — NaN mass. The denominator clamp
+    collapses the bracket's mass onto the duplicated knot instead."""
+
+    def test_duplicate_knots_no_nan(self):
+        grid = jnp.asarray([0.0, 1.0, 1.0, 2.0, 3.0])
+        pol = jnp.asarray([[0.5, 1.0, 1.0, 2.5, 3.0]])
+        idx, w_lo = young_lottery(pol, grid)
+        assert bool(jnp.all(jnp.isfinite(w_lo)))
+        assert float(w_lo.min()) >= 0.0 and float(w_lo.max()) <= 1.0
+        recon = w_lo * grid[idx] + (1.0 - w_lo) * grid[idx + 1]
+        np.testing.assert_allclose(np.asarray(recon), np.asarray(pol),
+                                   atol=1e-14)
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_mass_conserved_on_degenerate_grid(self, backend):
+        grid = jnp.asarray([0.0, 1.0, 1.0, 2.0, 3.0])
+        pol = jnp.asarray([[0.2, 1.0, 1.0, 1.5, 2.9],
+                           [0.0, 0.5, 1.0, 2.0, 3.0]])
+        idx, w_lo = young_lottery(pol, grid)
+        mu = jnp.full((2, 5), 0.1)
+        P = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        out = pushforward_step(mu, idx, w_lo, P, backend=backend)
+        assert bool(jnp.all(jnp.isfinite(out)))
+        assert float(out.sum()) == pytest.approx(float(mu.sum()), abs=1e-14)
+
+
+class TestInitialDistribution:
+    """ISSUE 5 satellite: the K-S start-point deposit now rides the shared
+    lottery helper and inherits its edge-clipping contract."""
+
+    def _build(self, K0, nk=12, u0=0.07):
+        from aiyagari_tpu.sim.ks_distribution import initial_distribution
+
+        k_grid = jnp.linspace(0.0, 10.0, nk)
+        K_grid = jnp.asarray([K0, K0 + 1.0, K0 + 2.0, K0 + 3.0])
+        return k_grid, initial_distribution(k_grid, K_grid, u0,
+                                            jnp.float64), u0
+
+    def test_interior_point_two_point_lottery(self):
+        k_grid, mu, u0 = self._build(4.5)
+        assert float(mu.sum()) == pytest.approx(1.0, abs=1e-14)
+        np.testing.assert_allclose(float(jnp.sum(mu * k_grid[None, :])),
+                                   4.5, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(mu.sum(axis=1)),
+                                   [1.0 - u0, u0], atol=1e-14)
+
+    def test_top_of_grid_edge(self):
+        """A start point AT the last knot: all mass on the top gridpoint,
+        total exactly 1 — no out-of-bounds write, no dropped mass."""
+        k_grid, mu, u0 = self._build(10.0)
+        assert float(mu.sum()) == pytest.approx(1.0, abs=1e-14)
+        assert float(mu[:, :-1].sum()) == pytest.approx(0.0, abs=1e-14)
+
+    def test_beyond_grid_clips(self):
+        k_grid, mu, _ = self._build(25.0)
+        assert float(mu.sum()) == pytest.approx(1.0, abs=1e-14)
+        assert float(mu[:, -1].sum()) == pytest.approx(1.0, abs=1e-14)
+
+
+class TestBandedSharding:
+    """Grid-axis sharding of the banded operator over the 8-virtual-device
+    mesh (parallel/mesh.shard_map shim): each device owns nt/8 target
+    tiles; results match the unsharded apply."""
+
+    def test_sharded_banded_apply_matches_unsharded(self):
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        if jax.device_count() < 2:
+            pytest.skip("needs the virtual multi-device CPU mesh")
+        na, N = 1024, 4            # nt = 1024/128 = 8 tiles, one per device
+        rng = np.random.default_rng(9)
+        a_grid = jnp.asarray(np.linspace(0.0, 20.0, na))
+        pol = jnp.asarray(
+            np.sort(rng.uniform(0.0, 20.0, (N, na)), axis=1))
+        idx, w_lo = young_lottery(pol, a_grid)
+        mu = jnp.asarray(rng.uniform(size=(N, na)))
+        mu = mu / mu.sum()
+        P = jnp.asarray(rng.uniform(0.1, 1.0, (N, N)))
+        P = P / P.sum(axis=1, keepdims=True)
+
+        plan = plan_pushforward(idx, w_lo, backend="banded",
+                                band_width=1024)
+        assert bool(plan.ok)
+        mesh = make_mesh(("grid",))
+        out_sh = shard_banded_plan(plan, mesh, P)(mu)
+        ref = apply_pushforward(plan, mu, P)
+        np.testing.assert_allclose(np.asarray(out_sh), np.asarray(ref),
+                                   atol=1e-14)
+
+    def test_rejects_non_banded_plan(self, solved_small):
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        model, _, idx, w_lo, _ = solved_small
+        plan = plan_pushforward(idx, w_lo, backend="transpose")
+        with pytest.raises(ValueError, match="banded"):
+            shard_banded_plan(plan, make_mesh(("grid",)), model.P)
+
+
+class TestKnobValidation:
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="unknown distribution backend"):
+            resolve_backend("bogus")
+
+    def test_auto_resolves_scatter_free(self):
+        assert resolve_backend("auto") in SCATTER_FREE
+
+    def test_dispatch_rejects_typo(self):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError, match="unknown distribution backend"):
+            solve(AiyagariConfig(grid=GridSpecConfig(n_points=40)),
+                  method="egm", aggregation="distribution",
+                  solver=SolverConfig(method="egm", pushforward="bogus"))
+
+    def test_dispatch_rejects_typo_krusell_smith(self):
+        from aiyagari_tpu import KrusellSmithConfig, solve
+
+        with pytest.raises(ValueError, match="unknown distribution backend"):
+            solve(KrusellSmithConfig(),
+                  solver=SolverConfig(pushforward="bogus"))
+
+    def test_dispatch_rejects_numpy_scatter_free(self):
+        from aiyagari_tpu import solve
+
+        with pytest.raises(ValueError, match="backend='jax'"):
+            solve(AiyagariConfig(grid=GridSpecConfig(n_points=40)),
+                  backend="numpy",
+                  solver=SolverConfig(pushforward="banded"))
+
+    def test_distribution_step_backend_knob(self, solved_small):
+        model, _, idx, w_lo, mu = solved_small
+        ref = distribution_step(mu, idx, w_lo, model.P, backend="scatter")
+        out = distribution_step(mu, idx, w_lo, model.P)   # default: auto
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-14)
+
+    def test_solve_end_to_end_banded(self):
+        from aiyagari_tpu import EquilibriumConfig, solve
+
+        res = solve(AiyagariConfig(grid=GridSpecConfig(n_points=60)),
+                    method="egm", aggregation="distribution",
+                    solver=SolverConfig(method="egm", pushforward="banded"),
+                    equilibrium=EquilibriumConfig(max_iter=3),
+                    on_nonconvergence="ignore")
+        assert res.mu is not None
+        assert float(res.mu.sum()) == pytest.approx(1.0, abs=1e-9)
