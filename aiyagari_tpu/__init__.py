@@ -26,6 +26,7 @@ from aiyagari_tpu.config import (
     SimConfig,
     SolverConfig,
     Technology,
+    TelemetryConfig,
     TransitionConfig,
 )
 from aiyagari_tpu.diagnostics.errors import ConvergenceError, ConvergenceWarning
@@ -83,6 +84,7 @@ __all__ = [
     "AccelConfig",
     "PrecisionLadderConfig",
     "SolverConfig",
+    "TelemetryConfig",
     "SimConfig",
     "EquilibriumConfig",
     "ALMConfig",

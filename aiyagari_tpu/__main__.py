@@ -10,6 +10,10 @@ workflows end-to-end (solve -> simulate -> statistics -> figures).
 
 Defaults reproduce the reference problem scales (BASELINE.md); outputs land in
 --outdir as figures + summary.json + run log (JSONL).
+
+Observability (diagnostics/ledger.py + health.py):
+
+  python -m aiyagari_tpu report <ledger.jsonl>          # render a run ledger
 """
 
 from __future__ import annotations
@@ -21,6 +25,16 @@ import sys
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # `report` is a host-only subcommand (no model solve, no device use):
+    # render a run ledger's events — runs, spans, verdicts, telemetry
+    # summaries, degradations (diagnostics/health.report_main). Importing
+    # it still pays the package __init__ (and thus jax import) — the early
+    # return just skips the solver argument parsing below.
+    if argv[:1] == ["report"]:
+        from aiyagari_tpu.diagnostics.health import report_main
+
+        return report_main(argv[1:])
     ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])
@@ -55,6 +69,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh-agents", action="store_true",
                     help="shard the K-S agent panel over all local devices")
     ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--ledger", default=None,
+                    help="append this run's flight record (config "
+                         "fingerprint, spans, telemetry, verdicts) to a "
+                         "JSONL run ledger; render it later with "
+                         "`python -m aiyagari_tpu report <path>`")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -100,6 +119,15 @@ def main(argv=None) -> int:
         jax.config.update("jax_enable_x64", True)
     backend = BackendConfig(dtype=dtype)
 
+    led = None
+    if args.ledger:
+        from aiyagari_tpu.diagnostics.ledger import RunLedger
+
+        led = RunLedger(args.ledger,
+                        meta={"entry": f"{args.model}/{args.method}",
+                              "outdir": outdir})
+    from aiyagari_tpu.dispatch import _ledger_result, _observe
+
     if args.model in ("aiyagari", "aiyagari-labor"):
         import jax.numpy as jnp
 
@@ -124,14 +152,20 @@ def main(argv=None) -> int:
         model = AiyagariModel.from_config(
             cfg, jnp.float32 if backend.dtype == "float32" else jnp.float64
         )
-        res = solve_equilibrium(
-            model,
-            solver=SolverConfig(method=args.method, ladder=ladder),
-            sim=SimConfig(periods=args.periods, n_agents=args.agents, seed=args.seed),
-            eq=EquilibriumConfig(),
-            on_iteration=sink,
-            checkpoint_dir=args.checkpoint_dir,
-        )
+        with _observe(led, "aiyagari_ge", method=args.method):
+            res = solve_equilibrium(
+                model,
+                solver=SolverConfig(method=args.method, ladder=ladder),
+                sim=SimConfig(periods=args.periods, n_agents=args.agents, seed=args.seed),
+                eq=EquilibriumConfig(),
+                on_iteration=sink,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+        _ledger_result(led, "Aiyagari GE bisection", res,
+                       converged=res.converged, iterations=res.iterations,
+                       distance=(abs(res.k_supply[-1] - res.k_demand[-1])
+                                 if res.k_supply else float("inf")),
+                       tol=EquilibriumConfig().tol)
         summary = equilibrium_report(res, model, outdir)
     else:
         from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
@@ -139,17 +173,22 @@ def main(argv=None) -> int:
 
         if args.mesh_agents:
             backend = dataclasses.replace(backend, mesh_axes=("agents",))
-        res = solve_krusell_smith(
-            KrusellSmithConfig(k_size=args.k_size),
-            method=args.method,
-            alm=ALMConfig(T=args.T, population=args.population,
-                          max_iter=args.alm_iters, seed=args.seed,
-                          acceleration=args.acceleration),
-            backend=backend,
-            on_iteration=sink,
-            checkpoint_dir=args.checkpoint_dir,
-            closure=args.closure,
-        )
+        alm_cfg = ALMConfig(T=args.T, population=args.population,
+                            max_iter=args.alm_iters, seed=args.seed,
+                            acceleration=args.acceleration)
+        with _observe(led, "krusell_smith", method=args.method):
+            res = solve_krusell_smith(
+                KrusellSmithConfig(k_size=args.k_size),
+                method=args.method,
+                alm=alm_cfg,
+                backend=backend,
+                on_iteration=sink,
+                checkpoint_dir=args.checkpoint_dir,
+                closure=args.closure,
+            )
+        _ledger_result(led, "Krusell-Smith ALM fixed point", res,
+                       converged=res.converged, iterations=res.iterations,
+                       distance=res.diff_B, tol=alm_cfg.tol)
         summary = krusell_smith_report(res, outdir, discard=min(100, args.T // 4))
 
     print(json.dumps(summary, indent=2))

@@ -29,6 +29,7 @@ __all__ = [
     "KrusellSmithConfig",
     "AccelConfig",
     "PrecisionLadderConfig",
+    "TelemetryConfig",
     "SolverConfig",
     "SimConfig",
     "EquilibriumConfig",
@@ -207,6 +208,30 @@ class AccelConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Device-resident flight recorder for the hot fixed-point loops
+    (diagnostics/telemetry.py): a fixed-length ring buffer carried INSIDE
+    each lax.while_loop capturing the per-sweep residual, the sweep's stage
+    dtype, accel safeguard trips, and push-forward fallback tallies — no
+    host callbacks, no device sync; the buffers come back on the Solution
+    as a SolveTelemetry pytree (one recorder per scenario under vmap).
+
+    Opt-in via SolverConfig(telemetry=TelemetryConfig(...)). None (the
+    default) compiles the recorder OUT entirely: the recorder calls trace
+    to nothing, the loop carries zero extra bytes, and the hot-path program
+    is identical to the pre-telemetry one (tests/test_telemetry.py pins
+    both the trajectory identity and the jaxpr no-op).
+
+    capacity sizes the ring: the LAST `capacity` sweeps are kept (the tail
+    is what the stall/oscillation certificates read; `count` keeps the true
+    total, so truncation is visible, never silent). Frozen/hashable — it
+    rides jit static args like AccelConfig.
+    """
+
+    capacity: int = 256
+
+
+@dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Inner household-solver controls.
 
@@ -263,6 +288,17 @@ class SolverConfig:
                                       # batched MXU matmuls), or "pallas"
                                       # (the fused TPU kernel,
                                       # ops/pallas_pushforward.py)
+    telemetry: Optional[TelemetryConfig] = None
+                                      # device-resident flight recorder
+                                      # (TelemetryConfig docstring): ring
+                                      # buffers of per-sweep residuals /
+                                      # stage dtypes / safeguard trips /
+                                      # fallback tallies carried inside
+                                      # every hot while_loop and returned
+                                      # as Solution.telemetry. None (the
+                                      # default) compiles the recorder out
+                                      # — the hot paths are bit-identical
+                                      # and pay zero bytes
 
 
 @dataclasses.dataclass(frozen=True)

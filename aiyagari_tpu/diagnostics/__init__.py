@@ -1,4 +1,4 @@
-"""Telemetry, profiling, and structured failure reporting."""
+"""Telemetry, profiling, tracing, metrics, and structured failure reporting."""
 
 from aiyagari_tpu.diagnostics.errors import (
     ConvergenceError,
@@ -9,6 +9,7 @@ from aiyagari_tpu.diagnostics.logging import (
     CollectSink,
     ConsoleSink,
     JSONLSink,
+    coerce_record,
     multiplex,
 )
 from aiyagari_tpu.diagnostics.progress import (
@@ -27,5 +28,13 @@ __all__ = [
     "CollectSink",
     "ConsoleSink",
     "JSONLSink",
+    "coerce_record",
     "multiplex",
+    # Heavier observability layers import on demand (they pull in jax or
+    # filesystem machinery the light users of this package don't need):
+    #   diagnostics.telemetry — device-resident flight recorders
+    #   diagnostics.ledger    — append-only JSONL run ledger
+    #   diagnostics.trace     — nested wall-clock spans
+    #   diagnostics.metrics   — process-wide counter/gauge/histogram registry
+    #   diagnostics.health    — health certificates + report CLI
 ]

@@ -30,17 +30,22 @@ class ConvergenceError(RuntimeError):
     Attributes carry the loop's final state so the failure is diagnosable
     and resumable without re-running: `context` names the loop, `iterations`
     how many steps ran, `distance` the last convergence measure against
-    `tol`, and `detail` any loop-specific extras (e.g. the r-bracket or the
-    ALM coefficient step).
+    `tol`, `detail` any loop-specific extras (e.g. the r-bracket or the
+    ALM coefficient step), and `telemetry` the loop's final SolveTelemetry
+    flight record (diagnostics/telemetry.py) when the solve carried one —
+    the residual trajectory that says WHY the cap was hit (stall vs slow
+    geometric decay vs oscillation), attached so policy='raise' failures
+    ship their own diagnosis.
     """
 
     def __init__(self, context: str, *, iterations: int, distance: float,
-                 tol: float, detail: dict | None = None):
+                 tol: float, detail: dict | None = None, telemetry=None):
         self.context = context
         self.iterations = int(iterations)
         self.distance = float(distance)
         self.tol = float(tol)
         self.detail = dict(detail or {})
+        self.telemetry = telemetry
         extra = f" ({', '.join(f'{k}={v}' for k, v in self.detail.items())})" if self.detail else ""
         super().__init__(
             f"{context}: no convergence after {self.iterations} iterations; "
@@ -50,17 +55,18 @@ class ConvergenceError(RuntimeError):
 
 def enforce_convergence(converged: bool, policy: str, context: str, *,
                         iterations: int, distance: float, tol: float,
-                        detail: dict | None = None) -> None:
+                        detail: dict | None = None, telemetry=None) -> None:
     """Apply a non-convergence policy: no-op when converged or
     policy='ignore'; emit ConvergenceWarning for 'warn' (the reference's
-    behavior, made typed); raise ConvergenceError for 'raise'."""
+    behavior, made typed); raise ConvergenceError for 'raise', carrying
+    `telemetry` (the loop's flight record, when one exists) on the error."""
     if policy not in _POLICIES:
         raise ValueError(f"unknown on_nonconvergence policy {policy!r}; expected one of {_POLICIES}")
     if converged or policy == "ignore":
         return
     if policy == "raise":
         raise ConvergenceError(context, iterations=iterations, distance=distance,
-                               tol=tol, detail=detail)
+                               tol=tol, detail=detail, telemetry=telemetry)
     warnings.warn(
         str(ConvergenceError(context, iterations=iterations, distance=distance,
                              tol=tol, detail=detail)),

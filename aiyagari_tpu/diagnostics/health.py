@@ -1,0 +1,286 @@
+"""Health certificates: one report that says whether a solve can be trusted.
+
+Den Haan (2010)'s program — and the accuracy literature it anchors
+(PAPERS.md) — is that the error TRAJECTORY and the off-grid Euler residuals
+certify a solution; terminal convergence flags do not. This module
+assembles that certificate from what a solve already carries:
+
+  * Euler-equation error percentiles (utils/accuracy.euler_equation_errors,
+    consumption-equivalent log10 units) at the converged policies;
+  * the distribution's mass defect |sum(mu) - 1|;
+  * policy monotonicity and push-forward fallback tallies (a non-monotone
+    savings policy silently degrades every scatter-free route);
+  * the residual trajectory's SHAPE (diagnostics/telemetry.py recorders):
+    geometric decay vs stall vs oscillation — a loop that exits at
+    max_iter while limit-cycling reports the same scalars as one that
+    genuinely converged, and only the trajectory tells them apart.
+
+`health_report(result, model=...)` returns the report as a dict;
+`EquilibriumResult.health()` / `TransitionResult.health()` delegate here.
+`render_report` pretty-prints it, and the `python -m aiyagari_tpu report
+<ledger.jsonl>` CLI (report_main) renders a whole run ledger.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "diagnose_trajectory",
+    "health_report",
+    "render_report",
+    "report_main",
+]
+
+# Trajectory-shape thresholds. A tail window whose best residual improves
+# by less than _STALL_GAIN over the window is "stalled"; a window where
+# more than _OSC_FRAC of consecutive differences flip sign is
+# "oscillating". Windows are short (tail behavior is what matters) and the
+# verdicts are advisory labels, not hard failures.
+_TAIL = 16
+_STALL_GAIN = 0.5     # tail must improve by >= 2x over the window
+_OSC_FRAC = 0.6
+
+
+def diagnose_trajectory(residuals) -> dict:
+    """Shape diagnosis of one residual trajectory (chronological, host).
+
+    Returns {"sweeps", "first", "final", "decay_rate", "stalled",
+    "oscillating"}: decay_rate is the per-sweep geometric factor fitted to
+    the finite positive tail (NaN when it cannot be estimated), `stalled`
+    and `oscillating` the tail-window verdicts described above."""
+    r = np.asarray(residuals, np.float64).reshape(-1)
+    r = r[np.isfinite(r)]
+    out = {"sweeps": int(len(r)),
+           "first": float(r[0]) if len(r) else None,
+           "final": float(r[-1]) if len(r) else None,
+           "decay_rate": None, "stalled": False, "oscillating": False}
+    if len(r) < 4:
+        return out
+    tail = r[-min(_TAIL, len(r)):]
+    pos = tail[tail > 0.0]
+    if len(pos) >= 3:
+        # Geometric fit: median ratio of consecutive positive residuals —
+        # robust to the occasional safeguard spike.
+        out["decay_rate"] = float(np.median(pos[1:] / pos[:-1]))
+    # Stall: the tail's end is not meaningfully below its start.
+    if tail[0] > 0 and tail[-1] > _STALL_GAIN * tail[0]:
+        out["stalled"] = True
+    # Oscillation: consecutive differences keep flipping sign (limit cycle
+    # around the fixed point — the f32 flat-top wobble signature).
+    d = np.diff(tail)
+    nz = d[d != 0.0]
+    if len(nz) >= 4:
+        flips = np.mean(np.sign(nz[1:]) != np.sign(nz[:-1]))
+        if flips >= _OSC_FRAC:
+            out["oscillating"] = True
+    return out
+
+
+def _policy_monotonicity(policy_k) -> dict:
+    pk = np.asarray(policy_k)
+    viol = np.sum(pk[..., 1:] < pk[..., :-1])
+    return {"monotone": bool(viol == 0), "violations": int(viol)}
+
+
+def _euler_percentiles(result, model) -> dict | None:
+    sol = getattr(result, "solution", None)
+    if (model is None or sol is None
+            or getattr(sol, "policy_c", None) is None):
+        return None
+    if not hasattr(model, "a_grid"):
+        # Accept the AiyagariConfig the caller handed to solve() — the
+        # discretized model is cheap to rebuild from it.
+        from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+        model = AiyagariModel.from_config(model)
+    if getattr(model.config, "endogenous_labor", False):
+        # The midpoint Euler residual below assumes the exogenous-labor
+        # budget; the labor variant's intratemporal FOC is not wired yet.
+        return None
+    from aiyagari_tpu.utils.accuracy import euler_equation_errors
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    prefs = model.preferences
+    tech = model.config.technology
+    r = float(result.r)
+    w = float(wage_from_r(r, tech.alpha, tech.delta))
+    log10_err, mask = euler_equation_errors(
+        sol.policy_c, sol.policy_k, model.a_grid, model.s, model.P, r, w,
+        model.amin, sigma=float(prefs.sigma), beta=float(prefs.beta))
+    err = np.asarray(log10_err)[np.asarray(mask)]
+    if err.size == 0:
+        return None
+    return {
+        "p50_log10": float(np.percentile(err, 50)),
+        "p90_log10": float(np.percentile(err, 90)),
+        "p99_log10": float(np.percentile(err, 99)),
+        "max_log10": float(err.max()),
+        "points": int(err.size),
+    }
+
+
+def health_report(result, model=None) -> dict:
+    """Assemble the health certificate for an EquilibriumResult or
+    TransitionResult (duck-typed: anything carrying the relevant fields).
+    `model` (an AiyagariModel) unlocks the Euler-error percentiles."""
+    from aiyagari_tpu.diagnostics.telemetry import (
+        SolveTelemetry,
+        telemetry_summary,
+        telemetry_trajectory,
+    )
+
+    report: dict = {"kind": type(result).__name__,
+                    "converged": bool(getattr(result, "converged", False))}
+
+    # Outer-loop residual trajectory (host recorder on the result).
+    tele = getattr(result, "telemetry", None)
+    if isinstance(tele, SolveTelemetry):
+        report["outer"] = {
+            **(telemetry_summary(tele) or {}),
+            "trajectory": diagnose_trajectory(telemetry_trajectory(tele)),
+        }
+
+    # Inner (household/distribution) recorder, when the solve carried one.
+    sol = getattr(result, "solution", None)
+    inner = getattr(sol, "telemetry", None) if sol is not None else None
+    if isinstance(inner, SolveTelemetry) and np.ndim(inner.count) == 0:
+        report["inner"] = {
+            **(telemetry_summary(inner) or {}),
+            "trajectory": diagnose_trajectory(telemetry_trajectory(inner)),
+        }
+
+    mu = getattr(result, "mu", None)
+    if mu is not None:
+        mass = float(np.sum(np.asarray(mu, np.float64)))
+        report["distribution"] = {
+            "mass_defect": abs(mass - 1.0),
+            "min_mass": float(np.min(np.asarray(mu))),
+        }
+
+    if sol is not None and getattr(sol, "policy_k", None) is not None:
+        report["policy"] = _policy_monotonicity(sol.policy_k)
+
+    euler = _euler_percentiles(result, model)
+    if euler is not None:
+        report["euler_errors"] = euler
+
+    # Transition results: the round history IS the outer trajectory.
+    hist = getattr(result, "max_excess_history", None)
+    if hist:
+        report["outer"] = report.get("outer") or {}
+        report["outer"]["trajectory"] = diagnose_trajectory(hist)
+        report["outer"]["rounds"] = len(hist)
+        report["outer"]["final_residual"] = float(hist[-1])
+
+    flags = []
+    if not report["converged"]:
+        flags.append("not-converged")
+        # Trajectory-shape flags explain WHY the iteration cap was hit
+        # (stall vs limit cycle vs slow-but-healthy decay). A CONVERGED
+        # solve's tail shape is moot — bisection gap trajectories
+        # legitimately oscillate while closing, and flagging them would
+        # mark every healthy GE solve sick.
+        for side in ("outer", "inner"):
+            tr = report.get(side, {}).get("trajectory") or {}
+            if tr.get("stalled"):
+                flags.append(f"{side}-stalled")
+            if tr.get("oscillating"):
+                flags.append(f"{side}-oscillating")
+    if report.get("distribution", {}).get("mass_defect", 0.0) > 1e-8:
+        flags.append("mass-defect")
+    if report.get("policy", {}).get("monotone") is False:
+        flags.append("non-monotone-policy")
+    report["flags"] = flags
+    report["healthy"] = not flags
+    return report
+
+
+def render_report(report: dict) -> str:
+    """Human-readable rendering of one health_report dict."""
+    lines = [f"health: {'OK' if report.get('healthy') else 'FLAGS: ' + ', '.join(report.get('flags', []))}"
+             f"  ({report.get('kind', '?')}, converged={report.get('converged')})"]
+    for side in ("outer", "inner"):
+        sec = report.get(side)
+        if not sec:
+            continue
+        tr = sec.get("trajectory") or {}
+        bits = [f"{side}: sweeps={sec.get('sweeps', tr.get('sweeps'))}",
+                f"final={tr.get('final', sec.get('final_residual'))}"]
+        if tr.get("decay_rate") is not None:
+            bits.append(f"decay~{tr['decay_rate']:.3g}/sweep")
+        if sec.get("accel_trips"):
+            bits.append(f"accel_trips={sec['accel_trips']}")
+        if sec.get("pushforward_fallbacks"):
+            bits.append(f"fallbacks={sec['pushforward_fallbacks']}")
+        lines.append("  " + "  ".join(str(b) for b in bits))
+    if "distribution" in report:
+        lines.append(f"  mass defect: {report['distribution']['mass_defect']:.3e}")
+    if "euler_errors" in report:
+        e = report["euler_errors"]
+        lines.append(
+            f"  euler errors (log10): p50={e['p50_log10']:.2f} "
+            f"p90={e['p90_log10']:.2f} p99={e['p99_log10']:.2f} "
+            f"max={e['max_log10']:.2f} over {e['points']} midpoints")
+    if "policy" in report and not report["policy"]["monotone"]:
+        lines.append(f"  policy: {report['policy']['violations']} "
+                     "monotonicity violations")
+    return "\n".join(lines)
+
+
+def report_main(argv) -> int:
+    """`python -m aiyagari_tpu report <ledger.jsonl>`: render a run ledger —
+    runs, spans, verdicts, telemetry summaries, degradations — to stdout."""
+    import argparse
+
+    from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu report")
+    ap.add_argument("ledger", help="path to a run-ledger JSONL file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the parsed events as one JSON document")
+    args = ap.parse_args(argv)
+    events = read_ledger(args.ledger)
+    if args.json:
+        import json
+
+        print(json.dumps(events, indent=2))
+        return 0
+
+    by_run: dict = {}
+    for ev in events:
+        by_run.setdefault(ev.get("run_id", "?"), []).append(ev)
+    for run_id, evs in by_run.items():
+        start = next((e for e in evs if e["kind"] == "run_start"), {})
+        print(f"run {run_id}  events={len(evs)}  "
+              f"fingerprint={start.get('config_fingerprint', '-')}")
+        for ev in evs:
+            k = ev["kind"]
+            if k == "run_start":
+                continue
+            if k == "span":
+                print(f"  span {ev.get('name')}: {ev.get('seconds')}s"
+                      + (f" (compile {ev.get('compile_s')}s)"
+                         if ev.get("compile_s") is not None else ""))
+            elif k == "verdict":
+                status = "converged" if ev.get("converged") else "NOT CONVERGED"
+                print(f"  verdict {ev.get('context')}: {status} after "
+                      f"{ev.get('iterations')} iterations "
+                      f"(distance {ev.get('distance'):.3e} vs tol {ev.get('tol'):.1e})")
+            elif k == "telemetry":
+                s = ev.get("summary", {})
+                print(f"  telemetry {ev.get('context')}: sweeps={s.get('sweeps')} "
+                      f"final={s.get('final_residual')} "
+                      f"trips={s.get('accel_trips')} "
+                      f"fallbacks={s.get('pushforward_fallbacks')}")
+            elif k == "degradation":
+                print(f"  degradation: {ev.get('event')} x{ev.get('n', 1)}"
+                      f" ({ev.get('route', '-')})")
+            elif k == "metric":
+                print(f"  metric {ev.get('metric')}: {ev.get('value')} "
+                      f"{ev.get('unit', '')}")
+            else:
+                print(f"  {k}: " + ", ".join(
+                    f"{a}={b}" for a, b in ev.items()
+                    if a not in ("run_id", "seq", "ts", "kind")))
+    return 0

@@ -1,0 +1,194 @@
+"""Run ledger: an append-only JSONL event store keyed by a run id — every
+solve's traceable, exportable flight record.
+
+The ROADMAP's calibration item will fit millions of economies whose
+failures must be diagnosable WITHOUT re-running; the serve item needs a
+durable record of what each request did. The ledger is the storage half of
+that story: one JSONL file per run (or shared across runs — events carry
+their run id), each line one event:
+
+    {"run_id": "r1a2...", "seq": 3, "ts": 1722700000.1, "kind": "span",
+     ...event fields...}
+
+Standard event kinds written by the wired entry points (dispatch.solve /
+solve_transition / bench.py):
+
+  run_start    — config fingerprint (io_utils.checkpoint.config_fingerprint)
+                 + free-form metadata, first event of every run
+  span         — a named wall-clock span (diagnostics/trace.py), nested
+                 spans carried as children
+  telemetry    — a SolveTelemetry summary (diagnostics/telemetry.py) for one
+                 solver context
+  verdict      — a convergence verdict (context, converged, iterations,
+                 distance, tol)
+  degradation  — a counted degradation event (accel safeguard trip storm,
+                 push-forward fallback, ...) — ops/pushforward.py emits
+                 these through the active-ledger hook below
+  metric       — a benchmark record (bench.py writes every metric line it
+                 prints)
+
+Reading back: `read_ledger(path)` returns the parsed events;
+`python -m aiyagari_tpu report <ledger.jsonl>` renders them
+(diagnostics/health.py). Records are coerced through
+diagnostics.logging.coerce_record, so numpy/jnp scalars serialize.
+
+The ACTIVE-LEDGER hook: deep device code (the push-forward fallback
+counter) cannot thread a ledger handle through jit static args; it calls
+`ledger.emit(kind, **fields)`, which appends to whatever ledger is active
+on this thread (`with ledger.activate(led): ...`) and is a no-op otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Iterator, Optional
+
+from aiyagari_tpu.diagnostics.logging import _json_default, coerce_record
+
+__all__ = [
+    "RunLedger",
+    "activate",
+    "active_ledger",
+    "emit",
+    "read_ledger",
+]
+
+
+def new_run_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RunLedger:
+    """Append-only JSONL event store for one run.
+
+    Thread-safe; append-only by construction (the file is opened in "a"
+    mode per event, so concurrent writers from different processes
+    interleave whole lines — POSIX O_APPEND — rather than corrupt)."""
+
+    def __init__(self, path, *, run_id: Optional[str] = None,
+                 config=None, meta: Optional[dict] = None):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.run_id = run_id or new_run_id()
+        self._seq = 0
+        self._lock = threading.Lock()
+        start = {"pid": os.getpid(), **(meta or {})}
+        if config is not None:
+            from aiyagari_tpu.io_utils.checkpoint import config_fingerprint
+
+            cfgs = config if isinstance(config, (tuple, list)) else (config,)
+            start["config_fingerprint"] = config_fingerprint(*cfgs)
+            start["config"] = [repr(c) for c in cfgs]
+        self.event("run_start", **start)
+
+    def event(self, kind: str, **fields) -> dict:
+        """Append one event; returns the written record (coerced)."""
+        with self._lock:
+            rec = {"run_id": self.run_id, "seq": self._seq,
+                   "ts": round(time.time(), 4), "kind": kind,
+                   **coerce_record(fields)}
+            self._seq += 1
+            with self.path.open("a") as f:
+                f.write(json.dumps(rec, default=_json_default) + "\n")
+        return rec
+
+    # -- convenience writers for the standard kinds ------------------------
+
+    def telemetry(self, context: str, tele) -> None:
+        """Store a SolveTelemetry summary (or a pre-built summary dict)."""
+        from aiyagari_tpu.diagnostics.telemetry import (
+            SolveTelemetry,
+            telemetry_summary,
+        )
+
+        if isinstance(tele, SolveTelemetry):
+            tele = telemetry_summary(tele)
+        if tele is not None:
+            self.event("telemetry", context=context, summary=tele)
+
+    def verdict(self, context: str, *, converged, iterations, distance,
+                tol, **extra) -> None:
+        self.event("verdict", context=context, converged=bool(converged),
+                   iterations=int(iterations), distance=float(distance),
+                   tol=float(tol), **extra)
+
+    def span(self, record: dict) -> None:
+        self.event("span", **record)
+
+    def metric(self, record: dict) -> None:
+        self.event("metric", **record)
+
+
+def read_ledger(path) -> list:
+    """Parse a ledger JSONL back into its event dicts (the round-trip the
+    bench CI test pins). Blank lines are skipped; a torn final line (a
+    crashed writer) raises — a ledger that cannot round-trip must be loud."""
+    events = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                events.append(json.loads(ln))
+    return events
+
+
+# -- active-ledger hook (thread-local + process fallback) ------------------
+
+_tls = threading.local()
+# Process-wide fallback: jax.debug.callback events (the push-forward
+# degradation counter) fire on the runtime's callback thread, where the
+# activating thread's local is invisible — without this fallback those
+# events would silently vanish. A STACK, not a single slot: overlapping
+# activations from different threads exit in arbitrary order, and a
+# save/restore slot would let the first exit re-point (or null out) the
+# fallback while another thread's run is still live. Each exit removes its
+# own entry; the fallback is the most recent still-active ledger. The
+# thread-local still takes precedence on the activating thread itself.
+_proc_lock = threading.Lock()
+_proc_stack: list = []
+
+
+def active_ledger() -> Optional[RunLedger]:
+    led = getattr(_tls, "ledger", None)
+    if led is not None:
+        return led
+    with _proc_lock:
+        return _proc_stack[-1] if _proc_stack else None
+
+
+@contextlib.contextmanager
+def activate(led: Optional[RunLedger]) -> Iterator[Optional[RunLedger]]:
+    """Scope `led` as the active ledger; `emit` routes to it. Scoped
+    thread-locally AND as the process fallback (async debug-callback
+    threads read the fallback). None is allowed (and makes the block a
+    no-op scope), so call sites can pass their optional ledger straight
+    through."""
+    prev = getattr(_tls, "ledger", None)
+    _tls.ledger = led
+    if led is not None:
+        with _proc_lock:
+            _proc_stack.append(led)
+    try:
+        yield led
+    finally:
+        _tls.ledger = prev
+        if led is not None:
+            with _proc_lock:
+                for i in range(len(_proc_stack) - 1, -1, -1):
+                    if _proc_stack[i] is led:
+                        del _proc_stack[i]
+                        break
+
+
+def emit(kind: str, **fields) -> None:
+    """Append to the active ledger, if any — the hook deep code (async
+    debug callbacks, solver internals) uses without holding a handle."""
+    led = active_ledger()
+    if led is not None:
+        led.event(kind, **fields)

@@ -14,9 +14,44 @@ import json
 import sys
 import time
 from pathlib import Path
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
-__all__ = ["ConsoleSink", "JSONLSink", "CollectSink", "multiplex"]
+__all__ = ["ConsoleSink", "JSONLSink", "CollectSink", "coerce_record",
+           "multiplex"]
+
+
+def _coerce_scalar(v):
+    """Collapse numpy/jnp 0-d scalars (and numpy scalar types) to plain
+    Python numbers. Solver records routinely carry them — `sol.distance` is
+    a 0-d device array, `np.max(...)` a numpy scalar — and they are NOT
+    `isinstance(v, float)`: the console sink printed them as opaque
+    `Array(1.2e-06, dtype=float64)` reprs and json.dumps raised TypeError.
+    Anything non-scalar (strings, dicts, >=1-d arrays) passes through."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    # numpy scalar types and 0-d arrays (jax arrays quack the same way).
+    ndim = getattr(v, "ndim", None)
+    if ndim == 0 and hasattr(v, "item"):
+        v = v.item()
+        # numpy datetime/str 0-d items pass through unchanged.
+        return v
+    return v
+
+
+def coerce_record(record: dict) -> dict:
+    """Recursively coerce a record's array scalars to Python numbers so it
+    prints readably and JSON-serializes; shared by every sink here and the
+    run ledger (diagnostics/ledger.py). Lists/tuples/dicts recurse; other
+    leaves pass through _coerce_scalar."""
+
+    def walk(v):
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [walk(x) for x in v]
+        return _coerce_scalar(v)
+
+    return {k: walk(v) for k, v in record.items()}
 
 
 class ConsoleSink:
@@ -29,7 +64,7 @@ class ConsoleSink:
 
     def __call__(self, record: dict) -> None:
         parts = []
-        for k, v in record.items():
+        for k, v in coerce_record(record).items():
             if isinstance(v, float):
                 parts.append(f"{k}={v:.6g}")
             elif isinstance(v, list):
@@ -37,6 +72,15 @@ class ConsoleSink:
             else:
                 parts.append(f"{k}={v}")
         print(self.prefix + " ".join(parts), file=self.stream)
+
+
+def _json_default(v):
+    """json.dumps fallback for leaves coerce_record left alone (e.g. 1-d
+    arrays inside records): try the array tolist protocol, else repr —
+    a log line must never crash the solve that emits it."""
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return repr(v)
 
 
 class JSONLSink:
@@ -49,9 +93,10 @@ class JSONLSink:
         self._t0 = time.time()
 
     def __call__(self, record: dict) -> None:
-        rec = {"wall_time": round(time.time() - self._t0, 4), **record}
+        rec = {"wall_time": round(time.time() - self._t0, 4),
+               **coerce_record(record)}
         with self.path.open("a") as f:
-            f.write(json.dumps(rec) + "\n")
+            f.write(json.dumps(rec, default=_json_default) + "\n")
 
 
 class CollectSink:

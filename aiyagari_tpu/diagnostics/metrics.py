@@ -1,0 +1,235 @@
+"""Process-wide metrics registry: counters, gauges, histograms, with
+Prometheus-text and JSON exporters.
+
+The persistent-solve-service item on the ROADMAP needs a scrape surface —
+a long-lived server cannot re-derive "how many push-forward fallbacks fired
+since boot" from per-solve results. This registry is that surface: tiny,
+dependency-free, thread-safe, and shaped so the future serve layer exposes
+`render_prometheus()` at /metrics verbatim. Solver-internal degradation
+events (ops/pushforward.py's fallback counter) land here through async
+`jax.debug.callback`s, so the hot device programs never block on it.
+
+Deliberately NOT a client-library clone: no label cardinality policing, no
+metric families beyond the three everything here needs. Labels are plain
+kwargs; a (name, sorted labels) pair is one time series.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
+    "registry",
+    "render_json",
+    "render_prometheus",
+    "reset",
+]
+
+# Histogram defaults tuned for solver residuals/walls: log-spaced, wide.
+_DEFAULT_BUCKETS = (1e-10, 1e-8, 1e-6, 1e-4, 1e-2, 1.0, 100.0)
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: dict) -> _Key:
+    return name, tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotone event count."""
+
+    def __init__(self, reg: "MetricsRegistry", key: _Key):
+        self._reg, self._key = reg, key
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counters only go up; got inc({n})")
+        with self._reg._lock:
+            self._reg._counters[self._key] = (
+                self._reg._counters.get(self._key, 0.0) + float(n))
+
+    @property
+    def value(self) -> float:
+        with self._reg._lock:
+            return self._reg._counters.get(self._key, 0.0)
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    def __init__(self, reg: "MetricsRegistry", key: _Key):
+        self._reg, self._key = reg, key
+
+    def set(self, v: float) -> None:
+        with self._reg._lock:
+            self._reg._gauges[self._key] = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._reg._lock:
+            return self._reg._gauges.get(self._key)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket counts
+    observations <= its upper bound; +Inf is implicit via `count`)."""
+
+    def __init__(self, reg: "MetricsRegistry", key: _Key, buckets):
+        self._reg, self._key = reg, key
+        self._buckets = tuple(sorted(float(b) for b in buckets))
+        with reg._lock:
+            reg._histograms.setdefault(
+                key, {"buckets": self._buckets,
+                      "counts": [0] * len(self._buckets),
+                      "count": 0, "sum": 0.0})
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._reg._lock:
+            # setdefault, not indexing: a handle held across registry.reset()
+            # (module-level caching is the intended usage pattern) must
+            # re-create its series, exactly as Counter/Gauge implicitly do.
+            h = self._reg._histograms.setdefault(
+                self._key, {"buckets": self._buckets,
+                            "counts": [0] * len(self._buckets),
+                            "count": 0, "sum": 0.0})
+            for i, b in enumerate(h["buckets"]):
+                if v <= b:
+                    h["counts"][i] += 1
+            h["count"] += 1
+            h["sum"] += v
+
+
+class MetricsRegistry:
+    """One process's metric store. The module-level `registry` is the
+    default every helper below uses; tests may build private ones."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: Dict[_Key, float] = {}
+        self._gauges: Dict[_Key, float] = {}
+        self._histograms: Dict[_Key, dict] = {}
+        self._started = time.time()
+
+    def counter(self, name: str, **labels) -> Counter:
+        return Counter(self, _key(name, labels))
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return Gauge(self, _key(name, labels))
+
+    def histogram(self, name: str, buckets=_DEFAULT_BUCKETS,
+                  **labels) -> Histogram:
+        return Histogram(self, _key(name, labels), buckets)
+
+    def reset(self) -> None:
+        """Drop every series — autouse-fixture friendly (the registry is
+        process-global, exactly the state tests must not leak)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+    # -- exporters ---------------------------------------------------------
+
+    def render_json(self) -> dict:
+        def unkey(store):
+            return [
+                {"name": name, "labels": dict(labels), "value": v}
+                for (name, labels), v in sorted(store.items())
+            ]
+
+        with self._lock:
+            return {
+                "counters": unkey(self._counters),
+                "gauges": unkey(self._gauges),
+                "histograms": [
+                    {"name": name, "labels": dict(labels),
+                     "buckets": list(h["buckets"]), "counts": list(h["counts"]),
+                     "count": h["count"], "sum": h["sum"]}
+                    for (name, labels), h in sorted(self._histograms.items())
+                ],
+                "uptime_seconds": round(time.time() - self._started, 3),
+            }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format v0.0.4 — the string a serve
+        layer returns from /metrics."""
+
+        def fmt_labels(labels, extra=()):
+            items = list(labels) + list(extra)
+            if not items:
+                return ""
+            return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+        lines = []
+        # ONE "# TYPE" line per metric NAME, not per label-set series — the
+        # Prometheus text parser rejects a repeated TYPE for the same name,
+        # which is exactly what multi-route fallback counters produce.
+        typed: set = set()
+
+        def typ(name, kind):
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                typ(name, "counter")
+                lines.append(f"{name}{fmt_labels(labels)} {v:g}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                typ(name, "gauge")
+                lines.append(f"{name}{fmt_labels(labels)} {v:g}")
+            for (name, labels), h in sorted(self._histograms.items()):
+                typ(name, "histogram")
+                for b, c in zip(h["buckets"], h["counts"]):
+                    lines.append(
+                        f"{name}_bucket{fmt_labels(labels, [('le', f'{b:g}')])} {c}")
+                lines.append(
+                    f"{name}_bucket{fmt_labels(labels, [('le', '+Inf')])} {h['count']}")
+                lines.append(f"{name}_sum{fmt_labels(labels)} {h['sum']:g}")
+                lines.append(f"{name}_count{fmt_labels(labels)} {h['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+registry = MetricsRegistry()
+
+
+def counter(name: str, **labels) -> Counter:
+    return registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return registry.gauge(name, **labels)
+
+
+def histogram(name: str, buckets=_DEFAULT_BUCKETS, **labels) -> Histogram:
+    return registry.histogram(name, buckets, **labels)
+
+
+def render_prometheus() -> str:
+    return registry.render_prometheus()
+
+
+def render_json() -> dict:
+    return registry.render_json()
+
+
+def reset() -> None:
+    registry.reset()
+
+
+def dump_json(path) -> None:
+    """Write the JSON exposition to a file (bench.py's per-run snapshot)."""
+    with open(path, "w") as f:
+        json.dump(registry.render_json(), f, indent=2)
+        f.write("\n")

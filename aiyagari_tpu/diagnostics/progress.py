@@ -20,7 +20,7 @@ from typing import Callable
 
 import jax
 
-__all__ = ["subscribe", "capture_progress", "device_progress"]
+__all__ = ["subscribe", "capture_progress", "device_progress", "reset"]
 
 _SINKS: list[Callable[[dict], None]] = []
 
@@ -39,6 +39,15 @@ def subscribe(sink: Callable[[dict], None]) -> Callable[[], None]:
     return unsubscribe
 
 
+def reset() -> None:
+    """Drop every subscribed sink. _SINKS is module-global state shared
+    across threads and test cases; an autouse fixture calling reset() makes
+    a leaked subscription (a test that crashed before its unsubscribe, a
+    capture_progress block interrupted mid-teardown) impossible to carry
+    into the next test."""
+    _SINKS.clear()
+
+
 @contextmanager
 def capture_progress(sink: Callable[[dict], None]):
     """Scope a sink subscription: records emitted by any jitted solver running
@@ -48,9 +57,14 @@ def capture_progress(sink: Callable[[dict], None]):
         yield sink
     finally:
         # debug.callback effects are asynchronous: drain in-flight records
-        # before dropping the subscription, or trailing ones vanish.
-        jax.effects_barrier()
-        unsubscribe()
+        # before dropping the subscription, or trailing ones vanish. The
+        # barrier itself can raise (a dead device, an interrupted runtime) —
+        # the subscription must still be dropped, or the sink leaks into
+        # every later solve in the process (test-isolation hazard).
+        try:
+            jax.effects_barrier()
+        finally:
+            unsubscribe()
 
 
 def _deliver(context: str, iteration, distance) -> None:

@@ -1,0 +1,249 @@
+"""Device-resident flight recorders for the hot fixed-point loops.
+
+Every solver in this framework is a `lax.while_loop` whose residual
+trajectory normally dies inside the loop: the host sees terminal scalars
+(`iterations`, `distance`, `hot_iterations`) and nothing else. Den Haan's
+accuracy-testing program (PAPERS.md) treats the error TRAJECTORY, not the
+endpoint, as the correctness certificate — a solve that limit-cycles at
+1.1x tol for 900 sweeps and one that decays geometrically to tol/100 report
+the same terminal scalars today. This module makes the trajectory a
+first-class output:
+
+  * `SolveTelemetry` is a small pytree of fixed-length ring buffers carried
+    INSIDE the while_loop body (residual + stage-dtype per sweep, accel
+    safeguard trips, push-forward fallback tallies). No host callbacks, no
+    sync, no dynamic shapes — it jits, vmaps (one recorder per scenario in
+    the batched-GE/sweep programs), and shard_maps (replicated: every
+    device records the pmax'd global residual, so the buffers agree).
+  * The recorder functions (`telemetry_record`, ...) are COMPILE-TIME
+    no-ops when the recorder is None: they return their None unchanged, so
+    a telemetry-off solve traces to the identical program and carries zero
+    extra bytes (pinned by tests/test_telemetry.py's jaxpr assertion).
+  * Ring, not head-truncated: a loop longer than `capacity` keeps the LAST
+    `capacity` residuals — the tail is what the stall/oscillation
+    certificates (diagnostics/health.py) read — while `count` keeps the
+    true total so truncation is visible.
+
+The user-facing knob is `config.TelemetryConfig` (frozen/hashable, a jit
+static arg), wired as `SolverConfig(telemetry=...)` through every solver
+family; host-side outer loops (GE bisection, KS ALM, transition rounds)
+assemble the same pytree from their per-round records via
+`host_telemetry`, so one shape serves both worlds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import TelemetryConfig
+
+__all__ = [
+    "SolveTelemetry",
+    "TelemetryConfig",
+    "telemetry_init",
+    "telemetry_record",
+    "telemetry_set_trips",
+    "telemetry_add_fallbacks",
+    "telemetry_leaves",
+    "telemetry_from_leaves",
+    "host_telemetry",
+    "telemetry_trajectory",
+    "telemetry_stages",
+    "telemetry_summary",
+]
+
+# Residuals are recorded in ONE dtype regardless of the sweep's stage dtype
+# (the mixed-precision ladder changes the carry dtype mid-solve, and the
+# recorder must cross that stage boundary without changing pytree structure).
+# f32 resolves any residual the stopping rules can distinguish (min normal
+# ~1e-38 vs tolerances >= 1e-16) at half the carry bytes of f64.
+_RES_DTYPE = jnp.float32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SolveTelemetry:
+    """One solve's flight record. All fields are arrays (pytree leaves), so
+    the record vmaps/shards with the solve that produced it; under a
+    scenario batch every field carries a leading [S] axis."""
+
+    residuals: jax.Array   # [capacity] f32 ring of per-sweep residuals
+    stage_bits: jax.Array  # [capacity] int32 dtype width of each sweep (32/64)
+    count: jax.Array       # int32 total sweeps recorded (may exceed capacity)
+    accel_trips: jax.Array     # int32 safeguard fallbacks (ops/accel.py)
+    fallbacks: jax.Array       # int32 push-forward degradations (ops/pushforward.py)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.residuals.shape[-1])
+
+
+def telemetry_init(cfg: Optional[TelemetryConfig],
+                   dtype=None) -> Optional[SolveTelemetry]:
+    """A fresh recorder for `cfg`, or None when telemetry is off — the None
+    flows through every recorder call unchanged, so the off path compiles
+    to the exact pre-telemetry program."""
+    if cfg is None:
+        return None
+    cap = int(cfg.capacity)
+    if cap < 1:
+        raise ValueError(f"TelemetryConfig.capacity must be >= 1, got {cap}")
+    return SolveTelemetry(
+        residuals=jnp.full((cap,), jnp.nan, _RES_DTYPE),
+        stage_bits=jnp.zeros((cap,), jnp.int32),
+        count=jnp.int32(0),
+        accel_trips=jnp.int32(0),
+        fallbacks=jnp.int32(0),
+    )
+
+
+def telemetry_record(tele: Optional[SolveTelemetry],
+                     residual) -> Optional[SolveTelemetry]:
+    """Record one sweep's residual into the ring (position count % capacity).
+    The stage dtype is read off the residual's own dtype at TRACE time, so
+    each ladder stage stamps its entries statically. No-op when off."""
+    if tele is None:
+        return None
+    cap = tele.capacity
+    pos = tele.count % cap
+    bits = jnp.int32(jnp.finfo(jnp.asarray(residual).dtype).bits)
+    return dataclasses.replace(
+        tele,
+        residuals=tele.residuals.at[pos].set(
+            jnp.asarray(residual).astype(_RES_DTYPE)),
+        stage_bits=tele.stage_bits.at[pos].set(bits),
+        count=tele.count + 1,
+    )
+
+
+def telemetry_set_trips(tele: Optional[SolveTelemetry],
+                        trips) -> Optional[SolveTelemetry]:
+    """Overwrite the accel-safeguard trip count (callers pass a running
+    total: stage base + the accel state's own counter). No-op when off."""
+    if tele is None:
+        return None
+    return dataclasses.replace(tele,
+                               accel_trips=jnp.asarray(trips, jnp.int32))
+
+
+def telemetry_add_fallbacks(tele: Optional[SolveTelemetry],
+                            n) -> Optional[SolveTelemetry]:
+    """Add `n` push-forward degradation events (a traced int — plan-validity
+    flags compile in, ops/pushforward.py). No-op when off."""
+    if tele is None:
+        return None
+    return dataclasses.replace(
+        tele, fallbacks=tele.fallbacks + jnp.asarray(n, jnp.int32))
+
+
+# shard_map carries: the recorder crosses the shard_map boundary as a flat
+# tuple of leaves (explicit out_specs per leaf — no pytree-prefix magic on
+# the jax-0.4.x shim), reassembled by the host wrapper.
+_N_LEAVES = 5
+
+
+def telemetry_leaves(tele: Optional[SolveTelemetry]) -> tuple:
+    """Flatten to a static-length tuple of arrays (empty when off)."""
+    if tele is None:
+        return ()
+    return (tele.residuals, tele.stage_bits, tele.count, tele.accel_trips,
+            tele.fallbacks)
+
+
+def telemetry_from_leaves(leaves) -> Optional[SolveTelemetry]:
+    """Inverse of telemetry_leaves."""
+    if not leaves:
+        return None
+    assert len(leaves) == _N_LEAVES
+    return SolveTelemetry(*leaves)
+
+
+def host_telemetry(residuals, stage_bits=None, *, trips: int = 0,
+                   fallbacks: int = 0) -> SolveTelemetry:
+    """Assemble a SolveTelemetry from HOST-side per-round records — the
+    outer loops (GE bisection rounds, KS ALM iterations, transition Newton
+    rounds) already collect their residual histories as Python lists; this
+    puts them in the same shape the device recorders return, so one report
+    path serves both. Host numpy arrays, no device transfer."""
+    res = np.asarray(residuals, np.float32).reshape(-1)
+    cap = max(len(res), 1)
+    buf = np.full(cap, np.nan, np.float32)
+    buf[: len(res)] = res
+    if stage_bits is None:
+        bits = np.full(cap, 64, np.int32)
+        bits[len(res):] = 0
+    else:
+        bits = np.zeros(cap, np.int32)
+        bits[: len(res)] = np.asarray(stage_bits, np.int32).reshape(-1)[: len(res)]
+    return SolveTelemetry(
+        residuals=buf,
+        stage_bits=bits,
+        count=np.int32(len(res)),
+        accel_trips=np.int32(trips),
+        fallbacks=np.int32(fallbacks),
+    )
+
+
+def _host(tele: SolveTelemetry) -> SolveTelemetry:
+    """One batched device fetch of every leaf (numpy out)."""
+    leaves = [tele.residuals, tele.stage_bits, tele.count,
+              tele.accel_trips, tele.fallbacks]
+    if any(isinstance(l, jax.Array) for l in leaves):
+        leaves = jax.device_get(leaves)
+    return SolveTelemetry(*[np.asarray(l) for l in leaves])
+
+
+def telemetry_trajectory(tele: SolveTelemetry) -> np.ndarray:
+    """The chronological residual trajectory (host float32 array): the ring
+    unrolled so index 0 is the OLDEST retained sweep. When count exceeded
+    capacity, the head of the trajectory was overwritten — only the last
+    `capacity` residuals exist (by design; `count` tells the truth)."""
+    t = _host(tele)
+    if t.residuals.ndim != 1:
+        raise ValueError(
+            "telemetry_trajectory reads ONE recorder; index a batched "
+            f"telemetry (shape {t.residuals.shape}) down to one scenario "
+            "first")
+    cap = t.residuals.shape[0]
+    n = int(t.count)
+    if n <= cap:
+        return t.residuals[:n]
+    return np.roll(t.residuals, -(n % cap))
+
+
+def telemetry_stages(tele: SolveTelemetry) -> np.ndarray:
+    """Chronological stage-dtype widths aligned with telemetry_trajectory."""
+    t = _host(tele)
+    cap = t.stage_bits.shape[0]
+    n = int(t.count)
+    if n <= cap:
+        return t.stage_bits[:n]
+    return np.roll(t.stage_bits, -(n % cap))
+
+
+def telemetry_summary(tele: Optional[SolveTelemetry]) -> Optional[dict]:
+    """JSON-ready summary of one recorder — what the run ledger stores per
+    solve (the full ring stays on the Solution for callers that want it)."""
+    if tele is None:
+        return None
+    traj = telemetry_trajectory(tele)
+    t = _host(tele)
+    finite = traj[np.isfinite(traj)]
+    switch = int(np.argmax(telemetry_stages(tele) ==
+                           np.max(t.stage_bits))) if len(traj) else 0
+    return {
+        "sweeps": int(t.count),
+        "retained": int(len(traj)),
+        "capacity": int(t.residuals.shape[-1]),
+        "first_residual": float(finite[0]) if len(finite) else None,
+        "final_residual": float(finite[-1]) if len(finite) else None,
+        "min_residual": float(finite.min()) if len(finite) else None,
+        "stage_switch_at": switch,
+        "accel_trips": int(t.accel_trips),
+        "pushforward_fallbacks": int(t.fallbacks),
+    }

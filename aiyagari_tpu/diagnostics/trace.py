@@ -1,0 +1,125 @@
+"""Nested wall-clock span tracing with device-profiler annotations and a
+compile-vs-run split.
+
+`diagnostics/profiler.py` times ONE function with proper fencing; this
+module adds structure: named spans that nest (`with span("ge_bisect"):`),
+land in the device profiler's timeline as `jax.profiler.TraceAnnotation`s
+(so a TensorBoard/Perfetto capture shows the host phases next to the XLA
+ops), and are collected as plain dicts the run ledger stores. No global
+mutable trace unless you open one: spans outside a `collect_spans()` scope
+still annotate the profiler but are otherwise dropped.
+
+    with collect_spans() as spans:
+        with span("anchor"):
+            ...
+        with span("newton", round=3):
+            ...
+    ledger.span(spans[0])
+
+`timed(name, fn, *args)` wraps profiler.time_fn to record the
+compile-vs-run split as a span — the same cold/hot semantics bench.py
+reports, available to any instrumented call site.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, List
+
+__all__ = ["collect_spans", "span", "timed"]
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def _sinks() -> list:
+    if not hasattr(_tls, "sinks"):
+        _tls.sinks = []
+    return _tls.sinks
+
+
+@contextlib.contextmanager
+def collect_spans() -> Iterator[List[dict]]:
+    """Scope a span collector: every TOP-LEVEL span closed inside the block
+    is appended to the yielded list (children ride inside their parent's
+    "children" field). Nested collectors each receive the spans closed in
+    their scope. Exception-safe: the collector is removed even when the
+    block raises."""
+    out: List[dict] = []
+    _sinks().append(out)
+    try:
+        yield out
+    finally:
+        _sinks().remove(out)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[dict]:
+    """One named wall-clock span. Nesting is structural: a span opened
+    inside another becomes a child record. The block also runs under
+    jax.profiler.TraceAnnotation(name), so device traces carry the same
+    names (annotation failures — e.g. no profiler backend — are never
+    allowed to break the solve)."""
+    rec = {"name": name, **attrs, "children": []}
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    stack.append(rec)
+    ann = None
+    try:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    except Exception:
+        ann = None
+    t0 = time.perf_counter()
+    try:
+        yield rec
+    finally:
+        rec["seconds"] = round(time.perf_counter() - t0, 6)
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        stack.pop()
+        if not rec["children"]:
+            del rec["children"]
+        if parent is not None:
+            parent.setdefault("children", []).append(rec)
+        else:
+            for sink in _sinks():
+                sink.append(rec)
+
+
+def timed(name: str, fn, *args, reps: int = 1, **kwargs):
+    """Run `fn(*args, **kwargs)` under a span that records the
+    compile-vs-run split (profiler.time_fn semantics: one fenced cold call,
+    `reps` fenced hot calls, compile = cold - best hot). Returns
+    (cold result, span_record). reps=0 skips the hot calls (the span then
+    carries only the cold wall) for call sites that cannot afford a
+    re-execution."""
+    from aiyagari_tpu.diagnostics.profiler import fence
+
+    with span(name) as rec:
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        fence(out)
+        cold = time.perf_counter() - t0
+        best = None
+        for _ in range(max(reps, 0)):
+            t0 = time.perf_counter()
+            fence(fn(*args, **kwargs))
+            best = min(best or float("inf"), time.perf_counter() - t0)
+    rec["compile_and_first_run_s"] = round(cold, 6)
+    if best is not None:
+        rec["run_s"] = round(best, 6)
+        rec["compile_s"] = round(max(0.0, cold - best), 6)
+    return out, rec

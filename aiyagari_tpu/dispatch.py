@@ -13,6 +13,7 @@ numbers, so speedups are reported against this at the reference's scales).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 from typing import Optional, Sequence, Union
@@ -34,6 +35,74 @@ from aiyagari_tpu.config import (
 )
 
 __all__ = ["solve", "sweep", "solve_transition", "sweep_transitions"]
+
+
+def _as_ledger(ledger, *configs, entry: str):
+    """Resolve the `ledger` argument: None (off), a RunLedger (used as-is),
+    or a path (a fresh RunLedger is opened there with the configs'
+    fingerprint as its run_start event)."""
+    if ledger is None:
+        return None
+    from aiyagari_tpu.diagnostics.ledger import RunLedger
+
+    if isinstance(ledger, RunLedger):
+        return ledger
+    return RunLedger(ledger, config=[c for c in configs if c is not None],
+                     meta={"entry": entry})
+
+
+@contextlib.contextmanager
+def _observe(led, name: str, **attrs):
+    """The entry-point observability scope: the run ledger becomes the
+    ACTIVE ledger (async degradation events — push-forward fallbacks —
+    route to it), and the whole solve runs under a named wall-clock span
+    (diagnostics/trace.py, device-profiler-annotated) written to the ledger
+    on exit. A no-op shell when led is None except the span annotation.
+
+    Spans flush in a finally: a solve that RAISES is exactly the run the
+    flight record exists to explain, so its wall-clock spans (trace.span
+    completes the record on unwind) and an "error" event land in the
+    ledger before the exception propagates."""
+    from aiyagari_tpu.diagnostics.ledger import activate
+    from aiyagari_tpu.diagnostics.trace import collect_spans, span
+
+    with activate(led), collect_spans() as spans:
+        try:
+            with span(name, **attrs) as rec:
+                yield rec
+        except BaseException as e:
+            if led is not None:
+                led.event("error", context=name, error_type=type(e).__name__,
+                          error=str(e)[:500])
+            raise
+        finally:
+            if led is not None:
+                for s in spans:
+                    led.span(s)
+
+
+def _ledger_result(led, context: str, result, *, converged, iterations,
+                   distance, tol) -> None:
+    """Write the solve's verdict + every flight-record summary it carries."""
+    if led is None:
+        return
+    led.verdict(context, converged=converged, iterations=iterations,
+                distance=distance, tol=tol)
+    sol = getattr(result, "solution", None)
+    for name, tele in (
+        ("outer", getattr(result, "telemetry", None)),
+        ("household", getattr(sol, "telemetry", None) if sol is not None
+         else None),
+        ("distribution", getattr(result, "dist_telemetry", None)),
+    ):
+        if tele is None:
+            continue
+        try:
+            led.telemetry(name, tele)
+        except ValueError:
+            # Batched recorders ([S]-leading leaves) have no single summary;
+            # the full buffers stay on the result for per-scenario reads.
+            pass
 
 
 def _dtype_of(backend: BackendConfig):
@@ -76,6 +145,7 @@ def solve(
     alm: Optional[ALMConfig] = None,
     aggregation: str = "simulation",
     on_nonconvergence: str = "warn",
+    ledger=None,
 ):
     """Solve a full model to general equilibrium.
 
@@ -118,6 +188,17 @@ def solve(
     ladder=PrecisionLadderConfig(...)); backends without x64 reject it
     loudly. For Krusell-Smith, "mixed" keeps the measured component policy
     (BackendConfig docstring).
+
+    Observability (docs/USAGE.md "Observability"):
+    SolverConfig(telemetry=TelemetryConfig(...)) carries a device-resident
+    flight recorder through every hot fixed-point loop — per-sweep residual
+    rings returned on the solutions (diagnostics/telemetry.py); off by
+    default with zero cost. `ledger` (a diagnostics.ledger.RunLedger or a
+    JSONL path) makes the solve write its traceable run record: config
+    fingerprint, wall-clock spans, telemetry summaries, the convergence
+    verdict, and any degradation events (push-forward fallbacks) — render
+    it with `python -m aiyagari_tpu report <ledger>`. Every result exposes
+    `.health()` (diagnostics/health.py), the Den-Haan-style certificate.
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -147,90 +228,107 @@ def solve(
         solver = _with_ladder(solver, method, backend)
         sim = sim or SimConfig()
         equilibrium = equilibrium or EquilibriumConfig()
-        if backend.backend == "numpy":
-            if backend.dtype == "mixed" or solver.ladder is not None:
-                raise ValueError(
-                    "the mixed-precision solve ladder (dtype='mixed' / "
-                    "SolverConfig.ladder) requires backend='jax'; the numpy "
-                    "reference backend is single-dtype by design")
-            if solver.pushforward not in ("auto", "scatter"):
-                raise ValueError(
-                    "SolverConfig.pushforward scatter-free backends require "
-                    "backend='jax'; the numpy reference backend has only "
-                    "the scatter formulation")
-            if aggregation != "simulation":
-                raise ValueError("aggregation='distribution' requires backend='jax'")
-            if equilibrium.batch >= 2:
-                raise ValueError(
-                    "EquilibriumConfig.batch >= 2 (batched GE) requires "
-                    "backend='jax'")
-            from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
-
-            result = solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
-        else:
-            from aiyagari_tpu.config import precision_scope
-            from aiyagari_tpu.equilibrium.bisection import (
-                solve_equilibrium,
-                solve_equilibrium_distribution,
-            )
-            from aiyagari_tpu.models.aiyagari import AiyagariModel
-
-            # Honor dtype="float64" even when global x64 is off (see
-            # precision_scope — without it the request silently truncates).
-            # Grid-axis mesh (BackendConfig.mesh_axes containing "grid"):
-            # the EGM household solves run DISTRIBUTED with the knots
-            # ring-redistributed across the mesh (solvers/egm_sharded.py).
-            mesh = None
-            if "grid" in backend.mesh_axes:
-                from aiyagari_tpu.parallel.mesh import make_mesh
-
-                mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
-            with precision_scope(backend.dtype):
-                if solver.ladder is not None:
-                    # Loud guard, BEFORE any solve: a backend configuration
-                    # that cannot represent the polish dtype must reject the
-                    # ladder instead of silently polishing in f32
-                    # (ops/precision.require_x64; precision_scope has
-                    # already enabled x64 where that is possible).
-                    from aiyagari_tpu.ops.precision import require_x64
-
-                    require_x64(solver.ladder)
-                m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+        led = _as_ledger(ledger, model, solver, equilibrium, entry="solve")
+        with _observe(led, "aiyagari_ge", method=method,
+                      backend=backend.backend, aggregation=aggregation):
+            if backend.backend == "numpy":
+                if backend.dtype == "mixed" or solver.ladder is not None:
+                    raise ValueError(
+                        "the mixed-precision solve ladder (dtype='mixed' / "
+                        "SolverConfig.ladder) requires backend='jax'; the numpy "
+                        "reference backend is single-dtype by design")
+                if solver.pushforward not in ("auto", "scatter"):
+                    raise ValueError(
+                        "SolverConfig.pushforward scatter-free backends require "
+                        "backend='jax'; the numpy reference backend has only "
+                        "the scatter formulation")
+                if aggregation != "simulation":
+                    raise ValueError("aggregation='distribution' requires backend='jax'")
                 if equilibrium.batch >= 2:
-                    # Opt-in batched GE (equilibrium/batched.py): B candidate
-                    # rates per device round through one vmapped excess-demand
-                    # kernel, same fixed point as the serial bisection below
-                    # in ~log2(B+1)-fold fewer rounds. Incompatible with the
-                    # grid-axis mesh routes (the batch axis IS the
-                    # parallelism); both closures are supported.
-                    if mesh is not None:
-                        raise ValueError(
-                            "EquilibriumConfig.batch >= 2 cannot be combined "
-                            "with a grid-axis device mesh; drop 'grid' from "
-                            "BackendConfig.mesh_axes or use the serial path")
-                    from aiyagari_tpu.equilibrium.batched import (
-                        solve_equilibrium_batched,
-                    )
+                    raise ValueError(
+                        "EquilibriumConfig.batch >= 2 (batched GE) requires "
+                        "backend='jax'")
+                from aiyagari_tpu.solvers.numpy_backend import solve_equilibrium_numpy
 
-                    result = solve_equilibrium_batched(
-                        m, solver=solver, eq=equilibrium, sim=sim,
-                        aggregation=aggregation)
-                elif aggregation == "distribution":
-                    result = solve_equilibrium_distribution(
-                        m, solver=solver, eq=equilibrium, mesh=mesh)
-                else:
-                    result = solve_equilibrium(
-                        m, solver=solver, sim=sim, eq=equilibrium, mesh=mesh)
-        gap = (
-            abs(result.k_supply[-1] - result.k_demand[-1])
-            if result.k_supply else float("inf")
-        )
+                result = solve_equilibrium_numpy(model, solver=solver, sim=sim, eq=equilibrium)
+            else:
+                from aiyagari_tpu.config import precision_scope
+                from aiyagari_tpu.equilibrium.bisection import (
+                    solve_equilibrium,
+                    solve_equilibrium_distribution,
+                )
+                from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+                # Honor dtype="float64" even when global x64 is off (see
+                # precision_scope — without it the request silently truncates).
+                # Grid-axis mesh (BackendConfig.mesh_axes containing "grid"):
+                # the EGM household solves run DISTRIBUTED with the knots
+                # ring-redistributed across the mesh (solvers/egm_sharded.py).
+                mesh = None
+                if "grid" in backend.mesh_axes:
+                    from aiyagari_tpu.parallel.mesh import make_mesh
+
+                    mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+                with precision_scope(backend.dtype):
+                    if solver.ladder is not None:
+                        # Loud guard, BEFORE any solve: a backend configuration
+                        # that cannot represent the polish dtype must reject the
+                        # ladder instead of silently polishing in f32
+                        # (ops/precision.require_x64; precision_scope has
+                        # already enabled x64 where that is possible).
+                        from aiyagari_tpu.ops.precision import require_x64
+
+                        require_x64(solver.ladder)
+                    m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+                    if equilibrium.batch >= 2:
+                        # Opt-in batched GE (equilibrium/batched.py): B candidate
+                        # rates per device round through one vmapped excess-demand
+                        # kernel, same fixed point as the serial bisection below
+                        # in ~log2(B+1)-fold fewer rounds. Incompatible with the
+                        # grid-axis mesh routes (the batch axis IS the
+                        # parallelism); both closures are supported.
+                        if mesh is not None:
+                            raise ValueError(
+                                "EquilibriumConfig.batch >= 2 cannot be combined "
+                                "with a grid-axis device mesh; drop 'grid' from "
+                                "BackendConfig.mesh_axes or use the serial path")
+                        from aiyagari_tpu.equilibrium.batched import (
+                            solve_equilibrium_batched,
+                        )
+
+                        result = solve_equilibrium_batched(
+                            m, solver=solver, eq=equilibrium, sim=sim,
+                            aggregation=aggregation)
+                    elif aggregation == "distribution":
+                        result = solve_equilibrium_distribution(
+                            m, solver=solver, eq=equilibrium, mesh=mesh)
+                    else:
+                        result = solve_equilibrium(
+                            m, solver=solver, sim=sim, eq=equilibrium, mesh=mesh)
+        # The solver's own stopping quantity: the batched rounds stop on the
+        # round's BEST candidate gap (per_iteration "best_gap"), the serial
+        # bisection on its single candidate ("gap"); the last-candidate
+        # fallback covers the numpy backend's record-free result.
+        per_it = getattr(result, "per_iteration", None)
+        if per_it:
+            last = per_it[-1]
+            gap = abs(last.get("best_gap", last.get("gap", float("inf"))))
+        else:
+            gap = (
+                abs(result.k_supply[-1] - result.k_demand[-1])
+                if result.k_supply else float("inf")
+            )
+        iters = getattr(result, "iterations", len(result.r_history))
+        _ledger_result(led, "Aiyagari GE bisection", result,
+                       converged=result.converged, iterations=iters,
+                       distance=gap, tol=equilibrium.tol)
         enforce_convergence(
             result.converged, on_nonconvergence, "Aiyagari GE bisection",
             # the numpy-backend result has no iterations field; its bisection
             # history is one entry per outer iteration
-            iterations=getattr(result, "iterations", len(result.r_history)),
+            iterations=iters,
             distance=gap, tol=equilibrium.tol, detail={"r": result.r},
+            telemetry=getattr(result, "telemetry", None),
         )
         return result
 
@@ -245,6 +343,7 @@ def solve(
 
             resolve_backend(solver.pushforward)
         alm = alm or ALMConfig()
+        led = _as_ledger(ledger, model, solver, alm, entry="solve")
         from aiyagari_tpu.equilibrium.alm import solve_krusell_smith
 
         # solver=None lets the KS loop apply its own reference defaults
@@ -252,14 +351,21 @@ def solve(
         # aggregation="distribution" advances the cross-section as a Young
         # histogram along the aggregate path (sim/ks_distribution.py) instead
         # of the reference's Monte-Carlo agent panel.
-        result = solve_krusell_smith(
-            model, method=method, solver=solver, alm=alm, backend=backend,
-            closure=("histogram" if aggregation == "distribution" else "panel"),
-        )
+        with _observe(led, "krusell_smith", method=method,
+                      aggregation=aggregation):
+            result = solve_krusell_smith(
+                model, method=method, solver=solver, alm=alm, backend=backend,
+                closure=("histogram" if aggregation == "distribution" else "panel"),
+            )
+        _ledger_result(led, "Krusell-Smith ALM fixed point", result,
+                       converged=result.converged,
+                       iterations=result.iterations,
+                       distance=result.diff_B, tol=alm.tol)
         enforce_convergence(
             result.converged, on_nonconvergence, "Krusell-Smith ALM fixed point",
             iterations=result.iterations, distance=result.diff_B, tol=alm.tol,
             detail={"B": [round(float(b), 6) for b in result.B]},
+            telemetry=getattr(result, "telemetry", None),
         )
         return result
 
@@ -303,6 +409,7 @@ def sweep(
     equilibrium: Optional[EquilibriumConfig] = None,
     aggregation: str = "distribution",
     configs: Optional[Sequence[AiyagariConfig]] = None,
+    ledger=None,
     **param_grids,
 ):
     """Solve MANY Aiyagari economies to general equilibrium as one batched
@@ -383,18 +490,28 @@ def sweep(
         from aiyagari_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
-    with precision_scope(backend.dtype):
-        if solver.ladder is not None:
-            from aiyagari_tpu.ops.precision import require_x64
+    led = _as_ledger(ledger, base, solver, equilibrium, entry="sweep")
+    with _observe(led, "aiyagari_sweep", scenarios=len(configs),
+                  method=method, aggregation=aggregation):
+        with precision_scope(backend.dtype):
+            if solver.ladder is not None:
+                from aiyagari_tpu.ops.precision import require_x64
 
-            require_x64(solver.ladder)
-        models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
-                  for c in configs]
-        batch = stack_scenarios(models, mesh=mesh)
-        result = solve_equilibrium_sweep(
-            batch, solver=solver, eq=equilibrium, sim=sim,
-            aggregation=aggregation)
+                require_x64(solver.ladder)
+            models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
+                      for c in configs]
+            batch = stack_scenarios(models, mesh=mesh)
+            result = solve_equilibrium_sweep(
+                batch, solver=solver, eq=equilibrium, sim=sim,
+                aggregation=aggregation)
     result.params = params
+    import numpy as _np
+
+    _ledger_result(led, "Aiyagari GE sweep", result,
+                   converged=bool(_np.all(result.converged)),
+                   iterations=result.rounds,
+                   distance=float(_np.max(_np.abs(result.gap))),
+                   tol=equilibrium.tol)
     return result
 
 
@@ -433,6 +550,7 @@ def solve_transition(
     solver: Optional[SolverConfig] = None,
     equilibrium: Optional[EquilibriumConfig] = None,
     on_nonconvergence: str = "warn",
+    ledger=None,
     **kwargs,
 ):
     """Solve a perfect-foresight MIT-shock transition path to general
@@ -457,17 +575,27 @@ def solve_transition(
     from aiyagari_tpu.diagnostics.errors import enforce_convergence
     from aiyagari_tpu.transition.mit import solve_transition as _solve
 
-    with precision_scope(backend.dtype):
-        result = _solve(model, shock, trans=transition, solver=solver,
-                        eq=equilibrium, dtype=_dtype_of(backend),
-                        ladder=_transition_ladder(backend, solver), **kwargs)
+    led = _as_ledger(ledger, model, shock, transition, solver,
+                     entry="solve_transition")
+    with _observe(led, "mit_transition", method=transition.method,
+                  T=transition.T):
+        with precision_scope(backend.dtype):
+            result = _solve(model, shock, trans=transition, solver=solver,
+                            eq=equilibrium, dtype=_dtype_of(backend),
+                            ladder=_transition_ladder(backend, solver),
+                            **kwargs)
+    distance = (result.max_excess_history[-1]
+                if result.max_excess_history else float("inf"))
+    _ledger_result(led, "MIT-shock transition path", result,
+                   converged=result.converged, iterations=result.rounds,
+                   distance=distance, tol=transition.tol)
     enforce_convergence(
         result.converged, on_nonconvergence, "MIT-shock transition path",
         iterations=result.rounds,
-        distance=(result.max_excess_history[-1]
-                  if result.max_excess_history else float("inf")),
+        distance=distance,
         tol=transition.tol,
         detail={"method": result.method, "T": result.T},
+        telemetry=getattr(result, "telemetry", None),
     )
     return result
 
@@ -483,6 +611,7 @@ def sweep_transitions(
     params: Optional[Sequence[str]] = None,
     sizes: Optional[Sequence[float]] = None,
     rhos: Optional[Sequence[float]] = None,
+    ledger=None,
     **kwargs,
 ):
     """Solve MANY MIT-shock scenarios of one economy in lockstep, every
@@ -525,7 +654,21 @@ def sweep_transitions(
     from aiyagari_tpu.config import precision_scope
     from aiyagari_tpu.transition.mit import solve_transitions_sweep as _sweep
 
-    with precision_scope(backend.dtype):
-        return _sweep(model, shocks, trans=transition, solver=solver,
-                      eq=equilibrium, mesh=mesh, dtype=_dtype_of(backend),
-                      ladder=_transition_ladder(backend, solver), **kwargs)
+    led = _as_ledger(ledger, model, transition, solver,
+                     entry="sweep_transitions")
+    with _observe(led, "mit_transition_sweep", scenarios=len(shocks),
+                  method=transition.method, T=transition.T):
+        with precision_scope(backend.dtype):
+            result = _sweep(model, shocks, trans=transition, solver=solver,
+                            eq=equilibrium, mesh=mesh,
+                            dtype=_dtype_of(backend),
+                            ladder=_transition_ladder(backend, solver),
+                            **kwargs)
+    import numpy as _np
+
+    _ledger_result(led, "MIT-shock transition sweep", result,
+                   converged=bool(_np.all(result.converged)),
+                   iterations=result.rounds,
+                   distance=float(_np.max(result.max_excess)),
+                   tol=transition.tol)
+    return result

@@ -65,6 +65,17 @@ class KSResult:
     mu: Optional[np.ndarray] = None   # [2, nk] final (employment, capital)
                                       # histogram under closure="histogram"
     k_grid: Optional[np.ndarray] = None   # [nk] capital grid mu lives on
+    # Outer flight record (diagnostics/telemetry.py host_telemetry): the
+    # per-iteration diff_B trajectory of the ALM fixed point — same
+    # SolveTelemetry shape as the device recorders, one report path.
+    telemetry: object = None
+
+    def health(self, model=None) -> dict:
+        """Health certificate (diagnostics/health.py): ALM residual-
+        trajectory shape, convergence verdict, histogram mass defect."""
+        from aiyagari_tpu.diagnostics.health import health_report
+
+        return health_report(self, model=model)
 
 
 def _default_ks_solver_config(method: str) -> SolverConfig:
@@ -544,6 +555,8 @@ def _solve_krusell_smith_impl(
 
     if mgr is not None:
         mgr.delete()   # run finished; a later call should start fresh
+    from aiyagari_tpu.diagnostics.telemetry import host_telemetry
+
     K_ts_np = np.asarray(K_ts)
     return KSResult(
         B=B,
@@ -559,4 +572,5 @@ def _solve_krusell_smith_impl(
         per_iteration=records,
         mu=(np.asarray(cross) if use_histogram else None),
         k_grid=np.asarray(model.k_grid),
+        telemetry=host_telemetry([r["diff_B"] for r in records]),
     )

@@ -82,7 +82,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         solver.tol, solver.max_iter, solver.howard_steps, solver.relative_tol,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
-        solver.accel, solver.ladder, solver.pushforward,
+        solver.accel, solver.ladder, solver.pushforward, solver.telemetry,
     )
 
 
@@ -107,7 +107,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
      dist_tol, dist_max_iter, periods, n_agents, discard, accel,
-     ladder, pushforward) = knobs
+     ladder, pushforward, telemetry) = knobs
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -128,12 +128,13 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                     warm, a_grid, labor_grid, s, P, r, w, sigma=sigma,
                     beta=beta, psi=psi, eta=eta, tol=tol, max_iter=max_iter,
                     howard_steps=howard_steps, relative_tol=relative_tol,
-                    ladder=ladder)
+                    ladder=ladder, telemetry=telemetry)
             else:
                 sol = solve_aiyagari_vfi(
                     warm, a_grid, s, P, r, w, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, howard_steps=howard_steps,
-                    relative_tol=relative_tol, ladder=ladder)
+                    relative_tol=relative_tol, ladder=ladder,
+                    telemetry=telemetry)
             warm_out = sol.v
         else:
             from aiyagari_tpu.solvers.egm import (
@@ -150,12 +151,13 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     psi=psi, eta=eta, tol=tol, max_iter=max_iter,
                     relative_tol=relative_tol, grid_power=0.0, accel=accel,
-                    ladder=ladder)
+                    ladder=ladder, telemetry=telemetry)
             else:
                 sol = solve_aiyagari_egm(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, relative_tol=relative_tol,
-                    grid_power=0.0, accel=accel, ladder=ladder)
+                    grid_power=0.0, accel=accel, ladder=ladder,
+                    telemetry=telemetry)
             warm_out = sol.policy_c
 
         out = {"warm": warm_out, "sol": sol,
@@ -164,9 +166,11 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
         if aggregation == "distribution":
             dist_sol = stationary_distribution(
                 sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
-                accel=accel, ladder=ladder, pushforward=pushforward)
+                accel=accel, ladder=ladder, pushforward=pushforward,
+                telemetry=telemetry)
             supply = aggregate_capital(dist_sol.mu, a_grid)
             out["mu"] = dist_sol.mu
+            out["dist_telemetry"] = dist_sol.telemetry
         else:
             from aiyagari_tpu.sim.ergodic import simulate_panel
 
@@ -384,6 +388,8 @@ def solve_equilibrium_batched(
     series_best = take(out["series"]) if "series" in out else None
     mu_best = out["mu"][best] if "mu" in out else None
     r_star = float(r_cand[best])
+    from aiyagari_tpu.diagnostics.telemetry import host_telemetry
+
     return EquilibriumResult(
         r=r_star,
         w=float(wage_from_r(r_star, tech.alpha, tech.delta)),
@@ -398,6 +404,11 @@ def solve_equilibrium_batched(
         solve_seconds=time.perf_counter() - t0,
         per_iteration=records,
         mu=mu_best,
+        # Outer flight record: the best candidate's |gap| per ROUND — the
+        # batched solver's own convergence trajectory.
+        telemetry=host_telemetry([abs(r["best_gap"]) for r in records]),
+        dist_telemetry=(take(out["dist_telemetry"])
+                        if out.get("dist_telemetry") is not None else None),
     )
 
 
@@ -502,6 +513,13 @@ class SweepResult:
     mu: object = None           # [S, N, na] stationary distributions, if
                                 # the distribution closure produced them
     params: Optional[list] = None   # per-scenario parameter dicts (sweep())
+    # Outer flight record (host): per-round max |gap| across the still-
+    # running scenarios — the lockstep sweep's convergence trajectory.
+    telemetry: object = None
+    # [S]-leading batched device recorders from the FINAL round's
+    # distribution solves, when SolverConfig.telemetry was set (index one
+    # scenario down before reading, telemetry_trajectory's contract).
+    dist_telemetry: object = None
 
 
 def solve_equilibrium_sweep(
@@ -542,6 +560,7 @@ def solve_equilibrium_sweep(
         batch.a_grid.shape[-1:]), batch.dtype)
     out = None
     rounds = 0
+    gap_hist: list = []
     for rnd in range(eq.max_iter):
         r_mid = np.where(conv, r_mid, 0.5 * (lo + hi))
         r_dev = jnp.asarray(r_mid, batch.dtype)
@@ -553,6 +572,8 @@ def solve_equilibrium_sweep(
         gaps, supplies = (np.asarray(x, np.float64) for x in
                           jax.device_get((out["gap"], out["supply"])))
         rounds = rnd + 1
+        finite = np.where(np.isfinite(gaps), np.abs(gaps), np.inf)
+        gap_hist.append(float(np.max(np.where(conv, 0.0, finite))))
         newly = np.isfinite(gaps) & (np.abs(gaps) < eq.tol)
         conv = conv | newly
         if conv.all():
@@ -562,6 +583,8 @@ def solve_equilibrium_sweep(
         hi = np.where(step & (gaps >= 0.0), r_mid, hi)
 
     wall = time.perf_counter() - t0
+    from aiyagari_tpu.diagnostics.telemetry import host_telemetry
+
     return SweepResult(
         r=r_mid.copy(),
         w=np.asarray(wage_from_r(r_mid, tech_alpha, tech_delta)),
@@ -574,4 +597,6 @@ def solve_equilibrium_sweep(
         scenarios_per_sec=S / wall if wall > 0 else float("inf"),
         solutions=out["sol"],
         mu=out.get("mu"),
+        telemetry=host_telemetry(gap_hist),
+        dist_telemetry=out.get("dist_telemetry"),
     )

@@ -58,6 +58,22 @@ class EquilibriumResult:
     per_iteration: list              # IterationRecord dicts (diagnostics)
     mu: object = None                # [N, na] stationary distribution, when the
                                      # non-stochastic closure produced one
+    # Outer-loop flight record (diagnostics/telemetry.py host_telemetry):
+    # the per-iteration |K_supply - K_demand| gap trajectory — the residual
+    # certificate of the GE fixed point itself. Always populated (host
+    # assembly is free; the device recorders stay opt-in).
+    telemetry: object = None
+    # The FINAL distribution solve's device flight record, when the
+    # non-stochastic closure ran with SolverConfig.telemetry set.
+    dist_telemetry: object = None
+
+    def health(self, model=None) -> dict:
+        """The health certificate for this solve (diagnostics/health.py):
+        outer/inner residual-trajectory shape, mass defect, monotonicity,
+        Euler-error percentiles (pass the AiyagariModel to unlock them)."""
+        from aiyagari_tpu.diagnostics.health import health_report
+
+        return health_report(self, model=model)
 
 
 def _initial_consumption_guess(model: AiyagariModel, r: float, w: float):
@@ -92,7 +108,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 sigma=prefs.sigma, beta=prefs.beta, psi=prefs.psi, eta=prefs.eta,
                 tol=solver.tol, max_iter=solver.max_iter, howard_steps=solver.howard_steps,
                 relative_tol=solver.relative_tol, progress_every=solver.progress_every,
-                ladder=solver.ladder,
+                ladder=solver.ladder, telemetry=solver.telemetry,
             )
         return solve_aiyagari_vfi(
             v0, model.a_grid, model.s, model.P, r, w,
@@ -100,7 +116,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             max_iter=solver.max_iter, howard_steps=solver.howard_steps,
             block_size=block_size, relative_tol=solver.relative_tol,
             use_pallas=solver.use_pallas, progress_every=solver.progress_every,
-            ladder=solver.ladder,
+            ladder=solver.ladder, telemetry=solver.telemetry,
         )
     if solver.method == "egm":
         from aiyagari_tpu.parallel.ring import ring_slab_fits
@@ -159,6 +175,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
                     accel=solver.accel, ladder=solver.ladder,
+                    telemetry=solver.telemetry,
                 )
             else:
                 sol = solve_aiyagari_egm_sharded(
@@ -168,6 +185,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     relative_tol=solver.relative_tol,
                     grid_power=model.config.grid.power,
                     accel=solver.accel, ladder=solver.ladder,
+                    telemetry=solver.telemetry,
                 )
             if not bool(sol.escaped):
                 return sol
@@ -202,6 +220,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     relative_tol=solver.relative_tol,
                     progress_every=solver.progress_every,
                     accel=solver.accel, ladder=solver.ladder,
+                    telemetry=solver.telemetry,
                 )
             from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
@@ -212,6 +231,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
                 accel=solver.accel, ladder=solver.ladder,
+                telemetry=solver.telemetry,
             )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
@@ -224,6 +244,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 progress_every=solver.progress_every,
                 grid_power=model.config.grid.power,
                 accel=solver.accel, ladder=solver.ladder,
+                telemetry=solver.telemetry,
             )
         return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
@@ -235,6 +256,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             # on the generic route if the windows escape).
             grid_power=model.config.grid.power,
             accel=solver.accel, ladder=solver.ladder,
+            telemetry=solver.telemetry,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 
@@ -292,15 +314,17 @@ class _DistributionAggregator:
 
     def __init__(self, model: AiyagariModel, dist_tol: float,
                  dist_max_iter: int, accel=None, ladder=None,
-                 pushforward: str = "auto"):
+                 pushforward: str = "auto", telemetry=None):
         self.model = model
         self.dist_tol = dist_tol
         self.dist_max_iter = dist_max_iter
         self.accel = accel
         self.ladder = ladder
         self.pushforward = pushforward
+        self.telemetry = telemetry
         self.series = None
         self.mu = None
+        self.dist_telemetry = None   # the LAST solve's flight record
 
     def restore(self, start_it: int, scalars: dict, arrays: dict) -> None:
         # The distribution may have been saved per shard (mesh routes, where
@@ -339,9 +363,10 @@ class _DistributionAggregator:
             policy_k, self.model.a_grid, self.model.P,
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
             accel=self.accel, ladder=self.ladder,
-            pushforward=self.pushforward,
+            pushforward=self.pushforward, telemetry=self.telemetry,
         )
         self.mu = dist_sol.mu
+        self.dist_telemetry = dist_sol.telemetry
         supply = float(aggregate_capital(self.mu, self.model.a_grid))
         return supply, {"distribution_iterations": int(dist_sol.iterations)}
 
@@ -466,6 +491,11 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
     if mgr is not None:
         mgr.delete()   # run finished; a later call should start fresh
     w = float(wage_from_r(r_mid, tech.alpha, tech.delta))
+    # Outer flight record: the per-iteration market-clearing gap trajectory
+    # in the same SolveTelemetry shape the device recorders return, so one
+    # report path (diagnostics/health.py) serves both loops.
+    from aiyagari_tpu.diagnostics.telemetry import host_telemetry
+
     return EquilibriumResult(
         r=r_mid,
         w=w,
@@ -480,6 +510,9 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         solve_seconds=time.perf_counter() - t0,
         per_iteration=records,
         mu=aggregator.mu,
+        telemetry=host_telemetry(
+            [abs(s - d) for s, d in zip(ks_hist, kd_hist)]),
+        dist_telemetry=getattr(aggregator, "dist_telemetry", None),
     )
 
 
@@ -532,7 +565,8 @@ def solve_equilibrium_distribution(
         model,
         _DistributionAggregator(model, dist_tol, dist_max_iter,
                                 accel=solver.accel, ladder=solver.ladder,
-                                pushforward=solver.pushforward),
+                                pushforward=solver.pushforward,
+                                telemetry=solver.telemetry),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,

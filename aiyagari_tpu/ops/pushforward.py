@@ -55,6 +55,7 @@ pairing; tests/test_pushforward.py pins it per backend.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -85,13 +86,38 @@ BACKENDS = ("auto", "scatter", "transpose", "banded", "pallas")
 DEFAULT_BAND_BLOCK = 128
 DEFAULT_BAND_WIDTH = 256
 
-# Emit a jax.debug.print from the traced program when a scatter-free route
-# falls back (non-monotone policy / band overflow). Module-level so tests
-# that build adversarial lotteries on purpose can silence it. Read at TRACE
-# time: the flag's value is baked into each compiled program, so set it
-# BEFORE the first trace of the plan you care about — flipping it later
+# Report scatter-free route fallbacks (non-monotone policy / band
+# overflow) from the traced program as COUNTED degradation events: an async
+# jax.debug.callback increments the process metrics counter
+# `aiyagari_pushforward_fallback_total{route=...}` (diagnostics/metrics.py)
+# and appends a "degradation" event to the active run ledger
+# (diagnostics/ledger.py), so a production solve's degradations are
+# scrape-able and diagnosable without rerunning. Module-level so tests that
+# build adversarial lotteries on purpose can silence the reporting. Read at
+# TRACE time: the flag's value is baked into each compiled program, so set
+# it BEFORE the first trace of the plan you care about — flipping it later
 # affects newly traced programs only, not jit-cache hits.
 WARN_ON_FALLBACK = True
+
+# The old always-on jax.debug.print warning is now OPT-IN (the
+# AIYAGARI_DEBUG_LOTTERY pattern): counted events are the production
+# signal; the print is a debugging aid that would otherwise spam every
+# sweep-level trace of the KS/transition scan paths.
+_FALLBACK_DEBUG = bool(os.environ.get("AIYAGARI_DEBUG_PUSHFORWARD", ""))
+
+
+def _record_fallback(route: str) -> None:
+    """Host side of the degradation event (runs on the runtime's async
+    callback thread — must never raise into the solve)."""
+    try:
+        from aiyagari_tpu.diagnostics import ledger, metrics
+
+        metrics.counter("aiyagari_pushforward_fallback_total",
+                        route=route).inc()
+        ledger.emit("degradation", event="pushforward_fallback", route=route,
+                    n=1)
+    except Exception:  # pragma: no cover - diagnostics must not kill solves
+        pass
 
 
 def resolve_backend(backend: Optional[str]) -> str:
@@ -246,13 +272,20 @@ class PushforwardPlan:
 def _warn_fallback(pred, route: str):
     if not WARN_ON_FALLBACK:
         return
-    jax.lax.cond(
-        pred,
-        lambda: jax.debug.print(
-            "pushforward: {} route invalid for this policy "
-            "(non-monotone lottery or band overflow) — falling back to the "
-            "reference formulation for correctness", route),
-        lambda: None)
+
+    def fire():
+        # ordered=False: the count is a fire-and-forget side effect — the
+        # device program never blocks on the host increment. The route name
+        # is closed over (debug.callback operands must be array-likes).
+        jax.debug.callback(lambda route=route: _record_fallback(route),
+                           ordered=False)
+        if _FALLBACK_DEBUG:
+            jax.debug.print(
+                "pushforward: {} route invalid for this policy "
+                "(non-monotone lottery or band overflow) — falling back to "
+                "the reference formulation for correctness", route)
+
+    jax.lax.cond(pred, fire, lambda: None)
 
 
 def plan_pushforward(idx, w_lo, *, backend: str = "auto",

@@ -27,6 +27,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.telemetry import (
+    telemetry_add_fallbacks,
+    telemetry_init,
+    telemetry_record,
+    telemetry_set_trips,
+)
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_simplex
 from aiyagari_tpu.ops.interp import bucket_index
 from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
@@ -62,6 +68,10 @@ class DistributionSolution:
         default_factory=lambda: jnp.array(0, jnp.int32))
     switch_distance: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.array(0.0))
+    # Device-resident flight record (diagnostics/telemetry.py): per-sweep
+    # residuals + stage dtypes + accel trips + push-forward fallback sweeps
+    # when `telemetry` is set; None when the recorder was compiled out.
+    telemetry: object = None
 
 
 # Loud diagnosis of degenerate lottery brackets (duplicate adjacent grid
@@ -158,12 +168,13 @@ def expectation_step(f, idx, w_lo, P):
 
 
 @partial(jax.jit, static_argnames=("noise_floor_ulp", "accel", "ladder",
-                                   "pushforward"))
+                                   "pushforward", "telemetry"))
 def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                             max_iter=10_000, mu_init=None,
                             noise_floor_ulp: float = 0.0,
                             accel=None, ladder=None,
-                            pushforward: str = "auto") -> DistributionSolution:
+                            pushforward: str = "auto",
+                            telemetry=None) -> DistributionSolution:
     """Iterate distribution_step to a sup-norm fixed point on device.
 
     The whole loop is one lax.while_loop program; the host sees only the
@@ -206,6 +217,14 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     block-band operator — is built ONCE per ladder stage and reused by
     every sweep of that stage's while_loop, which is where the scatter-free
     routes earn their keep: thousands of applications of one lottery.
+
+    telemetry (a TelemetryConfig, static) carries a device-resident flight
+    recorder through the loop (diagnostics/telemetry.py): per-sweep
+    residuals and stage dtypes in a fixed-length ring, accel safeguard
+    trips, and — when the plan's scatter-free route is invalid for this
+    policy — one push-forward fallback count per degraded sweep, all
+    returned as DistributionSolution.telemetry. None compiles the recorder
+    out entirely.
     """
     N, na = policy_k.shape
     if mu_init is None:
@@ -216,7 +235,7 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     max_it = jnp.asarray(max_iter, jnp.int32)
     stages = plan_stages(ladder, mu0.dtype, noise_floor_ulp)
 
-    def run_stage(spec, mu_in, it0):
+    def run_stage(spec, mu_in, it0, tele_in):
         dt = jnp.dtype(spec.dtype)
         # "highest" for final/no-ladder stages (the historical pinned
         # precision); a hot stage's configured relaxation otherwise.
@@ -231,13 +250,21 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
         plan = plan_pushforward(idx, w_lo_d, backend=pushforward)
         tol_c = jnp.asarray(tol, dt)
         ast0 = accel_init(mu, accel) if accel is not None else None
+        trip0 = (tele_in.accel_trips
+                 if (tele_in is not None and accel is not None) else None)
+        # Degraded-sweep tally: the plan is hoisted, so an invalid
+        # scatter-free route (plan.ok False) degrades EVERY sweep of this
+        # stage — one fallback event per sweep keeps the count honest.
+        fb_per_sweep = (jnp.where(plan.ok, 0, 1).astype(jnp.int32)
+                        if (tele_in is not None and plan.ok is not None)
+                        else None)
 
         def cond(carry):
-            _, _, dist, it, tol_eff, _ = carry
+            _, _, dist, it, tol_eff, _, _ = carry
             return (dist >= tol_eff) & (it < max_it)
 
         def body(carry):
-            mu, _, _, it, _, ast = carry
+            mu, _, _, it, _, ast, tele = carry
             mu_new = apply_pushforward(plan, mu, P_d, precision=prec)
             mu_new = mu_new / jnp.sum(mu_new)
             dist = jnp.max(jnp.abs(mu_new - mu))
@@ -245,29 +272,36 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                 tol_c, jnp.max(jnp.abs(mu_new)),
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=False, dtype=dt)
+            tele = telemetry_record(tele, dist)
+            if fb_per_sweep is not None:
+                tele = telemetry_add_fallbacks(tele, fb_per_sweep)
             if accel is None:
                 mu_next = mu_new
             else:
                 mu_next, ast = accel_step(ast, mu, mu_new, accel=accel,
                                           project=project_simplex)
-            return mu_next, mu_new, dist, it + 1, tol_eff, ast
+                if trip0 is not None:
+                    tele = telemetry_set_trips(tele, trip0 + ast.trips)
+            return mu_next, mu_new, dist, it + 1, tol_eff, ast, tele
 
-        _, mu, dist, it, _, _ = jax.lax.while_loop(
+        _, mu, dist, it, _, _, tele = jax.lax.while_loop(
             cond, body,
-            (mu, mu, jnp.array(jnp.inf, dt), it0, tol_c, ast0)
+            (mu, mu, jnp.array(jnp.inf, dt), it0, tol_c, ast0, tele_in)
         )
-        return mu, dist, it
+        return mu, dist, it, tele
 
     mu, it = mu0, jnp.int32(0)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    tele = telemetry_init(telemetry)
     dist = None
     for spec in stages:
-        mu, dist, it = run_stage(spec, mu, it)
+        mu, dist, it, tele = run_stage(spec, mu, it, tele)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
-    return DistributionSolution(mu, it, dist, hot_it, switch_dist)
+    return DistributionSolution(mu, it, dist, hot_it, switch_dist,
+                                telemetry=tele)
 
 
 def aggregate_capital(mu, a_grid):

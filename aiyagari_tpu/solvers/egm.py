@@ -12,6 +12,11 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.diagnostics.telemetry import (
+    telemetry_init,
+    telemetry_record,
+    telemetry_set_trips,
+)
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
 from aiyagari_tpu.ops.interp import prolong_power_grid
@@ -128,15 +133,20 @@ class EGMSolution:
         default_factory=lambda: jnp.array(0, jnp.int32))
     switch_distance: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.array(0.0))
+    # Device-resident flight record (diagnostics/telemetry.py): the ring of
+    # per-sweep residuals + stage dtypes + safeguard-trip counts carried
+    # through the while_loop when SolverConfig.telemetry is set; None (the
+    # default, an empty pytree leaf) when the recorder was compiled out.
+    telemetry: object = None
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder", "telemetry"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
                        use_pallas: bool = False, accel=None,
-                       ladder=None) -> EGMSolution:
+                       ladder=None, telemetry=None) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
@@ -180,12 +190,19 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     counts ALL sweeps; the hot-stage share and the residual at the switch
     are returned as EGMSolution.hot_iterations / .switch_distance. With
     relative_tol the criterion is already scale-free and the hot stage
-    simply runs to tol."""
+    simply runs to tol.
+
+    telemetry (a TelemetryConfig, static) carries a device-resident flight
+    recorder through the loop (diagnostics/telemetry.py): the per-sweep
+    residual and its stage dtype land in a fixed-length ring in the carry,
+    accel safeguard trips are tallied, and the buffers come back as
+    EGMSolution.telemetry. None compiles the recorder out entirely — the
+    traced program is identical to the recorder-free one."""
 
     stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def run_stage(spec, C0, pk0, it0, esc0):
+    def run_stage(spec, C0, pk0, it0, esc0, tele_in):
         dt = jnp.dtype(spec.dtype)
         Cd = C0.astype(dt)
         ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
@@ -193,13 +210,17 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
         sig, bet = jnp.asarray(sigma).astype(dt), jnp.asarray(beta).astype(dt)
         tol_c = jnp.asarray(tol, dt)
         ast0 = accel_init(Cd, accel) if accel is not None else None
+        # Trip base for this stage: the accel history restarts per stage, so
+        # the recorder's running total is stage base + the state's counter.
+        trip0 = (tele_in.accel_trips
+                 if (tele_in is not None and accel is not None) else None)
 
         def cond(carry):
-            _, _, _, dist, it, _, tol_eff, _ = carry
+            _, _, _, dist, it, _, tol_eff, _, _ = carry
             return (dist >= tol_eff) & (it < max_iter)
 
         def body(carry):
-            C, _, _, _, it, esc, _, ast = carry
+            C, _, _, _, it, esc, _, ast, tele = carry
             C_new, policy_k, esc_new = egm_step(
                 C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
                 grid_power=grid_power, with_escape=True,
@@ -212,33 +233,38 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
+            tele = telemetry_record(tele, dist)
             if accel is None:
                 C_next = C_new
             else:
                 C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
-            return C_next, C_new, policy_k, dist, it + 1, esc | esc_new, tol_eff, ast
+                if trip0 is not None:
+                    tele = telemetry_set_trips(tele, trip0 + ast.trips)
+            return (C_next, C_new, policy_k, dist, it + 1, esc | esc_new,
+                    tol_eff, ast, tele)
 
         init = (Cd, Cd, pk0.astype(dt), jnp.array(jnp.inf, dt), it0, esc0,
-                tol_c, ast0)
+                tol_c, ast0, tele_in)
         out = jax.lax.while_loop(cond, body, init)
         # (image C, policy_k, dist, it, esc, tol_eff) — the image, not the
         # accelerated carry, crosses the stage boundary: it is the certified
         # sweep output the stopping rule measured.
-        return out[1], out[2], out[3], out[4], out[5], out[6]
+        return out[1], out[2], out[3], out[4], out[5], out[6], out[8]
 
     C, policy_k = C_init, jnp.zeros_like(C_init)
     it, esc = jnp.int32(0), jnp.array(False)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, stages[-1].dtype)
+    tele = telemetry_init(telemetry)
     dist = tol_eff = None
     for spec in stages:
-        C, policy_k, dist, it, esc, tol_eff = run_stage(spec, C, policy_k,
-                                                        it, esc)
+        C, policy_k, dist, it, esc, tol_eff, tele = run_stage(
+            spec, C, policy_k, it, esc, tele)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
     return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
-                       hot_it, switch_dist)
+                       hot_it, switch_dist, telemetry=tele)
 
 
 def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
@@ -247,7 +273,7 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             grid_power: float = 0.0,
                             noise_floor_ulp: float = 0.0,
                             use_pallas: bool = False, accel=None,
-                            ladder=None) -> EGMSolution:
+                            ladder=None, telemetry=None) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
@@ -265,7 +291,8 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              progress_every=progress_every,
                              grid_power=grid_power,
                              noise_floor_ulp=noise_floor_ulp,
-                             use_pallas=use_pallas, accel=accel, ladder=ladder)
+                             use_pallas=use_pallas, accel=accel, ladder=ladder,
+                             telemetry=telemetry)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
@@ -273,18 +300,19 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                                  progress_every=progress_every,
                                  grid_power=0.0,
                                  noise_floor_ulp=noise_floor_ulp, accel=accel,
-                                 ladder=ladder)
+                                 ladder=ladder, telemetry=telemetry)
     return sol
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder", "telemetry"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                              psi, eta, tol: float, max_iter: int,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              grid_power: float = 0.0,
                              noise_floor_ulp: float = 0.0,
-                             accel=None, ladder=None) -> EGMSolution:
+                             accel=None, ladder=None,
+                             telemetry=None) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107). grid_power > 0 routes the
     consumption re-interpolation through the windowed value-interpolation
@@ -300,7 +328,7 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def run_stage(spec, C0, pk0, pl0, it0, esc0):
+    def run_stage(spec, C0, pk0, pl0, it0, esc0, tele_in):
         dt = jnp.dtype(spec.dtype)
         Cd = C0.astype(dt)
         ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
@@ -314,12 +342,14 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
         )
         tol_c = jnp.asarray(tol, dt)
         ast0 = accel_init(Cd, accel) if accel is not None else None
+        trip0 = (tele_in.accel_trips
+                 if (tele_in is not None and accel is not None) else None)
 
         def cond(carry):
             return (carry[4] >= carry[7]) & (carry[5] < max_iter)
 
         def body(carry):
-            C, _, _, _, _, it, esc, _, ast = carry
+            C, _, _, _, _, it, esc, _, ast, tele = carry
             C_new, policy_k, policy_l, esc_new = egm_step_labor(
                 C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
                 psi=psid, eta=etad, c_constrained=c_con,
@@ -333,32 +363,36 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
+            tele = telemetry_record(tele, dist)
             if accel is None:
                 C_next = C_new
             else:
                 C_next, ast = accel_step(ast, C, C_new, accel=accel, project=proj)
+                if trip0 is not None:
+                    tele = telemetry_set_trips(tele, trip0 + ast.trips)
             return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                    esc | esc_new, tol_eff, ast)
+                    esc | esc_new, tol_eff, ast, tele)
 
         init = (Cd, Cd, pk0.astype(dt), pl0.astype(dt),
-                jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0)
+                jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0, tele_in)
         out = jax.lax.while_loop(cond, body, init)
-        return out[1], out[2], out[3], out[4], out[5], out[6], out[7]
+        return out[1], out[2], out[3], out[4], out[5], out[6], out[7], out[9]
 
     z = jnp.zeros_like(C_init)
     C, policy_k, policy_l = C_init, z, z
     it, esc = jnp.int32(0), jnp.array(False)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, stages[-1].dtype)
+    tele = telemetry_init(telemetry)
     dist = tol_eff = None
     for spec in stages:
-        C, policy_k, policy_l, dist, it, esc, tol_eff = run_stage(
-            spec, C, policy_k, policy_l, it, esc)
+        C, policy_k, policy_l, dist, it, esc, tol_eff, tele = run_stage(
+            spec, C, policy_k, policy_l, it, esc, tele)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
     return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
-                       hot_it, switch_dist)
+                       hot_it, switch_dist, telemetry=tele)
 
 
 def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
@@ -368,7 +402,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                   progress_every: int = 0,
                                   grid_power: float = 0.0,
                                   noise_floor_ulp: float = 0.0,
-                                  accel=None, ladder=None) -> EGMSolution:
+                                  accel=None, ladder=None,
+                                  telemetry=None) -> EGMSolution:
     """Host-level escape retry for the labor family (the exact analogue of
     solve_aiyagari_egm_safe: re-solve on the generic route only when the
     windowed fast path actually escaped)."""
@@ -379,7 +414,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                    progress_every=progress_every,
                                    grid_power=grid_power,
                                    noise_floor_ulp=noise_floor_ulp,
-                                   accel=accel, ladder=ladder)
+                                   accel=accel, ladder=ladder,
+                                   telemetry=telemetry)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
                                        sigma=sigma, beta=beta, psi=psi, eta=eta,
@@ -388,7 +424,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                        progress_every=progress_every,
                                        grid_power=0.0,
                                        noise_floor_ulp=noise_floor_ulp,
-                                       accel=accel, ladder=ladder)
+                                       accel=accel, ladder=ladder,
+                                       telemetry=telemetry)
     return sol
 
 
@@ -436,12 +473,13 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
                                    "tol", "max_iter", "relative_tol",
                                    "progress_every", "grid_power",
                                    "noise_floor_ulp", "use_pallas", "accel",
-                                   "ladder"))
+                                   "ladder", "telemetry"))
 def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                       hi: float, sigma: float, beta: float, tol: float,
                       max_iter: int, relative_tol: bool, progress_every: int,
                       grid_power: float, noise_floor_ulp: float,
-                      use_pallas: bool, accel=None, ladder=None) -> EGMSolution:
+                      use_pallas: bool, accel=None, ladder=None,
+                      telemetry=None) -> EGMSolution:
     """The whole fast-path stage ladder traced as ONE device program:
     stage solve -> prolong -> next stage, unrolled over the static `sizes`
     tuple. Why one program: each separately-jitted stage costs a ~100 ms
@@ -470,6 +508,10 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                else _warm_stage_knobs(ladder, noise_floor_ulp))
         if i > 0:
             C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
+        # The flight recorder rides the FINAL stage only: warm stages are
+        # prolongation inputs, not certified solutions, and keeping them
+        # recorder-free keeps their programs bit-identical to the
+        # telemetry-off ladder.
         sol = solve_aiyagari_egm(C, g, s, P, r, w, amin,
                                  sigma=sigma, beta=beta, tol=tol,
                                  max_iter=max_iter,
@@ -478,7 +520,8 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  grid_power=grid_power,
                                  noise_floor_ulp=st_floor,
                                  use_pallas=use_pallas, accel=accel,
-                                 ladder=st_ladder)
+                                 ladder=st_ladder,
+                                 telemetry=telemetry if final else None)
         esc = esc | sol.escaped
     return dataclasses.replace(sol, escaped=esc)
 
@@ -559,7 +602,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   progress_every: int = 0,
                                   noise_floor_ulp: float = 0.0,
                                   use_pallas: bool = False,
-                                  accel=None, ladder=None) -> EGMSolution:
+                                  accel=None, ladder=None,
+                                  telemetry=None) -> EGMSolution:
     """Grid-sequenced EGM: solve on a coarse grid first, prolong the
     consumption policy to each finer grid, and re-converge there.
 
@@ -606,7 +650,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                             progress_every=progress_every,
                             grid_power=grid_power,
                             noise_floor_ulp=noise_floor_ulp,
-                            use_pallas=use_pallas, accel=accel, ladder=ladder)
+                            use_pallas=use_pallas, accel=accel, ladder=ladder,
+                            telemetry=telemetry)
     sol = _fetch_scalars(sol)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
@@ -618,7 +663,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                 C, g, s, P, r, w, amin, sigma=sigma, beta=beta, tol=tol,
                 max_iter=max_iter, relative_tol=relative_tol,
                 progress_every=progress_every, grid_power=0.0,
-                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder)
+                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder,
+                telemetry=telemetry if final else None)
 
         sol = _host_ladder(
             a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
@@ -635,7 +681,8 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                                         relative_tol: bool = False,
                                         progress_every: int = 0,
                                         noise_floor_ulp: float = 0.0,
-                                        accel=None, ladder=None) -> EGMSolution:
+                                        accel=None, ladder=None,
+                                        telemetry=None) -> EGMSolution:
     """Grid-sequenced EGM for the endogenous-labor family — the same nested
     iteration as solve_aiyagari_egm_multiscale (see its docstring for the
     rationale and escape handling). Only the consumption policy C is
@@ -664,7 +711,8 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                 eta=eta, tol=tol, max_iter=max_iter,
                 relative_tol=relative_tol, progress_every=progress_every,
                 grid_power=grid_power if fast else 0.0,
-                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder)
+                noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder,
+                telemetry=telemetry if final else None)
 
         return _host_ladder(
             a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,

@@ -41,6 +41,13 @@ import jax.numpy as jnp
 from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec as P
 
+from aiyagari_tpu.diagnostics.telemetry import (
+    telemetry_from_leaves,
+    telemetry_init,
+    telemetry_leaves,
+    telemetry_record,
+    telemetry_set_trips,
+)
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.bellman import expectation
 from aiyagari_tpu.ops.egm import constrained_consumption_labor
@@ -73,9 +80,17 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                                capacity: float = DEFAULT_CAPACITY,
                                pad: int = 8,
                                axis: str = "grid",
-                               accel=None, ladder=None) -> EGMSolution:
+                               accel=None, ladder=None,
+                               telemetry=None) -> EGMSolution:
     """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
     knots resident per device (module docstring).
+
+    telemetry (a TelemetryConfig) carries the device-resident flight
+    recorder through the sharded while_loop (diagnostics/telemetry.py).
+    The recorded residual is the pmax'd GLOBAL sup-norm, so every device's
+    recorder holds identical buffers; they cross the shard_map boundary as
+    replicated outputs and come back as EGMSolution.telemetry. None
+    compiles the recorder out (the program is the pre-telemetry one).
 
     accel opts into safeguarded fixed-point acceleration exactly as in the
     single-device solver; the acceleration's least-squares inner products
@@ -134,26 +149,28 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                        float(capacity), int(pad), float(sigma), float(beta),
                        float(tol), int(max_iter), bool(relative_tol),
                        float(noise_floor_ulp), jnp.dtype(dtype).name, accel,
-                       ladder)
-    C, policy_k, dist, it, esc, tol_eff, hot_it, sw_dist = run(
+                       ladder, telemetry)
+    C, policy_k, dist, it, esc, tol_eff, hot_it, sw_dist, *tele_leaves = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
     return _fetch_scalars(
         EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
-                    hot_it, sw_dist))
+                    hot_it, sw_dist,
+                    telemetry=telemetry_from_leaves(tele_leaves)))
 
 
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
                  noise_floor_ulp: float, dtype_name: str, accel=None,
-                 ladder=None):
+                 ladder=None, telemetry=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     span = hi - lo
     proj = project_floor()
     stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
+    n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
@@ -163,7 +180,7 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
             # unsharded routes interpolate onto bitwise-identical queries.
             j = dev * na_loc + jnp.arange(na_loc)
 
-            def run_stage(spec, C_in, pk_in, it0, esc0):
+            def run_stage(spec, C_in, pk_in, it0, esc0, tele_in):
                 dt = jnp.dtype(spec.dtype)
                 prec = matmul_precision_of(spec.matmul_precision)
                 a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
@@ -196,11 +213,11 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     return C_new, policy_k, esc
 
                 def cond(carry):
-                    _, _, _, dist, it, _, tol_eff, _ = carry
+                    _, _, _, dist, it, _, tol_eff, _, _ = carry
                     return (dist >= tol_eff) & (it < max_iter)
 
                 def body(carry):
-                    C, _, _, _, it, esc, _, ast = carry
+                    C, _, _, _, it, esc, _, ast, tele = carry
                     C_new, policy_k, esc_new = sweep(C)
                     diff = jnp.abs(C_new - C)
                     # Same criterion family as solve_aiyagari_egm: relative
@@ -215,6 +232,9 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
                         noise_floor_ulp=spec.noise_floor_ulp,
                         relative_tol=relative_tol, dtype=dt)
+                    # The recorder sees the GLOBAL pmax'd residual, so every
+                    # device's buffers stay bitwise identical (replicated).
+                    tele = telemetry_record(tele, dist)
                     if accel is None:
                         C_next = C_new
                     else:
@@ -222,42 +242,50 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         # psum, safeguard norms pmax (accel_step's axis hook).
                         C_next, ast = accel_step(ast, C, C_new, accel=accel,
                                                  axis=axis, project=proj)
+                        if trip0 is not None:
+                            tele = telemetry_set_trips(tele, trip0 + ast.trips)
                     return (C_next, C_new, policy_k, dist, it + 1,
-                            esc | (esc_new > 0), tol_eff, ast)
+                            esc | (esc_new > 0), tol_eff, ast, tele)
 
                 # Fresh acceleration history per stage: a stale hot-dtype
                 # residual history would poison the polish's normal
                 # equations (ops/accel.py restart semantics).
                 Cd = C_in.astype(dt)
                 ast0 = accel_init(Cd, accel) if accel is not None else None
+                trip0 = (tele_in.accel_trips
+                         if (tele_in is not None and accel is not None)
+                         else None)
                 init = (Cd, Cd, pk_in.astype(dt), jnp.array(jnp.inf, dt),
-                        it0, esc0, tol_c, ast0)
+                        it0, esc0, tol_c, ast0, tele_in)
                 out = jax.lax.while_loop(cond, body, init)
-                return out[1], out[2], out[3], out[4], out[5], out[6]
+                return out[1], out[2], out[3], out[4], out[5], out[6], out[8]
 
             C, pk = C0, jnp.zeros_like(C0)
             it, esc = jnp.int32(0), jnp.array(False)
             hot_it = jnp.int32(0)
             sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+            tele = telemetry_init(telemetry)
             dist = tol_eff = None
             for spec in stages:
-                C, pk, dist, it, esc, tol_eff = run_stage(spec, C, pk, it, esc)
+                C, pk, dist, it, esc, tol_eff, tele = run_stage(
+                    spec, C, pk, it, esc, tele)
                 if not spec.is_final:
                     hot_it = it
                     sw = dist.astype(sw.dtype)
-            return C, pk, dist, it, esc, tol_eff, hot_it, sw
+            return (C, pk, dist, it, esc, tol_eff, hot_it, sw,
+                    *telemetry_leaves(tele))
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P(),
-                       P(), P()),
+                       P(), P()) + (P(),) * n_tele,
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, tol, max_iter,
                                           relative_tol, noise_floor_ulp,
-                                          dtype_name, accel, ladder)
+                                          dtype_name, accel, ladder, telemetry)
     return cached_program(_EGM_PROGRAMS, key, build)
 
 
@@ -273,7 +301,8 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                                      capacity: float = DEFAULT_CAPACITY,
                                      pad: int = 8,
                                      axis: str = "grid",
-                                     accel=None, ladder=None) -> EGMSolution:
+                                     accel=None, ladder=None,
+                                     telemetry=None) -> EGMSolution:
     """solve_aiyagari_egm_labor with the grid axis sharded over mesh[axis]
     and the endogenous (knot, consumption) pairs resident per device — the
     labor-family form of solve_aiyagari_egm_sharded, generalizing the ring
@@ -321,14 +350,16 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                              float(beta), float(psi), float(eta), float(tol),
                              int(max_iter), bool(relative_tol),
                              float(noise_floor_ulp), jnp.dtype(dtype).name,
-                             accel, ladder)
-    C, policy_k, policy_l, dist, it, esc, tol_eff, hot_it, sw_dist = run(
+                             accel, ladder, telemetry)
+    (C, policy_k, policy_l, dist, it, esc, tol_eff, hot_it, sw_dist,
+     *tele_leaves) = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
     return _fetch_scalars(
         EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
-                    hot_it, sw_dist))
+                    hot_it, sw_dist,
+                    telemetry=telemetry_from_leaves(tele_leaves)))
 
 
 def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
@@ -336,19 +367,20 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                        beta: float, psi: float, eta: float, tol: float,
                        max_iter: int, relative_tol: bool,
                        noise_floor_ulp: float, dtype_name: str, accel=None,
-                       ladder=None):
+                       ladder=None, telemetry=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     span = hi - lo
     proj = project_floor()
     stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
+    n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
             dev = jax.lax.axis_index(axis)
             j = dev * na_loc + jnp.arange(na_loc)
 
-            def run_stage(spec, C_in, pk_in, pl_in, it0, esc0):
+            def run_stage(spec, C_in, pk_in, pl_in, it0, esc0, tele_in):
                 dt = jnp.dtype(spec.dtype)
                 prec = matmul_precision_of(spec.matmul_precision)
                 a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
@@ -410,11 +442,11 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     return g_c, policy_k, policy_l, esc
 
                 def cond(carry):
-                    _, _, _, _, dist, it, _, tol_eff, _ = carry
+                    _, _, _, _, dist, it, _, tol_eff, _, _ = carry
                     return (dist >= tol_eff) & (it < max_iter)
 
                 def body(carry):
-                    C, _, _, _, _, it, esc, _, ast = carry
+                    C, _, _, _, _, it, esc, _, ast, tele = carry
                     C_new, policy_k, policy_l, esc_new = sweep(C)
                     diff = jnp.abs(C_new - C)
                     local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
@@ -424,46 +456,56 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         tol_c, jax.lax.pmax(jnp.max(jnp.abs(C_new)), axis),
                         noise_floor_ulp=spec.noise_floor_ulp,
                         relative_tol=relative_tol, dtype=dt)
+                    # Global pmax'd residual: replicated recorder buffers.
+                    tele = telemetry_record(tele, dist)
                     if accel is None:
                         C_next = C_new
                     else:
                         C_next, ast = accel_step(ast, C, C_new, accel=accel,
                                                  axis=axis, project=proj)
+                        if trip0 is not None:
+                            tele = telemetry_set_trips(tele, trip0 + ast.trips)
                     return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                            esc | (esc_new > 0), tol_eff, ast)
+                            esc | (esc_new > 0), tol_eff, ast, tele)
 
                 Cd = C_in.astype(dt)
                 ast0 = accel_init(Cd, accel) if accel is not None else None
+                trip0 = (tele_in.accel_trips
+                         if (tele_in is not None and accel is not None)
+                         else None)
                 init = (Cd, Cd, pk_in.astype(dt), pl_in.astype(dt),
-                        jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0)
+                        jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0,
+                        tele_in)
                 out = jax.lax.while_loop(cond, body, init)
                 return (out[1], out[2], out[3], out[4], out[5], out[6],
-                        out[7])
+                        out[7], out[9])
 
             z = jnp.zeros_like(C0)
             C, pk, pl = C0, z, z
             it, esc = jnp.int32(0), jnp.array(False)
             hot_it = jnp.int32(0)
             sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+            tele = telemetry_init(telemetry)
             dist = tol_eff = None
             for spec in stages:
-                C, pk, pl, dist, it, esc, tol_eff = run_stage(
-                    spec, C, pk, pl, it, esc)
+                C, pk, pl, dist, it, esc, tol_eff, tele = run_stage(
+                    spec, C, pk, pl, it, esc, tele)
                 if not spec.is_final:
                     hot_it = it
                     sw = dist.astype(sw.dtype)
-            return C, pk, pl, dist, it, esc, tol_eff, hot_it, sw
+            return (C, pk, pl, dist, it, esc, tol_eff, hot_it, sw,
+                    *telemetry_leaves(tele))
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(None, axis),
-                       P(), P(), P(), P(), P(), P()),
+                       P(), P(), P(), P(), P(), P()) + (P(),) * n_tele,
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, psi, eta, tol,
                                           max_iter, relative_tol,
                                           noise_floor_ulp, dtype_name, accel,
-                                          ladder)
+                                          ladder, telemetry)
     return cached_program(_EGM_LABOR_PROGRAMS, key, build)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.diagnostics.telemetry import telemetry_init, telemetry_record
 from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
 from aiyagari_tpu.ops.bellman import (
@@ -73,6 +74,10 @@ class VFISolution:
         default_factory=lambda: jnp.array(0, jnp.int32))
     switch_distance: jax.Array = dataclasses.field(
         default_factory=lambda: jnp.array(0.0))
+    # Device-resident flight record (diagnostics/telemetry.py): per-sweep
+    # value residuals + stage dtypes when SolverConfig.telemetry is set;
+    # None (an empty pytree leaf) when the recorder was compiled out.
+    telemetry: object = None
 
 
 def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
@@ -80,12 +85,12 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                              block_size: int = 0, relative_tol: bool = False,
                              use_pallas: bool = False, progress_every: int = 0,
                              noise_floor_ulp: float = 0.0,
-                             ladder=None) -> VFISolution:
+                             ladder=None, telemetry=None) -> VFISolution:
     stages = plan_stages(ladder, v_init.dtype, noise_floor_ulp)
     na = v_init.shape[1]
     dense = block_size <= 0 or block_size >= na
 
-    def run_stage(spec, v0, idx0, it0):
+    def run_stage(spec, v0, idx0, it0, tele_in):
         dt = jnp.dtype(spec.dtype)
         # None = backend default; the ladder's hot stages may relax the
         # expectation contraction (bf16 MXU on TPU), the final/no-ladder
@@ -110,7 +115,7 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
             return v
 
         def cond(carry):
-            _, _, dist, it, tol_eff = carry
+            _, _, dist, it, tol_eff, _ = carry
             return (dist >= tol_eff) & (it < max_iter)
 
         # Dense path: the masked choice-utility tensor is loop-invariant, so
@@ -125,7 +130,7 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
              if dense and not use_pallas else None)
 
         def body(carry):
-            v, idx, _, it, _ = carry
+            v, idx, _, it, _, tele = carry
             if U is not None:
                 v_new, idx = bellman_step_precomputed(v, U, Pd, beta=bet,
                                                       precision=prec)
@@ -141,19 +146,22 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
+            tele = telemetry_record(tele, dist)
             v_new = eval_sweeps(v_new, idx)
-            return v_new, idx, dist, it + 1, tol_eff
+            return v_new, idx, dist, it + 1, tol_eff, tele
 
-        init = (v0.astype(dt), idx0, jnp.array(jnp.inf, dt), it0, tol_c)
+        init = (v0.astype(dt), idx0, jnp.array(jnp.inf, dt), it0, tol_c,
+                tele_in)
         return jax.lax.while_loop(cond, body, init)
 
     v, idx = v_init, jnp.zeros(v_init.shape, jnp.int32)
     it = jnp.int32(0)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    tele = telemetry_init(telemetry)
     dist = tol_eff = None
     for spec in stages:
-        v, idx, dist, it, tol_eff = run_stage(spec, v, idx, it)
+        v, idx, dist, it, tol_eff, tele = run_stage(spec, v, idx, it, tele)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
@@ -165,12 +173,12 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                 - policy_k)
     return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it,
                        dist, tol_eff, hot_iterations=hot_it,
-                       switch_distance=switch_dist)
+                       switch_distance=switch_dist, telemetry=tele)
 
 
 _VFI_STATIC = ("tol", "max_iter", "howard_steps", "block_size",
                "relative_tol", "use_pallas", "progress_every",
-               "noise_floor_ulp", "ladder")
+               "noise_floor_ulp", "ladder", "telemetry")
 # Default program: sigma/beta are TRACED operands, so (a) a batch of scenarios
 # differing only in preferences compiles once, and (b) the whole solve vmaps
 # over (r, sigma, beta, ...) — the batched-GE requirement. The Pallas route
@@ -187,7 +195,7 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
                        block_size: int = 0, relative_tol: bool = False,
                        use_pallas: bool = False, progress_every: int = 0,
                        noise_floor_ulp: float = 0.0,
-                       ladder=None) -> VFISolution:
+                       ladder=None, telemetry=None) -> VFISolution:
     """Iterate the Bellman operator to a sup-norm fixed point.
 
     Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
@@ -224,7 +232,8 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
               max_iter=max_iter, howard_steps=howard_steps,
               block_size=block_size, relative_tol=relative_tol,
               use_pallas=use_pallas, progress_every=progress_every,
-              noise_floor_ulp=noise_floor_ulp, ladder=ladder)
+              noise_floor_ulp=noise_floor_ulp, ladder=ladder,
+              telemetry=telemetry)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
@@ -894,14 +903,14 @@ def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
         warm_policy_k=egm_solution.policy_k)
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every", "noise_floor_ulp", "ladder"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every", "noise_floor_ulp", "ladder", "telemetry"))
 def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                              beta, psi, eta, tol: float,
                              max_iter: int, howard_steps: int = 0,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              noise_floor_ulp: float = 0.0,
-                             ladder=None) -> VFISolution:
+                             ladder=None, telemetry=None) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
     (Aiyagari_Endogenous_Labor_VFI.m:64-122). Preference scalars are traced
     operands (vmap/scenario-batch compatible), like solve_aiyagari_vfi —
@@ -912,7 +921,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
     N, na = v_init.shape
     nl = labor_grid.shape[0]
 
-    def run_stage(spec, v0, a_idx0, l_idx0, it0):
+    def run_stage(spec, v0, a_idx0, l_idx0, it0, tele_in):
         dt = jnp.dtype(spec.dtype)
         prec = (matmul_precision_of(spec.matmul_precision)
                 or jax.lax.Precision.DEFAULT)
@@ -940,6 +949,8 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
         def cond(carry):
             return (carry[3] >= carry[5]) & (carry[4] < max_iter)
 
+        # (tele rides at carry[6]; indices 3/4/5 above are unchanged)
+
         # Hoist the loop-invariant [nl, N, na, na'] joint-choice utility when
         # it fits comfortably in HBM (reference scale: 10x7x400x400 f64 =
         # 90 MB); beyond that fall back to the scanned per-labor form. Peak
@@ -953,7 +964,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                                              dtype=dt)
 
         def body(carry):
-            v, a_idx, l_idx, _, it, _ = carry
+            v, a_idx, l_idx, _, it, _, tele = carry
             if U4 is not None:
                 v_new, a_idx, l_idx = bellman_step_labor_precomputed(
                     v, U4, Pd, beta=bet, precision=prec)
@@ -969,11 +980,12 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)
+            tele = telemetry_record(tele, dist)
             v_new = eval_sweeps(v_new, a_idx, l_idx)
-            return v_new, a_idx, l_idx, dist, it + 1, tol_eff
+            return v_new, a_idx, l_idx, dist, it + 1, tol_eff, tele
 
         init = (v0.astype(dt), a_idx0, l_idx0, jnp.array(jnp.inf, dt), it0,
-                tol_c)
+                tol_c, tele_in)
         return jax.lax.while_loop(cond, body, init)
 
     zeros_i = jnp.zeros(v_init.shape, jnp.int32)
@@ -981,10 +993,11 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
     it = jnp.int32(0)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
+    tele = telemetry_init(telemetry)
     dist = tol_eff = None
     for spec in stages:
-        v, a_idx, l_idx, dist, it, tol_eff = run_stage(spec, v, a_idx,
-                                                       l_idx, it)
+        v, a_idx, l_idx, dist, it, tol_eff, tele = run_stage(
+            spec, v, a_idx, l_idx, it, tele)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
@@ -997,4 +1010,4 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                 * policy_l - policy_k)
     return VFISolution(v, a_idx, policy_k, policy_c, policy_l, it, dist,
                        tol_eff, hot_iterations=hot_it,
-                       switch_distance=switch_dist)
+                       switch_distance=switch_dist, telemetry=tele)

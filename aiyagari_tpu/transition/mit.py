@@ -107,6 +107,18 @@ class TransitionResult:
     # dtype switch fired (0.0 = the switch never fired).
     hot_rounds: int = 0
     switch_excess: float = 0.0
+    # Outer flight record (diagnostics/telemetry.py host_telemetry): the
+    # per-round max-excess-demand trajectory with per-round stage dtypes —
+    # the Newton/damped loop's convergence certificate in the same
+    # SolveTelemetry shape as the device recorders.
+    telemetry: object = None
+
+    def health(self, model=None) -> dict:
+        """Health certificate (diagnostics/health.py): round-trajectory
+        shape (stall/oscillation), convergence verdict."""
+        from aiyagari_tpu.diagnostics.health import health_report
+
+        return health_report(self, model=model)
 
 
 @dataclasses.dataclass
@@ -131,6 +143,14 @@ class TransitionSweepResult:
     # program dtype, so the switch is global over the batch).
     hot_rounds: int = 0
     switch_excess: float = 0.0
+    # Outer flight record: per-round max excess demand across the batch
+    # (host_telemetry; one trajectory — the lockstep rounds are shared).
+    telemetry: object = None
+
+    def health(self, model=None) -> dict:
+        from aiyagari_tpu.diagnostics.health import health_report
+
+        return health_report(self, model=model)
 
 
 def shock_paths(model: AiyagariModel, shock: MITShock, T: int) -> dict:
@@ -370,6 +390,7 @@ def solve_transition(
     out = None
     K_ts = D = None
     hist: list = []
+    bits_hist: list = []   # per-round stage dtype width (the ladder record)
     converged = False
     rounds = 0
     for rnd in range(trans.max_iter):
@@ -394,6 +415,7 @@ def solve_transition(
             hot_rounds = rounds
         max_d = float(np.max(np.abs(D)))
         hist.append(max_d)
+        bits_hist.append(int(jnp.finfo(jnp.dtype(dt_name)).bits))
         if on_iteration is not None:
             on_iteration({"round": rnd, "max_excess": max_d,
                           "dtype": dt_name,
@@ -465,7 +487,16 @@ def solve_transition(
         jacobian=jacobian,
         hot_rounds=hot_rounds,
         switch_excess=switch_excess,
+        telemetry=_round_telemetry(hist, bits_hist),
     )
+
+
+def _round_telemetry(hist, bits_hist):
+    """The round loop's host flight record (one shape with the device
+    recorders: diagnostics/telemetry.host_telemetry)."""
+    from aiyagari_tpu.diagnostics.telemetry import host_telemetry
+
+    return host_telemetry(hist, bits_hist)
 
 
 def solve_transitions_sweep(
@@ -560,6 +591,8 @@ def solve_transitions_sweep(
     max_d = np.full(S, np.inf)
     out = None
     rounds = 0
+    hist: list = []
+    bits_hist: list = []
     for rnd in range(trans.max_iter):
         it_t0 = time.perf_counter()
         dt_name = stage_names[stage]
@@ -581,6 +614,8 @@ def solve_transitions_sweep(
             # Count every hot-evaluated round (single-solve rationale).
             hot_rounds = rounds
         max_d = np.max(np.abs(D), axis=1)
+        hist.append(float(np.max(max_d)))
+        bits_hist.append(int(jnp.finfo(dt).bits))
         if final_stage:
             # Scenarios are only marked converged from final-dtype
             # evaluations — a hot-stage residual certifies nothing.
@@ -642,4 +677,5 @@ def solve_transitions_sweep(
         jacobian=jacobian,
         hot_rounds=hot_rounds,
         switch_excess=switch_excess,
+        telemetry=_round_telemetry(hist, bits_hist),
     )

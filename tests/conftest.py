@@ -40,3 +40,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability_state():
+    """Module-global observability state must not leak across tests: a
+    progress sink a crashed test left subscribed would receive every later
+    solve's records, and the process-wide metrics registry would blur one
+    test's degradation counts into the next's assertions. Reset AFTER each
+    test (the state is empty at entry by induction)."""
+    yield
+    from aiyagari_tpu.diagnostics import metrics
+    from aiyagari_tpu.diagnostics.progress import reset
+
+    reset()
+    metrics.reset()
