@@ -1,0 +1,211 @@
+"""Static analysis of the kernel zoo: jaxpr-level invariant auditing
+(analysis/jaxpr_audit.py over the traceable entry points in
+analysis/registry.py) and source-level lint (analysis/lint.py), under one
+declarative rule catalogue (analysis/rules.py).
+
+    python -m aiyagari_tpu.analysis [--format json|text] [--rules ...]
+
+`run_analysis()` is the library entry the CLI, `bench.py --preset ci`,
+and tier-1 (tests/test_static_analysis.py) all share. Findings emit into
+the PR 6 observability surface: an `analysis` ledger event with per-rule
+counts on the active run ledger, and
+`aiyagari_analysis_findings_total{rule=...}` metrics counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from aiyagari_tpu.analysis.rules import (
+    RULES,
+    Finding,
+    Rule,
+    findings_by_rule,
+    rule_by_name,
+)
+
+__all__ = [
+    "AnalysisReport",
+    "RULES",
+    "Finding",
+    "Rule",
+    "default_baseline_path",
+    "load_baseline",
+    "run_analysis",
+]
+
+
+_BASELINE_FILE = "baseline.json"
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / _BASELINE_FILE
+
+
+def load_baseline(path=None) -> set:
+    """The checked-in findings baseline: a set of Finding.baseline_key()
+    strings that predate their rule and are tolerated (reported as
+    suppressed). Shipped empty — the tree is clean."""
+    p = Path(path) if path is not None else default_baseline_path()
+    if not p.exists():
+        return set()
+    data = json.loads(p.read_text())
+    return set(data.get("findings", []))
+
+
+def write_baseline(findings: Sequence[Finding], path=None) -> Path:
+    """Regenerate the baseline from a run's findings: every ACTIVE finding
+    plus every finding the PREVIOUS baseline was suppressing (it still
+    exists in the tree — dropping it would resurface it as a gate failure
+    on the next run). noqa-suppressed findings are never imported: their
+    suppression lives in the source line."""
+    p = Path(path) if path is not None else default_baseline_path()
+    keys = sorted({f.baseline_key() for f in findings
+                   if not f.suppressed or f.suppressed_by == "baseline"})
+    p.write_text(json.dumps({"version": 1, "findings": keys}, indent=2)
+                 + "\n")
+    return p
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisReport:
+    findings: Tuple[Finding, ...]
+    programs_audited: Tuple[str, ...]
+    programs_skipped: Tuple[tuple, ...]   # (name, reason)
+    files_linted: int
+    wall_seconds: float
+
+    @property
+    def active(self) -> Tuple[Finding, ...]:
+        return tuple(f for f in self.findings if not f.suppressed)
+
+    @property
+    def active_count(self) -> int:
+        return len(self.active)
+
+    def rule_counts(self) -> dict:
+        return findings_by_rule(self.findings)
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "active_findings": self.active_count,
+            "rule_counts": self.rule_counts(),
+            "programs_audited": list(self.programs_audited),
+            "programs_skipped": [{"program": n, "reason": r}
+                                 for n, r in self.programs_skipped],
+            "files_linted": self.files_linted,
+            "wall_seconds": round(self.wall_seconds, 3),
+        }
+
+    def render_text(self) -> str:
+        lines = []
+        for f in self.findings:
+            mark = "suppressed " if f.suppressed else ""
+            lines.append(f"{f.location()}: {mark}{f.rule.id} "
+                         f"[{f.rule.name}] {f.message}")
+        for name, reason in self.programs_skipped:
+            lines.append(f"{name}: skipped ({reason})")
+        lines.append(
+            f"{self.active_count} finding(s) "
+            f"({len(self.findings) - self.active_count} suppressed) over "
+            f"{len(self.programs_audited)} program(s), "
+            f"{self.files_linted} file(s), "
+            f"{self.wall_seconds:.1f}s")
+        return "\n".join(lines)
+
+
+def _emit_observability(report: AnalysisReport) -> None:
+    """Record the run on the PR 6 surface. Ledger: one `analysis` event
+    (active run ledger only — a no-op otherwise). Metrics: per-rule
+    finding counters, zero-filled so a clean run still exports the
+    series."""
+    try:
+        from aiyagari_tpu.diagnostics import ledger, metrics
+
+        counts = report.rule_counts()
+        for rule_name, n in counts.items():
+            # inc(0) registers the zero series: a clean run still exports
+            # one aiyagari_analysis_findings_total{rule=...} per rule, so
+            # dashboards can tell "clean" from "never ran".
+            metrics.counter("aiyagari_analysis_findings_total",
+                            rule=rule_name).inc(n)
+        ledger.emit("analysis", findings=report.active_count,
+                    rules=counts,
+                    programs_audited=len(report.programs_audited),
+                    programs_skipped=[n for n, _ in report.programs_skipped],
+                    files_linted=report.files_linted,
+                    wall_seconds=round(report.wall_seconds, 3))
+    except Exception:  # pragma: no cover - diagnostics must not fail runs
+        pass
+
+
+def run_analysis(*, rules: Optional[Sequence[str]] = None,
+                 levels: Sequence[str] = ("jaxpr", "source"),
+                 baseline=None) -> AnalysisReport:
+    """Run the selected rules over the kernel zoo and the source tree.
+
+    rules   — rule names/ids to run (None = all).
+    levels  — which layers to run ("jaxpr", "source").
+    baseline — a baseline path, a pre-loaded key set, or None for the
+        checked-in default.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    selected = None if rules is None else [rule_by_name(r) for r in rules]
+
+    findings: List[Finding] = []
+    audited: List[str] = []
+    skipped: List[tuple] = []
+    files_linted = 0
+
+    if "jaxpr" in levels and (
+            selected is None or any(r.level == "jaxpr" for r in selected)):
+        from aiyagari_tpu.analysis.jaxpr_audit import audit_program
+        from aiyagari_tpu.analysis.registry import (
+            ProgramUnavailable,
+            registered_programs,
+        )
+
+        jaxpr_rules = (None if selected is None
+                       else [r for r in selected if r.level == "jaxpr"])
+        for spec in registered_programs():
+            try:
+                findings.extend(audit_program(spec, rules=jaxpr_rules))
+                audited.append(spec.name)
+            except ProgramUnavailable as e:
+                skipped.append((spec.name, str(e)))
+
+    if "source" in levels and (
+            selected is None or any(r.level == "source" for r in selected)):
+        from aiyagari_tpu.analysis.lint import iter_package_files, lint_file
+
+        want = (None if selected is None
+                else {r.id for r in selected if r.level == "source"})
+        for path, rel in iter_package_files():
+            files_linted += 1
+            for f in lint_file(path, rel):
+                if want is None or f.rule.id in want:
+                    findings.append(f)
+
+    base = (baseline if isinstance(baseline, set)
+            else load_baseline(baseline))
+    findings = [
+        dataclasses.replace(f, suppressed=True, suppressed_by="baseline")
+        if (not f.suppressed and f.baseline_key() in base) else f
+        for f in findings
+    ]
+
+    report = AnalysisReport(
+        findings=tuple(findings),
+        programs_audited=tuple(audited),
+        programs_skipped=tuple(skipped),
+        files_linted=files_linted,
+        wall_seconds=time.perf_counter() - t0,
+    )
+    _emit_observability(report)
+    return report

@@ -1,0 +1,91 @@
+"""CLI for the static-analysis layer.
+
+    python -m aiyagari_tpu.analysis                      # full run, text
+    python -m aiyagari_tpu.analysis --format json        # machine-readable
+    python -m aiyagari_tpu.analysis --rules no-scatter,mesh-shim-discipline
+    python -m aiyagari_tpu.analysis --level source       # lint only (no jax traces)
+    python -m aiyagari_tpu.analysis --list-rules
+    python -m aiyagari_tpu.analysis --write-baseline     # accept current findings
+
+Exit code: 0 when every finding is suppressed (noqa or baseline), 1
+otherwise — the CI contract `bench.py --preset ci` and tier-1 gate on.
+
+The jaxpr level traces the kernel zoo with abstract (ShapeDtypeStruct)
+inputs, so the run is deterministic on any host: the CLI pins
+JAX_PLATFORMS=cpu by default (override with --platform) and never needs
+an accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu.analysis",
+                                 description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--format", choices=["text", "json"], default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule names or ids to run "
+                         "(default: all)")
+    ap.add_argument("--level", choices=["all", "jaxpr", "source"],
+                    default="all")
+    ap.add_argument("--baseline", default=None,
+                    help="findings-baseline path (default: the checked-in "
+                         "analysis/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current unsuppressed findings into the "
+                         "baseline (then exit 0): the escape hatch for "
+                         "landing a new rule against a not-yet-clean tree")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--platform", choices=["cpu", "tpu"], default="cpu",
+                    help="jax platform for the trace step (default cpu: "
+                         "the audit traces, never executes, so it needs "
+                         "no accelerator and stays deterministic)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from aiyagari_tpu.analysis.rules import RULES
+
+        for r in RULES:
+            print(f"{r.id}  {r.name:28s} [{r.level}]  {r.description}")
+        return 0
+
+    # Platform pin BEFORE any jax initialization (the analysis package
+    # import itself is jax-free; the registry builders import lazily).
+    os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    # The zoo's reference programs are f64; without x64 they would
+    # silently canonicalize and the precision-leak declarations would lie.
+    jax.config.update("jax_enable_x64", True)
+
+    from aiyagari_tpu.analysis import run_analysis, write_baseline
+
+    levels = (("jaxpr", "source") if args.level == "all" else (args.level,))
+    rules = (None if args.rules is None
+             else [s.strip() for s in args.rules.split(",") if s.strip()])
+    report = run_analysis(rules=rules, levels=levels, baseline=args.baseline)
+
+    if args.write_baseline:
+        path = write_baseline(report.findings, args.baseline)
+        print(f"baseline written: {path} "
+              f"({report.active_count} finding(s) accepted)")
+        return 0
+
+    if args.format == "json":
+        import json
+
+        print(json.dumps(report.to_json()))
+    else:
+        print(report.render_text())
+    return 1 if report.active_count else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,544 @@
+"""Level-1 static analysis: walk the traced jaxpr of every registered hot
+program and enforce the compiled-artifact invariants (analysis/rules.py,
+AIYA1xx).
+
+Why the jaxpr and not the source or a parity test: the properties being
+certified — scatter-free hot paths, no precision leaks inside a ladder
+stage, no host sync per sweep, zero-cost telemetry-off — are properties of
+the PROGRAM XLA receives, produced by tracing through layers of Python
+(solver -> ops -> backend dispatch -> version shims). A source grep cannot
+see through that composition, and a parity test only certifies the inputs
+it ran; the jaxpr is the one structural object that certifies every path
+the program can take (the same move the sequence-space literature makes
+for model correctness: one structural object, checked once, covers all
+shocks).
+
+Programs are traced with `jax.make_jaxpr` on `jax.ShapeDtypeStruct`
+abstract inputs supplied by the registry (analysis/registry.py) — an
+eval_shape-style trace: no solve runs, (almost) nothing is allocated, so
+the audit is deterministic under JAX_PLATFORMS=cpu and runs on hosts with
+no accelerator at all.
+
+The walker recurses into every sub-jaxpr a primitive carries (while/scan
+bodies, cond branches, pjit/shard_map/remat/custom_* calls), tracking two
+context bits the rules need: the LOOP DEPTH (host-sync and scatter checks
+care whether an equation re-executes per sweep) and whether the equation
+sits inside a `cond` BRANCH (the compiled-in validity fallbacks of
+ops/pushforward.py put the reference scatter there on purpose — a
+conditional degradation path, not a hot-path regression).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Tuple
+
+from aiyagari_tpu.analysis.rules import (
+    CALLBACK_TAG_ATTR,
+    CALLBACK_WHITELIST,
+    Finding,
+    rule_by_name,
+)
+
+__all__ = [
+    "EqnContext",
+    "walk_jaxpr",
+    "audit_program",
+    "audit_closed_jaxpr",
+]
+
+# Primitives that move mass through a scatter. "scatter-add" is the
+# `.at[].add` lowering the push-forward backends replace; plain "scatter"
+# (`.at[].set`) rides along — a set inside a hot sweep has the same serial
+# lowering.
+_SCATTER_PRIMS = frozenset({"scatter-add", "scatter", "scatter-mul",
+                            "scatter-min", "scatter-max"})
+
+# Host-synchronizing primitives never allowed inside a loop body.
+_HOST_SYNC_PRIMS = frozenset({"io_callback", "infeed", "outfeed"})
+
+_FLOAT32 = "float32"
+_FLOAT64 = "float64"
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnContext:
+    """Where an equation sits in the recursion."""
+
+    loop_depth: int = 0          # nesting count of while/scan bodies
+    in_cond_branch: bool = False  # inside any lax.cond branch
+    path: Tuple[str, ...] = ()    # primitive names from the root
+
+    def describe(self) -> str:
+        return "/".join(self.path) if self.path else "<top>"
+
+
+def _sub_jaxprs(eqn):
+    """Yield (sub_jaxpr, context_kind) for every jaxpr carried in an
+    equation's params, generically: any param value that IS a jaxpr (or a
+    tuple/list of them) recurses, so new jaxpr-carrying primitives are
+    covered without a registry of param names. context_kind is "loop"
+    (while/scan bodies and conditions — re-executed per iteration),
+    "branch" (cond branches), or "call" (everything else)."""
+    import jax.core as jcore
+
+    prim = eqn.primitive.name
+
+    def kind_for(param_name: str) -> str:
+        if prim == "while" and param_name in ("body_jaxpr", "cond_jaxpr"):
+            return "loop"
+        if prim == "scan" and param_name == "jaxpr":
+            return "loop"
+        if prim == "cond" and param_name == "branches":
+            return "branch"
+        return "call"
+
+    for name, value in eqn.params.items():
+        values = value if isinstance(value, (tuple, list)) else (value,)
+        for v in values:
+            if isinstance(v, jcore.ClosedJaxpr):
+                yield v.jaxpr, kind_for(name)
+            elif isinstance(v, jcore.Jaxpr):
+                yield v, kind_for(name)
+
+
+def walk_jaxpr(jaxpr, ctx: EqnContext = EqnContext()) -> Iterator[tuple]:
+    """Yield (eqn, ctx) for every equation reachable from `jaxpr`,
+    recursing into all sub-jaxprs with the context updated per kind."""
+    for eqn in jaxpr.eqns:
+        yield eqn, ctx
+        for sub, kind in _sub_jaxprs(eqn):
+            sub_ctx = EqnContext(
+                loop_depth=ctx.loop_depth + (1 if kind == "loop" else 0),
+                in_cond_branch=ctx.in_cond_branch or kind == "branch",
+                path=ctx.path + (eqn.primitive.name,),
+            )
+            yield from walk_jaxpr(sub, sub_ctx)
+
+
+# -- callback identification ------------------------------------------------
+
+
+def _callback_tag(obj, depth: int = 0) -> Optional[str]:
+    """Find a CALLBACK_TAG_ATTR on a callback object or anything it closes
+    over. jax wraps the user's function (partial -> _flat_callback closure
+    on jax 0.4.x), so the tag is discovered by a bounded structural search:
+    the object itself, functools.partial fields, __wrapped__, and closure
+    cell contents."""
+    if depth > 4 or obj is None:
+        return None
+    tag = getattr(obj, CALLBACK_TAG_ATTR, None)
+    if isinstance(tag, str):
+        return tag
+    # functools.partial
+    for attr in ("func",):
+        inner = getattr(obj, attr, None)
+        if inner is not None and inner is not obj:
+            tag = _callback_tag(inner, depth + 1)
+            if tag:
+                return tag
+    wrapped = getattr(obj, "__wrapped__", None)
+    if wrapped is not None:
+        tag = _callback_tag(wrapped, depth + 1)
+        if tag:
+            return tag
+    closure = getattr(obj, "__closure__", None)
+    if closure:
+        for cell in closure:
+            try:
+                content = cell.cell_contents
+            except ValueError:  # pragma: no cover - empty cell
+                continue
+            if callable(content):
+                tag = _callback_tag(content, depth + 1)
+                if tag:
+                    return tag
+    return None
+
+
+def _eqn_callback_tag(eqn) -> Optional[str]:
+    for value in eqn.params.values():
+        if callable(value):
+            tag = _callback_tag(value)
+            if tag:
+                return tag
+    return None
+
+
+# -- per-rule checks --------------------------------------------------------
+
+
+def _select_guarded(eqn, users, depth: int = 0) -> bool:
+    """True when a scatter's value is consumed ONLY by the select_n that
+    arbitrates the compiled-in validity fallback. Under vmap, a
+    `lax.cond(plan.ok, scatter_free, scatter)` with a batched predicate
+    batches to both branches + `select_n` — the scatter is still the
+    guarded fallback, just in its residual batched form, so it must not
+    trip the rule (only an UNguarded scatter is a hot-path regression).
+    Chained scatters (the two-leg lottery) recurse."""
+    if depth > 4:
+        return False
+    for ov in eqn.outvars:
+        consumers = users.get(_var_key(ov), [])
+        if not consumers:
+            return False
+        for c in consumers:
+            name = c.primitive.name
+            if name == "select_n":
+                continue
+            if name in _SCATTER_PRIMS and _select_guarded(c, users,
+                                                          depth + 1):
+                continue
+            return False
+    return True
+
+
+def _check_no_scatter(jaxpr, program: str) -> List[Finding]:
+    import jax.core as jcore
+
+    rule = rule_by_name("no-scatter")
+    out: List[Finding] = []
+
+    def visit(jx, ctx: EqnContext):
+        users: dict = {}
+        for eqn in jx.eqns:
+            for v in eqn.invars:
+                if isinstance(v, jcore.Var):
+                    users.setdefault(_var_key(v), []).append(eqn)
+        for eqn in jx.eqns:
+            if (eqn.primitive.name in _SCATTER_PRIMS
+                    and not ctx.in_cond_branch
+                    and not _select_guarded(eqn, users)):
+                out.append(Finding(
+                    rule, program,
+                    f"{eqn.primitive.name} on the unconditional path "
+                    f"(at {ctx.describe()}) of a program declared "
+                    "scatter-free; only the validity-fallback branch "
+                    "(lax.cond, or its select_n residual under vmap) may "
+                    "scatter"))
+            for sub, kind in _sub_jaxprs(eqn):
+                visit(sub, EqnContext(
+                    loop_depth=ctx.loop_depth + (1 if kind == "loop" else 0),
+                    in_cond_branch=ctx.in_cond_branch or kind == "branch",
+                    path=ctx.path + (eqn.primitive.name,)))
+
+    visit(jaxpr, EqnContext())
+    return out
+
+
+def _check_precision_leak(jaxpr, program: str,
+                          stage_dtype: Optional[str]) -> List[Finding]:
+    rule = rule_by_name("no-precision-leak")
+    out = []
+    for eqn, ctx in walk_jaxpr(jaxpr):
+        name = eqn.primitive.name
+        if name == "convert_element_type" and stage_dtype is not None:
+            import numpy as np
+
+            new = np.dtype(eqn.params["new_dtype"])
+            old_aval = getattr(eqn.invars[0], "aval", None)
+            old = np.dtype(old_aval.dtype) if old_aval is not None else None
+            if (old is not None
+                    and np.issubdtype(new, np.floating)
+                    and np.issubdtype(old, np.floating)
+                    and new != old):
+                leak = ((stage_dtype == _FLOAT32 and new == np.float64)
+                        or (stage_dtype == _FLOAT64 and new == np.float32))
+                if leak:
+                    out.append(Finding(
+                        rule, program,
+                        f"convert_element_type {old} -> {new} inside a "
+                        f"declared-{stage_dtype} stage "
+                        f"(at {ctx.describe()})"))
+        elif name == "dot_general":
+            import numpy as np
+
+            dts = [np.dtype(v.aval.dtype) for v in eqn.invars
+                   if getattr(v, "aval", None) is not None]
+            floats = [d for d in dts if np.issubdtype(d, np.floating)]
+            if len(set(floats)) > 1:
+                out.append(Finding(
+                    rule, program,
+                    f"dot_general with mixed float operand dtypes "
+                    f"{sorted(str(d) for d in set(floats))} "
+                    f"(at {ctx.describe()})"))
+    return out
+
+
+def _check_host_sync(jaxpr, program: str) -> List[Finding]:
+    rule = rule_by_name("no-host-sync-in-loop")
+    out = []
+    for eqn, ctx in walk_jaxpr(jaxpr):
+        if ctx.loop_depth < 1:
+            continue
+        name = eqn.primitive.name
+        if name in _HOST_SYNC_PRIMS:
+            out.append(Finding(
+                rule, program,
+                f"{name} inside a loop body (at {ctx.describe()})"))
+        elif name == "debug_callback":
+            tag = _eqn_callback_tag(eqn)
+            if tag not in CALLBACK_WHITELIST:
+                label = f"tagged {tag!r}" if tag else "untagged"
+                out.append(Finding(
+                    rule, program,
+                    f"{label} debug_callback inside a loop body "
+                    f"(at {ctx.describe()}); route it through the counted "
+                    "degradation-event path and tag the host function "
+                    f"with {CALLBACK_TAG_ATTR}"))
+    return out
+
+
+def _all_avals(jaxpr):
+    seen = set()
+    for v in list(jaxpr.constvars) + list(jaxpr.invars) + list(jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            seen.add((getattr(aval, "shape", ()), str(getattr(aval, "dtype", ""))))
+    for eqn, _ in walk_jaxpr(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                seen.add((getattr(aval, "shape", ()),
+                          str(getattr(aval, "dtype", ""))))
+    return seen
+
+
+def _check_telemetry_noop(off_jaxpr, on_jaxpr, program: str,
+                          sentinel: int) -> List[Finding]:
+    """The PR 6 zero-cost pin, generalized: the recorder ring is traced
+    with a sentinel capacity no model dimension shares, so ANY
+    sentinel-sized value in the telemetry-off program is recorder residue;
+    and the telemetry-ON program must actually carry the ring (otherwise
+    the wiring regressed and the off-check is vacuous)."""
+    rule = rule_by_name("telemetry-noop")
+    out = []
+
+    def has_sentinel(jaxpr):
+        return any(sentinel in shape for shape, _ in _all_avals(jaxpr))
+
+    if has_sentinel(off_jaxpr):
+        out.append(Finding(
+            rule, program,
+            f"telemetry-off trace still carries a ring-buffer-shaped "
+            f"value (a dimension of {sentinel}); the recorder must "
+            "compile out entirely when TelemetryConfig is None"))
+    if on_jaxpr is not None and not has_sentinel(on_jaxpr):
+        out.append(Finding(
+            rule, program,
+            f"telemetry-on trace carries NO ring buffer (no dimension of "
+            f"{sentinel}): the recorder wiring is broken, so the "
+            "telemetry-off no-op check certifies nothing"))
+    return out
+
+
+def _var_key(v):
+    return id(v)
+
+
+def _outvar_root_deps(jaxpr, n_skip_invars: int = 0):
+    """For each jaxpr outvar: the set of invar indices (counted after
+    skipping the first `n_skip_invars` const invars) it transitively
+    depends on. Equations are treated as opaque — every output depends on
+    every input — which can only over-report reads (a conservative
+    direction for dead-carry: never a false positive)."""
+    import jax.core as jcore
+
+    roots = {}
+    for i, v in enumerate(jaxpr.invars):
+        if i >= n_skip_invars:
+            roots[_var_key(v)] = frozenset({i - n_skip_invars})
+    for eqn in jaxpr.eqns:
+        dep = frozenset()
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var):
+                dep |= roots.get(_var_key(v), frozenset())
+        for ov in eqn.outvars:
+            roots[_var_key(ov)] = dep
+    out = []
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var):
+            out.append(roots.get(_var_key(v), frozenset()))
+        else:
+            out.append(frozenset())
+    return out
+
+
+def _used_invar_slots(jaxpr, n_skip_invars: int = 0):
+    """Invar indices (post-skip) referenced by any equation or outvar."""
+    import jax.core as jcore
+
+    slot = {_var_key(v): i - n_skip_invars
+            for i, v in enumerate(jaxpr.invars) if i >= n_skip_invars}
+    used = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if isinstance(v, jcore.Var) and _var_key(v) in slot:
+                used.add(slot[_var_key(v)])
+    for v in jaxpr.outvars:
+        if isinstance(v, jcore.Var) and _var_key(v) in slot:
+            used.add(slot[_var_key(v)])
+    return used
+
+
+def _check_dead_carry(jaxpr, program: str) -> List[Finding]:
+    """Flag while_loop carry slots that are written but never read: the
+    loop condition ignores them, no OTHER carry slot reads them, and the
+    enclosing program drops the loop's corresponding output. Requires
+    use-site knowledge of each while eqn's outputs, so this walks each
+    jaxpr level explicitly instead of using the flat iterator."""
+    import jax.core as jcore
+
+    rule = rule_by_name("dead-carry")
+    out: List[Finding] = []
+
+    def visit(jx, path: Tuple[str, ...]):
+        # Vars consumed by LATER equations or by the jaxpr's outputs.
+        used_here = set()
+        for eqn in jx.eqns:
+            for v in eqn.invars:
+                if isinstance(v, jcore.Var):
+                    used_here.add(_var_key(v))
+        for v in jx.outvars:
+            if isinstance(v, jcore.Var):
+                used_here.add(_var_key(v))
+
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "while":
+                body = eqn.params["body_jaxpr"].jaxpr
+                cond = eqn.params["cond_jaxpr"].jaxpr
+                n_body_consts = eqn.params.get("body_nconsts", 0)
+                n_cond_consts = eqn.params.get("cond_nconsts", 0)
+                n_carry = len(body.outvars)
+                body_deps = _outvar_root_deps(body, n_body_consts)
+                cond_reads = _used_invar_slots(cond, n_cond_consts)
+                for i in range(n_carry):
+                    if i in cond_reads:
+                        continue
+                    read_by_other = any(i in body_deps[j]
+                                        for j in range(n_carry) if j != i)
+                    if read_by_other:
+                        continue
+                    ov = eqn.outvars[i]
+                    if (not isinstance(ov, jcore.DropVar)
+                            and _var_key(ov) in used_here):
+                        continue
+                    # Written vs pure self-passthrough: a slot whose next
+                    # value IS its own invar is carried unchanged; anything
+                    # else recomputes it every iteration.
+                    body_in = (body.invars[n_body_consts + i]
+                               if n_body_consts + i < len(body.invars)
+                               else None)
+                    passthrough = (isinstance(body.outvars[i], jcore.Var)
+                                   and body.outvars[i] is body_in)
+                    written = not passthrough
+                    kind = ("written every iteration but read by nothing"
+                            if written else
+                            "carried unchanged and read by nothing")
+                    where = "/".join(path) if path else "<top>"
+                    out.append(Finding(
+                        rule, program,
+                        f"while_loop carry slot {i} "
+                        f"({body.outvars[i].aval.str_short()}) is {kind} "
+                        f"— not the loop condition, not another carry "
+                        f"slot, and the enclosing program drops it "
+                        f"(at {where})"))
+            for sub, _ in _sub_jaxprs(eqn):
+                visit(sub, path + (eqn.primitive.name,))
+
+    visit(jaxpr, ())
+    return out
+
+
+def _check_stable_carry(jaxpr, program: str) -> List[Finding]:
+    rule = rule_by_name("stable-carry")
+    out = []
+    for eqn, ctx in walk_jaxpr(jaxpr):
+        name = eqn.primitive.name
+        if name == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+            n_consts = eqn.params.get("body_nconsts", 0)
+            carry_in = body.invars[n_consts:]
+            carry_out = body.outvars
+        elif name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+            n_consts = eqn.params.get("num_consts", 0)
+            n_carry = eqn.params.get("num_carry", 0)
+            carry_in = body.invars[n_consts:n_consts + n_carry]
+            carry_out = body.outvars[:n_carry]
+        else:
+            continue
+        for i, (vi, vo) in enumerate(zip(carry_in, carry_out)):
+            ai, ao = vi.aval, getattr(vo, "aval", None)
+            if ao is not None and (ai.shape != ao.shape
+                                   or ai.dtype != ao.dtype):
+                out.append(Finding(
+                    rule, program,
+                    f"{name} carry slot {i} changes aval across "
+                    f"iterations: {ai.str_short()} -> {ao.str_short()} "
+                    f"(at {ctx.describe()})"))
+            elif getattr(ai, "weak_type", False):
+                out.append(Finding(
+                    rule, program,
+                    f"{name} carry slot {i} ({ai.str_short()}) is "
+                    f"weak-typed (at {ctx.describe()}): a bare Python "
+                    "scalar in the carry init re-specializes the program "
+                    "per caller literal; wrap it in jnp.asarray with an "
+                    "explicit dtype"))
+    return out
+
+
+# -- program-level driver ---------------------------------------------------
+
+
+def audit_closed_jaxpr(closed, program: str, *, scatter_free: bool = False,
+                       stage_dtype: Optional[str] = None,
+                       rules=None) -> List[Finding]:
+    """Run the jaxpr-level rules (minus telemetry-noop, which needs a
+    paired trace — audit_program handles it) on one ClosedJaxpr."""
+    jaxpr = getattr(closed, "jaxpr", closed)
+    selected = None if rules is None else {r.name for r in rules}
+
+    def want(name):
+        return selected is None or name in selected
+
+    findings: List[Finding] = []
+    if scatter_free and want("no-scatter"):
+        findings += _check_no_scatter(jaxpr, program)
+    if want("no-precision-leak"):
+        findings += _check_precision_leak(jaxpr, program, stage_dtype)
+    if want("no-host-sync-in-loop"):
+        findings += _check_host_sync(jaxpr, program)
+    if want("dead-carry"):
+        findings += _check_dead_carry(jaxpr, program)
+    if want("stable-carry"):
+        findings += _check_stable_carry(jaxpr, program)
+    return findings
+
+
+def audit_program(spec, rules=None) -> List[Finding]:
+    """Trace one registered program (telemetry off) and run every
+    applicable jaxpr rule; when the program wires a telemetry recorder,
+    also run the paired on/off telemetry-noop check."""
+    import jax
+
+    selected = None if rules is None else {r.name for r in rules}
+
+    def want(name):
+        return selected is None or name in selected
+
+    fn, args = spec.build_off()
+    closed = jax.make_jaxpr(fn)(*args)
+    findings = audit_closed_jaxpr(
+        closed, spec.name, scatter_free=spec.scatter_free,
+        stage_dtype=spec.stage_dtype, rules=rules)
+
+    if spec.supports_telemetry and want("telemetry-noop"):
+        from aiyagari_tpu.analysis.registry import TELEMETRY_SENTINEL_CAPACITY
+
+        fn_on, args_on = spec.build_on()
+        closed_on = jax.make_jaxpr(fn_on)(*args_on)
+        findings += _check_telemetry_noop(
+            closed.jaxpr, closed_on.jaxpr, spec.name,
+            TELEMETRY_SENTINEL_CAPACITY)
+    return findings

@@ -1,0 +1,249 @@
+"""Level-2 static analysis: AST lint over the package source (AIYA2xx).
+
+The jaxpr auditor certifies the compiled artifacts; this lint certifies
+the source DISCIPLINE that keeps them auditable and fast:
+
+  * mesh-shim-discipline (AIYA201) — jax is pinned at 0.4.x on this image
+    and every sharding symbol goes through the one version probe in
+    parallel/mesh.py. A direct `from jax.sharding import ...` elsewhere
+    compiles today and breaks on the next jax bump — the exact class of
+    breakage PR 1 spent 39 test failures un-doing.
+  * no-host-scalar-in-hot-module (AIYA202) — `.item()` and
+    `float(x[i])`-style element fetches cost one ~100 ms host round trip
+    EACH on the remote TPU transport (solvers/egm._cached_grid_bounds
+    measured them at 45% of a 400k solve); hot modules batch through
+    jax.device_get instead.
+  * no-bare-debug-print (AIYA203) — production signals are counted
+    degradation events (metrics + ledger, PR 6); a jax.debug.print is a
+    debugging aid and must sit behind an env-gated `if *DEBUG*:` guard.
+
+Suppression: a `# noqa: AIYA###` comment on the flagged line (multiple
+ids comma-separated) marks a deliberate exception; suppressed findings
+are still reported, with `suppressed: true`. The checked-in findings
+baseline (analysis/baseline.json) plays the same role for findings that
+predate a new rule — the shipped baseline is EMPTY: the tree is clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+from aiyagari_tpu.analysis.rules import Finding, rule_by_name
+
+__all__ = ["lint_file", "lint_tree", "hot_module", "iter_package_files"]
+
+# Modules exempt from mesh-shim-discipline: the shim itself.
+_MESH_SHIM = "parallel/mesh.py"
+
+# Hot-module scope of AIYA202: the directories whose code runs per sweep
+# or per solve. numpy_backend.py is the HOST reference implementation
+# (plain numpy end to end) — float() there is arithmetic, not a sync.
+_HOT_DIRS = ("solvers/", "ops/", "sim/", "transition/")
+_HOT_EXEMPT = ("solvers/numpy_backend.py",)
+
+_NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z]{4}\d{3}(?:\s*,\s*[A-Z]{4}\d{3})*)")
+
+_FORBIDDEN_MODULES = ("jax.sharding", "jax.experimental.shard_map")
+
+
+def hot_module(rel_path: str) -> bool:
+    rel = rel_path.replace("\\", "/")
+    if any(rel.endswith(e) for e in _HOT_EXEMPT):
+        return False
+    return any(f"/{d}" in f"/{rel}" for d in _HOT_DIRS)
+
+
+def _noqa_ids(source_lines, lineno: int) -> set:
+    if 1 <= lineno <= len(source_lines):
+        m = _NOQA_RE.search(source_lines[lineno - 1])
+        if m:
+            return {s.strip() for s in m.group(1).split(",")}
+    return set()
+
+
+def _attr_chain(node) -> Optional[str]:
+    """'jax.sharding.PartitionSpec' for nested ast.Attribute, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel_path: str, source: str, *, hot: Optional[bool],
+                 mesh_exempt: Optional[bool]):
+        self.rel = rel_path
+        self.lines = source.splitlines()
+        self.hot = hot_module(rel_path) if hot is None else hot
+        exempt = rel_path.replace("\\", "/").endswith(_MESH_SHIM)
+        self.mesh_exempt = exempt if mesh_exempt is None else mesh_exempt
+        self.findings: List[Finding] = []
+        # Env-gated-debug context: names of If-tests containing "DEBUG"
+        # we are currently inside of (AIYA203's sanctioned pattern).
+        self._debug_guard_depth = 0
+
+    # -- helpers ------------------------------------------------------------
+
+    def _emit(self, rule_name: str, node, message: str):
+        rule = rule_by_name(rule_name)
+        line = getattr(node, "lineno", None)
+        suppressed = bool(line and rule.id in _noqa_ids(self.lines, line))
+        self.findings.append(Finding(
+            rule, self.rel, message, line=line, suppressed=suppressed,
+            suppressed_by="noqa" if suppressed else None))
+
+    # -- AIYA201: mesh-shim discipline --------------------------------------
+
+    def visit_Import(self, node: ast.Import):
+        if not self.mesh_exempt:
+            for alias in node.names:
+                if any(alias.name == m or alias.name.startswith(m + ".")
+                       for m in _FORBIDDEN_MODULES):
+                    self._emit(
+                        "mesh-shim-discipline", node,
+                        f"direct `import {alias.name}`; route sharding "
+                        "symbols through aiyagari_tpu.parallel.mesh")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = node.module or ""
+        if not self.mesh_exempt:
+
+            def forbidden(path: str) -> bool:
+                return any(path == m or path.startswith(m + ".")
+                           for m in _FORBIDDEN_MODULES)
+
+            if forbidden(mod):
+                names = ", ".join(a.name for a in node.names)
+                self._emit(
+                    "mesh-shim-discipline", node,
+                    f"direct `from {mod} import {names}`; import from "
+                    "aiyagari_tpu.parallel.mesh instead (it re-exports "
+                    "PartitionSpec/NamedSharding/Mesh and owns the "
+                    "shard_map version probe)")
+            else:
+                # The parent-module forms — `from jax import sharding`,
+                # `from jax.experimental import shard_map` — bind the
+                # forbidden module itself to a local name; catching only
+                # the full-path form would make the rule trivially
+                # bypassable.
+                for alias in node.names:
+                    if forbidden(f"{mod}.{alias.name}" if mod
+                                 else alias.name):
+                        self._emit(
+                            "mesh-shim-discipline", node,
+                            f"direct `from {mod} import {alias.name}`; "
+                            "import from aiyagari_tpu.parallel.mesh "
+                            "instead")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if not self.mesh_exempt:
+            chain = _attr_chain(node)
+            if chain and any(chain == m or chain.startswith(m + ".")
+                             for m in _FORBIDDEN_MODULES):
+                self._emit(
+                    "mesh-shim-discipline", node,
+                    f"direct attribute reference `{chain}`; go through "
+                    "aiyagari_tpu.parallel.mesh")
+                # Do not recurse: the inner `jax.sharding` node of
+                # `jax.sharding.X` would double-report the same reference.
+                return
+        self.generic_visit(node)
+
+    # -- AIYA202 / AIYA203 --------------------------------------------------
+
+    def visit_If(self, node: ast.If):
+        guard = any(isinstance(n, ast.Name) and "DEBUG" in n.id
+                    for n in ast.walk(node.test))
+        self.visit(node.test)
+        # Only the TRUE branch of an `if *DEBUG*:` is the opt-in debug
+        # path; the else branch is the production path taken when the
+        # flag is off, so a debug print there is exactly as bare as one
+        # with no guard at all.
+        if guard:
+            self._debug_guard_depth += 1
+        for child in node.body:
+            self.visit(child)
+        if guard:
+            self._debug_guard_depth -= 1
+        for child in node.orelse:
+            self.visit(child)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if self.hot:
+            if (isinstance(func, ast.Attribute) and func.attr == "item"
+                    and not node.args):
+                self._emit(
+                    "no-host-scalar-in-hot-module", node,
+                    ".item() is a per-element device fetch; batch scalars "
+                    "through one jax.device_get")
+            if (isinstance(func, ast.Name)
+                    and func.id in ("float", "int", "bool")
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.Subscript)
+                    # x.shape[-1] / mesh.shape[axis] index a host tuple,
+                    # not a device array — no fetch, no finding.
+                    and not (isinstance(node.args[0].value, ast.Attribute)
+                             and node.args[0].value.attr == "shape")):
+                self._emit(
+                    "no-host-scalar-in-hot-module", node,
+                    f"{func.id}(<indexed array>) eagerly fetches one "
+                    "element per call (~100 ms per round trip on the "
+                    "remote TPU transport); use the batched "
+                    "jax.device_get pattern (_cached_grid_bounds / "
+                    "_fetch_scalars)")
+        chain = _attr_chain(func) if isinstance(func, ast.Attribute) else None
+        if chain and chain.split(".")[-2:] == ["debug", "print"]:
+            if self._debug_guard_depth == 0:
+                self._emit(
+                    "no-bare-debug-print", node,
+                    f"bare `{chain}(...)`: route production signals "
+                    "through the counted degradation-event path "
+                    "(ops/pushforward._record_fallback) or gate the "
+                    "print behind an env-derived *DEBUG* flag")
+        self.generic_visit(node)
+
+
+def lint_file(path, rel_path: Optional[str] = None, *,
+              hot: Optional[bool] = None,
+              mesh_exempt: Optional[bool] = None) -> List[Finding]:
+    """Lint one file. `hot`/`mesh_exempt` override the path-based scoping
+    (the adversarial fixtures live outside the package tree and declare
+    their scope explicitly)."""
+    path = Path(path)
+    rel = rel_path or str(path)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:  # pragma: no cover - package must parse
+        rule = rule_by_name("mesh-shim-discipline")
+        return [Finding(rule, rel, f"file does not parse: {e}",
+                        line=e.lineno)]
+    linter = _Linter(rel, source, hot=hot, mesh_exempt=mesh_exempt)
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_package_files() -> Iterable[tuple]:
+    """(abs_path, package-relative path) for every .py file of the
+    installed aiyagari_tpu package."""
+    root = Path(__file__).resolve().parent.parent
+    for p in sorted(root.rglob("*.py")):
+        yield p, str(p.relative_to(root))
+
+
+def lint_tree() -> List[Finding]:
+    """Run every source rule over the whole package."""
+    findings: List[Finding] = []
+    for path, rel in iter_package_files():
+        findings.extend(lint_file(path, rel))
+    return findings

@@ -1,0 +1,332 @@
+"""The kernel zoo: every hot program the jaxpr auditor certifies, as a
+registry of TRACEABLE entry points.
+
+Each `ProgramSpec` knows how to build (fn, abstract_args) pairs for
+`jax.make_jaxpr` — `jax.ShapeDtypeStruct` inputs wherever the entry point
+accepts them (an eval_shape-style trace: nothing solves, nothing big
+allocates, so the audit is CPU-deterministic and adds seconds, not
+minutes, to tier-1), with tiny CONCRETE host arrays only where an entry
+point requires trace-time concreteness (power-grid bounds, model
+closures). Shapes are deliberately small and mutually distinct from the
+telemetry sentinel capacity below.
+
+Registering a new program
+-------------------------
+Add a `ProgramSpec` to `_build_registry()`:
+
+    ProgramSpec(
+        name="my_family/my_program",       # stable, shows up in findings
+        family="my_family",
+        build_off=<() -> (fn, args)>,      # telemetry OFF (or N/A)
+        build_on=<() -> (fn, args)>,       # same program, recorder ON
+                                           # (omit when not wired)
+        scatter_free=True,                 # AIYA101 applies
+        stage_dtype="float32",             # AIYA102 stage declaration
+    )
+
+`build_off` must trace without devices beyond the default CPU backend;
+raise `ProgramUnavailable("reason")` for environment-dependent programs
+(e.g. the ring-sharded EGM sweep needs >= 2 mesh devices) — the run
+reports them as skipped instead of failing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, List, Optional, Tuple
+
+__all__ = [
+    "ProgramSpec",
+    "ProgramUnavailable",
+    "TELEMETRY_SENTINEL_CAPACITY",
+    "registered_programs",
+]
+
+# The recorder ring is traced at this capacity for the telemetry-noop
+# check. Prime and far from every registry shape dimension, so a
+# sentinel-sized dimension in a telemetry-off jaxpr can only be recorder
+# residue, never a model array.
+TELEMETRY_SENTINEL_CAPACITY = 193
+
+# Registry trace shapes (small: tracing cost only, nothing iterates).
+_NZ = 3     # income states
+_NA = 16    # asset gridpoints
+_T = 5      # transition horizon
+
+
+class ProgramUnavailable(RuntimeError):
+    """This program cannot be traced in the current environment (the run
+    records it as skipped, with this reason)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    name: str
+    family: str
+    build_off: Callable[[], tuple]
+    build_on: Optional[Callable[[], tuple]] = None
+    scatter_free: bool = False
+    stage_dtype: Optional[str] = None
+
+    @property
+    def supports_telemetry(self) -> bool:
+        return self.build_on is not None
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _f(shape=()):
+    import jax.numpy as jnp
+
+    return _sds(shape, jnp.float64)
+
+
+def _f32(shape=()):
+    import jax.numpy as jnp
+
+    return _sds(shape, jnp.float32)
+
+
+def _i32(shape=()):
+    import jax.numpy as jnp
+
+    return _sds(shape, jnp.int32)
+
+
+def _telemetry_cfg():
+    from aiyagari_tpu.config import TelemetryConfig
+
+    return TelemetryConfig(capacity=TELEMETRY_SENTINEL_CAPACITY)
+
+
+# -- builders ---------------------------------------------------------------
+# Each returns (fn, args) with fn closing over every static knob, so
+# jax.make_jaxpr(fn)(*args) is the whole trace recipe.
+
+
+def _egm_args(dtype_fn):
+    return (dtype_fn((_NZ, _NA)), dtype_fn((_NA,)), dtype_fn((_NZ,)),
+            dtype_fn((_NZ, _NZ)), dtype_fn(), dtype_fn(), dtype_fn(),
+            dtype_fn(), dtype_fn())
+
+
+def _build_egm(telemetry=None, ladder=None, dtype_fn=_f):
+    from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
+
+    def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
+        return solve_aiyagari_egm(C, a_grid, s, P, r, w, amin, sigma=sigma,
+                                  beta=beta, tol=1e-6, max_iter=50,
+                                  ladder=ladder, telemetry=telemetry)
+
+    return fn, _egm_args(dtype_fn)
+
+
+def _build_egm_labor(telemetry=None):
+    from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_labor
+
+    def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
+        return solve_aiyagari_egm_labor(
+            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta, psi=1.0,
+            eta=2.0, tol=1e-6, max_iter=50, telemetry=telemetry)
+
+    return fn, _egm_args(_f)
+
+
+def _build_vfi(telemetry=None):
+    from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
+
+    def fn(v, a_grid, s, P, r, w, sigma, beta):
+        return solve_aiyagari_vfi(v, a_grid, s, P, r, w, sigma=sigma,
+                                  beta=beta, tol=1e-6, max_iter=50,
+                                  telemetry=telemetry)
+
+    return fn, (_f((_NZ, _NA)), _f((_NA,)), _f((_NZ,)), _f((_NZ, _NZ)),
+                _f(), _f(), _f(), _f())
+
+
+def _build_distribution_step(backend: str):
+    from aiyagari_tpu.sim.distribution import distribution_step
+
+    def fn(mu, idx, w_lo, P):
+        return distribution_step(mu, idx, w_lo, P, backend=backend)
+
+    return fn, (_f((_NZ, _NA)), _i32((_NZ, _NA)), _f((_NZ, _NA)),
+                _f((_NZ, _NZ)))
+
+
+def _build_stationary(telemetry=None, pushforward: str = "auto"):
+    from aiyagari_tpu.sim.distribution import stationary_distribution
+
+    def fn(policy_k, a_grid, P):
+        return stationary_distribution(policy_k, a_grid, P, tol=1e-8,
+                                       max_iter=200, pushforward=pushforward,
+                                       telemetry=telemetry)
+
+    return fn, (_f((_NZ, _NA)), _f((_NA,)), _f((_NZ, _NZ)))
+
+
+def _build_egm_sharded(telemetry=None):
+    import jax
+
+    import numpy as np
+
+    if len(jax.devices()) < 2:
+        raise ProgramUnavailable(
+            "the ring-sharded EGM sweep needs a >= 2-device mesh (run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count=8, as "
+            "tier-1 does, to audit it on a CPU host)")
+    from aiyagari_tpu.parallel.mesh import GRID_AXIS, make_mesh
+    from aiyagari_tpu.solvers.egm_sharded import _egm_program
+    from aiyagari_tpu.utils.grids import power_grid
+
+    D = 2
+    na = 64  # big enough for the ring slab at capacity 2.0 on 2 devices
+    mesh = make_mesh((GRID_AXIS,), (D,), devices=np.array(jax.devices()[:D]))
+    grid = power_grid(0.0, 20.0, na, 2.0)
+    lo, hi = float(grid[0]), float(grid[-1])
+    run = _egm_program(mesh, GRID_AXIS, _NZ, na, lo, hi, 2.0, 2.0, 1,
+                       0.9, 0.96, 1e-6, 50, False, 0.0, "float64",
+                       None, None, telemetry)
+
+    def fn(C, a_grid, s, P, r, w, amin):
+        return run(C, a_grid, s, P, r, w, amin)
+
+    return fn, (_f((_NZ, na)), _f((na,)), _f((_NZ,)), _f((_NZ, _NZ)),
+                _f(), _f(), _f())
+
+
+def _build_ge_round():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiyagari_tpu.config import SolverConfig
+    from aiyagari_tpu.equilibrium.batched import excess_demand_batch
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+    model = aiyagari_preset(grid_size=_NA, dtype=jnp.float64)
+    solver = SolverConfig(method="egm", tol=1e-6, max_iter=50)
+
+    def fn(r_batch):
+        gap, _ = excess_demand_batch(model, r_batch, solver=solver,
+                                     dist_tol=1e-8, dist_max_iter=200)
+        return gap
+
+    return fn, (np.array([0.02, 0.03]),)
+
+
+def _build_transition_round():
+    from aiyagari_tpu.transition.path import transition_path_aggregates
+
+    def fn(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path, sigma_ext,
+           amin_path):
+        return transition_path_aggregates(
+            C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path, sigma_ext,
+            amin_path)
+
+    return fn, (_f((_NZ, _NA)), _f((_NZ, _NA)), _f((_NA,)), _f((_NZ,)),
+                _f((_NZ, _NZ)), _f((_T + 1,)), _f((_T,)), _f((_T,)),
+                _f((_T + 1,)), _f((_T,)))
+
+
+def _build_ks_step():
+    from aiyagari_tpu.sim.ks_distribution import distribution_capital_path
+
+    nk, nK = _NA, 4
+
+    def fn(k_opt, k_grid, K_grid, z_path, eps_trans, mu_init):
+        return distribution_capital_path(k_opt, k_grid, K_grid, z_path,
+                                         eps_trans, mu_init, T=_T)
+
+    return fn, (_f((4, nK, nk)), _f((nk,)), _f((nK,)), _i32((_T + 1,)),
+                _f((2, 2, 2, 2)), _f((2, nk)))
+
+
+def _build_registry() -> List[ProgramSpec]:
+    tele = _telemetry_cfg
+
+    def egm_f32_ladder():
+        from aiyagari_tpu.ops.precision import PrecisionLadderConfig
+
+        # Single-stage f32 ladder: the documented way to pin that a hot
+        # stage never silently upcasts (ops/precision.py docstring).
+        return PrecisionLadderConfig(stage_dtypes=("float32",),
+                                     matmul_precision=("default",))
+
+    return [
+        ProgramSpec(
+            name="egm/sweep", family="egm",
+            build_off=partial(_build_egm),
+            build_on=lambda: _build_egm(telemetry=tele()),
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="egm/sweep_f32_stage", family="egm",
+            build_off=lambda: _build_egm(ladder=egm_f32_ladder(),
+                                         dtype_fn=_f32),
+            stage_dtype="float32"),
+        ProgramSpec(
+            name="egm/sweep_labor", family="egm",
+            build_off=partial(_build_egm_labor),
+            build_on=lambda: _build_egm_labor(telemetry=tele()),
+            stage_dtype="float64"),
+        # solve_aiyagari_egm_safe is a host-level retry wrapper around the
+        # same device program (its docstring); the traced artifact IS
+        # egm/sweep, so "safe" needs no separate entry.
+        ProgramSpec(
+            name="egm/sweep_sharded", family="egm",
+            build_off=partial(_build_egm_sharded),
+            build_on=lambda: _build_egm_sharded(telemetry=tele()),
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="vfi/step", family="vfi",
+            build_off=partial(_build_vfi),
+            build_on=lambda: _build_vfi(telemetry=tele()),
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="distribution/step_scatter", family="distribution",
+            build_off=lambda: _build_distribution_step("scatter"),
+            scatter_free=False, stage_dtype="float64"),
+        ProgramSpec(
+            name="distribution/step_transpose", family="distribution",
+            build_off=lambda: _build_distribution_step("transpose"),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="distribution/step_banded", family="distribution",
+            build_off=lambda: _build_distribution_step("banded"),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="distribution/stationary", family="distribution",
+            build_off=partial(_build_stationary),
+            build_on=lambda: _build_stationary(telemetry=tele()),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="equilibrium/ge_round_batched", family="equilibrium",
+            build_off=_build_ge_round,
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="transition/round", family="transition",
+            build_off=_build_transition_round,
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="ks/distribution_step", family="ks",
+            build_off=_build_ks_step,
+            scatter_free=True, stage_dtype="float64"),
+    ]
+
+
+_REGISTRY: Optional[List[ProgramSpec]] = None
+
+
+def registered_programs(families: Optional[Tuple[str, ...]] = None
+                        ) -> List[ProgramSpec]:
+    """The kernel zoo (built once per process; builders stay lazy)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = _build_registry()
+    if families is None:
+        return list(_REGISTRY)
+    return [p for p in _REGISTRY if p.family in families]

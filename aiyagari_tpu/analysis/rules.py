@@ -1,0 +1,225 @@
+"""The declarative rule catalogue of the static-analysis layer.
+
+Every invariant this framework ships is stated here ONCE, as a `Rule`
+record with a stable id (`AIYA###`) — the jaxpr auditor
+(analysis/jaxpr_audit.py) and the source lint (analysis/lint.py) implement
+the checks, but the catalogue is the contract: rule ids are what `# noqa:`
+suppressions, the findings baseline, the CLI `--rules` filter, the ledger's
+per-rule counts, and the tier-1 adversarial fixtures all key on, so an id
+is never reused or renumbered.
+
+Numbering: AIYA1xx are jaxpr-level rules (checked on the traced program of
+every registered hot entry point, analysis/registry.py); AIYA2xx are
+source-level rules (checked on the package's AST). The split matters: a
+jaxpr rule certifies the COMPILED artifact (what actually runs on the
+chip), a source rule certifies the code discipline that keeps the
+artifacts auditable (e.g. the jax-0.4.x shim boundary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+__all__ = [
+    "CALLBACK_TAG_ATTR",
+    "CALLBACK_WHITELIST",
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule_by_name",
+    "findings_by_rule",
+]
+
+# Device callbacks that are ALLOWED inside hot loop bodies tag their host
+# function with this attribute (the value names the event stream). The
+# emitting module sets the dunder literally — no import of this package —
+# so the contract is the attribute name, stated here and at the emit site
+# (ops/pushforward._warn_fallback).
+CALLBACK_TAG_ATTR = "__aiyagari_callback_tag__"
+
+# The recognized tags. "pushforward-degradation" is the PR 6 counted
+# degradation event: an async, fire-and-forget jax.debug.callback that
+# increments a process metrics counter — the device program never blocks
+# on it, so it is a sanctioned exception to no-host-sync-in-loop.
+CALLBACK_WHITELIST = frozenset({"pushforward-degradation"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One checked invariant. `level` is "jaxpr" or "source"."""
+
+    id: str
+    name: str
+    level: str
+    description: str
+
+
+RULES: Tuple[Rule, ...] = (
+    Rule(
+        id="AIYA101",
+        name="no-scatter",
+        level="jaxpr",
+        description=(
+            "A program whose registry entry declares a scatter-free "
+            "DistributionBackend must contain no scatter-add primitive on "
+            "its unconditional hot path. Scatter-adds inside lax.cond "
+            "branches are the compiled-in validity fallback "
+            "(ops/pushforward.py) and are allowed."),
+    ),
+    Rule(
+        id="AIYA102",
+        name="no-precision-leak",
+        level="jaxpr",
+        description=(
+            "A declared-f32 ladder stage must contain no "
+            "convert_element_type to float64 (and a declared-f64 program "
+            "none to float32) — a silent cast defeats the mixed-precision "
+            "ladder's bandwidth win or its accuracy certificate "
+            "(ops/precision.py). Mixed-float-dtype dot_general operands "
+            "are flagged in every program."),
+    ),
+    Rule(
+        id="AIYA103",
+        name="no-host-sync-in-loop",
+        level="jaxpr",
+        description=(
+            "No io_callback / infeed / outfeed / untagged debug_callback "
+            "inside a while_loop or scan body: a host round trip per sweep "
+            "serializes the hot loop on the host link (~100 ms per trip on "
+            "this image's remote TPU transport). Callbacks whose host "
+            "function carries a whitelisted "
+            "__aiyagari_callback_tag__ (the counted degradation events) "
+            "are allowed."),
+    ),
+    Rule(
+        id="AIYA104",
+        name="telemetry-noop",
+        level="jaxpr",
+        description=(
+            "A telemetry-off trace must contain no recorder artifacts (no "
+            "ring-buffer-shaped value anywhere in the program), and the "
+            "telemetry-on trace of the same program must contain them — "
+            "the compile-time no-op contract of "
+            "diagnostics/telemetry.py, generalized from the PR 6 jaxpr "
+            "pin to every registered program."),
+    ),
+    Rule(
+        id="AIYA105",
+        name="dead-carry",
+        level="jaxpr",
+        description=(
+            "No while_loop carry slot that is written every iteration but "
+            "never read — not by the loop condition, not by any other "
+            "carry slot, and not by the enclosing program. A dead carry "
+            "pays HBM traffic per sweep for a value nobody observes."),
+    ),
+    Rule(
+        id="AIYA106",
+        name="stable-carry",
+        level="jaxpr",
+        description=(
+            "while_loop / scan carry leaves must have fixed shape/dtype "
+            "and must not be weak-typed: a weak-typed carry (a bare "
+            "Python scalar in the init) re-specializes the program "
+            "whenever the caller's literal changes — the silent recompile "
+            "hazard."),
+    ),
+    Rule(
+        id="AIYA201",
+        name="mesh-shim-discipline",
+        level="source",
+        description=(
+            "No direct jax.sharding / jax.experimental.shard_map imports "
+            "or attribute references outside parallel/mesh.py: jax is "
+            "pinned at 0.4.x here and every new-API symbol goes through "
+            "the one version-probe shim (ROADMAP discipline)."),
+    ),
+    Rule(
+        id="AIYA202",
+        name="no-host-scalar-in-hot-module",
+        level="source",
+        description=(
+            "In the hot modules (solvers/, ops/, sim/, transition/): no "
+            ".item() and no float()/int()/bool() of an indexed array — "
+            "each is an eager per-element device fetch (~100 ms per round "
+            "trip on the remote TPU transport; the _cached_grid_bounds / "
+            "_fetch_scalars batched-device_get pattern is the sanctioned "
+            "route). Host-side numpy after an explicit jax.device_get is "
+            "fine — suppress those lines with `# noqa: AIYA202`."),
+    ),
+    Rule(
+        id="AIYA203",
+        name="no-bare-debug-print",
+        level="source",
+        description=(
+            "No bare jax.debug.print: production signals route through "
+            "the counted degradation-event path (metrics counter + ledger "
+            "event, ops/pushforward._record_fallback); a debug print is "
+            "allowed only behind an opt-in env-gated flag (an enclosing "
+            "`if <...DEBUG...>:` guard, the AIYAGARI_DEBUG_* pattern)."),
+    ),
+)
+
+_BY_NAME = {r.name: r for r in RULES}
+_BY_ID = {r.id: r for r in RULES}
+
+
+def rule_by_name(key: str) -> Rule:
+    """Look a rule up by name ("no-scatter") or id ("AIYA101")."""
+    r = _BY_NAME.get(key) or _BY_ID.get(key)
+    if r is None:
+        known = ", ".join(f"{r.id}/{r.name}" for r in RULES)
+        raise KeyError(f"unknown rule {key!r}; known rules: {known}")
+    return r
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation. `where` is a program name (jaxpr level) or a
+    repo-relative path (source level); `line` is set for source findings.
+    `suppressed` marks findings neutralized by a `# noqa: AIYA###` comment
+    or a baseline entry — reported, but not counted against the gate.
+    `suppressed_by` records WHICH mechanism ("noqa" or "baseline"):
+    baseline regeneration must keep re-writing baseline-suppressed
+    findings (they still exist in the tree) while never importing noqa'd
+    ones."""
+
+    rule: Rule
+    where: str
+    message: str
+    line: Optional[int] = None
+    suppressed: bool = False
+    suppressed_by: Optional[str] = None
+
+    def location(self) -> str:
+        return f"{self.where}:{self.line}" if self.line else self.where
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule.id,
+            "name": self.rule.name,
+            "level": self.rule.level,
+            "where": self.where,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppressed_by": self.suppressed_by,
+        }
+
+    def baseline_key(self) -> str:
+        """The identity a baseline entry matches on. Line numbers are
+        deliberately excluded — unrelated edits above a known finding must
+        not un-baseline it."""
+        return f"{self.rule.id}:{self.where}"
+
+
+def findings_by_rule(findings) -> dict:
+    """{rule name: active (unsuppressed) count} over every catalogued rule
+    — the shape the ledger's `analysis` event and the metrics counters
+    record, zero-filled so a clean run still names each rule."""
+    counts = {r.name: 0 for r in RULES}
+    for f in findings:
+        if not f.suppressed:
+            counts[f.rule.name] += 1
+    return counts

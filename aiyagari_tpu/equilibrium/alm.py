@@ -295,10 +295,9 @@ def _solve_krusell_smith_impl(
 
             k_sharding = None
             if grid_mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec
+                from aiyagari_tpu.parallel.mesh import named_sharding
 
-                k_sharding = NamedSharding(grid_mesh,
-                                           PartitionSpec(None, None, "grid"))
+                k_sharding = named_sharding(grid_mesh, None, None, "grid")
             def _restore(name, sharding, cast):
                 # restore_array handles shard-exact placement, resharding,
                 # and device_put of plain entries when a sharding is given;

@@ -354,9 +354,9 @@ class _DistributionAggregator:
         # Single-process sharded arrays keep the GSPMD route untouched.
         policy_k = sol.policy_k
         if isinstance(policy_k, jax.Array) and not policy_k.is_fully_addressable:
-            from jax.sharding import NamedSharding, PartitionSpec
+            from aiyagari_tpu.parallel.mesh import named_sharding
 
-            rep = NamedSharding(policy_k.sharding.mesh, PartitionSpec())
+            rep = named_sharding(policy_k.sharding.mesh)
             policy_k = _replicate_program(rep)(policy_k)
 
         dist_sol = stationary_distribution(
@@ -425,9 +425,9 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
 
         warm_sharding = None
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
+            from aiyagari_tpu.parallel.mesh import named_sharding
 
-            warm_sharding = NamedSharding(mesh, PartitionSpec(None, "grid"))
+            warm_sharding = named_sharding(mesh, None, "grid")
         warm = restore_array(sc, arrays, "warm", sharding=warm_sharding,
                              dtype=np.dtype(str(jnp.dtype(model.dtype))))
         if isinstance(warm, np.ndarray):   # meshless restore stays host-side

@@ -20,7 +20,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
+    "Mesh",
+    "NamedSharding",
+    "PartitionSpec",
     "make_mesh",
+    "named_sharding",
     "agents_sharding",
     "grid_sharding",
     "scenarios_sharding",
@@ -30,6 +34,13 @@ __all__ = [
     "shard_panel",
     "force_host_device_count",
 ]
+
+# Mesh / NamedSharding / PartitionSpec are RE-EXPORTED on purpose: every
+# module outside this file imports sharding symbols from HERE (the
+# mesh-shim discipline, enforced by `python -m aiyagari_tpu.analysis`
+# rule AIYA201), so a jax upgrade that moves or renames them is a
+# one-file fix — the same contract shard_map's version probe below
+# already provides.
 
 AGENTS_AXIS = "agents"
 GRID_AXIS = "grid"
@@ -89,6 +100,14 @@ def make_mesh(axis_names: Sequence[str] = (AGENTS_AXIS,),
     return jax.make_mesh(
         tuple(axis_sizes), tuple(axis_names), devices=devices.ravel(), **kwargs
     )
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """NamedSharding(mesh, PartitionSpec(*spec)) — the one-liner every
+    ad-hoc placement (checkpoint restore shardings, replication of a
+    process-spanning policy) goes through instead of importing the raw
+    jax.sharding classes."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
 
 
 def agents_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:

@@ -161,9 +161,11 @@ def _check_grid_power(k_grid, grid_power: float) -> None:
             k_grid[jnp.asarray([0, 1, n // 2, n - 1])]))
     except jax.errors.TracerArrayConversionError:
         return    # inside someone else's jit: nothing concrete to probe
-    lo, hi = float(probes[0]), float(probes[-1])
+    # `probes` is HOST numpy (one batched device_get above) — these
+    # float()s index host memory, not the device.
+    lo, hi = float(probes[0]), float(probes[-1])     # noqa: AIYA202
     scale = max(abs(lo), abs(hi), 1.0)
-    for j, v in ((1, float(probes[1])), (n // 2, float(probes[2]))):
+    for j, v in ((1, float(probes[1])), (n // 2, float(probes[2]))):  # noqa: AIYA202
         want = lo + (hi - lo) * (j / (n - 1)) ** grid_power
         if abs(v - want) > 1e-4 * scale:
             raise ValueError(
@@ -251,7 +253,7 @@ def simulate_capital_paths_batch(k_opt, k_grid, K_grid, z_paths, eps_panels,
 def _shardmap_panel_fn(mesh, axis: str, grid_power: float = 0.0):
     """Build (and cache per mesh/axis, so repeated calls hit jit's trace
     cache instead of recompiling the scan) the shard_map panel program."""
-    from jax.sharding import PartitionSpec as P
+    from aiyagari_tpu.parallel.mesh import PartitionSpec as P
 
     def shard_body(k_opt, k_grid, K_grid, z_path, eps_local, k_pop_local):
         def gmean(x):

@@ -38,8 +38,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
-from jax.sharding import PartitionSpec as P
+from aiyagari_tpu.parallel.mesh import PartitionSpec as P, shard_map as _shard_map
 
 from aiyagari_tpu.diagnostics.telemetry import (
     telemetry_from_leaves,

@@ -51,8 +51,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from aiyagari_tpu.parallel.mesh import shard_map as _shard_map
-from jax.sharding import PartitionSpec as P
+from aiyagari_tpu.parallel.mesh import PartitionSpec as P, shard_map as _shard_map
 
 from aiyagari_tpu.ops.interp import masked_pchip_interp
 from aiyagari_tpu.parallel.halo import cached_program, mesh_fingerprint

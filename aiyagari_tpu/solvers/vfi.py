@@ -832,7 +832,13 @@ def solve_aiyagari_vfi_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
         )
     n_final = int(a_grid.shape[-1])
     dtype = a_grid.dtype
-    lo, hi = float(a_grid[0]), float(a_grid[-1])
+    # One batched fetch through the id-keyed cache instead of two eager
+    # per-element float() round trips (~100 ms each on the remote TPU
+    # transport — solvers/egm._cached_grid_bounds rationale; found by the
+    # AIYA202 lint).
+    from aiyagari_tpu.solvers.egm import _cached_grid_bounds
+
+    lo, hi = _cached_grid_bounds(a_grid)
     sizes = stage_sizes(n_final, coarsest, refine_factor)
 
     sol = None

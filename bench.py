@@ -1783,6 +1783,35 @@ def bench_ks_fine(quick: bool, k_size: int = 1000, method: str = "egm") -> dict:
     }
 
 
+def bench_analysis() -> dict:
+    """Static-analysis gate (ISSUE 9): the same run as `python -m
+    aiyagari_tpu.analysis --format json`, in-process (the battery already
+    paid the jax import). The record's `value` is the ACTIVE finding count
+    — 0 on a healthy tree, gated at exactly 0 by tests/test_bench_ci.py —
+    and the per-rule counts ride along so a regression names its rule in
+    the artifact. When the battery runs with --ledger, run_analysis also
+    emits the `analysis` ledger event (per-rule counts) on the active run
+    ledger."""
+    import time
+
+    from aiyagari_tpu.analysis import run_analysis
+
+    t0 = time.perf_counter()
+    report = run_analysis()
+    wall = time.perf_counter() - t0
+    return {
+        "metric": "static_analysis_findings",
+        "value": float(report.active_count),
+        "unit": "findings",
+        "rule_counts": report.rule_counts(),
+        "programs_audited": len(report.programs_audited),
+        "programs_skipped": [n for n, _ in report.programs_skipped],
+        "files_linted": report.files_linted,
+        "suppressed_findings": len(report.findings) - report.active_count,
+        "wall_seconds": round(wall, 3),
+    }
+
+
 def _run_in_child(timeout_s: float) -> int | None:
     """Re-exec this benchmark in a child process with a hard timeout and relay
     its JSON line. Returns the exit code, or None if the child timed out or
@@ -1871,7 +1900,7 @@ def main() -> int:
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
                              "scale", "scale_vfi", "ge", "sweep",
                              "transition", "accel", "precision",
-                             "pushforward", "telemetry"],
+                             "pushforward", "telemetry", "analysis"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -1990,6 +2019,7 @@ def main() -> int:
         "precision": lambda: bench_precision(args.quick),
         "pushforward": lambda: bench_pushforward(args.quick),
         "telemetry": lambda: bench_telemetry(args.grid, args.quick),
+        "analysis": lambda: bench_analysis(),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
     # first: it is BASELINE.json's primary metric and must be the first line
@@ -2000,8 +2030,11 @@ def main() -> int:
     if args.preset == "ci":
         # An explicit --metric narrows the ci battery to that one metric
         # (still at ci sizes) instead of being silently ignored.
+        # "analysis" last: it audits the same programs the battery just
+        # exercised, and a perf metric dying mid-battery should not also
+        # cost the static gate its record.
         names = (("vfi", "scale", "ge", "sweep", "transition", "accel",
-                  "precision", "pushforward", "telemetry")
+                  "precision", "pushforward", "telemetry", "analysis")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",

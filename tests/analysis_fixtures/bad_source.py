@@ -1,0 +1,23 @@
+"""Adversarial source file for the lint level (tests/test_static_analysis.py).
+
+Every statement below violates exactly one source rule (AIYA2xx); the
+trailing block demonstrates the `# noqa:` suppression syntax. The file is
+only ever READ by the lint — never imported (it does not match test_*.py,
+so pytest never collects it either).
+"""
+
+import jax  # noqa: F401  (fixture: keep the attribute chains realistic)
+from jax.sharding import PartitionSpec  # AIYA201: direct sharding import
+
+
+def leaky(a_grid, dist):
+    lo = float(a_grid[0])          # AIYA202: eager per-element fetch
+    tol = dist.item()              # AIYA202: .item() device sync
+    jax.debug.print("lo={}", lo)   # AIYA203: bare debug print
+    spec = jax.sharding.PartitionSpec()   # AIYA201: direct attribute chain
+    return lo, tol, spec, PartitionSpec
+
+
+def deliberate(host_probes):
+    # Host numpy after an explicit device_get — the sanctioned suppression.
+    return float(host_probes[0])   # noqa: AIYA202

@@ -1,0 +1,113 @@
+"""Adversarial programs for the jaxpr auditor (tests/test_static_analysis.py).
+
+Each function is a minimal traceable program engineered to violate EXACTLY
+ONE jaxpr-level rule (analysis/rules.py AIYA1xx) — the tier-1 tests pin
+both that the rule fires on it and that NO OTHER rule cross-fires, so a
+rule implementation that over-matches breaks loudly here before it breaks
+a real audit.
+
+Loaded by the test via importlib (the file deliberately does not match
+test_*.py); never imported by the package.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+# -- AIYA101: an unguarded scatter on the hot path -------------------------
+
+def scatter_program(mu, idx, w_lo, P):
+    """The pre-PR 5 reference formulation, registered as scatter-free: the
+    `.at[].add` lottery with no validity fallback around it."""
+    rows = jnp.broadcast_to(jnp.arange(mu.shape[0])[:, None], mu.shape)
+    out = jnp.zeros_like(mu)
+    out = out.at[rows, idx].add(mu * w_lo)
+    out = out.at[rows, idx + 1].add(mu * (1.0 - w_lo))
+    return jnp.matmul(P.T, out, precision=jax.lax.Precision.HIGHEST)
+
+
+# -- AIYA102: an f64 leak inside a declared-f32 stage ----------------------
+
+def precision_leak_program(C, P):
+    """Declared float32 stage that silently upcasts its expectation to
+    float64 mid-sweep (and casts back, hiding the leak from the caller)."""
+    ev = jnp.matmul(P.astype(jnp.float64), C.astype(jnp.float64))
+    return (C + ev.astype(jnp.float32)) * 0.5
+
+
+# -- AIYA103: a host callback inside the hot loop --------------------------
+
+def _untagged_callback(x):  # pragma: no cover - never actually invoked
+    pass
+
+
+def host_sync_program(x):
+    """A per-sweep debug callback with NO __aiyagari_callback_tag__."""
+
+    def body(c):
+        jax.debug.callback(_untagged_callback, c, ordered=False)
+        return c - 1.0
+
+    return jax.lax.while_loop(lambda c: c > 0.0, body, x)
+
+
+def _tagged_callback(x):  # pragma: no cover - never actually invoked
+    pass
+
+
+_tagged_callback.__aiyagari_callback_tag__ = "pushforward-degradation"
+
+
+def host_sync_tagged_program(x):
+    """The same loop with the whitelisted degradation-event tag — must be
+    CLEAN (the ops/pushforward._record_fallback contract)."""
+
+    def body(c):
+        jax.debug.callback(_tagged_callback, c, ordered=False)
+        return c - 1.0
+
+    return jax.lax.while_loop(lambda c: c > 0.0, body, x)
+
+
+# -- AIYA104: telemetry that does not compile out --------------------------
+
+def telemetry_leak_program(x, capacity: int):
+    """Carries a ring buffer UNCONDITIONALLY — the recorder-off trace still
+    contains the capacity-shaped value, which is exactly the regression the
+    telemetry-noop rule exists to catch."""
+    ring = jnp.zeros((capacity,), jnp.float32) + x.astype(jnp.float32)
+    return x * 2.0, ring
+
+
+def telemetry_unwired_program(x):
+    """A 'telemetry-on' build that carries NO ring at all: the wiring-broken
+    direction of the telemetry-noop check."""
+    return x * 2.0
+
+
+# -- AIYA105: a dead while-loop carry --------------------------------------
+
+def dead_carry_program(x):
+    """Carries `junk`, rewritten every sweep, read by nothing: not the
+    condition, not another slot, and the caller drops it."""
+
+    def body(c):
+        i, y, junk = c
+        return i + 1, y * 0.5, junk + y
+
+    def cond(c):
+        return c[0] < 10
+
+    _, y_final, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), x, jnp.zeros_like(x)))
+    return y_final
+
+
+# -- AIYA106: a weak-typed carry -------------------------------------------
+
+def weak_carry_program(x):
+    """Bare Python-float carry init: the weak-typed-carry recompile hazard."""
+    return jax.lax.while_loop(
+        lambda c: c[0] < 3.0,
+        lambda c: (c[0] + 1.0, c[1] + jnp.sum(x)),
+        (0.0, 0.0))

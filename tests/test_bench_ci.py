@@ -19,7 +19,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
-              "precision", "pushforward", "telemetry")
+              "precision", "pushforward", "telemetry", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -43,14 +43,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-5]
+    tr = records[-6]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-4]
+    ac = records[-5]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -64,7 +64,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-3]
+    pr = records[-4]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -88,7 +88,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-2]
+    pw = records[-3]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -122,7 +122,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-1]
+    tm = records[-2]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -131,6 +131,18 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         lo = tm["loops"][loop]
         assert lo["wall_on_s"] > 0 and lo["wall_off_s"] > 0, tm
         assert lo["wall_on_s"] <= 1.5 * lo["wall_off_s"], tm
+    # The analysis record carries the ISSUE 9 acceptance gate: the static
+    # analyzer ran over the kernel zoo + source tree and found NOTHING —
+    # a scatter regression, a precision leak, a host sync in a loop, a
+    # direct jax.sharding import, or a broken telemetry no-op all land
+    # HERE as a nonzero finding count, with the offending rule named in
+    # rule_counts.
+    an = records[-1]
+    assert an["metric"] == "static_analysis_findings"
+    assert an["value"] == 0, an
+    assert all(v == 0 for v in an["rule_counts"].values()), an
+    assert an["programs_audited"] >= 11
+    assert an["files_linted"] > 50
     # Every metric record also landed in the run ledger, and the ledger
     # JSONL round-trips (read_ledger parses every line back).
     from aiyagari_tpu.diagnostics.ledger import read_ledger
@@ -141,5 +153,12 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert len(metric_events) == len(CI_METRICS)
     assert [e["metric"] for e in metric_events] == [r["metric"]
                                                     for r in records]
+    # run_analysis also emitted its own `analysis` event (per-rule counts)
+    # on the active ledger — the ISSUE 9 observability satellite.
+    analysis_events = [e for e in events if e["kind"] == "analysis"]
+    assert len(analysis_events) == 1
+    assert analysis_events[0]["findings"] == 0
+    assert set(analysis_events[0]["rules"]) >= {"no-scatter",
+                                                "mesh-shim-discipline"}
     # One shared run id stamps every event of this run.
     assert len({e["run_id"] for e in events}) == 1
