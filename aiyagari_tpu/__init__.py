@@ -12,7 +12,10 @@ Primary entry point: solve(model_config, method=..., backend=...).
 
 from aiyagari_tpu.config import (
     AccelConfig,
+    FaultPlan,
     PrecisionLadderConfig,
+    RescueConfig,
+    SentinelConfig,
     ALMConfig,
     AiyagariConfig,
     BackendConfig,
@@ -85,6 +88,9 @@ __all__ = [
     "PrecisionLadderConfig",
     "SolverConfig",
     "TelemetryConfig",
+    "SentinelConfig",
+    "FaultPlan",
+    "RescueConfig",
     "SimConfig",
     "EquilibriumConfig",
     "ALMConfig",

@@ -450,6 +450,71 @@ def _check_dead_carry(jaxpr, program: str) -> List[Finding]:
     return out
 
 
+def _check_nan_exit(jaxpr, program: str) -> List[Finding]:
+    """AIYA107: every residual while_loop's cond must exit on a non-finite
+    residual. Certified by CONCRETE evaluation, not pattern matching: the
+    cond sub-jaxpr is a tiny pure function, so it is executed once with
+    every float carry input NaN (loop-invariant/const inputs finite 1.0,
+    integer inputs 0 for carries — counters start there — and a large
+    value for consts, so an `it < max_iter` guard stays True and cannot
+    mask the NaN question; bools False for carries / True for consts, the
+    keep-running direction). A True output means a NaN-poisoned iterate
+    would keep the loop running — the burn-max_iter-on-garbage failure the
+    resilience layer exists to prevent. Conds reading no float carry
+    (fixed-count loops) are exempt; conds the evaluator cannot execute
+    (exotic primitives) are skipped conservatively."""
+    import numpy as np
+
+    import jax
+
+    rule = rule_by_name("nan-exit")
+    out: List[Finding] = []
+    for eqn, ctx in walk_jaxpr(jaxpr):
+        if eqn.primitive.name != "while":
+            continue
+        closed = eqn.params["cond_jaxpr"]
+        cjx = closed.jaxpr
+        n_consts = eqn.params.get("cond_nconsts", 0)
+        used = _used_invar_slots(cjx, n_consts)
+        float_read = any(
+            np.issubdtype(np.dtype(cjx.invars[n_consts + i].aval.dtype),
+                          np.floating)
+            for i in used
+            if n_consts + i < len(cjx.invars))
+        if not float_read:
+            continue
+        args = []
+        for k, v in enumerate(cjx.invars):
+            aval = v.aval
+            dt = np.dtype(aval.dtype)
+            shape = tuple(getattr(aval, "shape", ()))
+            const = k < n_consts
+            if np.issubdtype(dt, np.floating):
+                val = np.ones(shape, dt) if const else np.full(shape, np.nan,
+                                                               dt)
+            elif dt == np.bool_:
+                val = np.full(shape, const)
+            elif np.issubdtype(dt, np.integer):
+                val = np.full(shape, 2 ** 20 if const else 0, dt)
+            else:
+                val = np.zeros(shape, dt)
+            args.append(val)
+        try:
+            res = jax.core.eval_jaxpr(cjx, closed.consts, *args)
+        except Exception:   # pragma: no cover - un-evaluable cond: skip
+            continue
+        if res and bool(np.any(np.asarray(res[0]))):
+            out.append(Finding(
+                rule, program,
+                "while_loop condition stays True when every float carry "
+                "input is NaN (at "
+                f"{ctx.describe()}): a NaN-poisoned iterate runs to "
+                "max_iter instead of early-exiting; write the residual "
+                "test as `dist >= tol` (NaN-exiting) or carry the "
+                "failure sentinel (diagnostics/sentinel.py)"))
+    return out
+
+
 def _check_stable_carry(jaxpr, program: str) -> List[Finding]:
     rule = rule_by_name("stable-carry")
     out = []
@@ -513,6 +578,8 @@ def audit_closed_jaxpr(closed, program: str, *, scatter_free: bool = False,
         findings += _check_dead_carry(jaxpr, program)
     if want("stable-carry"):
         findings += _check_stable_carry(jaxpr, program)
+    if want("nan-exit"):
+        findings += _check_nan_exit(jaxpr, program)
     return findings
 
 

@@ -115,15 +115,22 @@ def _egm_args(dtype_fn):
             dtype_fn(), dtype_fn())
 
 
-def _build_egm(telemetry=None, ladder=None, dtype_fn=_f):
+def _build_egm(telemetry=None, ladder=None, dtype_fn=_f, sentinel=None):
     from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
 
     def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
         return solve_aiyagari_egm(C, a_grid, s, P, r, w, amin, sigma=sigma,
                                   beta=beta, tol=1e-6, max_iter=50,
-                                  ladder=ladder, telemetry=telemetry)
+                                  ladder=ladder, telemetry=telemetry,
+                                  sentinel=sentinel)
 
     return fn, _egm_args(dtype_fn)
+
+
+def _sentinel_cfg():
+    from aiyagari_tpu.config import SentinelConfig
+
+    return SentinelConfig()
 
 
 def _build_egm_labor(telemetry=None):
@@ -268,6 +275,19 @@ def _build_registry() -> List[ProgramSpec]:
             build_off=lambda: _build_egm(ladder=egm_f32_ladder(),
                                          dtype_fn=_f32),
             stage_dtype="float32"),
+        # The sentinel-carrying sweep is its own audited artifact: the
+        # failure sentinel changes the loop CONDITION (verdict == 0 ANDed
+        # in), so AIYA107 must certify the sentinel route NaN-exits too,
+        # and the dead-carry/stable-carry rules must accept the sentinel
+        # state slots (ISSUE 10 satellite). stage_dtype stays undeclared:
+        # the sentinel watches residuals in f32 REGARDLESS of the solve
+        # dtype (diagnostics/sentinel.py _DT — the same cross-stage-
+        # boundary rationale as the telemetry ring), which is a sanctioned
+        # diagnostic cast, not a precision leak; AIYA102 coverage of this
+        # operator lives on the sentinel-free egm/sweep entries.
+        ProgramSpec(
+            name="egm/sweep_sentinel", family="egm",
+            build_off=lambda: _build_egm(sentinel=_sentinel_cfg())),
         ProgramSpec(
             name="egm/sweep_labor", family="egm",
             build_off=partial(_build_egm_labor),

@@ -126,6 +126,23 @@ RULES: Tuple[Rule, ...] = (
             "hazard."),
     ),
     Rule(
+        id="AIYA107",
+        name="nan-exit",
+        level="jaxpr",
+        description=(
+            "Every while_loop whose condition reads a floating-point "
+            "carry slot (a residual loop) must EXIT when those slots go "
+            "non-finite: the condition, evaluated concretely with every "
+            "float carry input NaN (loop-invariant inputs finite, "
+            "iteration counters mid-range), must return False. This "
+            "certifies the NaN early-exit contract structurally — a "
+            "condition written `~(dist < tol)` keeps a NaN-poisoned "
+            "solve iterating to max_iter on garbage; `dist >= tol` (the "
+            "framework's discipline) and the sentinel-carrying conds "
+            "(diagnostics/sentinel.py) both exit. Fixed-count loops "
+            "(integer-only conditions) are exempt."),
+    ),
+    Rule(
         id="AIYA201",
         name="mesh-shim-discipline",
         level="source",

@@ -30,6 +30,9 @@ __all__ = [
     "AccelConfig",
     "PrecisionLadderConfig",
     "TelemetryConfig",
+    "SentinelConfig",
+    "FaultPlan",
+    "RescueConfig",
     "SolverConfig",
     "SimConfig",
     "EquilibriumConfig",
@@ -232,6 +235,113 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SentinelConfig:
+    """Device-resident failure sentinel for the hot fixed-point loops
+    (diagnostics/sentinel.py): a tiny state pytree carried INSIDE each
+    lax.while_loop that watches the per-sweep residual for non-finite
+    values, stalls, and explosions, and EARLY-EXITS the loop with a
+    structured verdict ("nan" | "stall" | "explode" | "escape") instead of
+    letting a poisoned or stuck solve burn `max_iter` sweeps on garbage.
+
+    Opt-in via SolverConfig(sentinel=SentinelConfig(...)). None (the
+    default) compiles the sentinel OUT entirely — the loop condition and
+    carry trace to the exact pre-sentinel program (the TelemetryConfig
+    zero-cost discipline; pinned by tests/test_resilience.py jaxpr
+    assertions). The host-side outer loops (GE bisection rounds, transition
+    Newton rounds) apply the same thresholds through
+    diagnostics/sentinel.host_verdict when the sentinel is set.
+
+    stall_window: sweeps without a new best residual before the "stall"
+    verdict fires (a healthy geometric decay sets a new best nearly every
+    sweep, so slow-but-converging solves never trip it; a limit cycle or a
+    flat tail does). explode_factor: a residual this many times the FIRST
+    recorded residual fires "explode" (divergent operators grow
+    geometrically, so the default 1e6 is conservative and unreachable by
+    Anderson's transient safeguard spikes). Frozen/hashable — a jit static
+    arg like TelemetryConfig.
+    """
+
+    stall_window: int = 50
+    explode_factor: float = 1e6
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Deterministic fault injection for the resilience machinery
+    (diagnostics/faults.py): every field is an opt-in injection point that
+    compiles IN a specific, reproducible failure so the recovery path that
+    handles it is exercised by CI rather than trusted. The default plan is
+    entirely off and every helper is a compile-time no-op for it — but the
+    intended usage is passing a NON-default plan explicitly via
+    SolverConfig(faults=FaultPlan(...)); production configs never set it.
+
+    Injection points (the catalogue docs/USAGE.md documents):
+      nan_sweep        — poison the solver iterate with NaN at this sweep
+                         (0-based) inside the EGM/VFI/distribution loops;
+                         -1 = off. Exercises the sentinel "nan" verdict and
+                         the loop's NaN early-exit contract.
+      force_escape     — force the EGM windowed-inversion escape (NaN
+                         poisoning + escaped=True) on every sweep.
+                         Exercises the "escape" verdict and the safe-route
+                         retry wrappers.
+      force_fallback   — force the push-forward plan validity flag false so
+                         every distribution sweep takes the compiled-in
+                         scatter fallback. Exercises the degradation
+                         counter/ledger path.
+      poison_scenario  — NaN one scenario's preferences in a
+                         dispatch.sweep()/sweep_transitions batch; -1 =
+                         off. Exercises scenario quarantine.
+      fail_stage       — comma-separated rescue-ladder stage names the
+                         rescue driver must treat as failed without
+                         running. Exercises multi-stage escalation and the
+                         attempt-history-carrying exhaustion error.
+
+    The rescue ladder clears `faults` on every rescue stage (a rescue
+    attempt re-runs the operator fresh — the injected fault models a
+    route/data pathology the escalation replaces), EXCEPT `fail_stage`,
+    which targets the driver itself. Frozen/hashable (jit static).
+    """
+
+    nan_sweep: int = -1
+    force_escape: bool = False
+    force_fallback: bool = False
+    poison_scenario: int = -1
+    fail_stage: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class RescueConfig:
+    """Host-side rescue ladder for failed solves (diagnostics/rescue.py):
+    when the base attempt fails — non-convergence under policy "raise", a
+    NaN-poisoned result, a diverged transition path — dispatch re-runs the
+    solve through a bounded escalation of progressively more conservative
+    configurations, returning the FIRST converged result or raising a
+    ConvergenceError that carries the full attempt history.
+
+    stages (each built from the BASE config, not cumulative state):
+      "plain"   — acceleration and the fused Pallas routes disabled (the
+                  reference first-order trajectory; injected faults
+                  cleared, as on every rescue stage).
+      "safe"    — plain + the scatter push-forward reference backend; for
+                  transition solves also the Jacobian-free damped update.
+      "float64" — safe + the mixed-precision ladder bypassed and the
+                  backend pinned to full f64 (rules out every low-precision
+                  pathology).
+      "patient" — float64 + doubled iteration caps (inner and outer) and,
+                  for transitions, halved damping — the last-resort
+                  slow-but-steady configuration.
+
+    Opt-in via dispatch.solve/sweep/solve_transition/sweep_transitions
+    (rescue=RescueConfig()). Every attempt emits a ledger "rescue" event
+    and an aiyagari_rescue_attempts_total{stage=...} metrics increment.
+    With a rescue ladder attached the exhaustion behavior is always a
+    raise (the ladder replaces the warn/ignore policies: a result that
+    survived it is converged, anything else is loud)."""
+
+    stages: Tuple[str, ...] = ("plain", "safe", "float64", "patient")
+
+
+@dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Inner household-solver controls.
 
@@ -299,6 +409,19 @@ class SolverConfig:
                                       # default) compiles the recorder out
                                       # — the hot paths are bit-identical
                                       # and pay zero bytes
+    sentinel: Optional[SentinelConfig] = None
+                                      # device-resident failure sentinel
+                                      # (SentinelConfig docstring): stall /
+                                      # explosion / non-finite detection in
+                                      # the hot while_loop carries with a
+                                      # structured early-exit verdict on
+                                      # Solution.sentinel. None (the
+                                      # default) compiles it out — loop
+                                      # cond and carry are bit-identical
+    faults: Optional[FaultPlan] = None
+                                      # deterministic fault injection
+                                      # (FaultPlan docstring) — CI/test
+                                      # harness only, never production
 
 
 @dataclasses.dataclass(frozen=True)

@@ -37,4 +37,7 @@ __all__ = [
     #   diagnostics.trace     — nested wall-clock spans
     #   diagnostics.metrics   — process-wide counter/gauge/histogram registry
     #   diagnostics.health    — health certificates + report CLI
+    #   diagnostics.sentinel  — device-resident failure sentinels
+    #   diagnostics.faults    — deterministic fault injection (CI harness)
+    #   diagnostics.rescue    — the host-side rescue ladder
 ]

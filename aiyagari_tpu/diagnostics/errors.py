@@ -8,10 +8,20 @@ stopped and how far from tolerance it was, so callers (and resumed runs) can
 act on it. The default policy stays reference-faithful ("warn" and return the
 last iterate); "raise" upgrades the guard to a hard failure for CI and
 unattended runs.
+
+Non-finite distances are their own verdict: a NaN distance fails `< tol`
+silently, so before this fix a NaN-poisoned solve under policy "warn" looked
+identical to an ordinary iteration-cap miss — and under "ignore" it was
+entirely silent. A non-finite distance now always reports as verdict "nan"
+and is ALWAYS loud: it warns even under "ignore" and even when the caller's
+`converged` flag claims success (a converged flag computed from a criterion
+the NaN also slipped through), and raises under "raise". Zero silent NaN
+results is the resilience contract (ISSUE 10).
 """
 
 from __future__ import annotations
 
+import math
 import warnings
 
 __all__ = ["ConvergenceError", "ConvergenceWarning", "enforce_convergence"]
@@ -20,56 +30,83 @@ _POLICIES = ("ignore", "warn", "raise")
 
 
 class ConvergenceWarning(UserWarning):
-    """A fixed point hit its iteration cap; the returned result is the last
-    iterate, not a converged one."""
+    """A fixed point hit its iteration cap (or reported a non-finite
+    distance); the returned result is the last iterate, not a converged
+    one."""
 
 
 class ConvergenceError(RuntimeError):
-    """A fixed point hit its iteration cap under policy='raise'.
+    """A fixed point failed under policy='raise' (or exhausted a rescue
+    ladder).
 
     Attributes carry the loop's final state so the failure is diagnosable
     and resumable without re-running: `context` names the loop, `iterations`
     how many steps ran, `distance` the last convergence measure against
-    `tol`, `detail` any loop-specific extras (e.g. the r-bracket or the
-    ALM coefficient step), and `telemetry` the loop's final SolveTelemetry
-    flight record (diagnostics/telemetry.py) when the solve carried one —
-    the residual trajectory that says WHY the cap was hit (stall vs slow
-    geometric decay vs oscillation), attached so policy='raise' failures
-    ship their own diagnosis.
+    `tol`, `verdict` the structured failure class ("max_iter" for an
+    ordinary cap miss, "nan" for a non-finite distance, or a sentinel
+    verdict like "stall"/"explode"/"escape" when the caller supplies one),
+    `detail` any loop-specific extras (e.g. the r-bracket or the ALM
+    coefficient step), `telemetry` the loop's final SolveTelemetry flight
+    record (diagnostics/telemetry.py) when the solve carried one — the
+    residual trajectory that says WHY the cap was hit — and `attempts` the
+    full rescue-ladder attempt history (a list of
+    diagnostics.rescue.RescueAttempt) when a rescue ladder exhausted
+    itself raising this error.
     """
 
     def __init__(self, context: str, *, iterations: int, distance: float,
-                 tol: float, detail: dict | None = None, telemetry=None):
+                 tol: float, detail: dict | None = None, telemetry=None,
+                 verdict: str | None = None, attempts=None):
         self.context = context
         self.iterations = int(iterations)
         self.distance = float(distance)
         self.tol = float(tol)
         self.detail = dict(detail or {})
         self.telemetry = telemetry
+        self.attempts = list(attempts) if attempts is not None else []
+        if verdict is None:
+            verdict = "nan" if not math.isfinite(self.distance) else "max_iter"
+        self.verdict = verdict
         extra = f" ({', '.join(f'{k}={v}' for k, v in self.detail.items())})" if self.detail else ""
+        stages = (f"; rescue ladder exhausted after {len(self.attempts)} "
+                  f"attempt(s): {[a.stage for a in self.attempts]}"
+                  if self.attempts else "")
         super().__init__(
-            f"{context}: no convergence after {self.iterations} iterations; "
+            f"{context}: no convergence after {self.iterations} iterations "
+            f"[verdict={self.verdict}]; "
             f"last distance {self.distance:.3e} vs tol {self.tol:.1e}{extra}"
+            f"{stages}"
         )
 
 
 def enforce_convergence(converged: bool, policy: str, context: str, *,
                         iterations: int, distance: float, tol: float,
-                        detail: dict | None = None, telemetry=None) -> None:
+                        detail: dict | None = None, telemetry=None,
+                        verdict: str | None = None) -> None:
     """Apply a non-convergence policy: no-op when converged or
     policy='ignore'; emit ConvergenceWarning for 'warn' (the reference's
     behavior, made typed); raise ConvergenceError for 'raise', carrying
-    `telemetry` (the loop's flight record, when one exists) on the error."""
+    `telemetry` (the loop's flight record, when one exists) on the error.
+
+    Non-finite `distance` is the explicit "nan" verdict and is ALWAYS loud
+    (module docstring): it overrides a True `converged` flag, warns under
+    'ignore' and 'warn', and raises under 'raise'. `verdict` lets outer
+    loops carrying a sentinel verdict ("stall"/"explode"/"escape") name
+    the failure class; it defaults from the distance."""
     if policy not in _POLICIES:
         raise ValueError(f"unknown on_nonconvergence policy {policy!r}; expected one of {_POLICIES}")
-    if converged or policy == "ignore":
+    nonfinite = not math.isfinite(float(distance))
+    if nonfinite and verdict is None:
+        verdict = "nan"
+    if (converged and not nonfinite) or (policy == "ignore" and not nonfinite):
         return
     if policy == "raise":
         raise ConvergenceError(context, iterations=iterations, distance=distance,
-                               tol=tol, detail=detail, telemetry=telemetry)
+                               tol=tol, detail=detail, telemetry=telemetry,
+                               verdict=verdict)
     warnings.warn(
         str(ConvergenceError(context, iterations=iterations, distance=distance,
-                             tol=tol, detail=detail)),
+                             tol=tol, detail=detail, verdict=verdict)),
         ConvergenceWarning,
         stacklevel=3,
     )

@@ -1,0 +1,94 @@
+"""Deterministic fault injection for the resilience machinery.
+
+Every recovery path this framework ships — the sentinel verdicts, the
+NaN-exiting loop conds, the escape retries, the push-forward fallback
+counting, scenario quarantine, the rescue ladder — is a path that NEVER
+runs on healthy inputs, which means CI would never exercise it and it
+would rot silently. This module is the antidote: a catalogue of opt-in,
+compile-time injection points (`config.FaultPlan`) that produce a
+specific, reproducible failure exactly where the corresponding recovery
+path watches for one.
+
+Design constraints (and why the helpers look the way they do):
+
+  * Injections must reach INSIDE jit-compiled while-loop bodies without
+    breaking the jit cache — so the plan is a frozen/hashable dataclass
+    threaded as a STATIC argument through the same plumbing as
+    TelemetryConfig (`SolverConfig(faults=...)`), never a mutable global a
+    cached trace could go stale against.
+  * A `None` (or default) plan must be a compile-time no-op: every helper
+    returns its inputs unchanged, so production programs are bit-identical
+    to a tree with no fault module at all.
+  * Injections are deterministic: `nan_sweep=k` poisons sweep k every run;
+    there is no randomness to make a recovery test flake.
+
+The injection-point catalogue lives on the FaultPlan docstring
+(aiyagari_tpu/config.py) and in docs/USAGE.md; bench.py's
+`--metric resilience` battery drives every point through its recovery
+path and tests/test_bench_ci.py gates 100% recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiyagari_tpu.config import FaultPlan
+
+__all__ = [
+    "FaultPlan",
+    "poison_iterate",
+    "force_escape_point",
+    "forces_fallback",
+    "poison_scenario_index",
+    "stage_fails",
+]
+
+
+def _off(plan: Optional[FaultPlan]) -> bool:
+    return plan is None
+
+
+def poison_iterate(plan: Optional[FaultPlan], x, it):
+    """Inject NaN into a solver iterate at sweep `plan.nan_sweep` (traced
+    counter `it`, 0-based). Compile-time no-op unless the plan sets
+    nan_sweep >= 0 — the traced program is unchanged."""
+    if _off(plan) or plan.nan_sweep < 0:
+        return x
+    import jax.numpy as jnp
+
+    return jnp.where(jnp.asarray(it) == plan.nan_sweep, jnp.nan, x)
+
+
+def force_escape_point(plan: Optional[FaultPlan], x, escaped):
+    """Force the EGM windowed-inversion escape contract: the iterate is
+    NaN-poisoned AND the escape flag raised, exactly as
+    ops/interp.inverse_interp_power_grid does when its static windows
+    cannot cover the knot density. Compile-time no-op unless forced."""
+    if _off(plan) or not plan.force_escape:
+        return x, escaped
+    import jax.numpy as jnp
+
+    return jnp.full_like(x, jnp.nan), jnp.ones_like(escaped)
+
+
+def forces_fallback(plan: Optional[FaultPlan]) -> bool:
+    """Trace-time switch: should the distribution loop's push-forward plan
+    be forced invalid (every sweep takes the compiled-in scatter fallback
+    and tallies a degradation)?"""
+    return not _off(plan) and plan.force_fallback
+
+
+def poison_scenario_index(plan: Optional[FaultPlan]) -> Optional[int]:
+    """The scenario index a sweep batch should poison (host-level: the
+    stacked preference operand is NaN'd for that lane), or None."""
+    if _off(plan) or plan.poison_scenario < 0:
+        return None
+    return int(plan.poison_scenario)
+
+
+def stage_fails(plan: Optional[FaultPlan], stage: str) -> bool:
+    """Should the rescue driver treat this ladder stage as failed without
+    running it? (`fail_stage` is a comma-separated stage-name list.)"""
+    if _off(plan) or not plan.fail_stage:
+        return False
+    return stage in {s.strip() for s in plan.fail_stage.split(",")}

@@ -46,14 +46,19 @@ def diagnose_trajectory(residuals) -> dict:
     """Shape diagnosis of one residual trajectory (chronological, host).
 
     Returns {"sweeps", "first", "final", "decay_rate", "stalled",
-    "oscillating"}: decay_rate is the per-sweep geometric factor fitted to
-    the finite positive tail (NaN when it cannot be estimated), `stalled`
-    and `oscillating` the tail-window verdicts described above."""
-    r = np.asarray(residuals, np.float64).reshape(-1)
-    r = r[np.isfinite(r)]
+    "oscillating", "nonfinite"}: decay_rate is the per-sweep geometric
+    factor fitted to the finite positive tail (NaN when it cannot be
+    estimated), `stalled` and `oscillating` the tail-window verdicts
+    described above, and `nonfinite` True when the RAW trajectory ends on
+    a non-finite residual — the NaN-poisoned-solve signature the report
+    must never launder into a clean-looking summary (the `first`/`final`
+    fields are computed over the finite entries only)."""
+    raw = np.asarray(residuals, np.float64).reshape(-1)
+    r = raw[np.isfinite(raw)]
     out = {"sweeps": int(len(r)),
            "first": float(r[0]) if len(r) else None,
            "final": float(r[-1]) if len(r) else None,
+           "nonfinite": bool(len(raw) and not np.isfinite(raw[-1])),
            "decay_rate": None, "stalled": False, "oscillating": False}
     if len(r) < 4:
         return out
@@ -174,6 +179,20 @@ def health_report(result, model=None) -> dict:
         report["outer"]["final_residual"] = float(hist[-1])
 
     flags = []
+    # A trajectory ending on a non-finite residual is ALWAYS flagged —
+    # even on a "converged" result (a NaN distance slips through `< tol`
+    # criteria silently; the nan verdict must never be laundered by a
+    # convergence flag the same NaN fooled). The errors.enforce_convergence
+    # counterpart of this rule warns/raises at solve time.
+    for side in ("outer", "inner"):
+        tr = report.get(side, {}).get("trajectory") or {}
+        if tr.get("nonfinite"):
+            flags.append(f"{side}-nan-residual")
+    # The sentinel's own verdict, when the solve carried one.
+    verdict = getattr(result, "verdict", "")
+    if verdict:
+        report["verdict"] = verdict
+        flags.append(f"verdict-{verdict}")
     if not report["converged"]:
         flags.append("not-converged")
         # Trajectory-shape flags explain WHY the iteration cap was hit

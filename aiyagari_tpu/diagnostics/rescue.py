@@ -1,0 +1,199 @@
+"""Host-side rescue ladder: bounded escalation for failed solves.
+
+The resilience contract (ISSUE 10) is that a solve either converges or
+fails LOUDLY with a structured verdict — but for the ROADMAP's
+million-economy calibration sweeps "fails loudly" is not enough: one
+pathological calibration must not cost its whole batch, and most
+pathologies are ROUTE pathologies (an acceleration history poisoned by a
+kinked operator, a windowed inversion whose knot density escapes, an f32
+hot stage whose noise floor sits above the target) that a more
+conservative configuration solves outright. The rescue ladder encodes that
+escalation once, at the dispatch boundary:
+
+    base -> plain -> safe -> float64 -> patient
+
+Each stage rebuilds the solve from the BASE configuration with
+progressively more machinery disabled (config.RescueConfig names the
+semantics); the first converged attempt returns, and exhaustion raises a
+ConvergenceError carrying the full attempt history. Every attempt lands on
+the observability surface: a ledger "rescue" event and an
+`aiyagari_rescue_attempts_total{stage=,outcome=}` metrics increment —
+a fleet operator reads the rescue rate off /metrics, not out of logs.
+
+The driver is deliberately solve-shape-agnostic: `run_rescue` takes an
+`attempt(solver, backend, outer)` callable (dispatch closes it over the
+real entry point with policy="raise", so failures arrive as exceptions)
+plus the three config objects each stage transforms. Injected faults
+(diagnostics/faults.py) are cleared on every rescue stage except
+`fail_stage`, which targets this driver itself — the CI battery's way of
+exercising multi-stage escalation deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+from aiyagari_tpu.config import (
+    BackendConfig,
+    EquilibriumConfig,
+    RescueConfig,
+    SolverConfig,
+    TransitionConfig,
+)
+from aiyagari_tpu.diagnostics.errors import ConvergenceError
+from aiyagari_tpu.diagnostics.faults import stage_fails
+
+__all__ = [
+    "RescueAttempt",
+    "RescueConfig",
+    "RESCUE_STAGES",
+    "apply_stage",
+    "run_rescue",
+]
+
+# Stage vocabulary (order is the escalation; RescueConfig.stages selects).
+RESCUE_STAGES = ("plain", "safe", "float64", "patient")
+
+
+@dataclasses.dataclass
+class RescueAttempt:
+    """One ladder attempt's record — what the ledger "rescue" event stores
+    and what ConvergenceError.attempts carries on exhaustion."""
+
+    stage: str
+    converged: bool
+    verdict: str = "ok"          # "ok" | error verdict ("nan"/"max_iter"/...)
+    error: Optional[str] = None  # the failed attempt's exception message
+    distance: float = float("nan")
+    iterations: int = 0
+    seconds: float = 0.0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _check_stages(rescue: RescueConfig) -> tuple:
+    unknown = [s for s in rescue.stages if s not in RESCUE_STAGES]
+    if unknown:
+        raise ValueError(
+            f"unknown rescue stage(s) {unknown}; known stages (escalation "
+            f"order): {RESCUE_STAGES}")
+    return tuple(rescue.stages)
+
+
+def apply_stage(stage: str, solver: SolverConfig, backend: BackendConfig,
+                outer):
+    """The (solver, backend, outer) triple one ladder stage runs with,
+    built from the BASE configs (stages are standalone escalations, not
+    cumulative state — semantics on RescueConfig's docstring). `outer` is
+    the outer-loop config the solve family uses (EquilibriumConfig or
+    TransitionConfig); "base" returns the inputs untouched."""
+    if stage == "base":
+        return solver, backend, outer
+    # Every rescue stage re-runs the operator fresh: injected faults model
+    # a route/data pathology the escalation replaces (FaultPlan docstring),
+    # so they are cleared here — fail_stage excepted, it targets run_rescue.
+    solver = dataclasses.replace(solver, faults=None, accel=None,
+                                 use_pallas=False)
+    if stage == "plain":
+        return solver, backend, outer
+    solver = dataclasses.replace(solver, pushforward="scatter")
+    trans = isinstance(outer, TransitionConfig)
+    if trans and outer.method != "damped":
+        outer = dataclasses.replace(outer, method="damped")
+    if stage == "safe":
+        return solver, backend, outer
+    solver = dataclasses.replace(solver, ladder=None)
+    backend = dataclasses.replace(backend, dtype="float64")
+    if stage == "float64":
+        return solver, backend, outer
+    # "patient": doubled caps, and for transitions halved damping — the
+    # last-resort slow-but-steady configuration.
+    solver = dataclasses.replace(solver, max_iter=2 * solver.max_iter)
+    outer = dataclasses.replace(
+        outer, max_iter=2 * outer.max_iter,
+        **({"damping": 0.5 * outer.damping} if trans else {}))
+    return solver, backend, outer
+
+
+def _record(ledger, attempt: RescueAttempt, context: str) -> None:
+    from aiyagari_tpu.diagnostics import metrics
+
+    metrics.counter(
+        "aiyagari_rescue_attempts_total", stage=attempt.stage,
+        outcome="converged" if attempt.converged else "failed").inc()
+    if ledger is not None:
+        ledger.event("rescue", context=context, **attempt.to_json())
+
+
+def run_rescue(attempt_fn: Callable, *, rescue: RescueConfig,
+               solver: SolverConfig, backend: BackendConfig, outer,
+               context: str, tol: float, ledger=None):
+    """Drive one solve through the ladder: the base attempt, then each
+    configured rescue stage, stopping at the first success.
+
+    `attempt_fn(solver, backend, outer)` must RAISE on failure
+    (ConvergenceError / FloatingPointError — dispatch runs the inner solve
+    with policy="raise") and return the converged result otherwise. The
+    returned result gains a `rescue_attempts` attribute (the full history,
+    successful final attempt included). Exhaustion raises a
+    ConvergenceError whose `attempts` carry the history and whose verdict
+    is the LAST attempt's."""
+    stages = ("base",) + _check_stages(rescue)
+    attempts: List[RescueAttempt] = []
+    faults = solver.faults
+    last: Optional[ConvergenceError] = None
+    for stage in stages:
+        s2, b2, o2 = apply_stage(stage, solver, backend, outer)
+        t0 = time.perf_counter()
+        if stage_fails(faults, stage):
+            att = RescueAttempt(stage=stage, converged=False,
+                                verdict="injected",
+                                error="forced failure (FaultPlan.fail_stage)",
+                                seconds=time.perf_counter() - t0)
+            attempts.append(att)
+            _record(ledger, att, context)
+            continue
+        try:
+            result = attempt_fn(s2, b2, o2)
+        except ConvergenceError as e:
+            att = RescueAttempt(stage=stage, converged=False,
+                                verdict=e.verdict, error=str(e),
+                                distance=e.distance, iterations=e.iterations,
+                                seconds=time.perf_counter() - t0)
+            attempts.append(att)
+            _record(ledger, att, context)
+            last = e
+            continue
+        except FloatingPointError as e:
+            # The transition path evaluator's divergence signal: no distance
+            # to report beyond "non-finite".
+            att = RescueAttempt(stage=stage, converged=False, verdict="nan",
+                                error=str(e),
+                                seconds=time.perf_counter() - t0)
+            attempts.append(att)
+            _record(ledger, att, context)
+            last = None
+            continue
+        att = RescueAttempt(
+            stage=stage, converged=True,
+            iterations=int(getattr(result, "iterations",
+                                   getattr(result, "rounds", 0)) or 0),
+            seconds=time.perf_counter() - t0)
+        attempts.append(att)
+        _record(ledger, att, context)
+        result.rescue_attempts = attempts
+        return result
+    failed = [a for a in attempts if not a.converged]
+    raise ConvergenceError(
+        context,
+        iterations=(last.iterations if last is not None else 0),
+        distance=(last.distance if last is not None else float("nan")),
+        tol=tol,
+        detail={"stages_tried": [a.stage for a in attempts]},
+        telemetry=(last.telemetry if last is not None else None),
+        verdict=(failed[-1].verdict if failed else "max_iter"),
+        attempts=attempts,
+    )

@@ -1,0 +1,238 @@
+"""Device-resident failure sentinels for the hot fixed-point loops.
+
+The framework's while-loops already EXIT on a non-finite residual (every
+cond is written `dist >= tol`, which is False for NaN — the NaN-poisoning
+contract), but exiting is not the same as DIAGNOSING: the caller sees a
+NaN distance and must guess whether it was an interp-window escape, genuine
+divergence, or an injected pathology; and two failure shapes the cond
+cannot see at all — a stalled iterate wandering at its noise floor and a
+divergent iterate whose residual grows for hundreds of sweeps before
+overflowing to inf — burn `max_iter` sweeps on garbage. This module makes
+failure a FIRST-CLASS loop outcome:
+
+  * `SentinelState` is a tiny pytree (5 scalars) carried INSIDE the
+    while_loop. `sentinel_update` watches each sweep's residual for
+    non-finite values (verdict "nan", or "escape" when the solver's
+    windowed-inversion escape flag is raised), residuals that exceed
+    `explode_factor` x the first recorded residual ("explode"), and
+    `stall_window` sweeps without a new best residual ("stall").
+  * `sentinel_cond` ANDs `verdict == 0` into the loop condition, so the
+    first nonzero verdict EARLY-EXITS the loop — a stalled 10k-sweep
+    distribution iteration stops after `stall_window` wasted sweeps, not
+    at max_iter.
+  * Every helper is a COMPILE-TIME no-op when the state is None: the
+    telemetry-off discipline of diagnostics/telemetry.py — a sentinel-off
+    solve traces to the identical program with the identical carry
+    (jaxpr-pinned by tests/test_resilience.py).
+
+The host-side outer loops (GE bisection/batched rounds, transition Newton
+rounds) apply the same thresholds through `host_verdict` on their residual
+histories, so one verdict taxonomy serves both worlds; the user-facing
+knob is `config.SentinelConfig`, wired as `SolverConfig(sentinel=...)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aiyagari_tpu.config import SentinelConfig
+
+__all__ = [
+    "SentinelConfig",
+    "SentinelState",
+    "VERDICT_NAMES",
+    "sentinel_init",
+    "sentinel_stage_reset",
+    "sentinel_update",
+    "sentinel_cond",
+    "sentinel_leaves",
+    "sentinel_from_leaves",
+    "sentinel_summary",
+    "verdict_name",
+    "host_verdict",
+]
+
+# Verdict codes, stable across the framework (ledger events, rescue attempt
+# records, and the AIYA107 contract all key on the names).
+VERDICT_NAMES = ("ok", "nan", "stall", "explode", "escape")
+_OK, _NAN, _STALL, _EXPLODE, _ESCAPE = range(5)
+
+# Residuals are watched in f32 for the same reason telemetry records them
+# in f32: the state must cross mixed-precision stage boundaries without
+# changing pytree structure, and f32 resolves anything the verdicts can
+# distinguish.
+_DT = jnp.float32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SentinelState:
+    """One loop's failure-watch state. All fields are arrays, so the state
+    vmaps/shards with the solve (a scenario batch carries one verdict per
+    scenario, leading [S] axis)."""
+
+    verdict: jax.Array     # int32 verdict code (0 = healthy); sticky
+    best: jax.Array        # f32 best (lowest) finite residual seen
+    first: jax.Array       # f32 first finite residual (explosion reference)
+    since_best: jax.Array  # int32 sweeps since `best` last improved
+    count: jax.Array       # int32 residuals watched
+
+
+def sentinel_init(cfg: Optional[SentinelConfig]) -> Optional[SentinelState]:
+    """A fresh sentinel for `cfg`, or None when off — the None flows through
+    every helper unchanged, so the off path compiles to the exact
+    pre-sentinel program."""
+    if cfg is None:
+        return None
+    if cfg.stall_window < 2:
+        raise ValueError(
+            f"SentinelConfig.stall_window must be >= 2, got {cfg.stall_window}")
+    if cfg.explode_factor <= 1.0:
+        raise ValueError(
+            "SentinelConfig.explode_factor must exceed 1.0, got "
+            f"{cfg.explode_factor}")
+    inf = jnp.asarray(jnp.inf, _DT)
+    return SentinelState(
+        verdict=jnp.int32(_OK),
+        best=inf,
+        first=inf,
+        since_best=jnp.int32(0),
+        count=jnp.int32(0),
+    )
+
+
+def sentinel_stage_reset(st: Optional[SentinelState]
+                         ) -> Optional[SentinelState]:
+    """Restart the stall/explosion references at a precision-ladder stage
+    boundary — the acceleration-history lesson (ops/accel.py) applied to
+    the sentinel: a hot stage exits AT its noise floor, so its `best` is a
+    bar the wider stage's residuals (which restart above it and decay at
+    the operator's own rate) can take hundreds of sweeps to beat — carrying
+    it across the cast would trip a false "stall" on a perfectly healthy
+    polish. `best`/`first`/`since_best` restart; the verdict stays sticky
+    (a failure in ANY stage is the solve's failure) and `count` keeps the
+    cumulative watched-sweep total (sentinel_update captures `first` on
+    the first finite residual after a reset, not on count == 0). No-op
+    when off."""
+    if st is None:
+        return None
+    inf = jnp.asarray(jnp.inf, _DT)
+    return SentinelState(verdict=st.verdict, best=inf, first=inf,
+                         since_best=jnp.int32(0), count=st.count)
+
+
+def sentinel_update(st: Optional[SentinelState], residual, *,
+                    config: Optional[SentinelConfig],
+                    escaped=None) -> Optional[SentinelState]:
+    """Watch one sweep's residual. `escaped` (a traced bool, or None) marks
+    a non-finite residual as the solver's windowed-inversion escape rather
+    than numerical divergence — the verdict the retry wrappers key on. The
+    verdict is STICKY: once nonzero it never changes (the loop exits on the
+    next cond evaluation anyway, and a sticky code survives the exit).
+    No-op when off."""
+    if st is None:
+        return None
+    r = jnp.asarray(residual).astype(_DT)
+    finite = jnp.isfinite(r)
+    # `first` captures the first FINITE residual since init/stage reset
+    # (both leave it +inf) — the explosion reference.
+    first = jnp.where(~jnp.isfinite(st.first) & finite, r, st.first)
+    improved = finite & (r < st.best)
+    best = jnp.where(improved, r, st.best)
+    since = jnp.where(improved, 0, st.since_best + 1).astype(jnp.int32)
+
+    bad = jnp.where(
+        jnp.asarray(escaped if escaped is not None else False),
+        jnp.int32(_ESCAPE), jnp.int32(_NAN))
+    explode = finite & (r > jnp.asarray(config.explode_factor, _DT) * first)
+    stall = since >= jnp.int32(config.stall_window)
+    new = jnp.where(
+        ~finite, bad,
+        jnp.where(explode, jnp.int32(_EXPLODE),
+                  jnp.where(stall, jnp.int32(_STALL), jnp.int32(_OK))))
+    verdict = jnp.where(st.verdict != _OK, st.verdict, new)
+    return SentinelState(verdict=verdict, best=best, first=first,
+                         since_best=since, count=st.count + 1)
+
+
+def sentinel_cond(st: Optional[SentinelState], base):
+    """AND the healthy-verdict predicate into a loop condition. Returns
+    `base` UNCHANGED when the sentinel is off — the off-path loop cond must
+    trace to the identical expression."""
+    if st is None:
+        return base
+    return base & (st.verdict == _OK)
+
+
+# shard_map crossings: the state crosses the boundary as a flat tuple of
+# leaves with explicit replicated out_specs, exactly like telemetry_leaves.
+_N_LEAVES = 5
+
+
+def sentinel_leaves(st: Optional[SentinelState]) -> tuple:
+    """Flatten to a static-length tuple of arrays (empty when off)."""
+    if st is None:
+        return ()
+    return (st.verdict, st.best, st.first, st.since_best, st.count)
+
+
+def sentinel_from_leaves(leaves) -> Optional[SentinelState]:
+    """Inverse of sentinel_leaves."""
+    if not leaves:
+        return None
+    assert len(leaves) == _N_LEAVES
+    return SentinelState(*leaves)
+
+
+def verdict_name(verdict) -> str:
+    """Host name of one verdict code (device_get's a device scalar)."""
+    return VERDICT_NAMES[int(jax.device_get(verdict))]
+
+
+def sentinel_summary(st: Optional[SentinelState]) -> Optional[dict]:
+    """JSON-ready summary of one sentinel state — what rescue attempts and
+    ledger events store. Batched states have no single verdict; index one
+    scenario down first."""
+    if st is None:
+        return None
+    verdict, best, first, since, count = (
+        np.asarray(x) for x in jax.device_get(sentinel_leaves(st)))
+    if verdict.ndim != 0:
+        raise ValueError(
+            "sentinel_summary reads ONE state; index a batched sentinel "
+            f"(shape {verdict.shape}) down to one scenario first")
+    return {
+        "verdict": VERDICT_NAMES[int(verdict)],
+        "best_residual": float(best) if np.isfinite(best) else None,
+        "first_residual": float(first) if np.isfinite(first) else None,
+        "since_best": int(since),
+        "sweeps_watched": int(count),
+    }
+
+
+def host_verdict(history, config: Optional[SentinelConfig]) -> str:
+    """The sentinel verdicts applied to a HOST-side residual history (the
+    outer loops collect their per-round residuals as Python lists). Returns
+    "" while healthy, else "nan" | "stall" | "explode" — the same taxonomy
+    as the device states (escape is a device-loop concept and never fires
+    here). No-op ("" always) when config is None."""
+    if config is None or not history:
+        return ""
+    last = float(history[-1])
+    if not math.isfinite(last):
+        return "nan"
+    finite = [float(h) for h in history if math.isfinite(float(h))]
+    if not finite:
+        return ""
+    if last > config.explode_factor * finite[0]:
+        return "explode"
+    w = int(config.stall_window)
+    if len(finite) > w and min(finite[-w:]) >= min(finite[:-w]):
+        return "stall"
+    return ""

@@ -134,6 +134,22 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
     return solver
 
 
+def _resolve_rescue(rescue):
+    """Normalize the `rescue` argument: None (off), True (the default
+    ladder), or a RescueConfig."""
+    if rescue is None or rescue is False:
+        return None
+    from aiyagari_tpu.config import RescueConfig
+
+    if rescue is True:
+        return RescueConfig()
+    if not isinstance(rescue, RescueConfig):
+        raise TypeError(
+            f"rescue must be a RescueConfig (or True/None), got "
+            f"{type(rescue).__name__}")
+    return rescue
+
+
 def solve(
     model: Union[AiyagariConfig, KrusellSmithConfig],
     *,
@@ -146,6 +162,7 @@ def solve(
     aggregation: str = "simulation",
     on_nonconvergence: str = "warn",
     ledger=None,
+    rescue=None,
 ):
     """Solve a full model to general equilibrium.
 
@@ -199,16 +216,54 @@ def solve(
     verdict, and any degradation events (push-forward fallbacks) — render
     it with `python -m aiyagari_tpu report <ledger>`. Every result exposes
     `.health()` (diagnostics/health.py), the Den-Haan-style certificate.
+
+    Resilience (docs/USAGE.md "Resilient solves & fault injection"):
+    SolverConfig(sentinel=SentinelConfig()) arms the device-resident
+    failure sentinels — every hot while_loop early-exits on a non-finite /
+    stalled / exploding residual with a structured verdict instead of
+    burning max_iter. `rescue` (a RescueConfig, or True for the default
+    ladder; Aiyagari family, jax backend) retries a failed solve through
+    the host-side escalation ladder (plain → safe → float64 → patient),
+    returning the first converged result or raising a ConvergenceError
+    that carries the full attempt history — with a rescue ladder attached
+    the exhaustion behavior is always a raise, regardless of
+    `on_nonconvergence`.
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
-    if backend.backend not in ("jax", "numpy"):
-        raise ValueError(
-            f"unknown backend {backend.backend!r}; expected 'jax' or 'numpy'"
-        )
+    # The method/solver.method conflict is rejected BEFORE the rescue
+    # branch too: the rescue attempts run on solver.method alone, and a
+    # conflicting method= silently overridden there would break the
+    # "never silently overridden" contract below.
     if solver is not None and method is not None and solver.method != method:
         raise ValueError(
             f"conflicting methods: method={method!r} but solver.method={solver.method!r}"
+        )
+    rescue = _resolve_rescue(rescue)
+    if rescue is not None:
+        if not isinstance(model, AiyagariConfig) or backend.backend != "jax":
+            raise ValueError(
+                "rescue ladders cover the Aiyagari family on the jax "
+                "backend (the escalation stages transform its solver "
+                "routes); drop rescue= for this solve")
+        from aiyagari_tpu.diagnostics.rescue import run_rescue
+
+        solver_r = solver or SolverConfig(method=method or "vfi")
+        eq_r = equilibrium or EquilibriumConfig()
+        led = _as_ledger(ledger, model, solver_r, eq_r, entry="solve")
+
+        def attempt(s2, b2, o2):
+            return solve(model, backend=b2, solver=s2, sim=sim,
+                         equilibrium=o2, alm=alm, aggregation=aggregation,
+                         on_nonconvergence="raise", ledger=led, rescue=None)
+
+        return run_rescue(attempt, rescue=rescue, solver=solver_r,
+                          backend=backend, outer=eq_r,
+                          context="Aiyagari GE rescue", tol=eq_r.tol,
+                          ledger=led)
+    if backend.backend not in ("jax", "numpy"):
+        raise ValueError(
+            f"unknown backend {backend.backend!r}; expected 'jax' or 'numpy'"
         )
     method = method or (solver.method if solver is not None else "vfi")
     if method not in ("vfi", "egm"):
@@ -329,6 +384,7 @@ def solve(
             iterations=iters,
             distance=gap, tol=equilibrium.tol, detail={"r": result.r},
             telemetry=getattr(result, "telemetry", None),
+            verdict=getattr(result, "verdict", "") or None,
         )
         return result
 
@@ -410,6 +466,8 @@ def sweep(
     aggregation: str = "distribution",
     configs: Optional[Sequence[AiyagariConfig]] = None,
     ledger=None,
+    rescue=None,
+    quarantine: bool = True,
     **param_grids,
 ):
     """Solve MANY Aiyagari economies to general equilibrium as one batched
@@ -439,6 +497,17 @@ def sweep(
     deterministic Young-histogram supply; "simulation" uses per-scenario
     Monte-Carlo panels. Returns a SweepResult ([S]-arrays of r/w/K plus the
     batched household solutions, still on device).
+
+    Scenario quarantine (default on): a lane whose excess demand goes
+    non-finite is frozen so the batch completes with per-scenario verdicts
+    (SweepResult.quarantined / .verdicts) — partial results instead of an
+    all-or-nothing sweep. With `rescue` (a RescueConfig, or True), each
+    quarantined scenario is then re-solved SERIALLY through the rescue
+    ladder (diagnostics/rescue.py) and its scalars spliced back into the
+    result (verdict "rescued"); scenarios the ladder cannot save keep
+    their "nan" verdict and the attempt history lands on
+    SweepResult.rescue_attempts. quarantine=False restores the historical
+    frozen-lane-until-max_iter behavior (benchmark A/B only).
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -490,6 +559,7 @@ def sweep(
         from aiyagari_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+    rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, base, solver, equilibrium, entry="sweep")
     with _observe(led, "aiyagari_sweep", scenarios=len(configs),
                   method=method, aggregation=aggregation):
@@ -501,18 +571,97 @@ def sweep(
             models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
                       for c in configs]
             batch = stack_scenarios(models, mesh=mesh)
+            # Injected poisoned scenario (diagnostics/faults.py): one
+            # lane's labor endowment is NaN'd AFTER stacking, so that
+            # lane's excess demand is NaN every round — the per-scenario
+            # config stays healthy, so the quarantine's serial re-solve
+            # recovers it, which is exactly the contract the CI battery
+            # certifies. (The demand-side operand is the deterministic
+            # poison: a NaN preference can be silently masked by the EGM
+            # constraint region's NaN-false comparisons.)
+            from aiyagari_tpu.diagnostics.faults import poison_scenario_index
+
+            pi = poison_scenario_index(solver.faults)
+            if pi is not None:
+                if not 0 <= pi < batch.size:
+                    raise ValueError(
+                        f"FaultPlan.poison_scenario={pi} outside the "
+                        f"{batch.size}-scenario batch")
+                batch = dataclasses.replace(
+                    batch, labor_raw=batch.labor_raw.at[pi].set(jnp.nan))
             result = solve_equilibrium_sweep(
                 batch, solver=solver, eq=equilibrium, sim=sim,
-                aggregation=aggregation)
+                aggregation=aggregation, quarantine=quarantine)
     result.params = params
     import numpy as _np
 
+    if (rescue is not None and result.quarantined is not None
+            and _np.any(result.quarantined)):
+        _rescue_quarantined_sweep(
+            result, configs, backend=backend, solver=solver,
+            sim=sim, equilibrium=equilibrium, aggregation=aggregation,
+            rescue=rescue, ledger=led)
+    live = (~result.quarantined if result.quarantined is not None
+            else _np.ones(result.scenarios, bool))
+    finite_gap = _np.abs(_np.where(live, result.gap, 0.0))
     _ledger_result(led, "Aiyagari GE sweep", result,
                    converged=bool(_np.all(result.converged)),
                    iterations=result.rounds,
-                   distance=float(_np.max(_np.abs(result.gap))),
+                   distance=float(_np.max(finite_gap, initial=0.0)),
                    tol=equilibrium.tol)
+    if led is not None and result.quarantined is not None:
+        for i in _np.nonzero(result.quarantined)[0]:
+            led.event("quarantine", context="Aiyagari GE sweep",
+                      scenario=int(i), verdict=result.verdicts[int(i)])
     return result
+
+
+def _rescue_quarantined_sweep(result, configs, *, backend, solver,
+                              sim, equilibrium, aggregation, rescue,
+                              ledger):
+    """Re-solve each quarantined sweep lane SERIALLY through the rescue
+    ladder and splice the recovered scalars (r/w/capital/gap/converged)
+    back into the SweepResult. The batched device pytrees (solutions, mu)
+    keep their lockstep values — the quarantined lane's entries there are
+    NaN-poisoned and callers should index them by verdict. Lanes the
+    ladder cannot save keep verdict "nan"; every attempt history lands on
+    result.rescue_attempts."""
+    import numpy as _np
+
+    from aiyagari_tpu.diagnostics.errors import ConvergenceError
+    from aiyagari_tpu.diagnostics import metrics
+
+    result.rescue_attempts = {}
+    # Device-fetched arrays can be read-only views; the splice writes them.
+    for name in ("r", "w", "capital", "gap", "converged"):
+        setattr(result, name, _np.array(getattr(result, name)))
+    # The serial re-solve must not re-apply batch-level faults: the
+    # poisoned-scenario injection lives at the stack_scenarios level, and
+    # device-fault plans are cleared so the lane gets a genuinely fresh
+    # solve (rescue stages would clear them anyway; the base attempt
+    # should too, or an injected nan_sweep re-fails it pointlessly).
+    solver_clean = dataclasses.replace(solver, faults=None)
+    for i in _np.nonzero(result.quarantined)[0]:
+        i = int(i)
+        try:
+            res_i = solve(configs[i], backend=backend, solver=solver_clean,
+                          sim=sim, equilibrium=equilibrium,
+                          aggregation=aggregation, ledger=ledger,
+                          rescue=rescue)
+        except ConvergenceError as e:
+            result.rescue_attempts[i] = e.attempts
+            metrics.counter("aiyagari_quarantine_total",
+                            outcome="unrecovered").inc()
+            continue
+        result.rescue_attempts[i] = res_i.rescue_attempts
+        result.r[i] = res_i.r
+        result.w[i] = res_i.w
+        result.capital[i] = res_i.capital
+        result.gap[i] = res_i.k_supply[-1] - res_i.k_demand[-1]
+        result.converged[i] = True
+        result.verdicts[i] = "rescued"
+        metrics.counter("aiyagari_quarantine_total",
+                        outcome="rescued").inc()
 
 
 def _transition_backend(backend: Union[str, BackendConfig]) -> BackendConfig:
@@ -551,6 +700,7 @@ def solve_transition(
     equilibrium: Optional[EquilibriumConfig] = None,
     on_nonconvergence: str = "warn",
     ledger=None,
+    rescue=None,
     **kwargs,
 ):
     """Solve a perfect-foresight MIT-shock transition path to general
@@ -575,6 +725,26 @@ def solve_transition(
     from aiyagari_tpu.diagnostics.errors import enforce_convergence
     from aiyagari_tpu.transition.mit import solve_transition as _solve
 
+    rescue = _resolve_rescue(rescue)
+    if rescue is not None:
+        from aiyagari_tpu.diagnostics.rescue import run_rescue
+
+        solver_r = solver or SolverConfig(method="egm", tol=1e-9,
+                                          max_iter=5000)
+        led = _as_ledger(ledger, model, shock, transition, solver_r,
+                         entry="solve_transition")
+
+        def attempt(s2, b2, o2):
+            return solve_transition(model, shock, transition=o2, backend=b2,
+                                    solver=s2, equilibrium=equilibrium,
+                                    on_nonconvergence="raise", ledger=led,
+                                    rescue=None, **kwargs)
+
+        return run_rescue(attempt, rescue=rescue, solver=solver_r,
+                          backend=backend, outer=transition,
+                          context="MIT-shock transition rescue",
+                          tol=transition.tol, ledger=led)
+
     led = _as_ledger(ledger, model, shock, transition, solver,
                      entry="solve_transition")
     with _observe(led, "mit_transition", method=transition.method,
@@ -596,6 +766,7 @@ def solve_transition(
         tol=transition.tol,
         detail={"method": result.method, "T": result.T},
         telemetry=getattr(result, "telemetry", None),
+        verdict=getattr(result, "verdict", "") or None,
     )
     return result
 
@@ -612,6 +783,8 @@ def sweep_transitions(
     sizes: Optional[Sequence[float]] = None,
     rhos: Optional[Sequence[float]] = None,
     ledger=None,
+    rescue=None,
+    quarantine: bool = True,
     **kwargs,
 ):
     """Solve MANY MIT-shock scenarios of one economy in lockstep, every
@@ -654,21 +827,79 @@ def sweep_transitions(
     from aiyagari_tpu.config import precision_scope
     from aiyagari_tpu.transition.mit import solve_transitions_sweep as _sweep
 
+    rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, model, transition, solver,
                      entry="sweep_transitions")
+    # Injected poisoned scenario (diagnostics/faults.py): one scenario's
+    # shock is replaced with an untempered unit TFP drop whose path
+    # evaluation overflows — the quarantine freezes that lane, and the
+    # serial rescue re-solves the ORIGINAL shock from the shocks list.
+    shocks_run = list(shocks)
+    pi = None
+    if solver is not None:
+        from aiyagari_tpu.diagnostics.faults import poison_scenario_index
+
+        pi = poison_scenario_index(solver.faults)
+    if pi is not None:
+        if not 0 <= pi < len(shocks_run):
+            raise ValueError(
+                f"FaultPlan.poison_scenario={pi} outside the "
+                f"{len(shocks_run)}-scenario batch")
+        shocks_run[pi] = MITShock(param="tfp", size=float("nan"), rho=0.0)
     with _observe(led, "mit_transition_sweep", scenarios=len(shocks),
                   method=transition.method, T=transition.T):
         with precision_scope(backend.dtype):
-            result = _sweep(model, shocks, trans=transition, solver=solver,
-                            eq=equilibrium, mesh=mesh,
+            result = _sweep(model, shocks_run, trans=transition,
+                            solver=solver, eq=equilibrium, mesh=mesh,
                             dtype=_dtype_of(backend),
                             ladder=_transition_ladder(backend, solver),
+                            quarantine=quarantine,
                             **kwargs)
     import numpy as _np
 
+    result.shocks = list(shocks)
+    if (rescue is not None and result.quarantined is not None
+            and _np.any(result.quarantined)):
+        from aiyagari_tpu.diagnostics.errors import ConvergenceError
+        from aiyagari_tpu.diagnostics import metrics
+
+        result.rescue_attempts = {}
+        result.r_paths = _np.array(result.r_paths)
+        result.max_excess = _np.array(result.max_excess)
+        result.converged = _np.array(result.converged)
+        solver_clean = (dataclasses.replace(solver, faults=None)
+                        if solver is not None else None)
+        for i in _np.nonzero(result.quarantined)[0]:
+            i = int(i)
+            try:
+                res_i = solve_transition(
+                    model, shocks[i], transition=transition, backend=backend,
+                    solver=solver_clean, equilibrium=equilibrium,
+                    ledger=led, rescue=rescue,
+                    ss=result.ss, jacobian=result.jacobian)
+            except ConvergenceError as e:
+                result.rescue_attempts[i] = e.attempts
+                metrics.counter("aiyagari_quarantine_total",
+                                outcome="unrecovered").inc()
+                continue
+            result.rescue_attempts[i] = res_i.rescue_attempts
+            result.r_paths[i] = res_i.r_path
+            result.max_excess[i] = float(_np.max(_np.abs(res_i.excess)))
+            result.converged[i] = True
+            result.verdicts[i] = "rescued"
+            metrics.counter("aiyagari_quarantine_total",
+                            outcome="rescued").inc()
+    live = (~result.quarantined if result.quarantined is not None
+            else _np.ones(result.scenarios, bool))
     _ledger_result(led, "MIT-shock transition sweep", result,
                    converged=bool(_np.all(result.converged)),
                    iterations=result.rounds,
-                   distance=float(_np.max(result.max_excess)),
+                   distance=float(_np.max(
+                       _np.where(live, result.max_excess, 0.0),
+                       initial=0.0)),
                    tol=transition.tol)
+    if led is not None and result.quarantined is not None:
+        for i in _np.nonzero(result.quarantined)[0]:
+            led.event("quarantine", context="MIT-shock transition sweep",
+                      scenario=int(i), verdict=result.verdicts[int(i)])
     return result

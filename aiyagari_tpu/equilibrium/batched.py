@@ -83,6 +83,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
         solver.accel, solver.ladder, solver.pushforward, solver.telemetry,
+        solver.sentinel, solver.faults,
     )
 
 
@@ -107,7 +108,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
      dist_tol, dist_max_iter, periods, n_agents, discard, accel,
-     ladder, pushforward, telemetry) = knobs
+     ladder, pushforward, telemetry, sentinel, faults) = knobs
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -128,13 +129,14 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                     warm, a_grid, labor_grid, s, P, r, w, sigma=sigma,
                     beta=beta, psi=psi, eta=eta, tol=tol, max_iter=max_iter,
                     howard_steps=howard_steps, relative_tol=relative_tol,
-                    ladder=ladder, telemetry=telemetry)
+                    ladder=ladder, telemetry=telemetry, sentinel=sentinel,
+                    faults=faults)
             else:
                 sol = solve_aiyagari_vfi(
                     warm, a_grid, s, P, r, w, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, howard_steps=howard_steps,
                     relative_tol=relative_tol, ladder=ladder,
-                    telemetry=telemetry)
+                    telemetry=telemetry, sentinel=sentinel, faults=faults)
             warm_out = sol.v
         else:
             from aiyagari_tpu.solvers.egm import (
@@ -151,13 +153,14 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     psi=psi, eta=eta, tol=tol, max_iter=max_iter,
                     relative_tol=relative_tol, grid_power=0.0, accel=accel,
-                    ladder=ladder, telemetry=telemetry)
+                    ladder=ladder, telemetry=telemetry, sentinel=sentinel,
+                    faults=faults)
             else:
                 sol = solve_aiyagari_egm(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, relative_tol=relative_tol,
                     grid_power=0.0, accel=accel, ladder=ladder,
-                    telemetry=telemetry)
+                    telemetry=telemetry, sentinel=sentinel, faults=faults)
             warm_out = sol.policy_c
 
         out = {"warm": warm_out, "sol": sol,
@@ -167,7 +170,7 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
             dist_sol = stationary_distribution(
                 sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
                 accel=accel, ladder=ladder, pushforward=pushforward,
-                telemetry=telemetry)
+                telemetry=telemetry, sentinel=sentinel, faults=faults)
             supply = aggregate_capital(dist_sol.mu, a_grid)
             out["mu"] = dist_sol.mu
             out["dist_telemetry"] = dist_sol.telemetry
@@ -332,6 +335,7 @@ def solve_equilibrium_batched(
     out = None
     r_hist, ks_hist, kd_hist, records = [], [], [], []
     converged = False
+    verdict = ""
     best = 0
     r_cand = np.array([0.5 * (lo + hi)])
     rounds = 0
@@ -370,6 +374,17 @@ def solve_equilibrium_batched(
         if np.isfinite(gaps[best]) and abs(gaps[best]) < eq.tol:
             converged = True
             break
+        # Host-side failure sentinel on the per-round best-gap trajectory
+        # (armed by SolverConfig.sentinel, like the serial bisection): an
+        # all-NaN round, an exploding gap, or a stalled bracket exits with
+        # a structured verdict instead of burning the remaining rounds.
+        if solver.sentinel is not None:
+            from aiyagari_tpu.diagnostics.sentinel import host_verdict
+
+            verdict = host_verdict([abs(r["best_gap"]) for r in records],
+                                   solver.sentinel)
+            if verdict:
+                break
         # Shrink to the sign change: gap is increasing in r, so the root
         # sits above the last negative candidate and below the first
         # positive one (bracket edges cover the all-one-sign cases).
@@ -409,6 +424,7 @@ def solve_equilibrium_batched(
         telemetry=host_telemetry([abs(r["best_gap"]) for r in records]),
         dist_telemetry=(take(out["dist_telemetry"])
                         if out.get("dist_telemetry") is not None else None),
+        verdict=verdict,
     )
 
 
@@ -520,6 +536,15 @@ class SweepResult:
     # distribution solves, when SolverConfig.telemetry was set (index one
     # scenario down before reading, telemetry_trajectory's contract).
     dist_telemetry: object = None
+    # Scenario quarantine (ISSUE 10): lanes whose gap went non-finite were
+    # FROZEN (their midpoint pinned, excluded from the done-check) so the
+    # rest of the batch completed — partial results instead of an
+    # all-or-nothing sweep. `verdicts` names each scenario's outcome:
+    # "converged" | "max_iter" | "nan" (quarantined) | "rescued" (dispatch
+    # re-solved the lane serially through the rescue ladder).
+    quarantined: object = None      # [S] bool
+    verdicts: object = None         # list[str], length S
+    rescue_attempts: object = None  # {scenario index: [RescueAttempt, ...]}
 
 
 def solve_equilibrium_sweep(
@@ -528,6 +553,7 @@ def solve_equilibrium_sweep(
     sim: SimConfig = SimConfig(),
     aggregation: str = "distribution",
     dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+    quarantine: bool = True,
 ) -> SweepResult:
     """Advance S independent GE bisections in lockstep: every round solves
     ALL scenarios' midpoint households through one vmapped device program
@@ -539,6 +565,18 @@ def solve_equilibrium_sweep(
     solve_equilibrium_distribution (or solve_equilibrium) scenario by
     scenario — same bracket update, same |gap| < eq.tol criterion — at
     1/S-th the sequential device rounds.
+
+    quarantine (default True) arms the per-scenario failure masks (ISSUE
+    10): a lane whose gap goes non-finite is FROZEN — its midpoint pinned,
+    its bracket no longer updated, excluded from the all-done check — so
+    one NaN-poisoned calibration costs its own lane, not the batch. The
+    frozen lane's household solve still runs each round (the lockstep
+    program shape never changes; its while_loop exits after one sweep on
+    the NaN carry, so the wasted compute is a single sweep per round).
+    Quarantined lanes report verdict "nan" on SweepResult.verdicts;
+    dispatch.sweep(rescue=...) re-solves them serially through the rescue
+    ladder. quarantine=False keeps the pre-quarantine behavior (a NaN lane
+    re-runs its frozen bracket until max_iter) for A/B benchmarking.
     """
     if aggregation not in ("distribution", "simulation"):
         raise ValueError(f"unknown aggregation {aggregation!r}")
@@ -549,7 +587,12 @@ def solve_equilibrium_sweep(
     lo = np.full(S, float(eq.r_low))
     hi = (np.full(S, float(eq.r_high)) if eq.r_high is not None
           else 1.0 / beta_host - 1.0)
+    # A NaN scenario parameter (a poisoned calibration) makes the bracket
+    # itself NaN; the first round's gap is then NaN and the lane
+    # quarantines immediately rather than iterating on a NaN midpoint.
+    hi = np.where(np.isfinite(hi), hi, 1.0)
     conv = np.zeros(S, bool)
+    quar = np.zeros(S, bool)
     r_mid = 0.5 * (lo + hi)
     gaps = np.full(S, np.inf)
     supplies = np.zeros(S)
@@ -562,7 +605,8 @@ def solve_equilibrium_sweep(
     rounds = 0
     gap_hist: list = []
     for rnd in range(eq.max_iter):
-        r_mid = np.where(conv, r_mid, 0.5 * (lo + hi))
+        done = conv | quar
+        r_mid = np.where(done, r_mid, 0.5 * (lo + hi))
         r_dev = jnp.asarray(r_mid, batch.dtype)
         keys = _round_keys(sim.seed, rnd, S)
         fn = _ge_round_program(solver.method, batch.endogenous_labor,
@@ -572,19 +616,29 @@ def solve_equilibrium_sweep(
         gaps, supplies = (np.asarray(x, np.float64) for x in
                           jax.device_get((out["gap"], out["supply"])))
         rounds = rnd + 1
+        if quarantine:
+            # Freeze newly-diverged lanes: non-finite gap on a lane that
+            # has not converged. (A lane that converged in an earlier round
+            # keeps its verdict — its pinned midpoint may legitimately
+            # reproduce a finite gap forever.)
+            quar = quar | (~np.isfinite(gaps) & ~conv)
         finite = np.where(np.isfinite(gaps), np.abs(gaps), np.inf)
-        gap_hist.append(float(np.max(np.where(conv, 0.0, finite))))
-        newly = np.isfinite(gaps) & (np.abs(gaps) < eq.tol)
+        done = conv | quar
+        gap_hist.append(float(np.max(np.where(done, 0.0, finite),
+                                     initial=0.0)))
+        newly = ~quar & np.isfinite(gaps) & (np.abs(gaps) < eq.tol)
         conv = conv | newly
-        if conv.all():
+        if (conv | quar).all():
             break
-        step = ~conv
+        step = ~(conv | quar)
         lo = np.where(step & (gaps < 0.0), r_mid, lo)
         hi = np.where(step & (gaps >= 0.0), r_mid, hi)
 
     wall = time.perf_counter() - t0
     from aiyagari_tpu.diagnostics.telemetry import host_telemetry
 
+    verdicts = ["converged" if c else ("nan" if q else "max_iter")
+                for c, q in zip(conv, quar)]
     return SweepResult(
         r=r_mid.copy(),
         w=np.asarray(wage_from_r(r_mid, tech_alpha, tech_delta)),
@@ -599,4 +653,6 @@ def solve_equilibrium_sweep(
         mu=out.get("mu"),
         telemetry=host_telemetry(gap_hist),
         dist_telemetry=out.get("dist_telemetry"),
+        quarantined=quar,
+        verdicts=verdicts,
     )

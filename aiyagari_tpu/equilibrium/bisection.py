@@ -66,6 +66,10 @@ class EquilibriumResult:
     # The FINAL distribution solve's device flight record, when the
     # non-stochastic closure ran with SolverConfig.telemetry set.
     dist_telemetry: object = None
+    # Structured failure verdict ("" healthy; "nan"/"stall"/"explode" when
+    # the host-side sentinel tripped on the gap trajectory, diagnostics/
+    # sentinel.host_verdict — only armed when SolverConfig.sentinel is set).
+    verdict: str = ""
 
     def health(self, model=None) -> dict:
         """The health certificate for this solve (diagnostics/health.py):
@@ -109,6 +113,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 tol=solver.tol, max_iter=solver.max_iter, howard_steps=solver.howard_steps,
                 relative_tol=solver.relative_tol, progress_every=solver.progress_every,
                 ladder=solver.ladder, telemetry=solver.telemetry,
+                sentinel=solver.sentinel, faults=solver.faults,
             )
         return solve_aiyagari_vfi(
             v0, model.a_grid, model.s, model.P, r, w,
@@ -117,6 +122,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             block_size=block_size, relative_tol=solver.relative_tol,
             use_pallas=solver.use_pallas, progress_every=solver.progress_every,
             ladder=solver.ladder, telemetry=solver.telemetry,
+            sentinel=solver.sentinel, faults=solver.faults,
         )
     if solver.method == "egm":
         from aiyagari_tpu.parallel.ring import ring_slab_fits
@@ -176,6 +182,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     grid_power=model.config.grid.power,
                     accel=solver.accel, ladder=solver.ladder,
                     telemetry=solver.telemetry,
+                    sentinel=solver.sentinel, faults=solver.faults,
                 )
             else:
                 sol = solve_aiyagari_egm_sharded(
@@ -186,6 +193,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     grid_power=model.config.grid.power,
                     accel=solver.accel, ladder=solver.ladder,
                     telemetry=solver.telemetry,
+                    sentinel=solver.sentinel, faults=solver.faults,
                 )
             if not bool(sol.escaped):
                 return sol
@@ -221,6 +229,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                     progress_every=solver.progress_every,
                     accel=solver.accel, ladder=solver.ladder,
                     telemetry=solver.telemetry,
+                    sentinel=solver.sentinel, faults=solver.faults,
                 )
             from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
@@ -232,6 +241,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 progress_every=solver.progress_every,
                 accel=solver.accel, ladder=solver.ladder,
                 telemetry=solver.telemetry,
+                sentinel=solver.sentinel, faults=solver.faults,
             )
         C0 = warm_start if warm_start is not None else _initial_consumption_guess(model, r, w)
         if model.config.endogenous_labor:
@@ -245,6 +255,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 grid_power=model.config.grid.power,
                 accel=solver.accel, ladder=solver.ladder,
                 telemetry=solver.telemetry,
+                sentinel=solver.sentinel, faults=solver.faults,
             )
         return solve_aiyagari_egm_safe(
             C0, model.a_grid, model.s, model.P, r, w, model.amin,
@@ -257,6 +268,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             grid_power=model.config.grid.power,
             accel=solver.accel, ladder=solver.ladder,
             telemetry=solver.telemetry,
+            sentinel=solver.sentinel, faults=solver.faults,
         )
     raise ValueError(f"unknown method {solver.method!r}; expected 'vfi' or 'egm'")
 
@@ -314,7 +326,8 @@ class _DistributionAggregator:
 
     def __init__(self, model: AiyagariModel, dist_tol: float,
                  dist_max_iter: int, accel=None, ladder=None,
-                 pushforward: str = "auto", telemetry=None):
+                 pushforward: str = "auto", telemetry=None, sentinel=None,
+                 faults=None):
         self.model = model
         self.dist_tol = dist_tol
         self.dist_max_iter = dist_max_iter
@@ -322,6 +335,8 @@ class _DistributionAggregator:
         self.ladder = ladder
         self.pushforward = pushforward
         self.telemetry = telemetry
+        self.sentinel = sentinel
+        self.faults = faults
         self.series = None
         self.mu = None
         self.dist_telemetry = None   # the LAST solve's flight record
@@ -364,6 +379,7 @@ class _DistributionAggregator:
             tol=self.dist_tol, max_iter=self.dist_max_iter, mu_init=self.mu,
             accel=self.accel, ladder=self.ladder,
             pushforward=self.pushforward, telemetry=self.telemetry,
+            sentinel=self.sentinel, faults=self.faults,
         )
         self.mu = dist_sol.mu
         self.dist_telemetry = dist_sol.telemetry
@@ -441,6 +457,7 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         warm = _warm_state(sol, solver.method)
 
     converged = False
+    verdict = ""
     r_mid = eq.r_init
     for it in range(start_it, eq.max_iter):
         it_t0 = time.perf_counter()
@@ -471,6 +488,19 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         if abs(supply - demand) < eq.tol:
             converged = True
             break
+        # Host-side failure sentinel on the outer gap trajectory (only
+        # armed when SolverConfig.sentinel is set): a NaN supply, an
+        # exploding gap, or a stalled bracket exits with a structured
+        # verdict instead of burning the remaining bisection rounds on a
+        # poisoned household solution.
+        if solver.sentinel is not None:
+            from aiyagari_tpu.diagnostics.sentinel import host_verdict
+
+            verdict = host_verdict(
+                [abs(s - d) for s, d in zip(ks_hist, kd_hist)],
+                solver.sentinel)
+            if verdict:
+                break
         if supply > demand:
             r_high = r_mid
         else:
@@ -513,6 +543,7 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         telemetry=host_telemetry(
             [abs(s - d) for s, d in zip(ks_hist, kd_hist)]),
         dist_telemetry=getattr(aggregator, "dist_telemetry", None),
+        verdict=verdict,
     )
 
 
@@ -566,7 +597,9 @@ def solve_equilibrium_distribution(
         _DistributionAggregator(model, dist_tol, dist_max_iter,
                                 accel=solver.accel, ladder=solver.ladder,
                                 pushforward=solver.pushforward,
-                                telemetry=solver.telemetry),
+                                telemetry=solver.telemetry,
+                                sentinel=solver.sentinel,
+                                faults=solver.faults),
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,

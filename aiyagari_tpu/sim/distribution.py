@@ -27,6 +27,13 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.faults import forces_fallback, poison_iterate
+from aiyagari_tpu.diagnostics.sentinel import (
+    sentinel_cond,
+    sentinel_init,
+    sentinel_stage_reset,
+    sentinel_update,
+)
 from aiyagari_tpu.diagnostics.telemetry import (
     telemetry_add_fallbacks,
     telemetry_init,
@@ -72,6 +79,9 @@ class DistributionSolution:
     # residuals + stage dtypes + accel trips + push-forward fallback sweeps
     # when `telemetry` is set; None when the recorder was compiled out.
     telemetry: object = None
+    # Failure-sentinel state (diagnostics/sentinel.py) with the structured
+    # early-exit verdict, when `sentinel` is set; None when compiled out.
+    sentinel: object = None
 
 
 # Loud diagnosis of degenerate lottery brackets (duplicate adjacent grid
@@ -168,13 +178,15 @@ def expectation_step(f, idx, w_lo, P):
 
 
 @partial(jax.jit, static_argnames=("noise_floor_ulp", "accel", "ladder",
-                                   "pushforward", "telemetry"))
+                                   "pushforward", "telemetry", "sentinel",
+                                   "faults"))
 def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                             max_iter=10_000, mu_init=None,
                             noise_floor_ulp: float = 0.0,
                             accel=None, ladder=None,
                             pushforward: str = "auto",
-                            telemetry=None) -> DistributionSolution:
+                            telemetry=None, sentinel=None,
+                            faults=None) -> DistributionSolution:
     """Iterate distribution_step to a sup-norm fixed point on device.
 
     The whole loop is one lax.while_loop program; the host sees only the
@@ -225,6 +237,15 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     policy — one push-forward fallback count per degraded sweep, all
     returned as DistributionSolution.telemetry. None compiles the recorder
     out entirely.
+
+    sentinel (a SentinelConfig, static) carries the failure sentinel
+    (diagnostics/sentinel.py): non-finite residuals, stalls, and
+    explosions early-exit the loop with a structured verdict on
+    DistributionSolution.sentinel — the stall watch matters most HERE,
+    where max_iter is 10k and an unreachable tolerance otherwise burns all
+    of it at the noise floor. faults (a FaultPlan, static) compiles in the
+    deterministic injection points (NaN at sweep k; forced push-forward
+    fallback). Both default None and compile out entirely.
     """
     N, na = policy_k.shape
     if mu_init is None:
@@ -235,7 +256,7 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
     max_it = jnp.asarray(max_iter, jnp.int32)
     stages = plan_stages(ladder, mu0.dtype, noise_floor_ulp)
 
-    def run_stage(spec, mu_in, it0, tele_in):
+    def run_stage(spec, mu_in, it0, tele_in, sent_in):
         dt = jnp.dtype(spec.dtype)
         # "highest" for final/no-ladder stages (the historical pinned
         # precision); a hot stage's configured relaxation otherwise.
@@ -248,10 +269,21 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
         # Per-stage plan (the band/bounds cast with the stage dtype),
         # hoisted out of the while_loop: one lottery, thousands of sweeps.
         plan = plan_pushforward(idx, w_lo_d, backend=pushforward)
+        if forces_fallback(faults) and plan.ok is not None:
+            # Injected degradation (diagnostics/faults.py): the plan's
+            # validity flag is forced false, so every sweep takes the
+            # compiled-in scatter fallback and tallies a degradation —
+            # the CI battery's way of exercising the fallback-counting
+            # path on a healthy policy.
+            plan = dataclasses.replace(plan, ok=jnp.zeros_like(plan.ok))
         tol_c = jnp.asarray(tol, dt)
         ast0 = accel_init(mu, accel) if accel is not None else None
         trip0 = (tele_in.accel_trips
                  if (tele_in is not None and accel is not None) else None)
+        # Per-stage sentinel reference restart: a hot stage exits AT its
+        # noise floor, and its `best` would falsely stall the f64 polish
+        # (sentinel_stage_reset docstring).
+        sent_in = sentinel_stage_reset(sent_in)
         # Degraded-sweep tally: the plan is hoisted, so an invalid
         # scatter-free route (plan.ok False) degrades EVERY sweep of this
         # stage — one fallback event per sweep keeps the count honest.
@@ -260,19 +292,21 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                         else None)
 
         def cond(carry):
-            _, _, dist, it, tol_eff, _, _ = carry
-            return (dist >= tol_eff) & (it < max_it)
+            _, _, dist, it, tol_eff, _, _, sent = carry
+            return sentinel_cond(sent, (dist >= tol_eff) & (it < max_it))
 
         def body(carry):
-            mu, _, _, it, _, ast, tele = carry
+            mu, _, _, it, _, ast, tele, sent = carry
             mu_new = apply_pushforward(plan, mu, P_d, precision=prec)
             mu_new = mu_new / jnp.sum(mu_new)
+            mu_new = poison_iterate(faults, mu_new, it)
             dist = jnp.max(jnp.abs(mu_new - mu))
             tol_eff = effective_tolerance(
                 tol_c, jnp.max(jnp.abs(mu_new)),
                 noise_floor_ulp=spec.noise_floor_ulp,
                 relative_tol=False, dtype=dt)
             tele = telemetry_record(tele, dist)
+            sent = sentinel_update(sent, dist, config=sentinel)
             if fb_per_sweep is not None:
                 tele = telemetry_add_fallbacks(tele, fb_per_sweep)
             if accel is None:
@@ -282,26 +316,28 @@ def stationary_distribution(policy_k, a_grid, P, *, tol=1e-10,
                                           project=project_simplex)
                 if trip0 is not None:
                     tele = telemetry_set_trips(tele, trip0 + ast.trips)
-            return mu_next, mu_new, dist, it + 1, tol_eff, ast, tele
+            return mu_next, mu_new, dist, it + 1, tol_eff, ast, tele, sent
 
-        _, mu, dist, it, _, _, tele = jax.lax.while_loop(
+        _, mu, dist, it, _, _, tele, sent = jax.lax.while_loop(
             cond, body,
-            (mu, mu, jnp.array(jnp.inf, dt), it0, tol_c, ast0, tele_in)
+            (mu, mu, jnp.array(jnp.inf, dt), it0, tol_c, ast0, tele_in,
+             sent_in)
         )
-        return mu, dist, it, tele
+        return mu, dist, it, tele, sent
 
     mu, it = mu0, jnp.int32(0)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
     tele = telemetry_init(telemetry)
+    sent = sentinel_init(sentinel)
     dist = None
     for spec in stages:
-        mu, dist, it, tele = run_stage(spec, mu, it, tele)
+        mu, dist, it, tele, sent = run_stage(spec, mu, it, tele, sent)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
     return DistributionSolution(mu, it, dist, hot_it, switch_dist,
-                                telemetry=tele)
+                                telemetry=tele, sentinel=sent)
 
 
 def aggregate_capital(mu, a_grid):

@@ -11,7 +11,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.faults import force_escape_point, poison_iterate
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.diagnostics.sentinel import (
+    sentinel_cond,
+    sentinel_init,
+    sentinel_stage_reset,
+    sentinel_update,
+)
 from aiyagari_tpu.diagnostics.telemetry import (
     telemetry_init,
     telemetry_record,
@@ -138,15 +145,20 @@ class EGMSolution:
     # through the while_loop when SolverConfig.telemetry is set; None (the
     # default, an empty pytree leaf) when the recorder was compiled out.
     telemetry: object = None
+    # Failure-sentinel state (diagnostics/sentinel.py): the structured
+    # verdict (nan/stall/explode/escape) the loop early-exited with, when
+    # SolverConfig.sentinel is set; None when the sentinel was compiled out.
+    sentinel: object = None
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder", "telemetry"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder", "telemetry", "sentinel", "faults"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
                        use_pallas: bool = False, accel=None,
-                       ladder=None, telemetry=None) -> EGMSolution:
+                       ladder=None, telemetry=None, sentinel=None,
+                       faults=None) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
     (Aiyagari_EGM.m:106, tol 1e-5, <=1000 iterations). progress_every>0 emits
     an in-jit telemetry record every that-many sweeps (diagnostics.progress).
@@ -197,12 +209,21 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     residual and its stage dtype land in a fixed-length ring in the carry,
     accel safeguard trips are tallied, and the buffers come back as
     EGMSolution.telemetry. None compiles the recorder out entirely — the
-    traced program is identical to the recorder-free one."""
+    traced program is identical to the recorder-free one.
+
+    sentinel (a SentinelConfig, static) carries the failure sentinel
+    (diagnostics/sentinel.py) through the loop: non-finite residuals (split
+    into "escape" vs "nan" by the windowed-inversion escape flag), stalls,
+    and explosions early-exit the loop with a structured verdict on
+    EGMSolution.sentinel. faults (a FaultPlan, static) compiles in the
+    deterministic injection points of diagnostics/faults.py — test/CI
+    machinery, never production. Both None (the default) compile out
+    entirely, same zero-cost contract as telemetry."""
 
     stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def run_stage(spec, C0, pk0, it0, esc0, tele_in):
+    def run_stage(spec, C0, pk0, it0, esc0, tele_in, sent_in):
         dt = jnp.dtype(spec.dtype)
         Cd = C0.astype(dt)
         ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
@@ -214,18 +235,24 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
         # the recorder's running total is stage base + the state's counter.
         trip0 = (tele_in.accel_trips
                  if (tele_in is not None and accel is not None) else None)
+        # The sentinel's stall/explosion references restart per stage too —
+        # a hot stage's noise-floor best must not stall the polish
+        # (sentinel_stage_reset docstring; the accel-history lesson).
+        sent_in = sentinel_stage_reset(sent_in)
 
         def cond(carry):
-            _, _, _, dist, it, _, tol_eff, _, _ = carry
-            return (dist >= tol_eff) & (it < max_iter)
+            _, _, _, dist, it, _, tol_eff, _, _, sent = carry
+            return sentinel_cond(sent, (dist >= tol_eff) & (it < max_iter))
 
         def body(carry):
-            C, _, _, _, it, esc, _, ast, tele = carry
+            C, _, _, _, it, esc, _, ast, tele, sent = carry
             C_new, policy_k, esc_new = egm_step(
                 C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
                 grid_power=grid_power, with_escape=True,
                 use_pallas=use_pallas,
                 matmul_precision=spec.matmul_precision)
+            C_new = poison_iterate(faults, C_new, it)
+            C_new, esc_new = force_escape_point(faults, C_new, esc_new)
             diff = jnp.abs(C_new - C)
             dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
             tol_eff = effective_tolerance(
@@ -234,6 +261,8 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_egm", it + 1, dist, every=progress_every)
             tele = telemetry_record(tele, dist)
+            sent = sentinel_update(sent, dist, config=sentinel,
+                                   escaped=esc | esc_new)
             if accel is None:
                 C_next = C_new
             else:
@@ -241,30 +270,31 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 if trip0 is not None:
                     tele = telemetry_set_trips(tele, trip0 + ast.trips)
             return (C_next, C_new, policy_k, dist, it + 1, esc | esc_new,
-                    tol_eff, ast, tele)
+                    tol_eff, ast, tele, sent)
 
         init = (Cd, Cd, pk0.astype(dt), jnp.array(jnp.inf, dt), it0, esc0,
-                tol_c, ast0, tele_in)
+                tol_c, ast0, tele_in, sent_in)
         out = jax.lax.while_loop(cond, body, init)
         # (image C, policy_k, dist, it, esc, tol_eff) — the image, not the
         # accelerated carry, crosses the stage boundary: it is the certified
         # sweep output the stopping rule measured.
-        return out[1], out[2], out[3], out[4], out[5], out[6], out[8]
+        return out[1], out[2], out[3], out[4], out[5], out[6], out[8], out[9]
 
     C, policy_k = C_init, jnp.zeros_like(C_init)
     it, esc = jnp.int32(0), jnp.array(False)
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, stages[-1].dtype)
     tele = telemetry_init(telemetry)
+    sent = sentinel_init(sentinel)
     dist = tol_eff = None
     for spec in stages:
-        C, policy_k, dist, it, esc, tol_eff, tele = run_stage(
-            spec, C, policy_k, it, esc, tele)
+        C, policy_k, dist, it, esc, tol_eff, tele, sent = run_stage(
+            spec, C, policy_k, it, esc, tele, sent)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
     return EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
-                       hot_it, switch_dist, telemetry=tele)
+                       hot_it, switch_dist, telemetry=tele, sentinel=sent)
 
 
 def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
@@ -273,7 +303,8 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             grid_power: float = 0.0,
                             noise_floor_ulp: float = 0.0,
                             use_pallas: bool = False, accel=None,
-                            ladder=None, telemetry=None) -> EGMSolution:
+                            ladder=None, telemetry=None, sentinel=None,
+                            faults=None) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
     fast-path inversion: if the power-grid inversion's query-block windows
     cannot cover the endogenous grid's local knot density, it poisons the
@@ -292,7 +323,8 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                              grid_power=grid_power,
                              noise_floor_ulp=noise_floor_ulp,
                              use_pallas=use_pallas, accel=accel, ladder=ladder,
-                             telemetry=telemetry)
+                             telemetry=telemetry, sentinel=sentinel,
+                             faults=faults)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                                  beta=beta, tol=tol, max_iter=max_iter,
@@ -300,11 +332,12 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                                  progress_every=progress_every,
                                  grid_power=0.0,
                                  noise_floor_ulp=noise_floor_ulp, accel=accel,
-                                 ladder=ladder, telemetry=telemetry)
+                                 ladder=ladder, telemetry=telemetry,
+                                 sentinel=sentinel, faults=faults)
     return sol
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder", "telemetry"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder", "telemetry", "sentinel", "faults"))
 def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                              psi, eta, tol: float, max_iter: int,
                              relative_tol: bool = False,
@@ -312,7 +345,8 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                              grid_power: float = 0.0,
                              noise_floor_ulp: float = 0.0,
                              accel=None, ladder=None,
-                             telemetry=None) -> EGMSolution:
+                             telemetry=None, sentinel=None,
+                             faults=None) -> EGMSolution:
     """EGM with the closed-form intratemporal labor FOC
     (Aiyagari_Endogenous_Labor_EGM.m:67-107). grid_power > 0 routes the
     consumption re-interpolation through the windowed value-interpolation
@@ -328,7 +362,7 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     stages = plan_stages(ladder, C_init.dtype, noise_floor_ulp)
     proj = project_floor()
 
-    def run_stage(spec, C0, pk0, pl0, it0, esc0, tele_in):
+    def run_stage(spec, C0, pk0, pl0, it0, esc0, tele_in, sent_in):
         dt = jnp.dtype(spec.dtype)
         Cd = C0.astype(dt)
         ag, sd, Pd = a_grid.astype(dt), s.astype(dt), P.astype(dt)
@@ -344,18 +378,24 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
         ast0 = accel_init(Cd, accel) if accel is not None else None
         trip0 = (tele_in.accel_trips
                  if (tele_in is not None and accel is not None) else None)
+        # Per-stage stall/explosion-reference restart (exogenous-family
+        # rationale above).
+        sent_in = sentinel_stage_reset(sent_in)
 
         def cond(carry):
-            return (carry[4] >= carry[7]) & (carry[5] < max_iter)
+            return sentinel_cond(
+                carry[10], (carry[4] >= carry[7]) & (carry[5] < max_iter))
 
         def body(carry):
-            C, _, _, _, _, it, esc, _, ast, tele = carry
+            C, _, _, _, _, it, esc, _, ast, tele, sent = carry
             C_new, policy_k, policy_l, esc_new = egm_step_labor(
                 C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
                 psi=psid, eta=etad, c_constrained=c_con,
                 grid_power=grid_power, with_escape=True,
                 matmul_precision=spec.matmul_precision,
             )
+            C_new = poison_iterate(faults, C_new, it)
+            C_new, esc_new = force_escape_point(faults, C_new, esc_new)
             diff = jnp.abs(C_new - C)
             dist = jnp.max(diff / (jnp.abs(C) + 1e-10)) if relative_tol else jnp.max(diff)
             tol_eff = effective_tolerance(
@@ -364,6 +404,8 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_egm_labor", it + 1, dist, every=progress_every)
             tele = telemetry_record(tele, dist)
+            sent = sentinel_update(sent, dist, config=sentinel,
+                                   escaped=esc | esc_new)
             if accel is None:
                 C_next = C_new
             else:
@@ -371,12 +413,14 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                 if trip0 is not None:
                     tele = telemetry_set_trips(tele, trip0 + ast.trips)
             return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                    esc | esc_new, tol_eff, ast, tele)
+                    esc | esc_new, tol_eff, ast, tele, sent)
 
         init = (Cd, Cd, pk0.astype(dt), pl0.astype(dt),
-                jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0, tele_in)
+                jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0, tele_in,
+                sent_in)
         out = jax.lax.while_loop(cond, body, init)
-        return out[1], out[2], out[3], out[4], out[5], out[6], out[7], out[9]
+        return (out[1], out[2], out[3], out[4], out[5], out[6], out[7],
+                out[9], out[10])
 
     z = jnp.zeros_like(C_init)
     C, policy_k, policy_l = C_init, z, z
@@ -384,15 +428,16 @@ def solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, stages[-1].dtype)
     tele = telemetry_init(telemetry)
+    sent = sentinel_init(sentinel)
     dist = tol_eff = None
     for spec in stages:
-        C, policy_k, policy_l, dist, it, esc, tol_eff, tele = run_stage(
-            spec, C, policy_k, policy_l, it, esc, tele)
+        C, policy_k, policy_l, dist, it, esc, tol_eff, tele, sent = run_stage(
+            spec, C, policy_k, policy_l, it, esc, tele, sent)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
     return EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
-                       hot_it, switch_dist, telemetry=tele)
+                       hot_it, switch_dist, telemetry=tele, sentinel=sent)
 
 
 def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
@@ -403,7 +448,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                   grid_power: float = 0.0,
                                   noise_floor_ulp: float = 0.0,
                                   accel=None, ladder=None,
-                                  telemetry=None) -> EGMSolution:
+                                  telemetry=None, sentinel=None,
+                                  faults=None) -> EGMSolution:
     """Host-level escape retry for the labor family (the exact analogue of
     solve_aiyagari_egm_safe: re-solve on the generic route only when the
     windowed fast path actually escaped)."""
@@ -415,7 +461,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                    grid_power=grid_power,
                                    noise_floor_ulp=noise_floor_ulp,
                                    accel=accel, ladder=ladder,
-                                   telemetry=telemetry)
+                                   telemetry=telemetry, sentinel=sentinel,
+                                   faults=faults)
     if grid_power > 0.0 and bool(sol.escaped):
         sol = solve_aiyagari_egm_labor(C_init, a_grid, s, P, r, w, amin,
                                        sigma=sigma, beta=beta, psi=psi, eta=eta,
@@ -425,7 +472,8 @@ def solve_aiyagari_egm_labor_safe(C_init, a_grid, s, P, r, w, amin, *,
                                        grid_power=0.0,
                                        noise_floor_ulp=noise_floor_ulp,
                                        accel=accel, ladder=ladder,
-                                       telemetry=telemetry)
+                                       telemetry=telemetry, sentinel=sentinel,
+                                       faults=faults)
     return sol
 
 
@@ -473,13 +521,15 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
                                    "tol", "max_iter", "relative_tol",
                                    "progress_every", "grid_power",
                                    "noise_floor_ulp", "use_pallas", "accel",
-                                   "ladder", "telemetry"))
+                                   "ladder", "telemetry", "sentinel",
+                                   "faults"))
 def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                       hi: float, sigma: float, beta: float, tol: float,
                       max_iter: int, relative_tol: bool, progress_every: int,
                       grid_power: float, noise_floor_ulp: float,
                       use_pallas: bool, accel=None, ladder=None,
-                      telemetry=None) -> EGMSolution:
+                      telemetry=None, sentinel=None,
+                      faults=None) -> EGMSolution:
     """The whole fast-path stage ladder traced as ONE device program:
     stage solve -> prolong -> next stage, unrolled over the static `sizes`
     tuple. Why one program: each separately-jitted stage costs a ~100 ms
@@ -508,10 +558,12 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                else _warm_stage_knobs(ladder, noise_floor_ulp))
         if i > 0:
             C = prolong_power_grid(sol.policy_c, lo, hi, grid_power, n)
-        # The flight recorder rides the FINAL stage only: warm stages are
-        # prolongation inputs, not certified solutions, and keeping them
-        # recorder-free keeps their programs bit-identical to the
-        # telemetry-off ladder.
+        # The flight recorder and the failure sentinel ride the FINAL stage
+        # only: warm stages are prolongation inputs, not certified
+        # solutions, and keeping them recorder-free keeps their programs
+        # bit-identical to the telemetry-off ladder. Injected faults hit
+        # the final stage too — the certified product is the one the
+        # recovery machinery must see fail.
         sol = solve_aiyagari_egm(C, g, s, P, r, w, amin,
                                  sigma=sigma, beta=beta, tol=tol,
                                  max_iter=max_iter,
@@ -521,7 +573,9 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  noise_floor_ulp=st_floor,
                                  use_pallas=use_pallas, accel=accel,
                                  ladder=st_ladder,
-                                 telemetry=telemetry if final else None)
+                                 telemetry=telemetry if final else None,
+                                 sentinel=sentinel if final else None,
+                                 faults=faults if final else None)
         esc = esc | sol.escaped
     return dataclasses.replace(sol, escaped=esc)
 
@@ -603,7 +657,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   noise_floor_ulp: float = 0.0,
                                   use_pallas: bool = False,
                                   accel=None, ladder=None,
-                                  telemetry=None) -> EGMSolution:
+                                  telemetry=None, sentinel=None,
+                                  faults=None) -> EGMSolution:
     """Grid-sequenced EGM: solve on a coarse grid first, prolong the
     consumption policy to each finer grid, and re-converge there.
 
@@ -651,7 +706,8 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                             grid_power=grid_power,
                             noise_floor_ulp=noise_floor_ulp,
                             use_pallas=use_pallas, accel=accel, ladder=ladder,
-                            telemetry=telemetry)
+                            telemetry=telemetry, sentinel=sentinel,
+                            faults=faults)
     sol = _fetch_scalars(sol)
     # Retry only arms when some stage's windowed route actually escaped; a
     # NaN distance with escaped=False is genuine divergence and surfaces.
@@ -664,7 +720,9 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                 max_iter=max_iter, relative_tol=relative_tol,
                 progress_every=progress_every, grid_power=0.0,
                 noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder,
-                telemetry=telemetry if final else None)
+                telemetry=telemetry if final else None,
+                sentinel=sentinel if final else None,
+                faults=faults if final else None)
 
         sol = _host_ladder(
             a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,
@@ -682,7 +740,8 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                                         progress_every: int = 0,
                                         noise_floor_ulp: float = 0.0,
                                         accel=None, ladder=None,
-                                        telemetry=None) -> EGMSolution:
+                                        telemetry=None, sentinel=None,
+                                        faults=None) -> EGMSolution:
     """Grid-sequenced EGM for the endogenous-labor family — the same nested
     iteration as solve_aiyagari_egm_multiscale (see its docstring for the
     rationale and escape handling). Only the consumption policy C is
@@ -712,7 +771,9 @@ def solve_aiyagari_egm_labor_multiscale(a_grid, s, P, r, w, amin, *,
                 relative_tol=relative_tol, progress_every=progress_every,
                 grid_power=grid_power if fast else 0.0,
                 noise_floor_ulp=st_floor, accel=accel, ladder=st_ladder,
-                telemetry=telemetry if final else None)
+                telemetry=telemetry if final else None,
+                sentinel=sentinel if final else None,
+                faults=faults if final else None)
 
         return _host_ladder(
             a_grid, s, r, w, sizes=tuple(sizes), lo=lo, hi=hi,

@@ -40,6 +40,15 @@ import jax.numpy as jnp
 
 from aiyagari_tpu.parallel.mesh import PartitionSpec as P, shard_map as _shard_map
 
+from aiyagari_tpu.diagnostics.faults import force_escape_point, poison_iterate
+from aiyagari_tpu.diagnostics.sentinel import (
+    sentinel_cond,
+    sentinel_from_leaves,
+    sentinel_init,
+    sentinel_leaves,
+    sentinel_stage_reset,
+    sentinel_update,
+)
 from aiyagari_tpu.diagnostics.telemetry import (
     telemetry_from_leaves,
     telemetry_init,
@@ -80,9 +89,19 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                                pad: int = 8,
                                axis: str = "grid",
                                accel=None, ladder=None,
-                               telemetry=None) -> EGMSolution:
+                               telemetry=None, sentinel=None,
+                               faults=None) -> EGMSolution:
     """solve_aiyagari_egm with the grid axis sharded over mesh[axis] and the
     knots resident per device (module docstring).
+
+    sentinel carries the failure sentinel (diagnostics/sentinel.py) through
+    the sharded while_loop: the watched residual is the pmax'd GLOBAL
+    sup-norm and the escape flag is pmax'd too, so every device computes
+    the identical verdict and the lockstep loop early-exits on all devices
+    at the same sweep; the state crosses the shard_map boundary as
+    replicated leaves like the telemetry recorder. faults compiles in the
+    deterministic injection points (diagnostics/faults.py). Both None by
+    default — compiled out, program unchanged.
 
     telemetry (a TelemetryConfig) carries the device-resident flight
     recorder through the sharded while_loop (diagnostics/telemetry.py).
@@ -148,28 +167,31 @@ def solve_aiyagari_egm_sharded(mesh, C_init, a_grid, s, P_mat, r, w, amin, *,
                        float(capacity), int(pad), float(sigma), float(beta),
                        float(tol), int(max_iter), bool(relative_tol),
                        float(noise_floor_ulp), jnp.dtype(dtype).name, accel,
-                       ladder, telemetry)
-    C, policy_k, dist, it, esc, tol_eff, hot_it, sw_dist, *tele_leaves = run(
+                       ladder, telemetry, sentinel, faults)
+    C, policy_k, dist, it, esc, tol_eff, hot_it, sw_dist, *extra = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
+    n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
     return _fetch_scalars(
         EGMSolution(C, policy_k, jnp.ones_like(C), it, dist, esc, tol_eff,
                     hot_it, sw_dist,
-                    telemetry=telemetry_from_leaves(tele_leaves)))
+                    telemetry=telemetry_from_leaves(extra[:n_tele]),
+                    sentinel=sentinel_from_leaves(extra[n_tele:])))
 
 
 def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                  power: float, capacity: float, pad: int, sigma: float,
                  beta: float, tol: float, max_iter: int, relative_tol: bool,
                  noise_floor_ulp: float, dtype_name: str, accel=None,
-                 ladder=None, telemetry=None):
+                 ladder=None, telemetry=None, sentinel=None, faults=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     span = hi - lo
     proj = project_floor()
     stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
     n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
+    n_sent = len(sentinel_leaves(sentinel_init(sentinel)))
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
@@ -179,7 +201,7 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
             # unsharded routes interpolate onto bitwise-identical queries.
             j = dev * na_loc + jnp.arange(na_loc)
 
-            def run_stage(spec, C_in, pk_in, it0, esc0, tele_in):
+            def run_stage(spec, C_in, pk_in, it0, esc0, tele_in, sent_in):
                 dt = jnp.dtype(spec.dtype)
                 prec = matmul_precision_of(spec.matmul_precision)
                 a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
@@ -212,12 +234,16 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     return C_new, policy_k, esc
 
                 def cond(carry):
-                    _, _, _, dist, it, _, tol_eff, _, _ = carry
-                    return (dist >= tol_eff) & (it < max_iter)
+                    _, _, _, dist, it, _, tol_eff, _, _, sent = carry
+                    return sentinel_cond(
+                        sent, (dist >= tol_eff) & (it < max_iter))
 
                 def body(carry):
-                    C, _, _, _, it, esc, _, ast, tele = carry
+                    C, _, _, _, it, esc, _, ast, tele, sent = carry
                     C_new, policy_k, esc_new = sweep(C)
+                    C_new = poison_iterate(faults, C_new, it)
+                    C_new, esc_new = force_escape_point(faults, C_new,
+                                                        esc_new)
                     diff = jnp.abs(C_new - C)
                     # Same criterion family as solve_aiyagari_egm: relative
                     # sup-norm when asked, else absolute (+ optional floor).
@@ -234,6 +260,15 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     # The recorder sees the GLOBAL pmax'd residual, so every
                     # device's buffers stay bitwise identical (replicated).
                     tele = telemetry_record(tele, dist)
+                    if sentinel is not None:
+                        # The escape flag is LOCAL per device; pmax it so
+                        # every device's sentinel verdict is identical and
+                        # the lockstep loop exits on all devices together.
+                        esc_g = jax.lax.pmax(
+                            (esc | (esc_new > 0)).astype(jnp.int32),
+                            axis) > 0
+                        sent = sentinel_update(sent, dist, config=sentinel,
+                                               escaped=esc_g)
                     if accel is None:
                         C_next = C_new
                     else:
@@ -244,7 +279,7 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         if trip0 is not None:
                             tele = telemetry_set_trips(tele, trip0 + ast.trips)
                     return (C_next, C_new, policy_k, dist, it + 1,
-                            esc | (esc_new > 0), tol_eff, ast, tele)
+                            esc | (esc_new > 0), tol_eff, ast, tele, sent)
 
                 # Fresh acceleration history per stage: a stale hot-dtype
                 # residual history would poison the polish's normal
@@ -254,37 +289,43 @@ def _egm_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                 trip0 = (tele_in.accel_trips
                          if (tele_in is not None and accel is not None)
                          else None)
+                # Per-stage sentinel reference restart (the accel-history
+                # lesson; sentinel_stage_reset docstring).
+                sent_in = sentinel_stage_reset(sent_in)
                 init = (Cd, Cd, pk_in.astype(dt), jnp.array(jnp.inf, dt),
-                        it0, esc0, tol_c, ast0, tele_in)
+                        it0, esc0, tol_c, ast0, tele_in, sent_in)
                 out = jax.lax.while_loop(cond, body, init)
-                return out[1], out[2], out[3], out[4], out[5], out[6], out[8]
+                return (out[1], out[2], out[3], out[4], out[5], out[6],
+                        out[8], out[9])
 
             C, pk = C0, jnp.zeros_like(C0)
             it, esc = jnp.int32(0), jnp.array(False)
             hot_it = jnp.int32(0)
             sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
             tele = telemetry_init(telemetry)
+            sent = sentinel_init(sentinel)
             dist = tol_eff = None
             for spec in stages:
-                C, pk, dist, it, esc, tol_eff, tele = run_stage(
-                    spec, C, pk, it, esc, tele)
+                C, pk, dist, it, esc, tol_eff, tele, sent = run_stage(
+                    spec, C, pk, it, esc, tele, sent)
                 if not spec.is_final:
                     hot_it = it
                     sw = dist.astype(sw.dtype)
             return (C, pk, dist, it, esc, tol_eff, hot_it, sw,
-                    *telemetry_leaves(tele))
+                    *telemetry_leaves(tele), *sentinel_leaves(sent))
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(), P(), P(), P(),
-                       P(), P()) + (P(),) * n_tele,
+                       P(), P()) + (P(),) * (n_tele + n_sent),
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, tol, max_iter,
                                           relative_tol, noise_floor_ulp,
-                                          dtype_name, accel, ladder, telemetry)
+                                          dtype_name, accel, ladder, telemetry,
+                                          sentinel, faults)
     return cached_program(_EGM_PROGRAMS, key, build)
 
 
@@ -301,7 +342,8 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                                      pad: int = 8,
                                      axis: str = "grid",
                                      accel=None, ladder=None,
-                                     telemetry=None) -> EGMSolution:
+                                     telemetry=None, sentinel=None,
+                                     faults=None) -> EGMSolution:
     """solve_aiyagari_egm_labor with the grid axis sharded over mesh[axis]
     and the endogenous (knot, consumption) pairs resident per device — the
     labor-family form of solve_aiyagari_egm_sharded, generalizing the ring
@@ -349,16 +391,18 @@ def solve_aiyagari_egm_labor_sharded(mesh, C_init, a_grid, s, P_mat, r, w,
                              float(beta), float(psi), float(eta), float(tol),
                              int(max_iter), bool(relative_tol),
                              float(noise_floor_ulp), jnp.dtype(dtype).name,
-                             accel, ladder, telemetry)
+                             accel, ladder, telemetry, sentinel, faults)
     (C, policy_k, policy_l, dist, it, esc, tol_eff, hot_it, sw_dist,
-     *tele_leaves) = run(
+     *extra) = run(
         C_init, a_grid, s, P_mat,
         jnp.asarray(r, dtype), jnp.asarray(w, dtype), jnp.asarray(amin, dtype),
     )
+    n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
     return _fetch_scalars(
         EGMSolution(C, policy_k, policy_l, it, dist, esc, tol_eff,
                     hot_it, sw_dist,
-                    telemetry=telemetry_from_leaves(tele_leaves)))
+                    telemetry=telemetry_from_leaves(extra[:n_tele]),
+                    sentinel=sentinel_from_leaves(extra[n_tele:])))
 
 
 def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
@@ -366,20 +410,23 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                        beta: float, psi: float, eta: float, tol: float,
                        max_iter: int, relative_tol: bool,
                        noise_floor_ulp: float, dtype_name: str, accel=None,
-                       ladder=None, telemetry=None):
+                       ladder=None, telemetry=None, sentinel=None,
+                       faults=None):
     D = int(mesh.shape[axis])
     na_loc = na // D
     span = hi - lo
     proj = project_floor()
     stages = plan_stages(ladder, jnp.dtype(dtype_name), noise_floor_ulp)
     n_tele = len(telemetry_leaves(telemetry_init(telemetry)))
+    n_sent = len(sentinel_leaves(sentinel_init(sentinel)))
 
     def build():
         def local(C0, a_loc, s, Pm, r, w, amin):
             dev = jax.lax.axis_index(axis)
             j = dev * na_loc + jnp.arange(na_loc)
 
-            def run_stage(spec, C_in, pk_in, pl_in, it0, esc0, tele_in):
+            def run_stage(spec, C_in, pk_in, pl_in, it0, esc0, tele_in,
+                          sent_in):
                 dt = jnp.dtype(spec.dtype)
                 prec = matmul_precision_of(spec.matmul_precision)
                 a_l, s_d, P_d = a_loc.astype(dt), s.astype(dt), Pm.astype(dt)
@@ -441,12 +488,16 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                     return g_c, policy_k, policy_l, esc
 
                 def cond(carry):
-                    _, _, _, _, dist, it, _, tol_eff, _, _ = carry
-                    return (dist >= tol_eff) & (it < max_iter)
+                    _, _, _, _, dist, it, _, tol_eff, _, _, sent = carry
+                    return sentinel_cond(
+                        sent, (dist >= tol_eff) & (it < max_iter))
 
                 def body(carry):
-                    C, _, _, _, _, it, esc, _, ast, tele = carry
+                    C, _, _, _, _, it, esc, _, ast, tele, sent = carry
                     C_new, policy_k, policy_l, esc_new = sweep(C)
+                    C_new = poison_iterate(faults, C_new, it)
+                    C_new, esc_new = force_escape_point(faults, C_new,
+                                                        esc_new)
                     diff = jnp.abs(C_new - C)
                     local_d = (jnp.max(diff / (jnp.abs(C) + 1e-10))
                                if relative_tol else jnp.max(diff))
@@ -457,6 +508,14 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         relative_tol=relative_tol, dtype=dt)
                     # Global pmax'd residual: replicated recorder buffers.
                     tele = telemetry_record(tele, dist)
+                    if sentinel is not None:
+                        # Escape pmax'd so every device's verdict agrees
+                        # (the exogenous program's rationale).
+                        esc_g = jax.lax.pmax(
+                            (esc | (esc_new > 0)).astype(jnp.int32),
+                            axis) > 0
+                        sent = sentinel_update(sent, dist, config=sentinel,
+                                               escaped=esc_g)
                     if accel is None:
                         C_next = C_new
                     else:
@@ -465,19 +524,22 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
                         if trip0 is not None:
                             tele = telemetry_set_trips(tele, trip0 + ast.trips)
                     return (C_next, C_new, policy_k, policy_l, dist, it + 1,
-                            esc | (esc_new > 0), tol_eff, ast, tele)
+                            esc | (esc_new > 0), tol_eff, ast, tele, sent)
 
                 Cd = C_in.astype(dt)
                 ast0 = accel_init(Cd, accel) if accel is not None else None
                 trip0 = (tele_in.accel_trips
                          if (tele_in is not None and accel is not None)
                          else None)
+                # Per-stage sentinel reference restart (exogenous-program
+                # rationale).
+                sent_in = sentinel_stage_reset(sent_in)
                 init = (Cd, Cd, pk_in.astype(dt), pl_in.astype(dt),
                         jnp.array(jnp.inf, dt), it0, esc0, tol_c, ast0,
-                        tele_in)
+                        tele_in, sent_in)
                 out = jax.lax.while_loop(cond, body, init)
                 return (out[1], out[2], out[3], out[4], out[5], out[6],
-                        out[7], out[9])
+                        out[7], out[9], out[10])
 
             z = jnp.zeros_like(C0)
             C, pk, pl = C0, z, z
@@ -485,26 +547,28 @@ def _egm_labor_program(mesh, axis: str, N: int, na: int, lo: float, hi: float,
             hot_it = jnp.int32(0)
             sw = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
             tele = telemetry_init(telemetry)
+            sent = sentinel_init(sentinel)
             dist = tol_eff = None
             for spec in stages:
-                C, pk, pl, dist, it, esc, tol_eff, tele = run_stage(
-                    spec, C, pk, pl, it, esc, tele)
+                C, pk, pl, dist, it, esc, tol_eff, tele, sent = run_stage(
+                    spec, C, pk, pl, it, esc, tele, sent)
                 if not spec.is_final:
                     hot_it = it
                     sw = dist.astype(sw.dtype)
             return (C, pk, pl, dist, it, esc, tol_eff, hot_it, sw,
-                    *telemetry_leaves(tele))
+                    *telemetry_leaves(tele), *sentinel_leaves(sent))
 
         return jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(None, axis), P(axis), P(), P(), P(), P(), P()),
             out_specs=(P(None, axis), P(None, axis), P(None, axis),
-                       P(), P(), P(), P(), P(), P()) + (P(),) * n_tele,
+                       P(), P(), P(), P(), P(), P())
+            + (P(),) * (n_tele + n_sent),
         ))
 
     key = mesh_fingerprint(mesh, axis) + (N, na, lo, hi, power, capacity,
                                           pad, sigma, beta, psi, eta, tol,
                                           max_iter, relative_tol,
                                           noise_floor_ulp, dtype_name, accel,
-                                          ladder, telemetry)
+                                          ladder, telemetry, sentinel, faults)
     return cached_program(_EGM_LABOR_PROGRAMS, key, build)

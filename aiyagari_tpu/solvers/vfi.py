@@ -14,7 +14,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from aiyagari_tpu.diagnostics.faults import poison_iterate
 from aiyagari_tpu.diagnostics.progress import device_progress
+from aiyagari_tpu.diagnostics.sentinel import (
+    sentinel_cond,
+    sentinel_init,
+    sentinel_stage_reset,
+    sentinel_update,
+)
 from aiyagari_tpu.diagnostics.telemetry import telemetry_init, telemetry_record
 from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
@@ -78,6 +85,10 @@ class VFISolution:
     # value residuals + stage dtypes when SolverConfig.telemetry is set;
     # None (an empty pytree leaf) when the recorder was compiled out.
     telemetry: object = None
+    # Failure-sentinel state (diagnostics/sentinel.py) with the structured
+    # early-exit verdict, when SolverConfig.sentinel is set; None when the
+    # sentinel was compiled out.
+    sentinel: object = None
 
 
 def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
@@ -85,12 +96,13 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                              block_size: int = 0, relative_tol: bool = False,
                              use_pallas: bool = False, progress_every: int = 0,
                              noise_floor_ulp: float = 0.0,
-                             ladder=None, telemetry=None) -> VFISolution:
+                             ladder=None, telemetry=None, sentinel=None,
+                             faults=None) -> VFISolution:
     stages = plan_stages(ladder, v_init.dtype, noise_floor_ulp)
     na = v_init.shape[1]
     dense = block_size <= 0 or block_size >= na
 
-    def run_stage(spec, v0, idx0, it0, tele_in):
+    def run_stage(spec, v0, idx0, it0, tele_in, sent_in):
         dt = jnp.dtype(spec.dtype)
         # None = backend default; the ladder's hot stages may relax the
         # expectation contraction (bf16 MXU on TPU), the final/no-ladder
@@ -101,6 +113,9 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
         rd, wd = jnp.asarray(r).astype(dt), jnp.asarray(w).astype(dt)
         sig, bet = jnp.asarray(sigma).astype(dt), jnp.asarray(beta).astype(dt)
         tol_c = jnp.asarray(tol, dt)
+        # Per-stage sentinel reference restart: a hot stage's noise-floor
+        # best must not stall the polish (sentinel_stage_reset docstring).
+        sent_in = sentinel_stage_reset(sent_in)
 
         def eval_sweeps(v, idx):
             if howard_steps <= 0:
@@ -115,8 +130,8 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
             return v
 
         def cond(carry):
-            _, _, dist, it, tol_eff, _ = carry
-            return (dist >= tol_eff) & (it < max_iter)
+            _, _, dist, it, tol_eff, _, sent = carry
+            return sentinel_cond(sent, (dist >= tol_eff) & (it < max_iter))
 
         # Dense path: the masked choice-utility tensor is loop-invariant, so
         # compute it once here (per ladder stage: loop-invariant but
@@ -130,7 +145,7 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
              if dense and not use_pallas else None)
 
         def body(carry):
-            v, idx, _, it, _, tele = carry
+            v, idx, _, it, _, tele, sent = carry
             if U is not None:
                 v_new, idx = bellman_step_precomputed(v, U, Pd, beta=bet,
                                                       precision=prec)
@@ -139,6 +154,7 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                                           beta=bet, block_size=block_size,
                                           use_pallas=use_pallas,
                                           precision=prec)
+            v_new = poison_iterate(faults, v_new, it)
             diff = jnp.abs(v_new - v)
             dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
             tol_eff = effective_tolerance(
@@ -147,11 +163,12 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_vfi", it + 1, dist, every=progress_every)
             tele = telemetry_record(tele, dist)
+            sent = sentinel_update(sent, dist, config=sentinel)
             v_new = eval_sweeps(v_new, idx)
-            return v_new, idx, dist, it + 1, tol_eff, tele
+            return v_new, idx, dist, it + 1, tol_eff, tele, sent
 
         init = (v0.astype(dt), idx0, jnp.array(jnp.inf, dt), it0, tol_c,
-                tele_in)
+                tele_in, sent_in)
         return jax.lax.while_loop(cond, body, init)
 
     v, idx = v_init, jnp.zeros(v_init.shape, jnp.int32)
@@ -159,9 +176,11 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
     tele = telemetry_init(telemetry)
+    sent = sentinel_init(sentinel)
     dist = tol_eff = None
     for spec in stages:
-        v, idx, dist, it, tol_eff, tele = run_stage(spec, v, idx, it, tele)
+        v, idx, dist, it, tol_eff, tele, sent = run_stage(spec, v, idx, it,
+                                                          tele, sent)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
@@ -173,12 +192,14 @@ def _solve_aiyagari_vfi_impl(v_init, a_grid, s, P, r, w, sigma, beta, *,
                 - policy_k)
     return VFISolution(v, idx, policy_k, policy_c, jnp.ones_like(policy_k), it,
                        dist, tol_eff, hot_iterations=hot_it,
-                       switch_distance=switch_dist, telemetry=tele)
+                       switch_distance=switch_dist, telemetry=tele,
+                       sentinel=sent)
 
 
 _VFI_STATIC = ("tol", "max_iter", "howard_steps", "block_size",
                "relative_tol", "use_pallas", "progress_every",
-               "noise_floor_ulp", "ladder", "telemetry")
+               "noise_floor_ulp", "ladder", "telemetry", "sentinel",
+               "faults")
 # Default program: sigma/beta are TRACED operands, so (a) a batch of scenarios
 # differing only in preferences compiles once, and (b) the whole solve vmaps
 # over (r, sigma, beta, ...) — the batched-GE requirement. The Pallas route
@@ -195,7 +216,8 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
                        block_size: int = 0, relative_tol: bool = False,
                        use_pallas: bool = False, progress_every: int = 0,
                        noise_floor_ulp: float = 0.0,
-                       ladder=None, telemetry=None) -> VFISolution:
+                       ladder=None, telemetry=None, sentinel=None,
+                       faults=None) -> VFISolution:
     """Iterate the Bellman operator to a sup-norm fixed point.
 
     Convergence: max|v_new - v| < tol, matching Aiyagari_VFI.m:85 (absolute
@@ -233,7 +255,7 @@ def solve_aiyagari_vfi(v_init, a_grid, s, P, r, w, *, sigma, beta,
               block_size=block_size, relative_tol=relative_tol,
               use_pallas=use_pallas, progress_every=progress_every,
               noise_floor_ulp=noise_floor_ulp, ladder=ladder,
-              telemetry=telemetry)
+              telemetry=telemetry, sentinel=sentinel, faults=faults)
 
 
 @partial(jax.jit, static_argnames=("sigma", "beta", "tol", "max_iter", "howard_steps",
@@ -909,14 +931,15 @@ def solve_aiyagari_vfi_egm_warmstart(a_grid, s, P, r, w, amin, *, sigma: float,
         warm_policy_k=egm_solution.policy_k)
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every", "noise_floor_ulp", "ladder", "telemetry"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "howard_steps", "relative_tol", "progress_every", "noise_floor_ulp", "ladder", "telemetry", "sentinel", "faults"))
 def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                              beta, psi, eta, tol: float,
                              max_iter: int, howard_steps: int = 0,
                              relative_tol: bool = False,
                              progress_every: int = 0,
                              noise_floor_ulp: float = 0.0,
-                             ladder=None, telemetry=None) -> VFISolution:
+                             ladder=None, telemetry=None, sentinel=None,
+                             faults=None) -> VFISolution:
     """VFI with the joint (labor x a') discrete choice
     (Aiyagari_Endogenous_Labor_VFI.m:64-122). Preference scalars are traced
     operands (vmap/scenario-batch compatible), like solve_aiyagari_vfi —
@@ -927,7 +950,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
     N, na = v_init.shape
     nl = labor_grid.shape[0]
 
-    def run_stage(spec, v0, a_idx0, l_idx0, it0, tele_in):
+    def run_stage(spec, v0, a_idx0, l_idx0, it0, tele_in, sent_in):
         dt = jnp.dtype(spec.dtype)
         prec = (matmul_precision_of(spec.matmul_precision)
                 or jax.lax.Precision.DEFAULT)
@@ -937,6 +960,8 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
         sig, bet, psid, etad = (jnp.asarray(x).astype(dt)
                                 for x in (sigma, beta, psi, eta))
         tol_c = jnp.asarray(tol, dt)
+        # Per-stage sentinel reference restart (dense-family rationale).
+        sent_in = sentinel_stage_reset(sent_in)
 
         def eval_sweeps(v, a_idx, l_idx):
             if howard_steps <= 0:
@@ -953,9 +978,11 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
             return v
 
         def cond(carry):
-            return (carry[3] >= carry[5]) & (carry[4] < max_iter)
+            return sentinel_cond(
+                carry[7], (carry[3] >= carry[5]) & (carry[4] < max_iter))
 
-        # (tele rides at carry[6]; indices 3/4/5 above are unchanged)
+        # (tele rides at carry[6], the sentinel at carry[7]; indices 3/4/5
+        # above are unchanged)
 
         # Hoist the loop-invariant [nl, N, na, na'] joint-choice utility when
         # it fits comfortably in HBM (reference scale: 10x7x400x400 f64 =
@@ -970,7 +997,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                                              dtype=dt)
 
         def body(carry):
-            v, a_idx, l_idx, _, it, _, tele = carry
+            v, a_idx, l_idx, _, it, _, tele, sent = carry
             if U4 is not None:
                 v_new, a_idx, l_idx = bellman_step_labor_precomputed(
                     v, U4, Pd, beta=bet, precision=prec)
@@ -979,6 +1006,7 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                     v, ag, lg, sd, Pd, rd, wd, sigma=sig, beta=bet,
                     psi=psid, eta=etad, precision=prec
                 )
+            v_new = poison_iterate(faults, v_new, it)
             diff = jnp.abs(v_new - v)
             dist = jnp.max(diff / (jnp.abs(v) + 1e-10)) if relative_tol else jnp.max(diff)
             tol_eff = effective_tolerance(
@@ -987,11 +1015,12 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                 relative_tol=relative_tol, dtype=dt)
             device_progress("aiyagari_vfi_labor", it + 1, dist, every=progress_every)
             tele = telemetry_record(tele, dist)
+            sent = sentinel_update(sent, dist, config=sentinel)
             v_new = eval_sweeps(v_new, a_idx, l_idx)
-            return v_new, a_idx, l_idx, dist, it + 1, tol_eff, tele
+            return v_new, a_idx, l_idx, dist, it + 1, tol_eff, tele, sent
 
         init = (v0.astype(dt), a_idx0, l_idx0, jnp.array(jnp.inf, dt), it0,
-                tol_c, tele_in)
+                tol_c, tele_in, sent_in)
         return jax.lax.while_loop(cond, body, init)
 
     zeros_i = jnp.zeros(v_init.shape, jnp.int32)
@@ -1000,10 +1029,11 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
     hot_it = jnp.int32(0)
     switch_dist = jnp.array(0.0, jnp.dtype(stages[-1].dtype))
     tele = telemetry_init(telemetry)
+    sent = sentinel_init(sentinel)
     dist = tol_eff = None
     for spec in stages:
-        v, a_idx, l_idx, dist, it, tol_eff, tele = run_stage(
-            spec, v, a_idx, l_idx, it, tele)
+        v, a_idx, l_idx, dist, it, tol_eff, tele, sent = run_stage(
+            spec, v, a_idx, l_idx, it, tele, sent)
         if not spec.is_final:
             hot_it = it
             switch_dist = dist.astype(switch_dist.dtype)
@@ -1016,4 +1046,5 @@ def solve_aiyagari_vfi_labor(v_init, a_grid, labor_grid, s, P, r, w, *, sigma,
                 * policy_l - policy_k)
     return VFISolution(v, a_idx, policy_k, policy_c, policy_l, it, dist,
                        tol_eff, hot_iterations=hot_it,
-                       switch_distance=switch_dist, telemetry=tele)
+                       switch_distance=switch_dist, telemetry=tele,
+                       sentinel=sent)

@@ -112,6 +112,12 @@ class TransitionResult:
     # the Newton/damped loop's convergence certificate in the same
     # SolveTelemetry shape as the device recorders.
     telemetry: object = None
+    # Structured failure verdict ("" healthy; "nan"/"stall"/"explode" when
+    # SolverConfig.sentinel armed the host-side round sentinel and it
+    # tripped — the loop then returns this instead of raising
+    # FloatingPointError, so dispatch's rescue ladder and
+    # enforce_convergence's nan verdict own the failure).
+    verdict: str = ""
 
     def health(self, model=None) -> dict:
         """Health certificate (diagnostics/health.py): round-trajectory
@@ -146,6 +152,13 @@ class TransitionSweepResult:
     # Outer flight record: per-round max excess demand across the batch
     # (host_telemetry; one trajectory — the lockstep rounds are shared).
     telemetry: object = None
+    # Scenario quarantine (ISSUE 10): lanes whose excess demand went
+    # non-finite were frozen (their paths pinned, excluded from the
+    # all-converged check) so the batch completed. `verdicts` per scenario:
+    # "converged" | "max_iter" | "nan" | "rescued".
+    quarantined: object = None      # [S] bool
+    verdicts: object = None         # list[str], length S
+    rescue_attempts: object = None  # {scenario index: [RescueAttempt, ...]}
 
     def health(self, model=None) -> dict:
         from aiyagari_tpu.diagnostics.health import health_report
@@ -392,6 +405,8 @@ def solve_transition(
     hist: list = []
     bits_hist: list = []   # per-round stage dtype width (the ladder record)
     converged = False
+    verdict = ""
+    sentinel_cfg = solver.sentinel if solver is not None else None
     rounds = 0
     for rnd in range(trans.max_iter):
         it_t0 = time.perf_counter()
@@ -437,9 +452,24 @@ def solve_transition(
             converged = True
             break
         if not np.isfinite(max_d):
+            if sentinel_cfg is not None:
+                # Sentinel-armed: the divergence is a structured outcome,
+                # not a crash — the result carries verdict "nan" and the
+                # (always-loud) non-finite-distance convergence policy or
+                # the rescue ladder owns what happens next.
+                verdict = "nan"
+                break
             raise FloatingPointError(
                 f"transition path diverged at round {rnd} (non-finite "
                 "excess demand); try method='damped' or a smaller shock")
+        if sentinel_cfg is not None:
+            from aiyagari_tpu.diagnostics.sentinel import host_verdict
+
+            verdict = host_verdict(hist, sentinel_cfg)
+            if verdict:
+                # Stall/explosion on the round trajectory: stop burning
+                # rounds on a path update that is not closing the market.
+                break
         if rnd == trans.max_iter - 1:
             # Round cap: keep the path the final evaluation actually used —
             # a last update would pair a never-evaluated r_path with this
@@ -488,6 +518,7 @@ def solve_transition(
         hot_rounds=hot_rounds,
         switch_excess=switch_excess,
         telemetry=_round_telemetry(hist, bits_hist),
+        verdict=verdict,
     )
 
 
@@ -512,6 +543,7 @@ def solve_transitions_sweep(
     on_iteration: Optional[Callable] = None,
     dtype=jnp.float64,
     ladder=None,
+    quarantine: bool = True,
 ) -> TransitionSweepResult:
     """Solve S MIT-shock scenarios in lockstep: every round evaluates ALL
     scenarios' candidate price paths through ONE vmapped backward+forward
@@ -534,6 +566,15 @@ def solve_transitions_sweep(
     whole batch (the switch is global: it fires when every scenario's max
     excess demand has reached the hot dtype's noise floor, and scenarios
     are only marked converged from final-dtype evaluations).
+
+    quarantine (default True) arms per-scenario failure masks (ISSUE 10):
+    a scenario whose excess demand goes non-finite is FROZEN — its rate
+    path pinned at the last evaluated candidate, its Newton/damped update
+    masked, excluded from the all-converged check — so one diverging shock
+    costs its lane, not the batch; the result reports it with verdict
+    "nan" and dispatch.sweep_transitions(rescue=...) re-solves it serially
+    through the rescue ladder. quarantine=False restores the historical
+    all-or-nothing FloatingPointError.
     """
     t0 = time.perf_counter()
     model = _as_model(model, dtype)
@@ -588,6 +629,7 @@ def solve_transitions_sweep(
 
     r_paths = np.full((S, T), r_ss)
     conv = np.zeros(S, bool)
+    quar = np.zeros(S, bool)
     max_d = np.full(S, np.inf)
     out = None
     rounds = 0
@@ -614,31 +656,41 @@ def solve_transitions_sweep(
             # Count every hot-evaluated round (single-solve rationale).
             hot_rounds = rounds
         max_d = np.max(np.abs(D), axis=1)
-        hist.append(float(np.max(max_d)))
+        if quarantine:
+            # Freeze newly-diverged lanes (non-finite excess on a lane not
+            # yet converged): their paths stay pinned, their updates are
+            # masked below, and the still-healthy lanes keep iterating.
+            quar = quar | (~np.isfinite(max_d) & ~conv)
+        live = ~quar
+        hist.append(float(np.max(np.where(live, max_d, 0.0), initial=0.0)))
         bits_hist.append(int(jnp.finfo(dt).bits))
         if final_stage:
             # Scenarios are only marked converged from final-dtype
             # evaluations — a hot-stage residual certifies nothing.
-            conv = conv | (np.isfinite(max_d) & (max_d < trans.tol))
+            conv = conv | (np.isfinite(max_d) & (max_d < trans.tol) & live)
         if on_iteration is not None:
             on_iteration({"round": rnd,
-                          "max_excess": float(np.max(max_d)),
+                          "max_excess": float(np.max(np.where(live, max_d,
+                                                              0.0),
+                                                     initial=0.0)),
                           "converged": int(np.sum(conv)),
+                          "quarantined": int(np.sum(quar)),
                           "dtype": dt_name,
                           "seconds": time.perf_counter() - it_t0})
-        if not final_stage and np.all(np.isfinite(max_d)):
+        if not final_stage and np.all(np.isfinite(max_d[live])):
             floor = (float(ladder.switch_ulp)
                      * float(jnp.finfo(dt).eps)
-                     * float(np.max(np.abs(K_s))))
-            if float(np.max(max_d)) < max(trans.tol, floor):
-                # Global switch: every scenario's residual is at the hot
-                # noise floor — re-evaluate the SAME paths wider.
-                switch_excess = float(np.max(max_d))
+                     * float(np.max(np.abs(K_s[live]), initial=0.0)))
+            if float(np.max(max_d[live], initial=0.0)) < max(trans.tol,
+                                                             floor):
+                # Global switch: every live scenario's residual is at the
+                # hot noise floor — re-evaluate the SAME paths wider.
+                switch_excess = float(np.max(max_d[live], initial=0.0))
                 stage += 1
                 continue
-        if conv.all():
+        if (conv | quar).all():
             break
-        if not np.all(np.isfinite(max_d)):
+        if not np.all(np.isfinite(max_d[live])):
             bad = [i for i in range(S) if not np.isfinite(max_d[i])]
             raise FloatingPointError(
                 f"transition sweep diverged at round {rnd} for scenario(s) "
@@ -655,11 +707,15 @@ def solve_transitions_sweep(
                 np.maximum(K_s[:, :T], 1e-10), model.labor_raw,
                 tech.alpha, tech.delta, stacked["z"])
             step = trans.damping * (r_paths - r_implied)
-        r_paths = np.where(conv[:, None], r_paths,
+        # A quarantined lane's step is NaN; the mask pins its path, so the
+        # NaN never reaches the carried candidate.
+        r_paths = np.where((conv | quar)[:, None], r_paths,
                            np.clip(r_paths - step, -tech.delta + 1e-3,
                                    _R_CEIL))
 
     wall = time.perf_counter() - t0
+    verdicts = ["converged" if c else ("nan" if q else "max_iter")
+                for c, q in zip(conv, quar)]
     return TransitionSweepResult(
         r_paths=r_paths,
         K_ts=np.asarray(jax.device_get(out["K_ts"]), np.float64),
@@ -678,4 +734,6 @@ def solve_transitions_sweep(
         hot_rounds=hot_rounds,
         switch_excess=switch_excess,
         telemetry=_round_telemetry(hist, bits_hist),
+        quarantined=quar,
+        verdicts=verdicts,
     )

@@ -103,6 +103,45 @@ def dead_carry_program(x):
     return y_final
 
 
+# -- AIYA107: a residual cond that keeps running on NaN --------------------
+
+def nan_trap_program(x, tol):
+    """The anti-pattern AIYA107 exists to catch: the continue-condition is
+    written `~(dist < tol)`, which is TRUE for a NaN dist (NaN comparisons
+    are False), so a poisoned iterate runs to max_iter on garbage. The
+    framework's `dist >= tol` discipline is False on NaN and exits."""
+
+    def cond(c):
+        dist, it = c[1], c[2]
+        return jnp.logical_not(dist < tol) & (it < 100)
+
+    def body(c):
+        y, _, it = c
+        y_new = y * 0.5
+        return y_new, jnp.max(jnp.abs(y_new - y)), it + 1
+
+    y, dist, _ = jax.lax.while_loop(
+        cond, body, (x, jnp.asarray(jnp.inf, x.dtype), jnp.int32(0)))
+    return y, dist
+
+
+def nan_exit_program(x, tol):
+    """The same loop with the sanctioned NaN-exiting comparison — must be
+    CLEAN."""
+
+    def cond(c):
+        return (c[1] >= tol) & (c[2] < 100)
+
+    def body(c):
+        y, _, it = c
+        y_new = y * 0.5
+        return y_new, jnp.max(jnp.abs(y_new - y)), it + 1
+
+    y, dist, _ = jax.lax.while_loop(
+        cond, body, (x, jnp.asarray(jnp.inf, x.dtype), jnp.int32(0)))
+    return y, dist
+
+
 # -- AIYA106: a weak-typed carry -------------------------------------------
 
 def weak_carry_program(x):

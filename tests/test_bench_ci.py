@@ -19,7 +19,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
-              "precision", "pushforward", "telemetry", "analysis")
+              "precision", "pushforward", "telemetry", "resilience",
+              "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -43,14 +44,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-6]
+    tr = records[-7]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-5]
+    ac = records[-6]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -64,7 +65,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-4]
+    pr = records[-5]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -88,7 +89,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-3]
+    pw = records[-4]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -122,7 +123,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-2]
+    tm = records[-3]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -131,6 +132,34 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         lo = tm["loops"][loop]
         assert lo["wall_on_s"] > 0 and lo["wall_off_s"] > 0, tm
         assert lo["wall_on_s"] <= 1.5 * lo["wall_off_s"], tm
+    # The resilience record carries the ISSUE 10 acceptance gates: the
+    # injected-fault battery recovered 100% (every injection point either
+    # converged through the rescue ladder or via its compiled-in fallback
+    # — zero silent NaN results), the sentinel's stall watch actually
+    # saved sweeps on the unreachable-tolerance battery, the poisoned
+    # sweep quarantined EXACTLY its one poisoned lane with every other
+    # lane parity-equal to the clean sweep, and the quarantine machinery
+    # costs <= 1.1x a clean sweep (host-side masks only).
+    rs = records[-2]
+    assert rs["metric"] == "resilience_fault_battery"
+    assert rs["value"] == 1.0, rs
+    assert rs["recovered"] == rs["points"]
+    for name, point in rs["injection_points"].items():
+        assert point["recovered"] is True, (name, point)
+    # The multi-stage escalation point actually escalated (forced stage
+    # failures walked the ladder past the forced stages).
+    assert rs["injection_points"]["rescue_stage_failure"][
+        "failed_attempts"] >= 3
+    st = rs["sentinel_stall"]
+    assert st["verdict"] == "stall"
+    assert st["sentinel_sweeps"] < st["plain_sweeps"] == st["max_iter"]
+    assert st["sweeps_saved"] > 0
+    q = rs["quarantine"]
+    assert q["contract_ok"] is True, q
+    assert q["quarantined_lanes"] == 1
+    assert q["poisoned_lane_verdict"] == "rescued"
+    assert q["unpoisoned_parity"] <= 1e-12, q
+    assert rs["quarantine_overhead"] <= 1.1, rs
     # The analysis record carries the ISSUE 9 acceptance gate: the static
     # analyzer ran over the kernel zoo + source tree and found NOTHING —
     # a scatter regression, a precision leak, a host sync in a loop, a
@@ -141,7 +170,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert an["metric"] == "static_analysis_findings"
     assert an["value"] == 0, an
     assert all(v == 0 for v in an["rule_counts"].values()), an
-    assert an["programs_audited"] >= 11
+    assert an["programs_audited"] >= 13
     assert an["files_linted"] > 50
     # Every metric record also landed in the run ledger, and the ledger
     # JSONL round-trips (read_ledger parses every line back).

@@ -78,10 +78,11 @@ class TestShippedTreeClean:
         # The zoo actually ran: every family is represented (the sharded
         # EGM program requires the >= 2-device mesh tier-1 provides, so it
         # must NOT be in the skip list here).
-        assert len(report.programs_audited) >= 11
+        assert len(report.programs_audited) >= 13
         assert report.programs_skipped == ()
         audited = set(report.programs_audited)
         for family_member in ("egm/sweep", "egm/sweep_f32_stage",
+                              "egm/sweep_sentinel",
                               "egm/sweep_labor", "egm/sweep_sharded",
                               "vfi/step", "distribution/step_transpose",
                               "distribution/stationary",
@@ -190,6 +191,30 @@ class TestAdversarialFixtures:
         findings = audit_program(spec)
         assert _rules_fired(findings) == {"stable-carry"}, findings
         assert all("weak-typed" in f.message for f in findings)
+
+    def test_nan_exit_fires_on_nan_trap(self):
+        """AIYA107 (ISSUE 10 satellite): a residual cond written
+        `~(dist < tol)` stays True on a NaN dist — the concrete NaN probe
+        must flag it, and ONLY it."""
+        spec = _spec("fixture/nan_trap", fx.nan_trap_program,
+                     (_f64(8), _f64()), stage_dtype="float64")
+        findings = audit_program(spec)
+        assert _rules_fired(findings) == {"nan-exit"}, findings
+        assert "NaN" in findings[0].message
+
+    def test_nan_exit_clean_on_sanctioned_comparison(self):
+        spec = _spec("fixture/nan_exit", fx.nan_exit_program,
+                     (_f64(8), _f64()), stage_dtype="float64")
+        assert audit_program(spec) == []
+
+    def test_sentinel_program_audited_and_clean(self):
+        """The sentinel-carrying EGM sweep is a registered zoo artifact:
+        its modified loop condition (verdict == 0 ANDed in) must still
+        NaN-exit, its sentinel state slots must not trip the dead-carry /
+        stable-carry rules, and the whole program must audit clean."""
+        spec = next(p for p in registered_programs()
+                    if p.name == "egm/sweep_sentinel")
+        assert audit_program(spec) == []
 
 
 class TestLintFixtures:
