@@ -115,14 +115,15 @@ def _egm_args(dtype_fn):
             dtype_fn(), dtype_fn())
 
 
-def _build_egm(telemetry=None, ladder=None, dtype_fn=_f, sentinel=None):
+def _build_egm(telemetry=None, ladder=None, dtype_fn=_f, sentinel=None,
+               egm_kernel="xla"):
     from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
 
     def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
         return solve_aiyagari_egm(C, a_grid, s, P, r, w, amin, sigma=sigma,
                                   beta=beta, tol=1e-6, max_iter=50,
                                   ladder=ladder, telemetry=telemetry,
-                                  sentinel=sentinel)
+                                  sentinel=sentinel, egm_kernel=egm_kernel)
 
     return fn, _egm_args(dtype_fn)
 
@@ -288,6 +289,30 @@ def _build_registry() -> List[ProgramSpec]:
         ProgramSpec(
             name="egm/sweep_sentinel", family="egm",
             build_off=lambda: _build_egm(sentinel=_sentinel_cfg())),
+        # The fused Pallas sweep is a separately audited artifact: its
+        # while_loop body carries one pallas_call instead of the op chain,
+        # and AIYA101-107 certify the fused program structurally — no
+        # scatter anywhere (declared scatter_free, unlike the XLA sweep,
+        # whose generic inversion route gathers), no precision leak inside
+        # the kernel, the same NaN-exiting cond, and the telemetry ring
+        # compiled out when off. Registered through the same solver entry
+        # (egm_kernel="pallas_fused"), so the audit covers the route users
+        # actually run, not a bare kernel call. Traced with the interpreter
+        # (the registry runs on the default CPU backend), which is also the
+        # artifact tier-1 parity pins — the chip-compiled Mosaic artifact
+        # stays a hardware-validation item (docs/USAGE.md).
+        ProgramSpec(
+            name="egm/sweep_fused", family="egm",
+            build_off=lambda: _build_egm(egm_kernel="pallas_fused"),
+            build_on=lambda: _build_egm(telemetry=tele(),
+                                        egm_kernel="pallas_fused"),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="egm/sweep_fused_f32_stage", family="egm",
+            build_off=lambda: _build_egm(ladder=egm_f32_ladder(),
+                                         dtype_fn=_f32,
+                                         egm_kernel="pallas_fused"),
+            scatter_free=True, stage_dtype="float32"),
         ProgramSpec(
             name="egm/sweep_labor", family="egm",
             build_off=partial(_build_egm_labor),

@@ -383,6 +383,21 @@ class SolverConfig:
                                       # stage at BackendConfig.dtype;
                                       # dispatch.solve() injects the default
                                       # ladder for dtype="mixed".
+    egm_kernel: str = "auto"          # EGM sweep kernel route
+                                      # (ops/egm.EGM_KERNELS, loudly
+                                      # validated like `pushforward`):
+                                      # "auto" (platform choice — the XLA
+                                      # chain until the fused route is
+                                      # chip-validated), "xla" (the
+                                      # reference op-by-op sweep),
+                                      # "pallas_inverse" (windowed grid
+                                      # inversion through its fused Pallas
+                                      # kernel), or "pallas_fused" (the
+                                      # whole interp→invert→update chain
+                                      # as one VMEM-resident kernel,
+                                      # ops/pallas_egm.py — reads the
+                                      # policy once per sweep instead of
+                                      # once per op)
     pushforward: str = "auto"         # DistributionBackend for the Young
                                       # lottery push-forward in every
                                       # cross-section hot path — the

@@ -96,7 +96,7 @@ def apply_stage(stage: str, solver: SolverConfig, backend: BackendConfig,
     # a route/data pathology the escalation replaces (FaultPlan docstring),
     # so they are cleared here — fail_stage excepted, it targets run_rescue.
     solver = dataclasses.replace(solver, faults=None, accel=None,
-                                 use_pallas=False)
+                                 use_pallas=False, egm_kernel="xla")
     if stage == "plain":
         return solver, backend, outer
     solver = dataclasses.replace(solver, pushforward="scatter")

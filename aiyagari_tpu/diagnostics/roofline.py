@@ -41,6 +41,7 @@ __all__ = [
     "vfi_sweep_cost",
     "vfi_slab_cost",
     "egm_sweep_cost",
+    "egm_fused_sweep_cost",
     "panel_step_cost",
     "utilization",
 ]
@@ -169,6 +170,55 @@ def egm_sweep_cost(N: int, na: int, itemsize: int = 4,
         bytes_ += itemsize * N * (L * nb)  # window slab gathers
     else:
         vpu += 3.0 * N * float(na) * na
+    return KernelCost(mxu, vpu, bytes_)
+
+
+def egm_fused_sweep_cost(N: int, na: int, itemsize: int = 4, *,
+                         block_q: int = 256, block_src: int = 256,
+                         straddle_chunks: float = 2.0) -> KernelCost:
+    """One fused Pallas EGM sweep (ops/pallas_egm.py): the whole
+    interp→invert→update chain in one VMEM-resident pass.
+
+    HBM model — the fused win this PR exists to price: C, a_grid and P are
+    read ONCE (full-array blocks with constant index maps: the pipeline
+    fetches them a single time and they stay resident across query-tile
+    programs) and only the finished (C_new, policy_k) tiles are written —
+    3 policy-sized streams + the grid + P, vs the XLA chain's ~10 streams
+    (egm_sweep_cost). `itemsize` is the stage dtype's width, so ladder hot
+    stages price at half the polish bytes exactly like the other models.
+
+    Compute model — what the fusion pays for the single-read property,
+    honestly: every query-tile program rebuilds the knot columns it needs
+    from the resident C. Per program (na/block_q of them): the gate scan
+    evaluates the EGM chain at 3 columns per na/block_src chunk — the two
+    boundaries plus the columnwise C-max bound column (an [N,N]x[N,1]
+    matvec + ~20 VPU ops per row each, plus the [N, block_src] max reduce
+    that builds the bound) — and ~`straddle_chunks` chunks (the
+    (1+r)-bounded
+    knot/query density overlap of the EGM endogenous grid; a non-monotone
+    pathological iterate just skips less, cf. the pallas push-forward
+    model) pay the dense work: the chunk's full chain, the masked-reduce
+    cummax ([N, block_src, block_src]) and the bracket compare-reduce
+    (~6 ops per [N, block_src, block_q] cell). The chain recomputation is
+    the deliberate trade — VPU/MXU work, which the starved MXU has to
+    spare (BENCH_r08), for HBM bytes, which it does not."""
+    S = float(min(block_q, max(na, 2)))
+    CH = float(min(block_src, S))
+    nt = float(-(-na // int(S)))
+    nc = float(-(-na // int(CH)))
+    gate_cols = 3.0 * nc + 2.0    # chunk boundaries + C-max bound + head
+    mxu = nt * (2.0 * N * N * gate_cols
+                + straddle_chunks * 2.0 * N * N * CH)
+    vpu = nt * (20.0 * N * gate_cols
+                + nc * N * CH                           # C-max gate reduce
+                + straddle_chunks * (N * CH * CH        # masked cummax
+                                     + 6.0 * N * CH * S  # bracket reduce
+                                     + 20.0 * N * CH)    # chunk chain
+                + 10.0 * N * S)                          # finish + budget
+    bytes_ = itemsize * (3.0 * N * na        # C read; C_new + policy_k write
+                         + na                # a_grid read (once)
+                         + N * N             # P read (once)
+                         + N)                # s read
     return KernelCost(mxu, vpu, bytes_)
 
 

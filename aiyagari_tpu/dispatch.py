@@ -120,13 +120,16 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
     already set SolverConfig.ladder explicitly."""
     from aiyagari_tpu.ops.precision import ladder_for_dtype
 
+    from aiyagari_tpu.ops.egm import resolve_egm_kernel
     from aiyagari_tpu.ops.pushforward import resolve_backend
 
     solver = solver or SolverConfig(method=method)
-    # Reject DistributionBackend typos HERE, before any compile: the knob
-    # is a jit static arg deep inside the closures, where an unknown name
-    # would otherwise surface as a mid-solve trace error.
+    # Reject DistributionBackend / EGM-kernel typos HERE, before any
+    # compile: both knobs are jit static args deep inside the closures,
+    # where an unknown name would otherwise surface as a mid-solve trace
+    # error.
     resolve_backend(solver.pushforward)
+    resolve_egm_kernel(solver.egm_kernel)
     if solver.ladder is None:
         ladder = ladder_for_dtype(backend.dtype)
         if ladder is not None:
@@ -297,6 +300,11 @@ def solve(
                         "SolverConfig.pushforward scatter-free backends require "
                         "backend='jax'; the numpy reference backend has only "
                         "the scatter formulation")
+                if solver.egm_kernel not in ("auto", "xla"):
+                    raise ValueError(
+                        "SolverConfig.egm_kernel Pallas routes require "
+                        "backend='jax'; the numpy reference backend has only "
+                        "the op-by-op sweep")
                 if aggregation != "simulation":
                     raise ValueError("aggregation='distribution' requires backend='jax'")
                 if equilibrium.batch >= 2:
@@ -677,11 +685,13 @@ def _transition_ladder(backend: BackendConfig, solver: Optional[SolverConfig]):
     """The ROUND-LOOP ladder for a transition solve: dtype='mixed' (or an
     explicit SolverConfig.ladder) hands transition/mit.py the ladder; the
     stationary anchoring solve inherits it through `solver` as usual."""
+    from aiyagari_tpu.ops.egm import resolve_egm_kernel
     from aiyagari_tpu.ops.precision import ladder_for_dtype, require_x64
     from aiyagari_tpu.ops.pushforward import resolve_backend
 
     if solver is not None:
         resolve_backend(solver.pushforward)   # loud typo rejection pre-solve
+        resolve_egm_kernel(solver.egm_kernel)
     ladder = solver.ladder if solver is not None else None
     if ladder is None:
         ladder = ladder_for_dtype(backend.dtype)

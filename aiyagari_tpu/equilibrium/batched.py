@@ -83,7 +83,7 @@ def _knobs(solver: SolverConfig, alpha: float, delta: float, dist_tol: float,
         alpha, delta, dist_tol, dist_max_iter,
         sim.periods, sim.n_agents, sim.discard,
         solver.accel, solver.ladder, solver.pushforward, solver.telemetry,
-        solver.sentinel, solver.faults,
+        solver.sentinel, solver.faults, solver.egm_kernel,
     )
 
 
@@ -108,7 +108,32 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     """
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
      dist_tol, dist_max_iter, periods, n_agents, discard, accel,
-     ladder, pushforward, telemetry, sentinel, faults) = knobs
+     ladder, pushforward, telemetry, sentinel, faults, egm_kernel) = knobs
+    if method == "egm":
+        from aiyagari_tpu.ops.egm import (
+            require_xla_egm_kernel,
+            resolve_egm_kernel,
+        )
+
+        if labor:
+            # Loud, not silent: the fused kernel implements the
+            # exogenous-labor chain only, so a Pallas route on the labor
+            # family must fail here rather than quietly run the XLA sweep
+            # (docs/USAGE.md).
+            require_xla_egm_kernel(egm_kernel,
+                                   "the endogenous-labor EGM family")
+        elif resolve_egm_kernel(egm_kernel) == "pallas_inverse":
+            # The batched closure pins grid_power=0.0 (its in-jit solves
+            # cannot host-retry a window escape — the call-site comment
+            # below), and the pallas_inverse route only exists on power
+            # grids; running the plain chain under that name would be a
+            # silent no-op. The fused route has no such conflict.
+            raise ValueError(
+                "egm_kernel='pallas_inverse' is not supported by the "
+                "batched GE closure: its vmapped solves run grid_power=0 "
+                "(no host escape retry mid-program), which the windowed "
+                "inversion route requires; use 'auto', 'xla', or "
+                "'pallas_fused'")
 
     def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
             amin, labor_raw):
@@ -159,8 +184,9 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                 sol = solve_aiyagari_egm(
                     warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
                     tol=tol, max_iter=max_iter, relative_tol=relative_tol,
-                    grid_power=0.0, accel=accel, ladder=ladder,
-                    telemetry=telemetry, sentinel=sentinel, faults=faults)
+                    grid_power=0.0, egm_kernel=egm_kernel, accel=accel,
+                    ladder=ladder, telemetry=telemetry, sentinel=sentinel,
+                    faults=faults)
             warm_out = sol.policy_c
 
         out = {"warm": warm_out, "sol": sol,

@@ -125,6 +125,10 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             sentinel=solver.sentinel, faults=solver.faults,
         )
     if solver.method == "egm":
+        from aiyagari_tpu.ops.egm import (
+            require_xla_egm_kernel,
+            resolve_egm_kernel,
+        )
         from aiyagari_tpu.parallel.ring import ring_slab_fits
         from aiyagari_tpu.solvers.egm import (
             LADDER_MIN_FINE,
@@ -132,8 +136,18 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             ladder_warm_start_labor,
         )
 
+        if model.config.endogenous_labor:
+            # The fused kernel implements the exogenous-labor chain only;
+            # fail loudly rather than silently running the XLA sweep
+            # (ops/egm.require_xla_egm_kernel rationale).
+            require_xla_egm_kernel(solver.egm_kernel,
+                                   "the endogenous-labor EGM family")
         if (
             mesh is not None
+            # The ring-sharded program has no fused-kernel route: a non-XLA
+            # egm_kernel falls through to the single-device solvers below,
+            # which honor it — the knob is never silently dropped.
+            and resolve_egm_kernel(solver.egm_kernel) == "xla"
             and model.config.grid.power > 0
             and na % int(mesh.shape["grid"]) == 0
             # Slab-geometry soundness: grids too small for the ring slab
@@ -239,6 +253,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
                 max_iter=solver.max_iter, grid_power=model.config.grid.power,
                 relative_tol=solver.relative_tol,
                 progress_every=solver.progress_every,
+                egm_kernel=solver.egm_kernel,
                 accel=solver.accel, ladder=solver.ladder,
                 telemetry=solver.telemetry,
                 sentinel=solver.sentinel, faults=solver.faults,
@@ -266,6 +281,7 @@ def solve_household(model: AiyagariModel, r: float, *, solver: SolverConfig = So
             # f64 resolution, pinned by TestPowerGridInversion; _safe retries
             # on the generic route if the windows escape).
             grid_power=model.config.grid.power,
+            egm_kernel=solver.egm_kernel,
             accel=solver.accel, ladder=solver.ladder,
             telemetry=solver.telemetry,
             sentinel=solver.sentinel, faults=solver.faults,

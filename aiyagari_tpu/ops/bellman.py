@@ -89,12 +89,13 @@ def bellman_step(v, a_grid, s, P, r, w, *, sigma, beta, block_size: int = 0,
 @partial(jax.jit, static_argnames=("sigma",))
 def _bellman_step_pallas(v, a_grid, s, P, r, w, *, sigma: float, beta):
     from aiyagari_tpu.ops.pallas_bellman import bellman_max_pallas
+    from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
 
     EV = expectation(P, v, beta)                          # [N, na']
     coh = (1.0 + r) * a_grid[None, :] + w * s[:, None]    # [N, na]
     return bellman_max_pallas(
         coh, a_grid, EV, sigma=sigma,
-        interpret=(jax.default_backend() != "tpu"),
+        interpret=pallas_interpret_mode(),
     )
 
 

@@ -26,15 +26,65 @@ from aiyagari_tpu.utils.utility import (
     labor_foc_inverse,
 )
 
-__all__ = ["egm_step", "egm_step_labor", "egm_step_transition",
-           "constrained_consumption_labor"]
+__all__ = ["EGM_KERNELS", "egm_step", "egm_step_labor",
+           "egm_step_transition", "constrained_consumption_labor",
+           "require_xla_egm_kernel", "resolve_egm_kernel"]
+
+# The EGM sweep kernel routes (SolverConfig.egm_kernel):
+#   "auto"          — the platform default; resolves to "xla" until the
+#                     fused route is chip-validated (the hook the measured
+#                     route selection of the autotuner roadmap item feeds).
+#   "xla"           — the reference op-by-op sweep below (matmul + inverse
+#                     + endogenous grid + inversion + clamp + budget).
+#   "pallas_inverse"— the op-by-op sweep with the windowed grid inversion
+#                     routed through the fused Pallas kernel
+#                     (ops/pallas_inverse.py; power grids above the dense
+#                     cutoff only, same escape contract as the XLA windows).
+#   "pallas_fused"  — the whole interp→invert→update chain as one
+#                     VMEM-resident Pallas kernel (ops/pallas_egm.py;
+#                     never escapes, interpreted off-TPU).
+EGM_KERNELS = ("auto", "xla", "pallas_inverse", "pallas_fused")
 
 
-@partial(jax.jit, static_argnames=("grid_power", "with_escape", "use_pallas",
+def resolve_egm_kernel(kernel: str) -> str:
+    """Validate an EGM kernel route name loudly (the typo/numpy rejection
+    mirror of ops/pushforward.resolve_backend) and resolve "auto" to its
+    current platform choice. Called at config validation (dispatch) and at
+    every egm_step trace, so a bad route name fails before any solve."""
+    if kernel not in EGM_KERNELS:
+        hint = ""
+        if kernel in ("numpy", "reference"):
+            hint = (" — the NumPy reference backend is selected via "
+                    "BackendConfig(backend='numpy'), not the EGM kernel "
+                    "route")
+        raise ValueError(
+            f"unknown egm_kernel {kernel!r}; expected one of "
+            f"{EGM_KERNELS}{hint}")
+    # "auto" stays the XLA chain until the fused kernel is validated on
+    # real hardware (the pallas_inverse round-2 lesson; docs/USAGE.md).
+    return "xla" if kernel == "auto" else kernel
+
+
+def require_xla_egm_kernel(kernel: str, where: str) -> str:
+    """Resolve a route name and REJECT Pallas routes loudly for sweep
+    chains the fused kernel does not implement (the endogenous-labor
+    family). Loud, not silent: quietly running the XLA chain would let a
+    caller believe they ran or benchmarked the fused route — the exact
+    failure mode the loud route validation exists to prevent."""
+    resolved = resolve_egm_kernel(kernel)
+    if resolved != "xla":
+        raise ValueError(
+            f"egm_kernel={kernel!r} is not supported by {where}: the fused "
+            "Pallas kernel implements the exogenous-labor EGM chain only; "
+            "use egm_kernel='auto' or 'xla' there")
+    return resolved
+
+
+@partial(jax.jit, static_argnames=("grid_power", "with_escape", "egm_kernel",
                                    "matmul_precision"))
 def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
              grid_power: float = 0.0, with_escape: bool = False,
-             use_pallas: bool = False, matmul_precision: str = "highest"):
+             egm_kernel: str = "xla", matmul_precision: str = "highest"):
     """One EGM policy update, exogenous labor.
 
     C [N, na] (consumption policy on the exogenous grid) ->
@@ -66,8 +116,29 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     mixed-precision ladder's hot stages (ops/precision.py: "default" is the
     TPU bf16 MXU path); the reference value "highest" keeps the historical
     pinned-HIGHEST behavior.
+
+    egm_kernel selects the sweep route (EGM_KERNELS above): "pallas_fused"
+    replaces this whole op chain with the single VMEM-resident Pallas
+    kernel (ops/pallas_egm.py — generic-inversion semantics, so grid_power
+    is ignored there and the escape flag is identically False);
+    "pallas_inverse" keeps the chain but routes the windowed power-grid
+    inversion through its fused kernel. Both interpret off-TPU via the
+    shared platform probe (ops/pallas_support.pallas_interpret_mode).
     """
     from aiyagari_tpu.ops.precision import matmul_precision_of
+
+    kernel = resolve_egm_kernel(egm_kernel)
+    if kernel == "pallas_fused":
+        from aiyagari_tpu.ops.pallas_egm import egm_sweep_pallas
+        from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
+
+        C_new, policy_k, escaped = egm_sweep_pallas(
+            C, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
+            matmul_precision=matmul_precision,
+            interpret=pallas_interpret_mode())
+        if with_escape:
+            return C_new, policy_k, escaped
+        return C_new, policy_k
 
     RHS = (1.0 + r) * expectation(P, crra_marginal(C, sigma), beta,
                                   precision=matmul_precision_of(matmul_precision))  # [N, na]
@@ -84,15 +155,17 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     # this image's remote-compile path at 40k+ points.
     a_hat = jax.lax.cummax(a_hat, axis=1)
     escaped = jnp.array(False)
-    if grid_power > 0.0 and use_pallas and a_grid.shape[-1] > INVERSE_DENSE_CUTOFF:
+    if (grid_power > 0.0 and kernel == "pallas_inverse"
+            and a_grid.shape[-1] > INVERSE_DENSE_CUTOFF):
         # Fused TPU kernel over the same window tiling (chunk-skipping,
         # ops/pallas_inverse.py); interpreted off-TPU so the routing stays
         # testable everywhere.
         from aiyagari_tpu.ops.pallas_inverse import inverse_interp_power_grid_pallas
+        from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
 
         policy_k, escaped = inverse_interp_power_grid_pallas(
             a_hat, a_grid[0], a_grid[-1], grid_power, a_grid.shape[-1],
-            interpret=(jax.default_backend() != "tpu"),
+            interpret=pallas_interpret_mode(),
         )
     elif grid_power > 0.0:
         policy_k, escaped = inverse_interp_power_grid(
@@ -114,10 +187,11 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     return C_new, policy_k
 
 
-@partial(jax.jit, static_argnames=("matmul_precision",))
+@partial(jax.jit, static_argnames=("matmul_precision", "egm_kernel"))
 def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
                         *, sigma_now, sigma_next, beta_now,
-                        matmul_precision: str = "highest"):
+                        matmul_precision: str = "highest",
+                        egm_kernel: str = "xla"):
     """One backward EGM step along a perfect-foresight transition path
     (transition/path.py): the stationary egm_step generalized to prices and
     preferences that differ between today and tomorrow.
@@ -143,8 +217,35 @@ def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
     equilibrium/batched.py on grid_power=0). matmul_precision relaxes the
     expectation contraction for the mixed-precision ladder's hot rounds
     (transition/mit.py), exactly as in egm_step.
+
+    egm_kernel="pallas_fused" routes the whole dated chain through the
+    VMEM-resident Pallas kernel (ops/pallas_egm.egm_sweep_transition_pallas
+    — same generic-inversion semantics as this operator, so every backward
+    scan step of transition/path.py reads the policy once instead of per
+    op). "pallas_inverse" is rejected here: it rides the windowed
+    power-grid fast path, whose host-retry escape contract a fused time
+    scan cannot honor (the same reason this operator never takes
+    grid_power).
     """
     from aiyagari_tpu.ops.precision import matmul_precision_of
+
+    kernel = resolve_egm_kernel(egm_kernel)
+    if kernel == "pallas_inverse":
+        raise ValueError(
+            "egm_step_transition supports egm_kernel 'auto'/'xla'/"
+            "'pallas_fused' only: the windowed pallas_inverse route needs "
+            "a host-level escape retry that a fused time scan cannot "
+            "perform (module docstring)")
+    if kernel == "pallas_fused":
+        from aiyagari_tpu.ops.pallas_egm import egm_sweep_transition_pallas
+        from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
+
+        C_now, policy_k, _ = egm_sweep_transition_pallas(
+            C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
+            sigma_now, sigma_next, beta_now,
+            matmul_precision=matmul_precision,
+            interpret=pallas_interpret_mode())
+        return C_now, policy_k
 
     RHS = (1.0 + r_next) * expectation(P, crra_marginal(C_next, sigma_next),
                                        beta_now,

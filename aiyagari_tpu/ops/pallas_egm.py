@@ -1,0 +1,343 @@
+"""Fused TPU (Pallas) EGM sweep kernel: the whole interp→invert→update chain
+of ops/egm.egm_step as ONE VMEM-resident pass over the policy.
+
+Why: every bench round says the EGM sweep is HBM-bound, not compute-bound
+(BENCH_r03/r04: bound "hbm", membw_frac ~0.4-0.45 against mfu ~0.001). The
+XLA sweep runs as separate ops — expectation matmul, u'-inverse, endogenous
+grid, cummax, grid inversion, clamp, budget update — and each re-reads the
+full [N, na] state from HBM, ~10 array streams per sweep
+(diagnostics/roofline.egm_sweep_cost). This kernel reads C, a_grid and P
+ONCE, keeps every intermediate in VMEM, and writes only the finished
+(C_new, policy_k) tiles back: 3 streams instead of ~10, the direct ~3x on
+the memory-bound roofline (priced, not asserted:
+diagnostics/roofline.egm_fused_sweep_cost).
+
+Geometry (ops/pallas_pushforward.py is the tiling template): the output is
+tiled over the exogenous asset grid (grid = query tiles of `block_q`
+lanes); the full C / a_grid / P stay resident across programs (identical
+block indices — the pipeline fetches them once). The key fusion obstacle is
+the grid inversion: query tile t's bracketing knots a_hat[K] live at
+DATA-DEPENDENT columns, possibly far from tile t. Because the chain is
+column-separable — a_hat[:, j] needs only C[:, j] (the Euler expectation is
+a per-column [N,N]x[N,1] contraction) — each program rebuilds exactly the
+knot columns it needs from the resident C instead of reading a materialized
+a_hat: it scans the source axis in `block_src`-wide chunks and, per chunk,
+evaluates the chain at the chunk's two BOUNDARY columns only (two matvecs +
+a few VPU ops). The boundary values drive the pallas_inverse-style
+`@pl.when` chunk gating: a chunk entirely below the tile's query span
+contributes its last knot/grid value as (x0, y0) candidates, one entirely
+above its first as (x1, y1) — O(1) scalar-broadcast work — and only chunks
+actually straddling the span (~(1+r) of them for the EGM operator's
+endogenous grids, whose knot spacing is bounded below by grid
+spacing/(1+r)) pay the dense work: the full chain on the chunk's columns,
+a masked-reduce cummax, and the [N, block_src, block_q] bracket
+compare-reduce. The skip gates hold for ANY iterate, not only monotone
+ones: the below gate bounds the chunk's a_hat by the chain at the chunk's
+columnwise C-max (the chain is monotone in C and a_grid — _sweep_kernel),
+so a non-monotone iterate — an Anderson overshoot, an arbitrary warm
+start — just skips less; it is never silently mis-bracketed. The scan
+covers the whole knot row, so unlike the windowed XLA fast path this
+kernel needs no escape: `escaped` is identically False, and the route
+composes with solve_aiyagari_egm_safe's retry contract trivially (the
+retry never arms).
+
+Semantics match egm_step's GENERIC inversion route (grid_power=0:
+cummax + linear_interp(a_hat, a_grid, a_grid) + clip + budget) — monotone
+bracketing by masked max/min reduces, first-segment linear extrapolation
+below the first knot, grid-top saturation above the last — so one kernel
+serves plain sweeps, the mixed-precision ladder's hot stages (the Euler
+contraction takes the stage's matmul precision), and the dated transition
+operator (egm_step_transition is the same chain with per-date prices; the
+stationary sweep is the collapsed special case). Parity: bitwise-ordering
+identical per column in exact arithmetic; tier-1 pins <= 1e-9 in f64 and
+the documented f32 ulp band (tests/test_pallas_egm.py).
+
+The one divergence from lax.cummax, bounded and stated: the running cummax
+CARRY between chunks advances by boundary values (plus the true max of
+every densely-scanned chunk), so an interior maximum inside a SKIPPED
+chunk is carried one chunk late. The below gate guarantees such a maximum
+is strictly below the tile's whole query span, which makes every
+knot-vs-query mask decision identical to the exact cummax's — the (y0, y1)
+bracket VALUES are exact — and only the x0 interpolation abscissa can sit
+low, moving the output within its exact bracket: deviation vs the XLA
+route is bounded by the local grid spacing, the same class as the
+documented tie-handling divergence of the windowed routes (and identically
+zero for monotone-in-exact-arithmetic iterates, the EGM operator's normal
+regime — there f32 rounding wiggles are sub-ulp of |a_hat|, invisible
+under the parity bands).
+
+interpret=True runs the Pallas interpreter off-TPU (CPU tier-1 parity
+pins) exactly like pallas_bellman / pallas_inverse / pallas_pushforward;
+the route stays opt-in (SolverConfig.egm_kernel="pallas_fused") until
+validated on real hardware — the pallas_inverse round-2 lesson: Mosaic
+lowerings must be cross-checked on chip before any solver defaults to
+them. Compile-time scaling caveat shared with the other fused kernels: the
+chunk scan is a static unroll (Mosaic rejects dynamically indexed sublane
+loads), so trace size grows with na/block_src.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["egm_sweep_pallas", "egm_sweep_transition_pallas"]
+
+_BLOCK_Q = 256     # queries (output lanes) per program
+_BLOCK_SRC = 256   # source columns per scanned chunk
+
+
+def _sweep_kernel(prm_ref, C_ref, agf_ref, agt_ref, s_ref, P_ref,
+                  cnew_ref, pk_ref, x0_ref, x1_ref, y0_ref, y1_ref, m_ref, *,
+                  block_q: int, block_src: int, n_chunks: int, na: int,
+                  precision):
+    """One query-tile program: rebuild the knot columns it needs from the
+    resident C, bracket its queries by masked reduces, finish the linear
+    inverse, clamp, and emit the budget-consistent consumption tile."""
+    S, CH = block_q, block_src
+    dtype = C_ref.dtype
+    r_next, r_now, w_now, amin_now, sig_now, sig_next, beta_now = (
+        prm_ref[0], prm_ref[1], prm_ref[2], prm_ref[3], prm_ref[4],
+        prm_ref[5], prm_ref[6])
+    sv = s_ref[...]                  # [N, 1]
+    Pm = P_ref[...]                  # [N, N]
+    q = agt_ref[0, :]                # [S] this tile's exogenous queries
+    q_lo = q[0]
+    q_hi = q[S - 1]
+    neg = jnp.array(-jnp.inf, dtype)
+    pos = jnp.array(jnp.inf, dtype)
+
+    def a_hat_of(Cc, agc):
+        # The dated EGM chain for columns (Cc [N, k], agc [k]): Euler RHS
+        # on the MXU at the ladder stage's precision, marginal-utility
+        # inversion, endogenous grid. Column-separable, so evaluating a
+        # slice is exact — identical per-column contraction order to the
+        # full-width XLA expectation.
+        rhs = (1.0 + r_next) * beta_now * jax.lax.dot_general(
+            Pm, Cc ** (-sig_next),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=precision)
+        c_endo = rhs ** (-1.0 / sig_now)
+        return (c_endo + agc[None, :] - w_now * sv) / (1.0 + r_now)
+
+    def a_hat_col(j):
+        return a_hat_of(C_ref[:, j:j + 1], agf_ref[0, j:j + 1])   # [N, 1]
+
+    # First two knots: the below-range extrapolation segment (linear_interp
+    # edge semantics). cummax is a no-op at column 0 by definition.
+    h0 = a_hat_col(0)
+    h1 = jnp.maximum(h0, a_hat_col(1))
+
+    # Scratch accumulators, re-initialized per program: bracketing knot
+    # values (x0, x1), their grid values (y0, y1), and the running cummax
+    # carry m (the prefix max of raw a_hat over all columns scanned so
+    # far — the kernel-side form of egm_step's f32 monotonicity insurance).
+    x0_ref[...] = jnp.full_like(x0_ref, neg)
+    x1_ref[...] = jnp.full_like(x1_ref, pos)
+    y0_ref[...] = jnp.full_like(y0_ref, neg)
+    y1_ref[...] = jnp.full_like(y1_ref, pos)
+    m_ref[...] = jnp.full_like(m_ref, neg)
+
+    # Static unroll over source chunks, ascending (the cummax carry is
+    # order-dependent; Mosaic rejects dynamically indexed sublane loads —
+    # the pallas_inverse/pallas_pushforward pattern).
+    for c in range(n_chunks):
+        jf, jl = c * CH, (c + 1) * CH - 1
+        ab_f = a_hat_col(jf)
+        ab_l = a_hat_col(jl)
+        first_cm = jnp.maximum(m_ref[...], ab_f)     # [N, 1] effective knots
+        last_cm = jnp.maximum(first_cm, ab_l)
+        ag_f = agf_ref[0, jf]
+        ag_l = agf_ref[0, jl]
+        # Skip gates must hold for ANY iterate, monotone or not, so they
+        # bound the chunk's cummaxed a_hat EXACTLY from boundary data:
+        #   * its minimum IS the first effective knot, max(m, a_hat[jf])
+        #     (cummaxed values are non-decreasing) — the above gate;
+        #   * its maximum is bounded by the chain evaluated at the chunk's
+        #     columnwise C-max and top grid value (a_hat is increasing in
+        #     every C entry — u' and its inverse are both decreasing — and
+        #     in a_grid), so an interior spike a boundary probe would miss
+        #     cannot slip through the below gate; a spiked chunk goes
+        #     dense instead. For a monotone chunk the bound equals the
+        #     last column's value — zero extra dense work on the normal
+        #     path.
+        # Gates are per-program scalars (@pl.when predication — a lax.cond
+        # with vector carries executes BOTH branches as selects, measured
+        # 10x on chip in the pallas_inverse rewrite), so a chunk skips only
+        # when EVERY row's span misses the tile; a straddle in any row runs
+        # the dense branch for all rows (exact for all of them).
+        C_ub = jnp.max(C_ref[:, jf:jl + 1], axis=1, keepdims=True)  # [N, 1]
+        ub = a_hat_of(C_ub, agf_ref[0, jl:jl + 1])
+        below_all = jnp.max(jnp.maximum(m_ref[...], ub)) < q_lo
+        above_all = jnp.min(first_cm) >= q_hi
+
+        @pl.when(below_all)
+        def _():
+            # Entire chunk < every query, all rows: its last effective knot
+            # is an x0 candidate, its last grid value the matching y0.
+            x0_ref[...] = jnp.maximum(x0_ref[...], last_cm)
+            y0_ref[...] = jnp.maximum(y0_ref[...], ag_l)
+
+        @pl.when(above_all)
+        def _():
+            # Entire chunk >= every query: only its first knot can be the
+            # min-at-or-above bracket.
+            x1_ref[...] = jnp.minimum(x1_ref[...], first_cm)
+            y1_ref[...] = jnp.minimum(y1_ref[...], ag_f)
+
+        @pl.when(jnp.logical_not(below_all | above_all))
+        def _():
+            agc = agf_ref[0, jf:jl + 1]                    # [CH]
+            ah_raw = a_hat_of(C_ref[:, jf:jl + 1], agc)    # [N, CH]
+            # Within-chunk cummax as a masked reduce (k <= j prefix max):
+            # lax.cummax has no Mosaic lowering; the [N, CH, CH] compare
+            # runs only on straddling chunks.
+            kk = jax.lax.broadcasted_iota(jnp.int32, (CH, CH), 0)
+            jj = jax.lax.broadcasted_iota(jnp.int32, (CH, CH), 1)
+            ah_cm = jnp.max(jnp.where((kk <= jj)[None, :, :],
+                                      ah_raw[:, :, None], neg), axis=1)
+            ah_cm = jnp.maximum(ah_cm, m_ref[...])         # prefix carry
+            lt = ah_cm[:, :, None] < q[None, None, :]      # [N, CH, S]
+            agb = agc[None, :, None]
+            x0_ref[...] = jnp.maximum(
+                x0_ref[...],
+                jnp.max(jnp.where(lt, ah_cm[:, :, None], neg), axis=1))
+            y0_ref[...] = jnp.maximum(
+                y0_ref[...], jnp.max(jnp.where(lt, agb, neg), axis=1))
+            x1_ref[...] = jnp.minimum(
+                x1_ref[...],
+                jnp.min(jnp.where(lt, pos, ah_cm[:, :, None]), axis=1))
+            y1_ref[...] = jnp.minimum(
+                y1_ref[...], jnp.min(jnp.where(lt, pos, agb), axis=1))
+            m_ref[...] = jnp.maximum(
+                m_ref[...], jnp.max(ah_raw, axis=1, keepdims=True))
+
+        # Advance the cummax carry for every chunk, scanned or skipped
+        # (no-op after the dense branch's true-max update). last_cm, not
+        # ab_l: it folds BOTH boundary values in, so a spike at the first
+        # column of an above-skipped chunk still reaches later chunks'
+        # effective knots — dropping it under-carried the plateau and
+        # mis-bracketed queries between the later raw values and the
+        # spike (caught by the non-monotone crossing repro in tier-1).
+        m_ref[...] = jnp.maximum(m_ref[...], last_cm)
+
+    # Finish: piecewise-linear inverse from the bracket data — the
+    # _finish_monotone edge semantics with linear_interp's tie guard.
+    x0 = x0_ref[...]
+    x1 = x1_ref[...]
+    y0 = y0_ref[...]
+    y1 = y1_ref[...]
+    have_lo = x0 > neg
+    dx = x1 - x0
+    ok = have_lo & (x1 < pos) & (dx > 0)
+    tq = jnp.where(ok, (q[None, :] - x0) / jnp.where(ok, dx, 1.0), 0.0)
+    # y1 is +inf when no knot sits at-or-above q (query beyond the top
+    # knot): select y0 there BEFORE the fma — 0 * inf would poison it.
+    out = y0 + tq * (jnp.where(ok, y1, y0) - y0)
+    # Below the first knot: linear extrapolation on the first segment
+    # (zero-width first segment degrades to the first grid value, the
+    # linear_interp collision guard).
+    d0 = h1 - h0
+    ag0 = agf_ref[0, 0]
+    ag1 = agf_ref[0, 1]
+    out_below = jnp.where(
+        d0 > 0,
+        ag0 + (q[None, :] - h0) * (ag1 - ag0) / jnp.where(d0 > 0, d0, 1.0),
+        ag0)
+    out = jnp.where(have_lo, out, out_below)
+    # Clamp (borrowing limit + grid top, egm_step's truncation rationale)
+    # and close the budget: the only values this tile writes back to HBM.
+    ag_top = agf_ref[0, na - 1]
+    pk = jnp.minimum(jnp.maximum(out, amin_now), ag_top)
+    pk_ref[...] = pk
+    cnew_ref[...] = (1.0 + r_now) * q[None, :] + w_now * sv - pk
+
+
+@functools.partial(jax.jit, static_argnames=("matmul_precision", "block_q",
+                                             "block_src", "interpret"))
+def egm_sweep_transition_pallas(C_next, a_grid, s, P, r_next, r_now, w_now,
+                                amin_now, sigma_now, sigma_next, beta_now, *,
+                                matmul_precision: str = "highest",
+                                block_q: int = _BLOCK_Q,
+                                block_src: int = _BLOCK_SRC,
+                                interpret: bool = False):
+    """One fused dated EGM sweep (the ops/egm.egm_step_transition operator):
+    C_next [N, na] tomorrow's consumption policy -> (C_now [N, na],
+    policy_k [N, na], escaped). Every price/preference argument is a traced
+    operand — one compile covers the whole backward time scan. `escaped` is
+    identically False (module docstring: the full-row scan cannot escape);
+    it is returned so the fused route plugs into the same (out, escaped)
+    plumbing as the windowed XLA fast path. matmul_precision (static) is
+    the Euler contraction's precision for the ladder's hot stages
+    (ops/precision.matmul_precision_of names)."""
+    from aiyagari_tpu.ops.precision import matmul_precision_of
+
+    N, na = C_next.shape
+    dtype = C_next.dtype
+    S = min(block_q, na)
+    CH = min(block_src, S)
+    if S % CH:
+        raise ValueError(
+            f"effective block_src {CH} must divide effective block_q {S} "
+            f"(requested block_q={block_q}, block_src={block_src}, both "
+            f"clamped to na={na})")
+    nt = -(-na // S)
+    nap = nt * S
+    # Edge padding keeps the padded knot columns exact duplicates of the
+    # top knot (tied knots change no bracket) and the padded query lanes
+    # duplicates of the top query (their outputs are sliced off).
+    C_p = jnp.pad(C_next, ((0, 0), (0, nap - na)), mode="edge")
+    ag_p = jnp.pad(a_grid, (0, nap - na), mode="edge")[None, :]
+    prm = jnp.stack([jnp.asarray(v).astype(dtype) for v in
+                     (r_next, r_now, w_now, amin_now, sigma_now, sigma_next,
+                      beta_now)])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt,),
+        in_specs=[
+            # Full-array blocks with constant index maps: fetched once,
+            # resident across every program (the pallas_pushforward
+            # pattern); the tile view of a_grid is the same padded buffer
+            # blocked per program.
+            pl.BlockSpec((N, nap), lambda t, prm: (0, 0)),
+            pl.BlockSpec((1, nap), lambda t, prm: (0, 0)),
+            pl.BlockSpec((1, S), lambda t, prm: (0, t)),
+            pl.BlockSpec((N, 1), lambda t, prm: (0, 0)),
+            pl.BlockSpec((N, N), lambda t, prm: (0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((N, S), lambda t, prm: (0, t)),
+                   pl.BlockSpec((N, S), lambda t, prm: (0, t))),
+        scratch_shapes=[pltpu.VMEM((N, S), dtype)] * 4
+                       + [pltpu.VMEM((N, 1), dtype)],
+    )
+    kern = functools.partial(
+        _sweep_kernel, block_q=S, block_src=CH, n_chunks=nap // CH, na=na,
+        precision=matmul_precision_of(matmul_precision))
+    C_now, policy_k = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((N, nap), dtype),
+                   jax.ShapeDtypeStruct((N, nap), dtype)),
+        interpret=interpret,
+    )(prm, C_p, ag_p, ag_p, s.reshape(N, 1).astype(dtype), P.astype(dtype))
+    return C_now[:, :na], policy_k[:, :na], jnp.array(False)
+
+
+@functools.partial(jax.jit, static_argnames=("matmul_precision", "block_q",
+                                             "block_src", "interpret"))
+def egm_sweep_pallas(C, a_grid, s, P, r, w, amin, *, sigma, beta,
+                     matmul_precision: str = "highest",
+                     block_q: int = _BLOCK_Q, block_src: int = _BLOCK_SRC,
+                     interpret: bool = False):
+    """One fused stationary EGM sweep (the ops/egm.egm_step operator):
+    C [N, na] -> (C_new [N, na], policy_k [N, na], escaped) — the dated
+    kernel with every dated argument collapsed to its stationary value
+    (exactly how egm_step relates to egm_step_transition)."""
+    return egm_sweep_transition_pallas(
+        C, a_grid, s, P, r, r, w, amin, sigma, sigma, beta,
+        matmul_precision=matmul_precision, block_q=block_q,
+        block_src=block_src, interpret=interpret)

@@ -1,0 +1,61 @@
+"""Shared plumbing for the Pallas kernel family (pallas_bellman,
+pallas_inverse, pallas_pushforward, pallas_egm): one platform probe deciding
+whether a kernel runs compiled (Mosaic, real TPU) or under the Pallas
+interpreter (every other backend — the CPU tier-1 parity vehicle).
+
+Why one helper: each kernel call site used to compute
+``interpret=(jax.default_backend() != "tpu")`` inline at trace time, which
+meant (a) the probe could drift per kernel, and (b) a test could not force
+interpret mode without monkeypatching jax itself. Route tests now use
+``force_interpret()`` to pin the mode explicitly; the decision stays a
+TRACE-TIME host branch (the flag is a jit static arg at every kernel), so
+each backend still compiles only its own route.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+__all__ = ["pallas_interpret_mode", "force_interpret"]
+
+# Test hook: None = probe the backend; True/False = forced by
+# force_interpret(). Never set directly.
+_FORCED: Optional[bool] = None
+
+
+def pallas_interpret_mode() -> bool:
+    """True when Pallas kernels must run the interpreter: any backend that
+    is not a real TPU (CPU tier-1, GPU, forced-platform bench runs). The
+    single source of truth for every fused kernel's ``interpret=`` flag."""
+    if _FORCED is not None:
+        return _FORCED
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+@contextlib.contextmanager
+def force_interpret(value: bool = True) -> Iterator[None]:
+    """Force the interpret decision inside the context (tests only).
+
+    The probe is read at TRACE time inside jitted entry points whose cache
+    keys do not include it (egm_step, the solvers), so flipping it alone
+    would neither retrace already-compiled programs nor stop a forced-mode
+    trace leaking into later unforced calls. The context therefore clears
+    jax's compilation caches on entry AND exit — every program traced
+    inside sees the forced mode, and everything after re-traces with the
+    real probe. Heavy-handed (whole-process cache flush) and deliberately
+    so: this is a test hook, and silent mode confusion is the one failure
+    it must never have."""
+    import jax
+
+    global _FORCED
+    prev = _FORCED
+    _FORCED = bool(value)
+    jax.clear_caches()
+    try:
+        yield
+    finally:
+        _FORCED = prev
+        jax.clear_caches()

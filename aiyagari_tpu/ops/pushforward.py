@@ -338,10 +338,10 @@ def apply_pushforward(plan: PushforwardPlan, mu, P,
     than a renormalizing round trip), so that route is HIGHEST-only."""
     if plan.kind == "pallas":
         from aiyagari_tpu.ops.pallas_pushforward import lottery_step_pallas
+        from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
 
-        interpret = jax.default_backend() != "tpu"
         return lottery_step_pallas(mu, plan.idx, plan.w_lo, P,
-                                   interpret=interpret)
+                                   interpret=pallas_interpret_mode())
     if plan.kind == "scatter":
         mu_a = lottery_scatter(mu, plan.idx, plan.w_lo)
     elif plan.kind == "transpose":

@@ -151,12 +151,12 @@ class EGMSolution:
     sentinel: object = None
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "use_pallas", "accel", "ladder", "telemetry", "sentinel", "faults"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "egm_kernel", "accel", "ladder", "telemetry", "sentinel", "faults"))
 def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
                        tol: float, max_iter: int, relative_tol: bool = False,
                        progress_every: int = 0, grid_power: float = 0.0,
                        noise_floor_ulp: float = 0.0,
-                       use_pallas: bool = False, accel=None,
+                       egm_kernel: str = "xla", accel=None,
                        ladder=None, telemetry=None, sentinel=None,
                        faults=None) -> EGMSolution:
     """Iterate the EGM operator until max|C_new - C| < tol
@@ -211,6 +211,15 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
     EGMSolution.telemetry. None compiles the recorder out entirely — the
     traced program is identical to the recorder-free one.
 
+    egm_kernel (static, ops/egm.EGM_KERNELS — loudly validated) selects the
+    sweep route per stage: "pallas_fused" runs every sweep as the one
+    VMEM-resident Pallas kernel (ops/pallas_egm.py; generic-inversion
+    semantics, never escapes, interpreted off-TPU), with the ladder's
+    per-stage matmul precision threaded into its Euler contraction;
+    "pallas_inverse" keeps the op chain but fuses the windowed grid
+    inversion. The sentinel, telemetry, fault and acceleration carries
+    compose with every route unchanged — they act on the sweep's OUTPUT.
+
     sentinel (a SentinelConfig, static) carries the failure sentinel
     (diagnostics/sentinel.py) through the loop: non-finite residuals (split
     into "escape" vs "nan" by the windowed-inversion escape flag), stalls,
@@ -249,7 +258,7 @@ def solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, *, sigma, beta,
             C_new, policy_k, esc_new = egm_step(
                 C, ag, sd, Pd, rd, wd, amind, sigma=sig, beta=bet,
                 grid_power=grid_power, with_escape=True,
-                use_pallas=use_pallas,
+                egm_kernel=egm_kernel,
                 matmul_precision=spec.matmul_precision)
             C_new = poison_iterate(faults, C_new, it)
             C_new, esc_new = force_escape_point(faults, C_new, esc_new)
@@ -302,7 +311,7 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                             relative_tol: bool = False, progress_every: int = 0,
                             grid_power: float = 0.0,
                             noise_floor_ulp: float = 0.0,
-                            use_pallas: bool = False, accel=None,
+                            egm_kernel: str = "xla", accel=None,
                             ladder=None, telemetry=None, sentinel=None,
                             faults=None) -> EGMSolution:
     """solve_aiyagari_egm plus the host-level escape retry for the windowed
@@ -311,18 +320,23 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
     sweep with NaN and raises the solution's `escaped` flag
     (ops/interp.inverse_interp_power_grid), the while_loop exits on the NaN
     distance, and this wrapper re-solves on the generic exact route
-    (grid_power=0). Host-level by design — callers inside jit should use
-    solve_aiyagari_egm directly and accept the documented poisoning contract.
-    The retry arms on the `escaped` flag, not on NaN itself: genuine
-    numerical divergence also yields a NaN distance (on any grid size), and
-    re-solving there would double the cost only to return the same NaN."""
+    (grid_power=0, egm_kernel="xla" — the most conservative sweep). Host-
+    level by design — callers inside jit should use solve_aiyagari_egm
+    directly and accept the documented poisoning contract. The retry arms
+    on the `escaped` flag, not on NaN itself: genuine numerical divergence
+    also yields a NaN distance (on any grid size), and re-solving there
+    would double the cost only to return the same NaN. The fused Pallas
+    route (egm_kernel="pallas_fused") never raises the flag — it scans the
+    full knot row, so escapes cannot occur and the retry never arms — but
+    the contract is preserved verbatim: injected escapes (FaultPlan
+    .force_escape) and the windowed routes still retry exactly as before."""
     sol = solve_aiyagari_egm(C_init, a_grid, s, P, r, w, amin, sigma=sigma,
                              beta=beta, tol=tol, max_iter=max_iter,
                              relative_tol=relative_tol,
                              progress_every=progress_every,
                              grid_power=grid_power,
                              noise_floor_ulp=noise_floor_ulp,
-                             use_pallas=use_pallas, accel=accel, ladder=ladder,
+                             egm_kernel=egm_kernel, accel=accel, ladder=ladder,
                              telemetry=telemetry, sentinel=sentinel,
                              faults=faults)
     if grid_power > 0.0 and bool(sol.escaped):
@@ -520,14 +534,14 @@ def _host_ladder(a_grid, s, r, w, *, sizes, lo: float, hi: float,
 @partial(jax.jit, static_argnames=("sizes", "lo", "hi", "sigma", "beta",
                                    "tol", "max_iter", "relative_tol",
                                    "progress_every", "grid_power",
-                                   "noise_floor_ulp", "use_pallas", "accel",
+                                   "noise_floor_ulp", "egm_kernel", "accel",
                                    "ladder", "telemetry", "sentinel",
                                    "faults"))
 def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                       hi: float, sigma: float, beta: float, tol: float,
                       max_iter: int, relative_tol: bool, progress_every: int,
                       grid_power: float, noise_floor_ulp: float,
-                      use_pallas: bool, accel=None, ladder=None,
+                      egm_kernel: str, accel=None, ladder=None,
                       telemetry=None, sentinel=None,
                       faults=None) -> EGMSolution:
     """The whole fast-path stage ladder traced as ONE device program:
@@ -571,7 +585,7 @@ def _egm_ladder_fused(a_grid, s, P, r, w, amin, *, sizes, lo: float,
                                  progress_every=progress_every,
                                  grid_power=grid_power,
                                  noise_floor_ulp=st_floor,
-                                 use_pallas=use_pallas, accel=accel,
+                                 egm_kernel=egm_kernel, accel=accel,
                                  ladder=st_ladder,
                                  telemetry=telemetry if final else None,
                                  sentinel=sentinel if final else None,
@@ -655,7 +669,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                                   relative_tol: bool = False,
                                   progress_every: int = 0,
                                   noise_floor_ulp: float = 0.0,
-                                  use_pallas: bool = False,
+                                  egm_kernel: str = "xla",
                                   accel=None, ladder=None,
                                   telemetry=None, sentinel=None,
                                   faults=None) -> EGMSolution:
@@ -705,7 +719,7 @@ def solve_aiyagari_egm_multiscale(a_grid, s, P, r, w, amin, *, sigma: float,
                             progress_every=progress_every,
                             grid_power=grid_power,
                             noise_floor_ulp=noise_floor_ulp,
-                            use_pallas=use_pallas, accel=accel, ladder=ladder,
+                            egm_kernel=egm_kernel, accel=accel, ladder=ladder,
                             telemetry=telemetry, sentinel=sentinel,
                             faults=faults)
     sol = _fetch_scalars(sol)

@@ -65,6 +65,13 @@ def fake_news_jacobian(C_ss, k_ss, mu_ss, a_grid, s, P, *, r_ss, w_ss,
     monotonicity cond differentiates through the taken branch). The adjoint
     expectation functions keep the gather-form expectation_step, whose
     pairing <f, L mu> == <L' f, mu> holds against every backend.
+
+    No egm_kernel knob here, deliberately: this pass DIFFERENTIATES
+    backward_policies (jax.jvp below), and pallas_call carries no AD rule,
+    so the fused sweep route (ops/pallas_egm.py) cannot serve it — the
+    Jacobian's one-off T sweeps stay on the AD-transparent XLA chain while
+    the round loops' primal path evaluations honor SolverConfig.egm_kernel
+    (transition/mit.py _egm_kernel_of).
     """
     dt = a_grid.dtype
     ones = jnp.ones((T,), dt)

@@ -266,6 +266,31 @@ def _pushforward_of(solver: Optional[SolverConfig]) -> str:
     return solver.pushforward if solver is not None else "auto"
 
 
+def _egm_kernel_of(solver: Optional[SolverConfig]) -> str:
+    """The EGM sweep route of the round loops' backward scans (ops/egm.
+    EGM_KERNELS): every PRIMAL path evaluation — the per-round aggregates
+    program, the final policy materialization, the scenario-sweep batch —
+    honors SolverConfig.egm_kernel. The fake-news Jacobian build does NOT:
+    it differentiates backward_policies with jax.jvp, and pallas_call has
+    no AD rule, so that one-off pass stays on the AD-transparent XLA chain
+    regardless (transition/jacobian.py).
+
+    "pallas_inverse" is rejected HERE, before the stationary anchor solve
+    runs — egm_step_transition would refuse it anyway (the windowed
+    route's host-escape-retry contract cannot ride a fused time scan),
+    but only mid-round-loop, after the anchor's work is already spent;
+    the hoisted check keeps the dispatch discipline of failing before any
+    compile."""
+    kernel = solver.egm_kernel if solver is not None else "auto"
+    if kernel == "pallas_inverse":
+        raise ValueError(
+            "transition solves support egm_kernel 'auto'/'xla'/"
+            "'pallas_fused' only: the windowed pallas_inverse route needs "
+            "a host-level escape retry that a fused time scan cannot "
+            "perform (ops/egm.egm_step_transition)")
+    return kernel
+
+
 def transition_jacobian(model: AiyagariModel, ss, T: int,
                         pushforward: str = "auto") -> np.ndarray:
     """The Newton matrix J_D for this (model, stationary anchor, horizon):
@@ -381,6 +406,9 @@ def solve_transition(
     model = _as_model(model, dtype)
     _check_trans(trans)
     T = int(trans.T)
+    # Route validation BEFORE the anchor solve (the _egm_kernel_of raise).
+    pushforward = _pushforward_of(solver)
+    egm_kernel = _egm_kernel_of(solver)
     if ss is None:
         ss = stationary_anchor(model, solver=solver, eq=eq)
     _check_anchor(ss)
@@ -388,7 +416,6 @@ def solve_transition(
     r_ss = float(ss.r)
     K_ss = float(aggregate_capital(ss.mu, model.a_grid))
     paths = shock_paths(model, shock, T)
-    pushforward = _pushforward_of(solver)
 
     if trans.method == "newton" and jacobian is None:
         jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
@@ -418,7 +445,7 @@ def solve_transition(
         out = transition_path_aggregates(
             *anchors.get(dt_name), *dev,
             matmul_precision=_stage_matmul_precision(ladder, stage),
-            pushforward=pushforward)
+            pushforward=pushforward, egm_kernel=egm_kernel)
         K_ts = np.asarray(jax.device_get(out["K_ts"]), np.float64)
         D = K_ts[:T] - capital_demand(r_path, model.labor_raw, tech.alpha,
                                       tech.delta, paths["z"])
@@ -493,7 +520,8 @@ def solve_transition(
         full = transition_path(ss.solution.policy_c, ss.mu, model.a_grid,
                                model.s, model.P,
                                *_device_paths(model, r_path, paths, r_ss),
-                               pushforward=pushforward)
+                               pushforward=pushforward,
+                               egm_kernel=egm_kernel)
         policies = {"C_ts": full["C_ts"], "k_ts": full["k_ts"]}
     return TransitionResult(
         r_path=r_path,
@@ -584,12 +612,14 @@ def solve_transitions_sweep(
         raise ValueError("solve_transitions_sweep needs at least one shock")
     T = int(trans.T)
     S = len(shocks)
+    # Route validation BEFORE the anchor solve (the _egm_kernel_of raise).
+    pushforward = _pushforward_of(solver)
+    egm_kernel = _egm_kernel_of(solver)
     if ss is None:
         ss = stationary_anchor(model, solver=solver, eq=eq)
     _check_anchor(ss)
     tech = model.config.technology
     r_ss = float(ss.r)
-    pushforward = _pushforward_of(solver)
     if trans.method == "newton" and jacobian is None:
         jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
 
@@ -646,7 +676,7 @@ def solve_transitions_sweep(
             *anchors.get(dt_name),
             place(r_ext_s, dt), place(w_s, dt), beta_dev, sig_dev, amin_dev,
             matmul_precision=_stage_matmul_precision(ladder, stage),
-            pushforward=pushforward)
+            pushforward=pushforward, egm_kernel=egm_kernel)
         K_s = np.asarray(jax.device_get(out["K_ts"]), np.float64)  # [S, T+1]
         D = K_s[:, :T] - capital_demand(r_paths, model.labor_raw, tech.alpha,
                                         tech.delta, stacked["z"])

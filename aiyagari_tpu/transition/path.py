@@ -50,7 +50,8 @@ __all__ = ["backward_policies", "forward_capital", "transition_path"]
 
 
 def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
-                      sigma_ext, amin_path, matmul_precision: str = "highest"):
+                      sigma_ext, amin_path, matmul_precision: str = "highest",
+                      egm_kernel: str = "xla"):
     """Backward EGM sweep over t = T-1 .. 0 from the terminal policy.
 
     C_term [N, na] is the stationary consumption policy the path ends at
@@ -58,7 +59,15 @@ def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
     w_path/beta_path/amin_path are [T]. Returns (C_ts, k_ts), each
     [T, N, na] in FORWARD time order (C_ts[t] is the period-t policy).
     matmul_precision (static) relaxes the per-step Euler expectation for
-    the ladder's hot rounds (ops/egm.egm_step_transition).
+    the ladder's hot rounds (ops/egm.egm_step_transition). egm_kernel
+    (static) selects the per-step sweep route: "pallas_fused" runs every
+    dated sweep of the scan as the fused VMEM-resident Pallas kernel
+    (ops/pallas_egm.py), so each of the T backward steps reads the policy
+    once from HBM instead of once per op — the same fusion win T-fold on
+    every PRIMAL evaluation (round loops, scenario sweeps, final policy
+    materialization). The fake-news Jacobian cannot take it: it
+    differentiates this function with jax.jvp and pallas_call has no AD
+    rule (transition/jacobian.py keeps the XLA chain there).
     """
 
     def step(C_next, xs):
@@ -66,7 +75,7 @@ def backward_policies(C_term, a_grid, s, P, r_ext, w_path, beta_path,
         C_now, k_now = egm_step_transition(
             C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
             sigma_now=sig_now, sigma_next=sig_next, beta_now=beta_now,
-            matmul_precision=matmul_precision)
+            matmul_precision=matmul_precision, egm_kernel=egm_kernel)
         return C_now, (C_now, k_now)
 
     xs = (r_ext[:-1], r_ext[1:], w_path, beta_path,
@@ -105,10 +114,11 @@ def forward_capital(mu0, k_ts, a_grid, P, pushforward: str = "auto"):
     return K_ts, A_ts, mu_T
 
 
-@partial(jax.jit, static_argnames=("matmul_precision", "pushforward"))
+@partial(jax.jit, static_argnames=("matmul_precision", "pushforward",
+                                   "egm_kernel"))
 def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
                     sigma_ext, amin_path, matmul_precision: str = "highest",
-                    pushforward: str = "auto"):
+                    pushforward: str = "auto", egm_kernel: str = "xla"):
     """Backward sweep + forward push as one jitted program.
 
     Returns a dict: K_ts [T+1] (capital path, K_ts[0] predetermined),
@@ -119,18 +129,21 @@ def transition_path(C_term, mu0, a_grid, s, P, r_ext, w_path, beta_path,
     """
     C_ts, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
                                    beta_path, sigma_ext, amin_path,
-                                   matmul_precision=matmul_precision)
+                                   matmul_precision=matmul_precision,
+                                   egm_kernel=egm_kernel)
     K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P,
                                        pushforward=pushforward)
     return {"K_ts": K_ts, "A_ts": A_ts, "C_ts": C_ts, "k_ts": k_ts,
             "mu_T": mu_T}
 
 
-@partial(jax.jit, static_argnames=("matmul_precision", "pushforward"))
+@partial(jax.jit, static_argnames=("matmul_precision", "pushforward",
+                                   "egm_kernel"))
 def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
                                beta_path, sigma_ext, amin_path,
                                matmul_precision: str = "highest",
-                               pushforward: str = "auto"):
+                               pushforward: str = "auto",
+                               egm_kernel: str = "xla"):
     """transition_path without the [T, N, na] policy stacks in the output.
 
     The round loops only read K_ts, and jit OUTPUTS cannot be dead-code-
@@ -140,7 +153,8 @@ def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
     converged path when the caller wants the policies."""
     _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
                                 beta_path, sigma_ext, amin_path,
-                                matmul_precision=matmul_precision)
+                                matmul_precision=matmul_precision,
+                                egm_kernel=egm_kernel)
     K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P,
                                        pushforward=pushforward)
     return {"K_ts": K_ts, "A_ts": A_ts, "mu_T": mu_T}
@@ -156,14 +170,15 @@ _PATH_BATCH_CACHE: dict = {}
 
 def transition_path_batch(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s,
                           sigma_s, amin_s, matmul_precision: str = "highest",
-                          pushforward: str = "auto"):
-    key = (matmul_precision, pushforward)
+                          pushforward: str = "auto",
+                          egm_kernel: str = "xla"):
+    key = (matmul_precision, pushforward, egm_kernel)
     fn = _PATH_BATCH_CACHE.get(key)
     if fn is None:
         fn = jax.jit(jax.vmap(
             lambda *a: transition_path_aggregates(
                 *a, matmul_precision=matmul_precision,
-                pushforward=pushforward),
+                pushforward=pushforward, egm_kernel=egm_kernel),
             in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0),
         ))
         _PATH_BATCH_CACHE[key] = fn
