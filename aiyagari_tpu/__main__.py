@@ -14,6 +14,14 @@ Defaults reproduce the reference problem scales (BASELINE.md); outputs land in
 Observability (diagnostics/ledger.py + health.py):
 
   python -m aiyagari_tpu report <ledger.jsonl>          # render a run ledger
+
+Route observatory (tuning/autotuner.py; docs/USAGE.md "Route observatory
+& autotuning"):
+
+  python -m aiyagari_tpu tune                # measure the "auto" knobs,
+                                             # persist the tuning cache
+  python -m aiyagari_tpu tune --explain      # render the decision table
+                                             # from the cached probe data
 """
 
 from __future__ import annotations
@@ -35,6 +43,13 @@ def main(argv=None) -> int:
         from aiyagari_tpu.diagnostics.health import report_main
 
         return report_main(argv[1:])
+    # `tune` runs the measured route probes (or, with --explain, renders
+    # the cached decision table) — the route-observatory CLI
+    # (tuning/autotuner.tune_main).
+    if argv[:1] == ["tune"]:
+        from aiyagari_tpu.tuning.autotuner import tune_main
+
+        return tune_main(argv[1:])
     ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])

@@ -1,0 +1,276 @@
+"""Compiled-cost attribution: reconcile XLA's own cost accounting of every
+registered program against the analytic roofline price — the route
+observatory's measurement half (ISSUE 12).
+
+The roofline models (diagnostics/roofline.py) are analytic LOWER bounds on
+the work an algorithm specifies; XLA's `cost_analysis()` /
+`memory_analysis()` report what the compiler actually emitted for the
+same program (FLOPs, bytes accessed, argument/output/temp bytes). Joining
+the two per `ProgramSpec` (analysis/registry.py) gives a modeled-vs-
+compiled attribution table with a structural interpretation:
+
+  * compiled/modeled byte ratios near 1-10x are the normal price of
+    padding, rematerialization, and tile round-up;
+  * a ratio drifting far above its historical band means an op chain
+    STOPPED FUSING — the compiler now materializes intermediates the
+    model assumed fused away. That is a fusion regression, detectable
+    without a device or a timer: the attribution is a property of the
+    compiled artifact, deterministic under JAX_PLATFORMS=cpu like the
+    jaxpr audit beside it (tests/test_bench_ci.py gates the band for the
+    audited EGM + push-forward programs).
+
+Programs whose compiled artifact is NOT the production artifact on this
+host are joined but never flagged: the Pallas-fused programs compile the
+INTERPRETER off-TPU (its bytes say nothing about the Mosaic kernel), and
+the ring-sharded sweep pads and replicates per-device buffers the
+single-device model deliberately does not price.
+
+Each run lands on the PR 6 observability surface: one `attribution`
+ledger event per program on the active run ledger, plus
+`aiyagari_attribution_{compiled,modeled}_bytes{program=}` /
+`aiyagari_attribution_byte_ratio{program=}` Prometheus gauges and an
+`aiyagari_attribution_flagged_total` counter. `bench.py --metric
+attribution` freezes the table into BENCH_r11_attribution.json.
+
+Like the registry traces, attribution compiles at the registry's tiny
+shapes (nothing solves, nothing big allocates): XLA counts a while-loop
+BODY once — trip counts are dynamic — so the compiled numbers are
+per-sweep quantities, directly comparable to the per-sweep roofline
+models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "AttributionReport",
+    "DEFAULT_FLAG_RATIO",
+    "attribute_program",
+    "modeled_cost",
+    "run_attribution",
+]
+
+# Compiled bytes above this multiple of the modeled (lower-bound) bytes
+# flag a structural fusion regression. The shipped tree measures 1.7-6.3x
+# on the gated programs (CPU f64, registry shapes — frozen in
+# BENCH_r11_attribution.json); a chain that stops fusing and
+# materializes its broadcasts lands at 10-100x.
+DEFAULT_FLAG_RATIO = 25.0
+
+# Registry trace shapes (analysis/registry.py) — the shapes the analytic
+# prices below are evaluated at.
+_NZ = 3
+_NA = 16
+
+
+def _sharded_na() -> int:
+    # egm/sweep_sharded traces at na=64 on a 2-device mesh (registry).
+    return 64
+
+
+def _model_prices() -> Dict[str, Tuple[Optional[Callable], Optional[float]]]:
+    """program name -> (cost thunk | None, flag ratio | None).
+
+    None cost: no analytic model applies (multi-solve GE/transition
+    rounds compose several operators; pricing them as one sweep would be
+    a fiction). None flag ratio: joined for the record but never flagged
+    (interpreted Pallas artifacts off-TPU, the mesh-padded sharded
+    sweep)."""
+    import jax
+
+    from aiyagari_tpu.diagnostics.roofline import (
+        distribution_sweep_cost,
+        egm_fused_sweep_cost,
+        egm_sweep_cost,
+        vfi_sweep_cost,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    fused_flag = DEFAULT_FLAG_RATIO if on_tpu else None
+    return {
+        "egm/sweep": (lambda: egm_sweep_cost(_NZ, _NA, 8),
+                      DEFAULT_FLAG_RATIO),
+        "egm/sweep_f32_stage": (lambda: egm_sweep_cost(_NZ, _NA, 4),
+                                DEFAULT_FLAG_RATIO),
+        "egm/sweep_sentinel": (lambda: egm_sweep_cost(_NZ, _NA, 8),
+                               DEFAULT_FLAG_RATIO),
+        "egm/sweep_fused": (lambda: egm_fused_sweep_cost(_NZ, _NA, 8),
+                            fused_flag),
+        "egm/sweep_fused_f32_stage": (
+            lambda: egm_fused_sweep_cost(_NZ, _NA, 4), fused_flag),
+        "egm/sweep_labor": (lambda: egm_sweep_cost(_NZ, _NA, 8),
+                            DEFAULT_FLAG_RATIO),
+        "egm/sweep_sharded": (lambda: egm_sweep_cost(_NZ, _sharded_na(), 8),
+                              None),
+        "vfi/step": (lambda: vfi_sweep_cost(_NZ, _NA, 8),
+                     DEFAULT_FLAG_RATIO),
+        "distribution/step_scatter": (
+            lambda: distribution_sweep_cost(_NZ, _NA, 8, route="scatter"),
+            DEFAULT_FLAG_RATIO),
+        "distribution/step_transpose": (
+            lambda: distribution_sweep_cost(_NZ, _NA, 8, route="transpose"),
+            DEFAULT_FLAG_RATIO),
+        "distribution/step_banded": (
+            # The registry grid is a single tile, so the band geometry
+            # collapses to the dense per-row operator (band_width = na).
+            lambda: distribution_sweep_cost(_NZ, _NA, 8, route="banded",
+                                            band_width=_NA),
+            DEFAULT_FLAG_RATIO),
+        "distribution/stationary": (
+            # The stationary loop runs the "auto" default route.
+            lambda: distribution_sweep_cost(_NZ, _NA, 8, route="transpose"),
+            DEFAULT_FLAG_RATIO),
+        "equilibrium/ge_round_batched": (None, None),
+        "transition/round": (None, None),
+        "ks/distribution_step": (None, None),
+    }
+
+
+def modeled_cost(program: str):
+    """The analytic roofline price of one registered program at its
+    registry trace shapes, or None when no model applies."""
+    thunk, _ = _model_prices().get(program, (None, None))
+    return thunk() if thunk is not None else None
+
+
+def _first(d, *keys):
+    for k in keys:
+        v = d.get(k)
+        if v is not None:
+            return float(v)
+    return None
+
+
+def attribute_program(spec) -> dict:
+    """Lower + compile one ProgramSpec's telemetry-off entry point and
+    join XLA's cost accounting against the roofline price. Raises
+    ProgramUnavailable (from the builder) for environment-dependent
+    programs, exactly like the jaxpr audit."""
+    import jax
+
+    fn, args = spec.build_off()
+    compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = dict(ca or {})
+    rec = {
+        "program": spec.name,
+        "family": spec.family,
+        "compiled": {
+            "flops": _first(ca, "flops"),
+            "transcendentals": _first(ca, "transcendentals"),
+            "bytes_accessed": _first(ca, "bytes accessed", "bytes_accessed"),
+        },
+    }
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:   # pragma: no cover - optional on some backends
+        ma = None
+    if ma is not None:
+        rec["compiled"].update(
+            argument_bytes=int(getattr(ma, "argument_size_in_bytes", 0)),
+            output_bytes=int(getattr(ma, "output_size_in_bytes", 0)),
+            temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0)),
+            peak_bytes=int(getattr(ma, "argument_size_in_bytes", 0)
+                           + getattr(ma, "output_size_in_bytes", 0)
+                           + getattr(ma, "temp_size_in_bytes", 0)),
+        )
+
+    thunk, flag_ratio = _model_prices().get(spec.name, (None, None))
+    if thunk is None:
+        rec["modeled"] = None
+        rec["byte_ratio"] = None
+        rec["flop_ratio"] = None
+        rec["flagged"] = False
+        return rec
+    cost = thunk()
+    rec["modeled"] = {"mxu_flops": cost.mxu_flops, "vpu_ops": cost.vpu_ops,
+                      "hbm_bytes": cost.hbm_bytes}
+    cb = rec["compiled"]["bytes_accessed"]
+    cf = rec["compiled"]["flops"]
+    rec["byte_ratio"] = (round(cb / cost.hbm_bytes, 3)
+                         if cb and cost.hbm_bytes else None)
+    # Model FLOPs = MXU + VPU ops: XLA's flop count includes the
+    # elementwise work the split model books on the VPU.
+    ops = cost.mxu_flops + cost.vpu_ops
+    rec["flop_ratio"] = round(cf / ops, 3) if cf and ops else None
+    rec["flag_ratio"] = flag_ratio
+    rec["flagged"] = bool(flag_ratio is not None
+                          and rec["byte_ratio"] is not None
+                          and rec["byte_ratio"] > flag_ratio)
+    return rec
+
+
+@dataclasses.dataclass(frozen=True)
+class AttributionReport:
+    records: Tuple[dict, ...]
+    skipped: Tuple[tuple, ...]      # (program, reason)
+    wall_seconds: float
+
+    @property
+    def flagged(self) -> Tuple[dict, ...]:
+        return tuple(r for r in self.records if r.get("flagged"))
+
+    def by_program(self) -> Dict[str, dict]:
+        return {r["program"]: r for r in self.records}
+
+
+def _emit_observability(report: AttributionReport) -> None:
+    """Per-program ledger events + Prometheus gauges (the analysis
+    layer's _emit_observability pattern — diagnostics must never fail a
+    run)."""
+    try:
+        from aiyagari_tpu.diagnostics import ledger, metrics
+
+        for rec in report.records:
+            cb = rec["compiled"].get("bytes_accessed")
+            if cb is not None:
+                metrics.gauge("aiyagari_attribution_compiled_bytes",
+                              program=rec["program"]).set(cb)
+            if rec.get("modeled") is not None:
+                metrics.gauge("aiyagari_attribution_modeled_bytes",
+                              program=rec["program"]).set(
+                    rec["modeled"]["hbm_bytes"])
+            if rec.get("byte_ratio") is not None:
+                metrics.gauge("aiyagari_attribution_byte_ratio",
+                              program=rec["program"]).set(rec["byte_ratio"])
+            if rec.get("flagged"):
+                metrics.counter("aiyagari_attribution_flagged_total",
+                                program=rec["program"]).inc()
+            ledger.emit("attribution", program=rec["program"],
+                        family=rec["family"], compiled=rec["compiled"],
+                        modeled=rec.get("modeled"),
+                        byte_ratio=rec.get("byte_ratio"),
+                        flop_ratio=rec.get("flop_ratio"),
+                        flagged=rec.get("flagged", False))
+    except Exception:   # pragma: no cover - diagnostics must not fail runs
+        pass
+
+
+def run_attribution(families: Optional[Tuple[str, ...]] = None
+                    ) -> AttributionReport:
+    """Compile every (selected) registry program and assemble the
+    modeled-vs-compiled attribution table. Environment-dependent
+    programs report as skipped, like the jaxpr audit."""
+    from aiyagari_tpu.analysis.registry import (
+        ProgramUnavailable,
+        registered_programs,
+    )
+
+    t0 = time.perf_counter()
+    records = []
+    skipped = []
+    for spec in registered_programs(families):
+        try:
+            records.append(attribute_program(spec))
+        except ProgramUnavailable as e:
+            skipped.append((spec.name, str(e)))
+    report = AttributionReport(
+        records=tuple(records), skipped=tuple(skipped),
+        wall_seconds=time.perf_counter() - t0)
+    _emit_observability(report)
+    return report

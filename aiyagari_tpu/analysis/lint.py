@@ -16,6 +16,12 @@ the source DISCIPLINE that keeps them auditable and fast:
   * no-bare-debug-print (AIYA203) — production signals are counted
     degradation events (metrics + ledger, PR 6); a jax.debug.print is a
     debugging aid and must sit behind an env-gated `if *DEBUG*:` guard.
+  * route-resolution-discipline (AIYA204) — a conditional that maps the
+    literal "auto" (or a jax.default_backend() platform test) onto a
+    concrete route literal ("transpose", "xla", "sort", ...) may live
+    only in the sanctioned resolver functions and tuning/ — anywhere
+    else it re-hardcodes a route choice behind the autotuner's back and
+    escapes the route_decision ledger trail.
 
 Suppression: a `# noqa: AIYA###` comment on the flagged line (multiple
 ids comma-separated) marks a deliberate exception; suppressed findings
@@ -47,6 +53,27 @@ _HOT_EXEMPT = ("solvers/numpy_backend.py",)
 _NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z]{4}\d{3}(?:\s*,\s*[A-Z]{4}\d{3})*)")
 
 _FORBIDDEN_MODULES = ("jax.sharding", "jax.experimental.shard_map")
+
+# AIYA204 scope: the sanctioned resolver functions (per file) and the
+# tuning layer. Everything else that conditions on the "auto" literal or
+# a default_backend() test and binds/returns a route literal re-hardcodes
+# a route choice.
+_ROUTE_RESOLVER_FUNCS = {
+    "ops/pushforward.py": {"resolve_backend"},
+    "ops/egm.py": {"resolve_egm_kernel", "require_xla_egm_kernel"},
+    "ops/interp.py": {"bucket_index", "searchsorted_method"},
+}
+_ROUTE_EXEMPT_DIRS = ("tuning/",)
+
+# The route names a resolution binds (ops/pushforward.BACKENDS,
+# ops/egm.EGM_KERNELS, the searchsorted methods) — kept literal here so
+# the lint needs no jax import; membership is exact-match, which keeps
+# dtype strings and error messages out of scope.
+_ROUTE_LITERALS = frozenset({
+    "scatter", "transpose", "banded", "pallas",
+    "xla", "pallas_inverse", "pallas_fused",
+    "scan", "sort",
+})
 
 
 def hot_module(rel_path: str) -> bool:
@@ -82,8 +109,18 @@ class _Linter(ast.NodeVisitor):
         self.rel = rel_path
         self.lines = source.splitlines()
         self.hot = hot_module(rel_path) if hot is None else hot
-        exempt = rel_path.replace("\\", "/").endswith(_MESH_SHIM)
+        rel_norm = rel_path.replace("\\", "/")
+        exempt = rel_norm.endswith(_MESH_SHIM)
         self.mesh_exempt = exempt if mesh_exempt is None else mesh_exempt
+        # AIYA204 scope for this file: the sanctioned resolver functions
+        # (when this IS one of the resolver modules) and the tuning layer.
+        self.route_exempt = any(f"/{d}" in f"/{rel_norm}"
+                                for d in _ROUTE_EXEMPT_DIRS)
+        self._route_allowed_funcs = set()
+        for suffix, funcs in _ROUTE_RESOLVER_FUNCS.items():
+            if rel_norm.endswith(suffix):
+                self._route_allowed_funcs |= funcs
+        self._func_stack: List[str] = []
         self.findings: List[Finding] = []
         # Env-gated-debug context: names of If-tests containing "DEBUG"
         # we are currently inside of (AIYA203's sanctioned pattern).
@@ -158,9 +195,71 @@ class _Linter(ast.NodeVisitor):
                 return
         self.generic_visit(node)
 
+    # -- AIYA204: route-resolution discipline --------------------------------
+
+    @staticmethod
+    def _binds_route_literal(branch) -> bool:
+        """Whether a conditional branch binds or returns one of the route
+        literals. `branch` is a statement list (ast.If arm) or a bare
+        expression (ast.IfExp arm). Only Return/assignment VALUES are
+        searched — raise messages mentioning a route name are guidance,
+        not a choice."""
+        if isinstance(branch, list):
+            values = []
+            for stmt in branch:
+                for n in ast.walk(stmt):
+                    if isinstance(n, (ast.Return, ast.Assign, ast.AnnAssign,
+                                      ast.AugAssign, ast.NamedExpr)):
+                        if n.value is not None:
+                            values.append(n.value)
+        else:
+            values = [branch]
+        return any(isinstance(c, ast.Constant) and c.value in _ROUTE_LITERALS
+                   for v in values for c in ast.walk(v))
+
+    def _check_route_resolution(self, node, test, branches):
+        if self.route_exempt or any(f in self._route_allowed_funcs
+                                    for f in self._func_stack):
+            return
+        if any(isinstance(n, ast.Constant) and n.value == "auto"
+               for n in ast.walk(test)):
+            trigger = '"auto"'
+        elif any(isinstance(n, ast.Call)
+                 and ((isinstance(n.func, ast.Attribute)
+                       and n.func.attr == "default_backend")
+                      or (isinstance(n.func, ast.Name)
+                          and n.func.id == "default_backend"))
+                 for n in ast.walk(test)):
+            trigger = "jax.default_backend()"
+        else:
+            return
+        if any(self._binds_route_literal(b) for b in branches):
+            self._emit(
+                "route-resolution-discipline", node,
+                f"conditional on {trigger} binds a concrete route literal "
+                "outside the sanctioned resolvers; route this choice "
+                "through ops/pushforward.resolve_backend / "
+                "ops/egm.resolve_egm_kernel / ops/interp."
+                "searchsorted_method so the tuning cache and the "
+                "route_decision ledger trail see it")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._check_route_resolution(node, node.test,
+                                     [node.body, node.orelse])
+        self.generic_visit(node)
+
     # -- AIYA202 / AIYA203 --------------------------------------------------
 
     def visit_If(self, node: ast.If):
+        self._check_route_resolution(node, node.test,
+                                     [node.body, node.orelse])
         guard = any(isinstance(n, ast.Name) and "DEBUG" in n.id
                     for n in ast.walk(node.test))
         self.visit(node.test)

@@ -176,6 +176,24 @@ RULES: Tuple[Rule, ...] = (
             "allowed only behind an opt-in env-gated flag (an enclosing "
             "`if <...DEBUG...>:` guard, the AIYAGARI_DEBUG_* pattern)."),
     ),
+    Rule(
+        id="AIYA204",
+        name="route-resolution-discipline",
+        level="source",
+        description=(
+            "Literal \"auto\"-resolution fallbacks and platform-split "
+            "route choices may live ONLY in the sanctioned resolver "
+            "functions (ops/pushforward.resolve_backend, "
+            "ops/egm.resolve_egm_kernel / require_xla_egm_kernel, "
+            "ops/interp.bucket_index / searchsorted_method) and the "
+            "tuning layer itself (tuning/): no other module may map "
+            "\"auto\" — or a jax.default_backend() test — onto a "
+            "concrete route literal. A re-hardcoded route silently "
+            "bypasses the measured tuning cache, the roofline prior, and "
+            "the route_decision ledger trail those resolvers emit "
+            "(tuning/autotuner.py), turning an audited decision back "
+            "into an unexplained constant."),
+    ),
 )
 
 _BY_NAME = {r.name: r for r in RULES}

@@ -298,6 +298,45 @@ def report_main(argv) -> int:
             elif k == "metric":
                 print(f"  metric {ev.get('metric')}: {ev.get('value')} "
                       f"{ev.get('unit', '')}")
+            elif k == "route_decision":
+                ev_d = ev.get("evidence") or {}
+                walls = ev_d.get("walls_us") or {}
+                if walls:
+                    detail = "  ".join(
+                        f"{r}={w:.1f}us" for r, w in
+                        sorted(walls.items(), key=lambda kv: kv[1]))
+                elif ev_d.get("predicted_us"):
+                    detail = "roofline prior: " + "  ".join(
+                        f"{r}={w:.1f}us" for r, w in
+                        sorted(ev_d["predicted_us"].items(),
+                               key=lambda kv: kv[1]))
+                else:
+                    detail = "shipped default"
+                print(f"  route {ev.get('knob')} -> {ev.get('choice')} "
+                      f"[{ev.get('source')}, {ev.get('bucket', 'any')}/"
+                      f"{ev.get('dtype', 'any')}] {detail}")
+            elif k == "analysis":
+                print(f"  analysis: {ev.get('findings')} active finding(s) "
+                      f"over {ev.get('programs_audited')} program(s), "
+                      f"{ev.get('files_linted')} file(s)"
+                      + (f", skipped {', '.join(ev['programs_skipped'])}"
+                         if ev.get("programs_skipped") else ""))
+            elif k == "attribution":
+                comp = ev.get("compiled") or {}
+                ratio = ev.get("byte_ratio")
+                mod = ev.get("modeled") or {}
+                print(f"  attribution {ev.get('program')}: compiled "
+                      f"{comp.get('bytes_accessed')} B vs modeled "
+                      f"{mod.get('hbm_bytes', '-')} B"
+                      + (f" (x{ratio})" if ratio is not None else "")
+                      + (" ** FUSION-REGRESSION FLAG **"
+                         if ev.get("flagged") else ""))
+            elif k == "tuning_probe":
+                walls = ev.get("walls_us") or {}
+                detail = "  ".join(f"{r}={w:.1f}us" for r, w in
+                                   sorted(walls.items(), key=lambda kv: kv[1]))
+                print(f"  probe {ev.get('knob')} -> {ev.get('choice')} "
+                      f"(na={ev.get('na')}, {ev.get('dtype')}) {detail}")
             else:
                 print(f"  {k}: " + ", ".join(
                     f"{a}={b}" for a, b in ev.items()

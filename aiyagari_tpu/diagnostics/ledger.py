@@ -172,6 +172,11 @@ def activate(led: Optional[RunLedger]) -> Iterator[Optional[RunLedger]]:
     prev = getattr(_tls, "ledger", None)
     _tls.ledger = led
     if led is not None:
+        # Each activation scope is one observed run: reset the
+        # route-decision dedup set (tuning/autotuner._record_decision)
+        # so a solve re-run on the same ledger records its own
+        # route_decision events — exactly one per knob per activation.
+        led.__dict__.pop("_route_decisions_emitted", None)
         with _proc_lock:
             _proc_stack.append(led)
     try:

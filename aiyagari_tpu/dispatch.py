@@ -137,6 +137,55 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
     return solver
 
 
+def _resolve_routes(solver: Optional[SolverConfig], *,
+                    na: Optional[int] = None, dtype=None,
+                    egm: bool = True) -> Optional[SolverConfig]:
+    """Resolve the contested route knobs ("auto" pushforward /
+    egm_kernel / searchsorted method) at the dispatch boundary, INSIDE
+    the _observe scope, so every solve/sweep run records exactly one
+    `route_decision` ledger event per knob (tuning/autotuner.py dedupes
+    per activation) — jit caching makes the deep trace-time resolutions
+    unreliable as a per-run record (a cache-hit run never re-traces).
+    `na`/`dtype` are the run's OWN grid size and solve dtype: the
+    boundary and the deep trace-time resolvers then consult the same
+    tuning-cache cell, so the recorded decision is the executed one.
+
+    With tuning ACTIVE the resolved concrete routes are threaded back
+    into the SolverConfig, so the jit static args key on the measured
+    choice instead of the literal "auto" (a mid-process cache refresh can
+    then never serve a stale "auto"-keyed executable). A None solver
+    cannot carry the threading — its runs still record decisions, and the
+    deep resolvers reach the same choice from the same cache, but a
+    mid-process cache refresh can leave an already-compiled "auto"-keyed
+    executable on the old route (the staleness caveat the threading
+    exists to remove; pass a SolverConfig to get it). With tuning off
+    the config is returned untouched — the exact historical object, same
+    jit keys, bit-identical programs (the PR 6 zero-cost discipline
+    applied to decisions; pinned by tests/test_tuning.py).
+
+    egm=False skips the egm_kernel knob (the endogenous-labor family
+    routes through require_xla_egm_kernel, a constraint rather than a
+    decision — a measured fused-route winner must not be recorded, let
+    alone applied, for a chain the fused kernel does not implement)."""
+    from aiyagari_tpu.ops.egm import resolve_egm_kernel
+    from aiyagari_tpu.ops.interp import searchsorted_method
+    from aiyagari_tpu.ops.pushforward import resolve_backend
+    from aiyagari_tpu.tuning.autotuner import tuning_active
+
+    pf_in = solver.pushforward if solver is not None else "auto"
+    ek_in = solver.egm_kernel if solver is not None else "auto"
+    pf = resolve_backend(pf_in, na=na, dtype=dtype)
+    ek = resolve_egm_kernel(ek_in, na=na, dtype=dtype) if egm else ek_in
+    # The searchsorted split has no SolverConfig knob but every
+    # push-forward plan build exercises it (_segment_bounds): resolving
+    # it here records the run's decision even when jit caching skips the
+    # trace-time resolver.
+    searchsorted_method(na)
+    if solver is not None and tuning_active() and (pf, ek) != (pf_in, ek_in):
+        solver = dataclasses.replace(solver, pushforward=pf, egm_kernel=ek)
+    return solver
+
+
 def _resolve_rescue(rescue):
     """Normalize the `rescue` argument: None (off), True (the default
     ladder), or a RescueConfig."""
@@ -322,6 +371,16 @@ def solve(
                 )
                 from aiyagari_tpu.models.aiyagari import AiyagariModel
 
+                # Route observatory: record this run's "auto" decisions
+                # (one route_decision ledger event per knob) and, with
+                # tuning active, thread the measured routes into the
+                # solver config (jax backend only — the numpy reference
+                # implements the scatter/XLA routes alone).
+                solver = _resolve_routes(
+                    solver, na=model.grid.n_points,
+                    dtype=_dtype_of(backend),
+                    egm=not model.endogenous_labor)
+
                 # Honor dtype="float64" even when global x64 is off (see
                 # precision_scope — without it the request silently truncates).
                 # Grid-axis mesh (BackendConfig.mesh_axes containing "grid"):
@@ -417,6 +476,17 @@ def solve(
         # of the reference's Monte-Carlo agent panel.
         with _observe(led, "krusell_smith", method=method,
                       aggregation=aggregation):
+            # Route observatory, KS flavor: the pushforward decision is
+            # recorded by the ALM loop itself (equilibrium/alm.py
+            # resolves with the sim-dtype context dispatch does not
+            # have, exactly once per activation); the searchsorted knob
+            # has no config surface, so record it HERE where jit caching
+            # cannot skip it (the trace-time resolver never re-runs on a
+            # warm executable). egm_kernel has no KS route and stays
+            # unrecorded.
+            from aiyagari_tpu.ops.interp import searchsorted_method
+
+            searchsorted_method(model.k_size)
             result = solve_krusell_smith(
                 model, method=method, solver=solver, alm=alm, backend=backend,
                 closure=("histogram" if aggregation == "distribution" else "panel"),
@@ -571,6 +641,9 @@ def sweep(
     led = _as_ledger(ledger, base, solver, equilibrium, entry="sweep")
     with _observe(led, "aiyagari_sweep", scenarios=len(configs),
                   method=method, aggregation=aggregation):
+        solver = _resolve_routes(solver, na=base.grid.n_points,
+                                 dtype=_dtype_of(backend),
+                                 egm=not base.endogenous_labor)
         with precision_scope(backend.dtype):
             if solver.ladder is not None:
                 from aiyagari_tpu.ops.precision import require_x64
@@ -759,6 +832,8 @@ def solve_transition(
                      entry="solve_transition")
     with _observe(led, "mit_transition", method=transition.method,
                   T=transition.T):
+        solver = _resolve_routes(solver, na=model.grid.n_points,
+                                 dtype=_dtype_of(backend))
         with precision_scope(backend.dtype):
             result = _solve(model, shock, trans=transition, solver=solver,
                             eq=equilibrium, dtype=_dtype_of(backend),
@@ -858,6 +933,8 @@ def sweep_transitions(
         shocks_run[pi] = MITShock(param="tfp", size=float("nan"), rho=0.0)
     with _observe(led, "mit_transition_sweep", scenarios=len(shocks),
                   method=transition.method, T=transition.T):
+        solver = _resolve_routes(solver, na=model.grid.n_points,
+                                 dtype=_dtype_of(backend))
         with precision_scope(backend.dtype):
             result = _sweep(model, shocks_run, trans=transition,
                             solver=solver, eq=equilibrium, mesh=mesh,

@@ -407,18 +407,16 @@ def _solve_krusell_smith_impl(
             cross = cross * (target[:, None] / jnp.maximum(row_mass, 1e-300))
             # Push-forward backend: an EXPLICIT SolverConfig.pushforward
             # wins; under the "auto" default the route splits on the SIM
-            # dtype. The scatter-free transpose route computes bucket
-            # masses as differences of row-prefix cumsums, so its ABSOLUTE
-            # per-bucket error is O(eps * prefix mass) ~ eps — irrelevant
-            # in f64 (2e-16) but in the mixed mode's f32 scan (~1.2e-7) it
-            # sits exactly at the bias floor the stall detector below
-            # polices: measured, the f32 sim then falls back to f64 in
-            # ~20% of rounds, forfeiting the dtype split. So "auto" keeps
-            # the scatter form for the f32 scan (whose per-bucket error
-            # stays relative) and goes scatter-free for f64 sims.
-            pf_knob = solver.pushforward if solver is not None else "auto"
-            if pf_knob == "auto" and sim_dtype == jnp.float32:
-                pf_knob = "scatter"
+            # dtype — resolve_backend's f32_sim override (the cumsum-bias
+            # rationale lives on its docstring; the split itself lives
+            # THERE per the AIYA204 route-resolution discipline, so this
+            # module re-hardcodes nothing).
+            from aiyagari_tpu.ops.pushforward import resolve_backend
+
+            pf_knob = resolve_backend(
+                solver.pushforward if solver is not None else "auto",
+                na=int(k_grid_sim.shape[-1]), dtype=sim_dtype,
+                f32_sim=sim_dtype == jnp.float32)
             K_ts, cross_new = distribution_capital_path(
                 k_opt_sim, k_grid_sim, K_grid_sim, z_path, eps_trans_sim,
                 cross, T=alm.T, pushforward=pf_knob,

@@ -9,6 +9,7 @@ loops (Aiyagari_EGM.m:74-110) collapse into batched array ops.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,11 +47,7 @@ __all__ = ["EGM_KERNELS", "egm_step", "egm_step_labor",
 EGM_KERNELS = ("auto", "xla", "pallas_inverse", "pallas_fused")
 
 
-def resolve_egm_kernel(kernel: str) -> str:
-    """Validate an EGM kernel route name loudly (the typo/numpy rejection
-    mirror of ops/pushforward.resolve_backend) and resolve "auto" to its
-    current platform choice. Called at config validation (dispatch) and at
-    every egm_step trace, so a bad route name fails before any solve."""
+def _validate_egm_kernel(kernel: str) -> None:
     if kernel not in EGM_KERNELS:
         hint = ""
         if kernel in ("numpy", "reference"):
@@ -60,24 +57,48 @@ def resolve_egm_kernel(kernel: str) -> str:
         raise ValueError(
             f"unknown egm_kernel {kernel!r}; expected one of "
             f"{EGM_KERNELS}{hint}")
-    # "auto" stays the XLA chain until the fused kernel is validated on
-    # real hardware (the pallas_inverse round-2 lesson; docs/USAGE.md).
-    return "xla" if kernel == "auto" else kernel
+
+
+def resolve_egm_kernel(kernel: str, *, na: Optional[int] = None,
+                       dtype=None) -> str:
+    """Validate an EGM kernel route name loudly (the typo/numpy rejection
+    mirror of ops/pushforward.resolve_backend) and resolve "auto". Called
+    at config validation (dispatch) and at every egm_step trace, so a bad
+    route name fails before any solve.
+
+    The shipped "auto" default is the XLA chain until the fused kernel is
+    validated on real hardware (the pallas_inverse round-2 lesson;
+    docs/USAGE.md). With tuning active (tuning/autotuner.py) a measured
+    probe for this platform/grid-bucket/dtype — or the roofline prior on
+    modeled platforms — wins over the default, and every "auto"
+    resolution lands on the active run ledger as a `route_decision`
+    event. `na`/`dtype` are optional cache-keying context."""
+    _validate_egm_kernel(kernel)
+    if kernel != "auto":
+        return kernel
+    from aiyagari_tpu.tuning.autotuner import resolve_route
+
+    return resolve_route("egm_kernel", "xla", na=na, dtype=dtype)
 
 
 def require_xla_egm_kernel(kernel: str, where: str) -> str:
-    """Resolve a route name and REJECT Pallas routes loudly for sweep
-    chains the fused kernel does not implement (the endogenous-labor
-    family). Loud, not silent: quietly running the XLA chain would let a
-    caller believe they ran or benchmarked the fused route — the exact
-    failure mode the loud route validation exists to prevent."""
-    resolved = resolve_egm_kernel(kernel)
-    if resolved != "xla":
-        raise ValueError(
-            f"egm_kernel={kernel!r} is not supported by {where}: the fused "
-            "Pallas kernel implements the exogenous-labor EGM chain only; "
-            "use egm_kernel='auto' or 'xla' there")
-    return resolved
+    """Accept only routes that resolve to the XLA chain, loudly rejecting
+    Pallas routes for sweep chains the fused kernel does not implement
+    (the endogenous-labor family). Loud, not silent: quietly running the
+    XLA chain would let a caller believe they ran or benchmarked the
+    fused route — the exact failure mode the loud route validation exists
+    to prevent. "auto" resolves straight to "xla" here WITHOUT consulting
+    the tuning cache: a measured fused-route winner describes the
+    exogenous chain and must not (and cannot) reroute the labor family —
+    a routing constraint, not a decision, so no route_decision is
+    emitted."""
+    _validate_egm_kernel(kernel)
+    if kernel in ("auto", "xla"):
+        return "xla"
+    raise ValueError(
+        f"egm_kernel={kernel!r} is not supported by {where}: the fused "
+        "Pallas kernel implements the exogenous-labor EGM chain only; "
+        "use egm_kernel='auto' or 'xla' there")
 
 
 @partial(jax.jit, static_argnames=("grid_power", "with_escape", "egm_kernel",
@@ -127,7 +148,8 @@ def egm_step(C, a_grid, s, P, r, w, amin, *, sigma, beta,
     """
     from aiyagari_tpu.ops.precision import matmul_precision_of
 
-    kernel = resolve_egm_kernel(egm_kernel)
+    kernel = resolve_egm_kernel(egm_kernel, na=a_grid.shape[-1],
+                                dtype=C.dtype)
     if kernel == "pallas_fused":
         from aiyagari_tpu.ops.pallas_egm import egm_sweep_pallas
         from aiyagari_tpu.ops.pallas_support import pallas_interpret_mode
@@ -229,7 +251,8 @@ def egm_step_transition(C_next, a_grid, s, P, r_next, r_now, w_now, amin_now,
     """
     from aiyagari_tpu.ops.precision import matmul_precision_of
 
-    kernel = resolve_egm_kernel(egm_kernel)
+    kernel = resolve_egm_kernel(egm_kernel, na=a_grid.shape[-1],
+                                dtype=C_next.dtype)
     if kernel == "pallas_inverse":
         raise ValueError(
             "egm_step_transition supports egm_kernel 'auto'/'xla'/"

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 __all__ = [
     "INVERSE_DENSE_CUTOFF",
     "bucket_index",
+    "searchsorted_method",
     "inverse_interp_power_grid",
     "bucket_onehot",
     "power_bucket_index",
@@ -39,6 +40,32 @@ __all__ = [
 _COMPARE_ALL_MAX = 1024
 
 
+def searchsorted_method(n: int | None = None) -> str:
+    """THE resolver for the searchsorted route split (tuning knob
+    "bucket_index") — the one place the platform default lives, per the
+    route-resolution discipline (analysis/rules.py AIYA204: no other
+    module may re-hardcode a route choice). Shipped default, both
+    directions measured (BENCHMARKS.md round 7):
+
+      * CPU: 'scan' — the host executes the binary search's scalar
+        gathers in nanoseconds, while the sort route costs 20x more
+        (30 ms vs 1.4 ms for 28k queries over 4k knots).
+      * accelerators: 'sort' — jnp.searchsorted's 'scan' lowers to
+        log2(n) SERIAL gather rounds (the documented TPU pathology,
+        ~33 ms vs ~0.4 ms at 40k knots on a v5e); GPU is unmeasured but
+        serial gather rounds are the generic accelerator pathology.
+
+    With tuning active (tuning/autotuner.py) the measured probe for this
+    platform/grid-bucket wins over the default, and the resolution lands
+    on the active run ledger as a `route_decision` event. This is a
+    TRACE-time host decision: each backend compiles only its own route.
+    """
+    from aiyagari_tpu.tuning.autotuner import resolve_route
+
+    default = "scan" if jax.default_backend() == "cpu" else "sort"
+    return resolve_route("bucket_index", default, na=n)
+
+
 def bucket_index(x: jnp.ndarray, q: jnp.ndarray, hi_clip: int | None = None) -> jnp.ndarray:
     """Index i of the grid interval [x[i], x[i+1]) containing each query,
     clipped to [0, n-2] so out-of-range queries use the edge segments.
@@ -54,22 +81,10 @@ def bucket_index(x: jnp.ndarray, q: jnp.ndarray, hi_clip: int | None = None) -> 
     if n <= _COMPARE_ALL_MAX:
         idx = jnp.sum(x <= q[..., None], axis=-1).astype(jnp.int32) - 1
     else:
-        # Platform-split above the compare-all cutoff, both directions
-        # measured (BENCHMARKS.md round 7):
-        #   * TPU: method='sort' counts by co-sorting knots and queries —
-        #     one bitonic sort (~0.4 ms at 40k knots on a v5e) instead of
-        #     log2(n) SERIAL gather rounds (~2 ms each, ~33 ms total at
-        #     40k; 'scan_unrolled' was the dominant cost of an entire EGM
-        #     sweep).
-        #   * CPU: the exact opposite — the host executes the binary
-        #     search's scalar gathers in nanoseconds, while the sort route
-        #     costs 20x more (30 ms vs 1.4 ms for 28k queries over 4k
-        #     knots; it was the dominant cost of a CPU EGM sweep). The
-        #     branch is a trace-time host decision, so each backend
-        #     compiles only its own route. Only CPU takes 'scan': any
-        #     accelerator (GPU included, unmeasured) keeps the sort route —
-        #     serial gather rounds are the documented accelerator pathology.
-        method = "scan" if jax.default_backend() == "cpu" else "sort"
+        # Platform-split above the compare-all cutoff — the measured
+        # rationale, the default, and the tuning-cache consult all live in
+        # searchsorted_method (the knob's one resolver, AIYA204).
+        method = searchsorted_method(n)
         idx = jnp.searchsorted(x, q, side="right", method=method).astype(jnp.int32) - 1
     return jnp.clip(idx, 0, hi)
 

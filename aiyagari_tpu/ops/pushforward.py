@@ -120,15 +120,34 @@ def _record_fallback(route: str) -> None:
         pass
 
 
-def resolve_backend(backend: Optional[str]) -> str:
+def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
+                    dtype=None, f32_sim: bool = False) -> str:
     """Validate a DistributionBackend name and resolve "auto".
 
-    "auto" resolves to "transpose" on every platform: it is scatter-free,
-    needs no per-policy build, wins or ties the scatter wall on the CPU
-    host (BENCH_r08), and its TPU lowering is plain cumsum/gather HLO. The
-    banded and pallas routes stay explicit opt-ins until validated on real
-    hardware (the pallas_inverse round-2 lesson: fused TPU routes must be
-    cross-checked on chip before any solver defaults to them).
+    The shipped "auto" default is "transpose" on every platform: it is
+    scatter-free, needs no per-policy build, wins or ties the scatter wall
+    on the CPU host (BENCH_r08), and its TPU lowering is plain
+    cumsum/gather HLO. The banded and pallas routes stay explicit opt-ins
+    until validated on real hardware (the pallas_inverse round-2 lesson:
+    fused TPU routes must be cross-checked on chip before any solver
+    defaults to them). With tuning active (tuning/autotuner.py) a
+    measured probe for this platform/grid-bucket/dtype — or the roofline
+    prior on modeled platforms — wins over the default, and every "auto"
+    resolution lands on the active run ledger as a `route_decision`
+    event (exactly one per dispatch run and knob).
+
+    f32_sim=True is the Krusell-Smith mixed-mode histogram scan's
+    ACCURACY override (equilibrium/alm.py): the transpose route's bucket
+    masses are differences of row-prefix cumsums, whose absolute O(eps *
+    prefix-mass) error in an f32 scan sits exactly at the ALM stall
+    detector's bias floor (measured: ~20% of rounds then fall back to
+    f64, forfeiting the dtype split) — so "auto" keeps the scatter form
+    there regardless of any measured wall. A correctness constraint, not
+    a perf choice; the tuning cache is never consulted for it.
+
+    `na`/`dtype` are optional resolution context (grid-bucket keying of
+    the tuning cache); plan-build call sites pass them, the dispatch
+    validation boundary does not.
     """
     if backend is None:
         backend = "auto"
@@ -136,9 +155,23 @@ def resolve_backend(backend: Optional[str]) -> str:
         raise ValueError(
             f"unknown distribution backend {backend!r}; expected one of "
             f"{BACKENDS}")
-    if backend == "auto":
-        return "transpose"
-    return backend
+    if backend != "auto":
+        return backend
+    if f32_sim:
+        # Still a recorded decision — source "default" with the
+        # constraint named as evidence, so a K-S mixed-mode run's ledger
+        # explains why its histogram scan scatters.
+        from aiyagari_tpu.tuning.autotuner import _record_decision
+
+        _record_decision(
+            "pushforward", "scatter", "default",
+            {"constraint": "f32-sim cumsum bias pins the scatter form "
+                           "(resolve_backend docstring)"},
+            na=na, dtype=dtype)
+        return "scatter"
+    from aiyagari_tpu.tuning.autotuner import resolve_route
+
+    return resolve_route("pushforward", "transpose", na=na, dtype=dtype)
 
 
 def lottery_scatter(mass, idx, w_lo, n_out: Optional[int] = None):
@@ -161,13 +194,16 @@ def _segment_bounds(idx, na: int):
     bucket l as the LO leg occupy exactly [bounds[l], bounds[l+1]) — the
     contiguous-segment fact the transpose and banded routes are built on.
 
-    Searchsorted method is the ops/interp.bucket_index platform split:
+    Searchsorted method routes through ops/interp.searchsorted_method —
+    the bucket_index platform split's ONE resolver (AIYA204 discipline):
     jnp.searchsorted's default 'scan' lowers to log2(na) SERIAL gather
     rounds on accelerators (the documented TPU pathology — and this runs
     per scan STEP in the KS/transition paths, where the plan rebuilds each
     period), so only the CPU host takes 'scan'; accelerators co-sort."""
+    from aiyagari_tpu.ops.interp import searchsorted_method
+
     targets = jnp.arange(na + 1, dtype=idx.dtype)
-    method = "scan" if jax.default_backend() == "cpu" else "sort"
+    method = searchsorted_method(na)
     return jax.vmap(
         lambda row: jnp.searchsorted(row, targets, side="left", method=method)
     )(idx)
@@ -302,7 +338,7 @@ def plan_pushforward(idx, w_lo, *, backend: str = "auto",
     """Compile a lottery for `backend` (module docstring). The returned
     plan is policy-specific: rebuild it when (idx, w_lo) change (the scan
     paths do this per step; the stationary loop hoists it)."""
-    kind = resolve_backend(backend)
+    kind = resolve_backend(backend, na=idx.shape[-1], dtype=w_lo.dtype)
     if kind == "scatter":
         return PushforwardPlan("scatter", idx, w_lo)
     if kind == "pallas":

@@ -1,0 +1,36 @@
+"""Measured route selection for the framework's `"auto"` knobs.
+
+The route observatory's decision half (ISSUE 12): `tuning/autotuner.py`
+runs short interleaved measured probes per (platform fingerprint,
+grid-size bucket, dtype) for each contested knob — the push-forward
+backend, the EGM sweep kernel, the searchsorted method split — persists
+the winners in a JSON tuning cache beside the XLA compile cache, and
+feeds the `"auto"` resolvers (`ops/pushforward.resolve_backend`,
+`ops/egm.resolve_egm_kernel`, `ops/interp.bucket_index`) from data
+instead of hardcoded constants. Every resolution lands on the run ledger
+as a `route_decision` event with the evidence behind it.
+
+Off by default: with tuning disabled and no cache, every resolver
+returns today's exact defaults (the PR 6 zero-cost discipline applied to
+decisions; pinned by tests/test_tuning.py).
+"""
+
+from aiyagari_tpu.tuning.autotuner import (  # noqa: F401
+    KNOBS,
+    autotune,
+    configure,
+    explain,
+    resolve_route,
+    tuning_active,
+    tuning_cache_path,
+)
+
+__all__ = [
+    "KNOBS",
+    "autotune",
+    "configure",
+    "explain",
+    "resolve_route",
+    "tuning_active",
+    "tuning_cache_path",
+]

@@ -1,0 +1,683 @@
+"""The `"auto"`-knob autotuner: measured probes, a stepping-safe tuning
+cache, and the `route_decision` ledger trail behind every resolution.
+
+The framework carries competing routes at every layer whose winners are
+platform-dependent (PR 4 measured a 20x CPU/TPU split in one searchsorted
+call; BENCH_r08 shows a 24x spread between push-forward routes), yet each
+`"auto"` used to resolve to a hardcoded constant. This module turns that
+assertion into an audited measurement:
+
+  * `autotune()` runs short INTERLEAVED probes per contested knob — the
+    candidates race round-robin so host drift hits every side equally
+    (the PR 6/10 rotated-variant timing lesson), fenced through
+    `diagnostics/profiler.fence` — and persists the winners in a JSON
+    cache keyed by (knob, grid-size bucket, dtype) inside a document
+    stamped with the jax version and the platform fingerprint
+    (`io_utils/compile_cache._host_cpu_tag`, the same stepping-safe
+    keying as the XLA compile cache next to which the file lives).
+  * `resolve_route(knob, default, ...)` is what the three sanctioned
+    resolvers (`ops/pushforward.resolve_backend`,
+    `ops/egm.resolve_egm_kernel`, `ops/interp.bucket_index` via
+    `searchsorted_method`) call on the `"auto"` path. With tuning ON it
+    consults the cache (source `"measured"`), falls back to the roofline
+    prior on modeled platforms (source `"prior"`,
+    `diagnostics/roofline.py` pricing each candidate against the chip
+    peaks), and otherwise — and ALWAYS with tuning off — returns the
+    caller's default unchanged (source `"default"`).
+  * Every `"auto"` resolution emits one `route_decision` event
+    `{knob, choice, source, evidence}` on the active run ledger plus an
+    `aiyagari_route_decisions_total{knob=,choice=,source=}` counter,
+    deduplicated per activation scope so a `dispatch.solve`/`sweep` run
+    carries exactly one decision per knob (the dedup set resets when
+    `diagnostics/ledger.activate` enters).
+
+Zero-cost discipline: tuning is OFF unless `AIYAGARI_TPU_TUNING=1` (or
+`configure(enabled=True)`); the off path never touches the filesystem and
+returns bit-identical defaults, so solve programs and results are
+unchanged (jaxpr/result-pinned by tests/test_tuning.py).
+
+Cache hygiene: a document whose jax version or platform fingerprint no
+longer matches is invalidated wholesale (counted in
+`aiyagari_tuning_cache_invalidated_total`); a torn/corrupt file warns
+loudly, emits a ledger degradation event, and is treated as empty rather
+than killing the solve; every consult lands in
+`aiyagari_tuning_cache_{hits,misses}_total`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import math
+import os
+import time
+import warnings
+from pathlib import Path
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "KNOBS",
+    "KnobSpec",
+    "autotune",
+    "configure",
+    "explain",
+    "grid_bucket",
+    "load_cache",
+    "platform_fingerprint",
+    "probe_knob",
+    "resolve_route",
+    "save_cache",
+    "tune_main",
+    "tuning_active",
+    "tuning_cache_path",
+]
+
+_CACHE_VERSION = 1
+_ENV_ENABLED = "AIYAGARI_TPU_TUNING"
+_ENV_CACHE = "AIYAGARI_TPU_TUNING_CACHE"
+
+# Module override state (configure()); None defers to the environment.
+_enabled_override: Optional[bool] = None
+_cache_path_override: Optional[str] = None
+# Paths whose torn-file warning already fired (warn once per process, not
+# per resolution — the loud-but-non-fatal contract must not spam a sweep).
+_torn_warned: set = set()
+# load_cache memo keyed by path -> ((mtime_ns, size), validated doc):
+# resolution sites run inside per-round host loops (the K-S ALM loop) and
+# must not re-read + re-parse an unchanged file every round. A re-written
+# file changes its stat signature and refreshes the memo.
+_doc_memo: dict = {}
+
+
+def _platform() -> str:
+    """The resolved jax backend — one seam so tests can exercise the
+    TPU-only prior path without hardware."""
+    import jax
+
+    return jax.default_backend()
+
+
+def tuning_active() -> bool:
+    """Whether resolvers may consult the cache/prior. Off by default."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get(_ENV_ENABLED, "") not in ("", "0")
+
+
+def platform_fingerprint() -> str:
+    """backend + host-CPU-stepping tag — the cache document's identity
+    (the compile cache's keying, reused so the two caches age together)."""
+    from aiyagari_tpu.io_utils.compile_cache import _host_cpu_tag
+
+    return f"{_platform()}-{_host_cpu_tag()}"
+
+
+def tuning_cache_path() -> Optional[Path]:
+    """Resolve the cache file: configure() override, then
+    $AIYAGARI_TPU_TUNING_CACHE, then
+    ~/.cache/aiyagari_tpu/tuning-{backend}-{cpu_tag}.json (beside the XLA
+    compile cache directories). An empty env value disables persistence
+    entirely (returns None) — the compile cache's kill-switch semantics."""
+    if _cache_path_override is not None:
+        # The empty string disables persistence, exactly like the env
+        # kill switch below — Path("") would silently mean the cwd.
+        return Path(_cache_path_override) if _cache_path_override else None
+    env = os.environ.get(_ENV_CACHE)
+    if env == "":
+        return None
+    if env:
+        return Path(env)
+    return Path(os.path.expanduser("~")) / ".cache" / "aiyagari_tpu" / (
+        f"tuning-{platform_fingerprint()}.json")
+
+
+@contextlib.contextmanager
+def configure(*, enabled: Optional[bool] = None,
+              cache_path: Optional[str] = None):
+    """Scope the tuner's state (tests, the `tune` CLI): `enabled`
+    overrides the env gate, `cache_path` the cache file. Restores the
+    previous state on exit."""
+    global _enabled_override, _cache_path_override
+    prev = (_enabled_override, _cache_path_override)
+    if enabled is not None:
+        _enabled_override = enabled
+    if cache_path is not None:
+        _cache_path_override = str(cache_path)
+    try:
+        yield
+    finally:
+        _enabled_override, _cache_path_override = prev
+
+
+def grid_bucket(na: Optional[int]) -> str:
+    """Pow-2 grid-size bucket ("b512") — probe walls generalize across
+    nearby sizes but not across orders of magnitude; "any" when the
+    resolution site has no grid in hand (dispatch-boundary validation)."""
+    if na is None:
+        return "any"
+    return f"b{1 << max(int(na) - 1, 1).bit_length()}"
+
+
+def _dtype_name(dtype) -> str:
+    if dtype is None:
+        return "any"
+    import numpy as np
+
+    return str(np.dtype(dtype))
+
+
+def _entry_key(knob: str, bucket: str, dtype_name: str) -> str:
+    return f"{knob}|{bucket}|{dtype_name}"
+
+
+# -- cache I/O --------------------------------------------------------------
+
+
+def _fresh_doc() -> dict:
+    import jax
+
+    return {"version": _CACHE_VERSION, "jax_version": jax.__version__,
+            "fingerprint": platform_fingerprint(), "entries": {}}
+
+
+def load_cache(path=None) -> dict:
+    """Load + validate the tuning cache document. Missing file -> fresh
+    empty doc. Torn/corrupt file -> LOUD warning + ledger degradation
+    event + fresh doc (non-fatal: a broken cache must never kill a
+    solve). Stale identity (jax version / platform fingerprint changed)
+    -> invalidated wholesale, counted."""
+    from aiyagari_tpu.diagnostics import ledger, metrics
+
+    p = Path(path) if path is not None else tuning_cache_path()
+    if p is None or not p.exists():
+        return _fresh_doc()
+    try:
+        st = p.stat()
+        sig = (st.st_mtime_ns, st.st_size)
+        memo = _doc_memo.get(str(p))
+        if memo is not None and memo[0] == sig:
+            return memo[1]
+    except OSError:
+        sig = None
+    try:
+        doc = json.loads(p.read_text())
+        if not isinstance(doc, dict) or "entries" not in doc:
+            raise ValueError("tuning cache document has no 'entries'")
+    except (json.JSONDecodeError, ValueError, OSError) as e:
+        metrics.counter("aiyagari_tuning_cache_torn_total").inc()
+        ledger.emit("degradation", event="tuning_cache_torn", path=str(p),
+                    error=str(e)[:200])
+        if str(p) not in _torn_warned:
+            _torn_warned.add(str(p))
+            warnings.warn(
+                f"tuning cache {p} is torn/corrupt ({e}); ignoring it — "
+                "re-run `python -m aiyagari_tpu tune` to rebuild",
+                RuntimeWarning, stacklevel=2)
+        return _fresh_doc()
+    fresh = _fresh_doc()
+    if (doc.get("version") != _CACHE_VERSION
+            or doc.get("jax_version") != fresh["jax_version"]
+            or doc.get("fingerprint") != fresh["fingerprint"]):
+        # The measurements were taken under a different jax lowering or
+        # on different silicon — both move route walls, so the whole
+        # document is stale, not just one entry.
+        metrics.counter("aiyagari_tuning_cache_invalidated_total").inc()
+        doc = fresh
+    if sig is not None:
+        _doc_memo[str(p)] = (sig, doc)
+    return doc
+
+
+def save_cache(doc: dict, path=None) -> Optional[Path]:
+    p = Path(path) if path is not None else tuning_cache_path()
+    if p is None:
+        return None
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(p.suffix + ".tmp")
+    tmp.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    os.replace(tmp, p)   # atomic: a concurrent reader never sees a torn doc
+    return p
+
+
+def _lookup(doc: dict, knob: str, na: Optional[int], dtype) -> Optional[dict]:
+    """Best matching cache entry for (knob, grid bucket, dtype): exact
+    bucket+dtype first, then same-dtype nearest bucket, then any-dtype
+    nearest bucket. Nearness is log2 bucket distance — probe walls drift
+    smoothly in size, so the nearest measurement beats no measurement."""
+    entries = {k: v for k, v in doc.get("entries", {}).items()
+               if len(k.split("|")) == 3 and k.split("|")[0] == knob
+               and isinstance(v, dict) and v.get("choice")}
+    if not entries:
+        return None
+    want_b, want_d = grid_bucket(na), _dtype_name(dtype)
+    exact = entries.get(_entry_key(knob, want_b, want_d))
+    if exact is not None:
+        return exact
+
+    def bucket_dist(key: str) -> float:
+        b = key.split("|")[1]
+        if want_b == "any" or b == "any":
+            return 0.5
+        try:
+            return abs(math.log2(int(b[1:])) - math.log2(int(want_b[1:])))
+        except ValueError:   # hand-edited bucket tag: neutral distance
+            return 0.5
+
+    def score(item):
+        key, _ = item
+        d = key.split("|")[2]
+        dtype_penalty = 0.0 if (want_d == "any" or d == want_d) else 10.0
+        return dtype_penalty + bucket_dist(key)
+
+    return min(entries.items(), key=score)[1]
+
+
+# -- the roofline prior -----------------------------------------------------
+
+
+def _predicted_seconds(cost, peaks) -> float:
+    """Roofline time estimate: the binding resource's transfer time."""
+    return max(cost.mxu_flops / peaks.matmul_flops,
+               cost.vpu_ops / peaks.vpu_ops,
+               cost.hbm_bytes / peaks.hbm_bytes)
+
+
+def _prior_choice(knob: str, na: Optional[int], dtype,
+                  platform: str) -> Optional[Tuple[str, dict]]:
+    """Price each candidate with the analytic roofline models against the
+    platform's chip peaks and pick the cheapest. Only platforms with a
+    chip model (CHIP_PEAKS) have a prior — elsewhere the resolver keeps
+    the shipped default. Returns (choice, evidence) or None."""
+    from aiyagari_tpu.diagnostics.roofline import (
+        CHIP_PEAKS,
+        distribution_sweep_cost,
+        dtype_itemsize,
+        egm_fused_sweep_cost,
+        egm_sweep_cost,
+    )
+
+    peaks = CHIP_PEAKS.get(platform)
+    if peaks is None or na is None:
+        return None
+    item = dtype_itemsize(dtype) if dtype is not None else 4
+    nz = 7   # the reference income-state count; route ordering is nz-robust
+    if knob == "pushforward":
+        costs = {rt: distribution_sweep_cost(nz, int(na), item, route=rt)
+                 for rt in ("scatter", "transpose", "banded", "pallas")}
+    elif knob == "egm_kernel":
+        costs = {"xla": egm_sweep_cost(nz, int(na), item),
+                 "pallas_fused": egm_fused_sweep_cost(nz, int(na), item)}
+    else:
+        return None   # no analytic model for the searchsorted split
+    pred = {rt: _predicted_seconds(c, peaks) * 1e6 for rt, c in costs.items()}
+    choice = min(pred, key=pred.get)
+    return choice, {"predicted_us": {k: round(v, 3) for k, v in pred.items()}}
+
+
+# -- decision recording -----------------------------------------------------
+
+
+def _record_decision(knob: str, choice: str, source: str, evidence: dict,
+                     *, na: Optional[int], dtype) -> None:
+    """Emit the route_decision event + counter for one `"auto"`
+    resolution, deduplicated per ledger-activation scope and knob so a
+    dispatch.solve/sweep run carries exactly one decision per knob (the
+    dedup set is cleared on ledger.activate entry). No active ledger ->
+    no event, no counter — resolution stays free for library users who
+    opted into neither observability nor tuning."""
+    from aiyagari_tpu.diagnostics import ledger, metrics
+
+    led = ledger.active_ledger()
+    if led is None:
+        return
+    emitted = led.__dict__.setdefault("_route_decisions_emitted", set())
+    if knob in emitted:
+        return
+    emitted.add(knob)
+    led.event("route_decision", knob=knob, choice=choice, source=source,
+              evidence=evidence, bucket=grid_bucket(na),
+              dtype=_dtype_name(dtype))
+    metrics.counter("aiyagari_route_decisions_total", knob=knob,
+                    choice=choice, source=source).inc()
+
+
+def resolve_route(knob: str, default: str, *, na: Optional[int] = None,
+                  dtype=None) -> str:
+    """Resolve one `"auto"` knob: measured cache entry -> roofline prior
+    -> the caller's default, in that order — the first two only with
+    tuning active. Records the decision (see _record_decision) and
+    returns the chosen route name. The off path returns `default`
+    untouched, so disabled-tuning resolution is bit-identical to the
+    historical constants."""
+    from aiyagari_tpu.diagnostics import metrics
+
+    choice, source, evidence = default, "default", {}
+    if tuning_active():
+        entry = _lookup(load_cache(), knob, na, dtype)
+        if entry is not None:
+            metrics.counter("aiyagari_tuning_cache_hits_total",
+                            knob=knob).inc()
+            choice, source = entry["choice"], "measured"
+            evidence = {"walls_us": entry.get("walls_us", {}),
+                        "probe_na": entry.get("na"),
+                        "measured_utc": entry.get("utc")}
+        else:
+            metrics.counter("aiyagari_tuning_cache_misses_total",
+                            knob=knob).inc()
+            prior = _prior_choice(knob, na, dtype, _platform())
+            if prior is not None:
+                choice, source = prior[0], "prior"
+                evidence = prior[1]
+    _record_decision(knob, choice, source, evidence, na=na, dtype=dtype)
+    return choice
+
+
+# -- measured probes --------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One contested knob: its shipped default and the probe building the
+    per-candidate timed closures at a (na, dtype) workload."""
+
+    name: str
+    default: Callable[[], str]
+    candidates: Callable[[], Tuple[str, ...]]
+    build_probe: Callable[[int, object], Dict[str, Callable]]
+
+
+def _interleaved_walls(fns: Dict[str, Callable], reps: int) -> Dict[str, float]:
+    """Best-of-`reps` walls (µs) with the candidates raced ROUND-ROBIN:
+    one warm fenced call each (compile excluded), then every rep times
+    all candidates back to back so host drift lands on each side equally
+    — ratios need both sides sampled under the same drift (the PR 6/10
+    rotated-variant lesson, bench.py timed_pair)."""
+    from aiyagari_tpu.diagnostics.profiler import fence
+
+    for fn in fns.values():
+        fence(fn())
+    keys = list(fns)
+    best = {k: float("inf") for k in keys}
+    for r in range(max(int(reps), 1)):
+        # Rotate the start position per rep (the PR 10 quarantine-overhead
+        # fix): a fixed order would time the same candidate last every
+        # rep, so position-correlated drift (thermal ramp, a periodic
+        # background burst) biases its best-of-reps wall.
+        for k in keys[r % len(keys):] + keys[:r % len(keys)]:
+            t0 = time.perf_counter()
+            fence(fns[k]())
+            best[k] = min(best[k], time.perf_counter() - t0)
+    return {k: round(v * 1e6, 3) for k, v in best.items()}
+
+
+def _probe_pushforward(na: int, dtype) -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.ops.pushforward import pushforward_step
+
+    nz = 7
+    # A monotone near-45-degree lottery — the savings-policy shape every
+    # route sees in production, so the banded window fits and no fallback
+    # cond fires mid-probe.
+    idx = jnp.broadcast_to(jnp.clip(jnp.arange(na, dtype=jnp.int32) - 1,
+                                    0, na - 2)[None, :], (nz, na))
+    w_lo = jnp.full((nz, na), 0.5, dtype)
+    mu = jnp.full((nz, na), 1.0 / (nz * na), dtype)
+    P = jnp.full((nz, nz), 1.0 / nz, dtype)
+    candidates = ["scatter", "transpose", "banded"]
+    if _platform() == "tpu":
+        candidates.append("pallas")   # interpreted off-TPU: never a winner,
+        # and minutes-slow at probe sizes — racing it would poison nothing
+        # but waste the whole probe budget (bench r08 times it separately).
+
+    def make(rt):
+        step = jax.jit(lambda m, i, w, p: pushforward_step(m, i, w, p,
+                                                           backend=rt))
+        return lambda: step(mu, idx, w_lo, P)
+
+    return {rt: make(rt) for rt in candidates}
+
+
+def _probe_egm_kernel(na: int, dtype) -> Dict[str, Callable]:
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.ops.egm import egm_step
+    from aiyagari_tpu.solvers.egm import initial_consumption_guess
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    model = aiyagari_preset(grid_size=na, dtype=dtype)
+    r = 0.04
+    w = float(wage_from_r(r, model.config.technology.alpha,
+                          model.config.technology.delta))
+    C0 = initial_consumption_guess(model.a_grid, model.s, r, w)
+    candidates = ("xla", "pallas_fused") if _platform() == "tpu" else ("xla",)
+    # Off-TPU the fused route runs the Pallas INTERPRETER — a correctness
+    # vehicle whose wall says nothing about the Mosaic artifact, so it is
+    # never raced into the cache there (the pallas_inverse round-2
+    # lesson: TPU routes are validated on chip, not simulated).
+
+    def make(rt):
+        return lambda: egm_step(C0, model.a_grid, model.s, model.P, r, w,
+                                model.amin, sigma=model.preferences.sigma,
+                                beta=model.preferences.beta, egm_kernel=rt)
+
+    return {rt: make(rt) for rt in candidates}
+
+
+def _probe_bucket_index(na: int, dtype) -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    # The split only engages above the compare-all cutoff; probe at least
+    # there so the measured walls describe the contested regime.
+    n = max(int(na), 2048)
+    x = jnp.linspace(0.0, 100.0, n, dtype=dtype)
+    q = jnp.linspace(-1.0, 101.0, n, dtype=dtype)
+
+    def make(method):
+        fn = jax.jit(lambda xx, qq: jnp.searchsorted(
+            xx, qq, side="right", method=method))
+        return lambda: fn(x, q)
+
+    return {m: make(m) for m in ("scan", "sort")}
+
+
+KNOBS: Dict[str, KnobSpec] = {
+    "pushforward": KnobSpec(
+        name="pushforward",
+        default=lambda: "transpose",
+        candidates=lambda: ("scatter", "transpose", "banded") + (
+            ("pallas",) if _platform() == "tpu" else ()),
+        build_probe=_probe_pushforward),
+    "egm_kernel": KnobSpec(
+        name="egm_kernel",
+        default=lambda: "xla",
+        candidates=lambda: (("xla", "pallas_fused")
+                            if _platform() == "tpu" else ("xla",)),
+        build_probe=_probe_egm_kernel),
+    "bucket_index": KnobSpec(
+        name="bucket_index",
+        default=lambda: "scan" if _platform() == "cpu" else "sort",
+        candidates=lambda: ("scan", "sort"),
+        build_probe=_probe_bucket_index),
+}
+
+
+def probe_knob(knob: str, *, na: int, dtype, reps: int = 3) -> dict:
+    """Run one knob's measured probe and return its cache entry (not yet
+    persisted): winner + per-candidate interleaved walls."""
+    spec = KNOBS[knob]
+    walls = _interleaved_walls(spec.build_probe(na, dtype), reps)
+    return {
+        "choice": min(walls, key=walls.get),
+        "source": "measured",
+        "walls_us": walls,
+        "na": int(na),
+        "reps": int(reps),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def autotune(knobs: Optional[Sequence[str]] = None, *, na: int = 4096,
+             dtype=None, reps: int = 3, cache_path=None) -> dict:
+    """Probe every (requested) contested knob at the (na, dtype) workload
+    and persist the winners into the tuning cache. Returns
+    {entry_key: entry}. dtype defaults to the platform's solver dtype
+    (f32 on TPU, f64 elsewhere — the bench convention)."""
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.diagnostics import ledger, metrics
+
+    if dtype is None:
+        dtype = jnp.float32 if _platform() == "tpu" else jnp.float64
+    names = list(knobs) if knobs is not None else list(KNOBS)
+    unknown = set(names) - set(KNOBS)
+    if unknown:
+        raise ValueError(f"unknown tuning knob(s) {sorted(unknown)}; "
+                         f"known: {sorted(KNOBS)}")
+    import copy
+
+    # Deep copy: load_cache memoizes the parsed doc by file signature,
+    # and the entries merged below must not alias into that memo before
+    # save_cache stamps a new signature.
+    doc = copy.deepcopy(load_cache(cache_path))
+    out = {}
+    for name in names:
+        entry = probe_knob(name, na=na, dtype=dtype, reps=reps)
+        key = _entry_key(name, grid_bucket(na), _dtype_name(dtype))
+        doc["entries"][key] = entry
+        out[key] = entry
+        metrics.counter("aiyagari_tuning_probes_total", knob=name).inc()
+        ledger.emit("tuning_probe", knob=name, key=key,
+                    choice=entry["choice"], walls_us=entry["walls_us"],
+                    na=int(na), dtype=_dtype_name(dtype))
+    save_cache(doc, cache_path)
+    return out
+
+
+# -- the decision table (tune CLI / --explain) ------------------------------
+
+
+def explain(cache_path=None) -> list:
+    """The decision table: one row per knob and cached measurement (plus
+    a default row for knobs with no measurement), each reproducing the
+    choice the resolvers would make from the evidence on file — probe
+    walls re-argmin'd, never trusted blindly (a hand-edited cache whose
+    stored winner disagrees with its own walls is surfaced, not
+    replayed)."""
+    doc = load_cache(cache_path)
+    rows = []
+    for name, spec in KNOBS.items():
+        entries = {k: v for k, v in doc.get("entries", {}).items()
+                   if k.split("|", 1)[0] == name}
+        for key, entry in sorted(entries.items()):
+            walls = entry.get("walls_us", {})
+            if not isinstance(walls, dict):
+                walls = {}
+            # Re-argmin over the NUMERIC walls only: hand-edited entries
+            # with malformed values must render as inconsistent rows, not
+            # crash the renderer (the whole point of --explain).
+            numeric = {k: v for k, v in walls.items()
+                       if isinstance(v, (int, float))}
+            reproduced = min(numeric, key=numeric.get) if numeric else None
+            rows.append({
+                "knob": name,
+                "bucket": key.split("|")[1],
+                "dtype": key.split("|")[2],
+                "choice": entry.get("choice"),
+                "source": "measured",
+                "reproduced_choice": reproduced,
+                "consistent": reproduced == entry.get("choice"),
+                "evidence": {"walls_us": walls,
+                             "na": entry.get("na"),
+                             "measured_utc": entry.get("utc")},
+            })
+        if not entries:
+            rows.append({
+                "knob": name, "bucket": "any", "dtype": "any",
+                "choice": spec.default(), "source": "default",
+                "reproduced_choice": spec.default(), "consistent": True,
+                "evidence": {"note": "no measurement cached; shipped "
+                                     "default applies"},
+            })
+    return rows
+
+
+def _render_rows(rows: list) -> str:
+    lines = [f"{'knob':<14}{'bucket':<8}{'dtype':<10}{'choice':<16}"
+             f"{'source':<10}evidence"]
+    for r in rows:
+        walls = r["evidence"].get("walls_us")
+        if walls:
+            def fmt(v):
+                return (f"{v:.1f}us" if isinstance(v, (int, float))
+                        else f"{v!r} (malformed)")
+
+            ev = "  ".join(
+                f"{k}={fmt(v)}" for k, v in
+                sorted(walls.items(),
+                       key=lambda kv: (not isinstance(kv[1], (int, float)),
+                                       kv[1] if isinstance(kv[1],
+                                                           (int, float))
+                                       else 0.0)))
+        else:
+            ev = r["evidence"].get("note", "-")
+        mark = "" if r.get("consistent", True) else "  !! stored choice " \
+            "disagrees with its own walls"
+        # str() everywhere: --explain is the debugging tool for exactly
+        # the hand-edited caches whose entries may be malformed (a None
+        # choice must render as a row, not crash the renderer).
+        lines.append(f"{r['knob']:<14}{str(r['bucket']):<8}"
+                     f"{str(r['dtype']):<10}{str(r['choice']):<16}"
+                     f"{str(r['source']):<10}{ev}{mark}")
+    return "\n".join(lines)
+
+
+def tune_main(argv) -> int:
+    """`python -m aiyagari_tpu tune [--explain]`: run the measured probes
+    (or just render the cached decision table) — the CLI face of the
+    route observatory (docs/USAGE.md "Route observatory & autotuning")."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu tune")
+    ap.add_argument("--explain", action="store_true",
+                    help="render the decision table from the cached probe "
+                         "data without re-measuring")
+    ap.add_argument("--na", type=int, default=4096,
+                    help="grid size the probes run at (default 4096)")
+    ap.add_argument("--dtype", choices=["float32", "float64"], default=None,
+                    help="probe dtype (default: platform solver dtype)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--knobs", default=None,
+                    help=f"comma-separated subset of {sorted(KNOBS)}")
+    ap.add_argument("--cache", default=None,
+                    help="tuning-cache file (default: "
+                         "~/.cache/aiyagari_tpu/tuning-<fingerprint>.json)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_enable_x64", True)
+    if not args.explain:
+        from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+        dtype = None
+        if args.dtype:
+            import jax.numpy as jnp
+
+            dtype = jnp.float32 if args.dtype == "float32" else jnp.float64
+        knobs = args.knobs.split(",") if args.knobs else None
+        autotune(knobs, na=args.na, dtype=dtype, reps=args.reps,
+                 cache_path=args.cache)
+    rows = explain(args.cache)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        path = args.cache or tuning_cache_path()
+        print(f"tuning cache: {path}")
+        print(_render_rows(rows))
+    return 0

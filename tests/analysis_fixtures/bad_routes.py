@@ -1,0 +1,22 @@
+"""Adversarial source file for AIYA204 (tests/test_static_analysis.py).
+
+Both functions below re-hardcode a route choice outside the sanctioned
+resolvers — the first maps the "auto" literal onto a concrete route, the
+second splits on the platform probe — and each must trip exactly
+route-resolution-discipline (no cross-fire from the other source rules:
+nothing here imports jax.sharding, fetches a host scalar, or debug-prints).
+The file is only ever READ by the lint, never imported.
+"""
+
+import jax  # noqa: F401  (fixture: keep the platform probe realistic)
+
+
+def my_resolver(backend):
+    if backend == "auto":               # AIYA204: "auto" -> literal route
+        return "transpose"
+    return backend
+
+
+def my_method_split():
+    # AIYA204: platform-split route choice outside the resolvers.
+    return "scan" if jax.default_backend() == "cpu" else "sort"

@@ -1,0 +1,177 @@
+"""Tier-1 gates for the route observatory's measurement half (ISSUE 12):
+the modeled-vs-compiled attribution table (analysis/attribution.py) —
+XLA's cost_analysis()/memory_analysis() of every registry program joined
+against the roofline price — its fusion-regression flag, and the
+observability surface (ledger events, gauges, the report CLI rendering).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from aiyagari_tpu.analysis.attribution import (
+    DEFAULT_FLAG_RATIO,
+    attribute_program,
+    modeled_cost,
+    run_attribution,
+)
+from aiyagari_tpu.analysis.registry import ProgramSpec
+from aiyagari_tpu.diagnostics import metrics
+from aiyagari_tpu.diagnostics.ledger import RunLedger, activate, read_ledger
+
+# Programs whose compiled artifact is the production artifact on this CPU
+# host AND carry an analytic model — the fusion-regression band is gated
+# on exactly these (the interpreted Pallas programs and the mesh-padded
+# sharded sweep are joined but band-exempt by design).
+GATED = ("egm/sweep", "egm/sweep_f32_stage", "egm/sweep_sentinel",
+         "egm/sweep_labor", "vfi/step", "distribution/step_scatter",
+         "distribution/step_transpose", "distribution/step_banded",
+         "distribution/stationary")
+
+
+@pytest.fixture(scope="module")
+def report():
+    return run_attribution()
+
+
+class TestAttributionTable:
+    def test_covers_the_registry(self, report):
+        # Tier-1 runs on the 8-virtual-device mesh, so even the sharded
+        # sweep compiles; >= 10 is the ISSUE 12 acceptance floor.
+        assert len(report.records) >= 13
+        names = {r["program"] for r in report.records}
+        assert set(GATED) <= names
+        assert "egm/sweep_fused" in names
+
+    def test_compiled_numbers_present(self, report):
+        for rec in report.records:
+            assert rec["compiled"]["bytes_accessed"] > 0, rec
+            assert rec["compiled"]["flops"] > 0, rec
+            assert rec["compiled"]["peak_bytes"] > 0, rec
+
+    def test_gated_programs_modeled_and_in_band(self, report):
+        by = report.by_program()
+        for name in GATED:
+            rec = by[name]
+            assert rec["modeled"] is not None, name
+            assert rec["modeled"]["hbm_bytes"] > 0, name
+            # Compiled bytes sit in the normal padding/remat band above
+            # the analytic lower bound — the shipped tree measures
+            # 1.7-8.5x at the registry shapes; a fusion regression lands
+            # at 10-100x (DEFAULT_FLAG_RATIO).
+            assert 0.5 <= rec["byte_ratio"] <= 20.0, (name, rec)
+            assert rec["flagged"] is False, (name, rec)
+
+    def test_interpreted_and_sharded_programs_never_flag(self, report):
+        by = report.by_program()
+        for name in ("egm/sweep_fused", "egm/sweep_fused_f32_stage",
+                     "egm/sweep_sharded"):
+            rec = by[name]
+            # Joined (the compiled numbers are real) ...
+            assert rec["compiled"]["bytes_accessed"] > 0
+            # ... but exempt from the band: the off-TPU artifact is the
+            # Pallas interpreter / the mesh-padded replica, not the
+            # production kernel the model prices.
+            assert rec["flagged"] is False, (name, rec)
+
+    def test_unmodeled_composites_join_without_ratios(self, report):
+        by = report.by_program()
+        for name in ("equilibrium/ge_round_batched", "transition/round",
+                     "ks/distribution_step"):
+            rec = by[name]
+            assert rec["modeled"] is None
+            assert rec["byte_ratio"] is None
+            assert rec["flagged"] is False
+
+    def test_modeled_cost_helper_matches_roofline(self):
+        from aiyagari_tpu.diagnostics.roofline import egm_sweep_cost
+
+        cost = modeled_cost("egm/sweep")
+        assert cost.hbm_bytes == egm_sweep_cost(3, 16, 8).hbm_bytes
+        assert modeled_cost("transition/round") is None
+
+
+class TestFusionRegressionFlag:
+    def test_defused_program_trips_the_flag(self):
+        """The oracle actually fires: a 'distribution/step_scatter' whose
+        chain materializes a large broadcast (the compiler now streams
+        bytes the model assumed fused away) must flag."""
+        from aiyagari_tpu.sim.distribution import distribution_step
+
+        def defused(mu, idx, w_lo, P):
+            out = distribution_step(mu, idx, w_lo, P, backend="scatter")
+            # A broadcast forced across a fusion barrier (dot operands
+            # must materialize): ~3 MB of compiled traffic against a
+            # ~3 KB model price -> ratio far past the flag threshold.
+            big = jnp.broadcast_to(mu.reshape(-1)[None, :], (600, 48))
+            z = jnp.dot(big, big.T)
+            return out + jnp.tanh(jnp.sum(z)) * 1e-30
+
+        spec = ProgramSpec(
+            name="distribution/step_scatter", family="fixture",
+            build_off=lambda: (defused, (
+                jax.ShapeDtypeStruct((3, 16), jnp.float64),
+                jax.ShapeDtypeStruct((3, 16), jnp.int32),
+                jax.ShapeDtypeStruct((3, 16), jnp.float64),
+                jax.ShapeDtypeStruct((3, 3), jnp.float64))))
+        rec = attribute_program(spec)
+        assert rec["byte_ratio"] > DEFAULT_FLAG_RATIO, rec
+        assert rec["flagged"] is True
+
+
+class TestObservability:
+    def test_ledger_events_and_gauges(self, tmp_path):
+        metrics.reset()
+        led = RunLedger(tmp_path / "led.jsonl")
+        with activate(led):
+            rep = run_attribution(families=("distribution",))
+        events = [e for e in read_ledger(led.path)
+                  if e["kind"] == "attribution"]
+        assert len(events) == len(rep.records) == 4
+        for ev in events:
+            assert ev["compiled"]["bytes_accessed"] > 0
+            assert ev["flagged"] is False
+        gauges = {(g["name"], g["labels"].get("program")): g["value"]
+                  for g in metrics.render_json()["gauges"]}
+        assert gauges[("aiyagari_attribution_compiled_bytes",
+                       "distribution/step_scatter")] > 0
+        assert gauges[("aiyagari_attribution_byte_ratio",
+                       "distribution/step_transpose")] > 0
+
+    def test_report_cli_renders_observatory_events(self, tmp_path, capsys):
+        """The drive-by satellite: `python -m aiyagari_tpu report` renders
+        route_decision / attribution / analysis / tuning_probe events as
+        formatted rows instead of the generic key=value fallback."""
+        from aiyagari_tpu.diagnostics.health import report_main
+
+        led = RunLedger(tmp_path / "led.jsonl")
+        led.event("route_decision", knob="pushforward", choice="scatter",
+                  source="measured", bucket="b512", dtype="float64",
+                  evidence={"walls_us": {"scatter": 1.5, "transpose": 3.0}})
+        led.event("route_decision", knob="egm_kernel", choice="xla",
+                  source="default", bucket="any", dtype="any", evidence={})
+        led.event("attribution", program="egm/sweep", family="egm",
+                  compiled={"bytes_accessed": 21115.0},
+                  modeled={"hbm_bytes": 3840.0}, byte_ratio=5.5,
+                  flop_ratio=2.4, flagged=False)
+        led.event("attribution", program="egm/bad", family="egm",
+                  compiled={"bytes_accessed": 999999.0},
+                  modeled={"hbm_bytes": 100.0}, byte_ratio=9999.99,
+                  flagged=True)
+        led.event("analysis", findings=0, rules={}, programs_audited=15,
+                  programs_skipped=[], files_linted=83, wall_seconds=2.0)
+        led.event("tuning_probe", knob="bucket_index", choice="scan",
+                  walls_us={"scan": 10.0, "sort": 20.0}, na=512,
+                  dtype="float64")
+        rc = report_main([str(led.path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "route pushforward -> scatter [measured, b512/float64]" in out
+        assert "scatter=1.5us" in out
+        assert "route egm_kernel -> xla [default, any/any] shipped default" \
+            in out
+        assert "attribution egm/sweep: compiled 21115.0 B vs modeled " \
+               "3840.0 B (x5.5)" in out
+        assert "FUSION-REGRESSION FLAG" in out
+        assert "analysis: 0 active finding(s) over 15 program(s)" in out
+        assert "probe bucket_index -> scan" in out

@@ -20,7 +20,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
               "precision", "pushforward", "egm_fused", "telemetry",
-              "resilience", "analysis")
+              "resilience", "attribution", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -44,14 +44,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-8]
+    tr = records[-9]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-7]
+    ac = records[-8]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -65,7 +65,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-6]
+    pr = records[-7]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -89,7 +89,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-5]
+    pw = records[-6]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -117,7 +117,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The host WALL is advisory only: off-TPU the fused route runs the
     # Pallas interpreter — a correctness vehicle — so no speedup is gated
     # here; the speedup claim is TPU-side (docs/USAGE.md).
-    ef = records[-4]
+    ef = records[-5]
     assert ef["metric"].startswith("egm_fused_sweep")
     assert set(ef["routes"]) == {"xla", "pallas_fused"}
     for name, route in ef["routes"].items():
@@ -143,7 +143,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-3]
+    tm = records[-4]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -160,7 +160,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # sweep quarantined EXACTLY its one poisoned lane with every other
     # lane parity-equal to the clean sweep, and the quarantine machinery
     # costs <= 1.1x a clean sweep (host-side masks only).
-    rs = records[-2]
+    rs = records[-3]
     assert rs["metric"] == "resilience_fault_battery"
     assert rs["value"] == 1.0, rs
     assert rs["recovered"] == rs["points"]
@@ -180,6 +180,45 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert q["poisoned_lane_verdict"] == "rescued"
     assert q["unpoisoned_parity"] <= 1e-12, q
     assert rs["quarantine_overhead"] <= 1.1, rs
+    # The attribution record carries the ISSUE 12 acceptance telemetry:
+    # modeled-vs-compiled attribution for >= 10 registry programs, the
+    # compiled/modeled byte ratio inside its checked band for the audited
+    # EGM + push-forward programs (the fusion-regression oracle — the
+    # shipped tree measures 1.7-8.5x at the registry shapes; a chain that
+    # stops fusing and materializes its broadcasts lands at 10-100x), a
+    # measured probe with per-candidate walls for every contested knob,
+    # and the frozen BENCH_r11_attribution.json artifact.
+    at = records[-2]
+    assert at["metric"] == "route_attribution"
+    assert at["value"] >= 10, at
+    assert not at["flagged"], at
+    gated = ("egm/sweep", "egm/sweep_f32_stage", "egm/sweep_labor",
+             "distribution/step_scatter", "distribution/step_transpose",
+             "distribution/step_banded", "distribution/stationary")
+    for name in gated:
+        prog = at["programs"][name]
+        assert prog["modeled_bytes"] and prog["compiled_bytes"], (name, prog)
+        assert 0.5 <= prog["byte_ratio"] <= 20.0, (name, prog)
+        assert prog["flagged"] is False, (name, prog)
+    # The interpreted fused programs are joined but never flagged off-TPU
+    # (their compiled artifact is the Pallas interpreter, not the Mosaic
+    # kernel).
+    assert at["programs"]["egm/sweep_fused"]["flagged"] is False
+    assert set(at["knobs"]) >= {"pushforward", "egm_kernel", "bucket_index"}
+    for knob, rec in at["knobs"].items():
+        assert rec["choice"], (knob, rec)
+        assert all(w > 0 for w in rec["walls_us"].values()), (knob, rec)
+    # The push-forward and searchsorted probes race real alternatives.
+    assert set(at["knobs"]["pushforward"]["walls_us"]) >= {
+        "scatter", "transpose", "banded"}
+    assert set(at["knobs"]["bucket_index"]["walls_us"]) == {"scan", "sort"}
+    # The frozen artifact the ci battery owns (ISSUE 12 acceptance).
+    bench_dir = os.path.dirname(BENCH)
+    with open(os.path.join(bench_dir, "BENCH_r11_attribution.json")) as f:
+        frozen = json.load(f)
+    assert frozen["metric"] == "route_attribution"
+    assert len(frozen["programs"]) >= 10
+    assert len(frozen["knobs"]) >= 3
     # The analysis record carries the ISSUE 9 acceptance gate: the static
     # analyzer ran over the kernel zoo + source tree and found NOTHING —
     # a scatter regression, a precision leak, a host sync in a loop, a
@@ -208,6 +247,19 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert len(analysis_events) == 1
     assert analysis_events[0]["findings"] == 0
     assert set(analysis_events[0]["rules"]) >= {"no-scatter",
-                                                "mesh-shim-discipline"}
+                                                "mesh-shim-discipline",
+                                                "route-resolution-discipline"}
+    # The route observatory's events landed on the same ledger: one
+    # `attribution` event per compiled registry program, a `tuning_probe`
+    # per contested knob, and `route_decision` events from the
+    # dispatch-based metrics (sweep/transition run under the active
+    # ledger) — the ISSUE 12 observability satellite.
+    assert sum(e["kind"] == "attribution" for e in events) >= 10
+    assert sum(e["kind"] == "tuning_probe" for e in events) >= 3
+    route_events = [e for e in events if e["kind"] == "route_decision"]
+    assert route_events, events
+    for ev in route_events:
+        assert ev["knob"] and ev["choice"], ev
+        assert ev["source"] in ("measured", "prior", "default"), ev
     # One shared run id stamps every event of this run.
     assert len({e["run_id"] for e in events}) == 1

@@ -240,6 +240,40 @@ class TestLintFixtures:
         assert suppressed[0].rule.id == "AIYA202"
         assert "host_probes" not in suppressed[0].message  # msg is generic
 
+    def test_bad_routes_trips_exactly_route_discipline(self):
+        """ISSUE 12 satellite: both re-hardcoding forms — the "auto"
+        literal mapped to a route, and a default_backend() platform split
+        binding a route — trip exactly AIYA204, nothing else."""
+        findings = lint_file(FIXTURES / "bad_routes.py", "bad_routes.py",
+                             hot=False, mesh_exempt=False)
+        assert [f.rule.id for f in findings] == ["AIYA204", "AIYA204"]
+        assert all(f.rule.name == "route-resolution-discipline"
+                   for f in findings)
+
+    def test_route_discipline_spares_validation_guards(self, tmp_path):
+        """Membership checks against ("auto", ...) that only RAISE (the
+        numpy-backend capability guards in dispatch.py) are validation,
+        not resolution — no finding."""
+        src = ("def check(knob):\n"
+               "    if knob not in ('auto', 'scatter'):\n"
+               "        raise ValueError('scatter-free backends need jax; "
+               "use scatter')\n")
+        p = tmp_path / "guard.py"
+        p.write_text(src)
+        findings = lint_file(p, "guard.py", hot=False, mesh_exempt=False)
+        assert "route-resolution-discipline" not in _rules_fired(findings)
+
+    def test_route_discipline_exempts_sanctioned_resolvers(self):
+        """The resolver modules and the tuning layer own the literal
+        fallbacks by design; a scoping regression must name its file."""
+        import aiyagari_tpu
+
+        root = Path(aiyagari_tpu.__file__).resolve().parent
+        for rel in ("ops/pushforward.py", "ops/egm.py", "ops/interp.py",
+                    "tuning/autotuner.py"):
+            findings = lint_file(root / rel, rel)
+            assert not [f for f in findings if f.rule.id == "AIYA204"], rel
+
     def test_mesh_shim_catches_parent_module_import_forms(self, tmp_path):
         """`from jax import sharding` / `from jax.experimental import
         shard_map` bind the forbidden module under a local name — the

@@ -1,0 +1,385 @@
+"""Tier-1 gates for the route observatory's decision half (ISSUE 12):
+tuning cache round-trips, resolver-consults-cache end-to-end through
+dispatch.solve, prior/default fallbacks, cache hygiene (invalidation +
+torn-file), and — the PR 6 zero-cost discipline applied to decisions —
+the off-path pin: with tuning disabled and no cache, every resolver
+returns today's exact defaults and solve programs/results are bitwise
+unchanged.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    SolverConfig,
+)
+from aiyagari_tpu.diagnostics import metrics
+from aiyagari_tpu.diagnostics.ledger import RunLedger, activate, read_ledger
+from aiyagari_tpu.dispatch import solve
+from aiyagari_tpu.ops.egm import require_xla_egm_kernel, resolve_egm_kernel
+from aiyagari_tpu.ops.interp import searchsorted_method
+from aiyagari_tpu.ops.pushforward import resolve_backend
+from aiyagari_tpu.tuning import autotuner
+
+
+def _counter_value(name, **labels):
+    key = metrics._key(name, labels)
+    return metrics.registry._counters.get(key, 0.0)
+
+
+def _seed_cache(path, knob="pushforward", bucket="b512", dtype="float64",
+                choice="scatter", walls=None):
+    """A valid measured cache document with one entry."""
+    doc = {
+        "version": 1,
+        "jax_version": jax.__version__,
+        "fingerprint": autotuner.platform_fingerprint(),
+        "entries": {
+            f"{knob}|{bucket}|{dtype}": {
+                "choice": choice,
+                "source": "measured",
+                "walls_us": walls or {choice: 1.0, "transpose": 9.0},
+                "na": 512, "reps": 1, "utc": "2026-08-04T00:00:00Z",
+            },
+        },
+    }
+    path.write_text(json.dumps(doc))
+    return doc
+
+
+def _tiny_solve(**kw):
+    cfg = AiyagariConfig(grid=GridSpecConfig(n_points=24))
+    return solve(cfg, method="egm",
+                 solver=SolverConfig(method="egm", tol=1e-4, max_iter=150),
+                 equilibrium=EquilibriumConfig(max_iter=3, tol=1e-2),
+                 aggregation="distribution", on_nonconvergence="ignore",
+                 **kw)
+
+
+class TestOffPathBitIdentity:
+    """With tuning disabled and no cache: today's exact defaults
+    (ISSUE 12 acceptance: jaxpr/result-pinned)."""
+
+    def test_resolver_defaults(self):
+        assert resolve_backend("auto") == "transpose"
+        assert resolve_backend(None) == "transpose"
+        assert resolve_egm_kernel("auto") == "xla"
+        assert require_xla_egm_kernel("auto", "here") == "xla"
+        # This suite runs on the CPU host (conftest pins the platform).
+        assert searchsorted_method() == "scan"
+        assert searchsorted_method(100_000) == "scan"
+
+    def test_explicit_choices_pass_through(self):
+        for b in ("scatter", "transpose", "banded", "pallas"):
+            assert resolve_backend(b) == b
+        for k in ("xla", "pallas_inverse", "pallas_fused"):
+            assert resolve_egm_kernel(k) == k
+
+    def test_f32_sim_override_wins_over_cache(self, tmp_path):
+        cache = tmp_path / "t.json"
+        _seed_cache(cache, choice="banded")
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            # The K-S f32 histogram scan's accuracy constraint is not a
+            # tunable decision: scatter regardless of the measured winner.
+            assert resolve_backend("auto", f32_sim=True) == "scatter"
+            assert resolve_backend("auto", na=512,
+                                   dtype=jnp.float64) == "banded"
+
+    def test_auto_jaxpr_identical_to_default_route(self):
+        from aiyagari_tpu.sim.distribution import distribution_step
+
+        args = (jnp.ones((3, 16)) / 48.0,
+                jnp.clip(jnp.arange(16, dtype=jnp.int32), 0, 14)[None, :]
+                * jnp.ones((3, 1), jnp.int32),
+                jnp.full((3, 16), 0.5), jnp.full((3, 3), 1.0 / 3))
+        auto = jax.make_jaxpr(
+            lambda m, i, w, p: distribution_step(m, i, w, p, backend="auto"))
+        pinned = jax.make_jaxpr(
+            lambda m, i, w, p: distribution_step(m, i, w, p,
+                                                 backend="transpose"))
+        # The degradation callback's partial repr embeds a host address;
+        # everything structural must match exactly.
+        import re
+
+        scrub = lambda s: re.sub(r"0x[0-9a-f]+", "0x", s)
+        assert scrub(str(auto(*args))) == scrub(str(pinned(*args)))
+
+    def test_solve_results_bitwise_unchanged_by_observability(self, tmp_path):
+        """The route_decision emission layer is host-only: a ledger-carrying
+        solve returns bit-identical results to a bare one."""
+        bare = _tiny_solve()
+        led = _tiny_solve(ledger=str(tmp_path / "led.jsonl"))
+        assert float(bare.r) == float(led.r)
+        np.testing.assert_array_equal(np.asarray(bare.solution.policy_k),
+                                      np.asarray(led.solution.policy_k))
+
+
+class TestCacheRoundTrip:
+    def test_autotune_round_trips_deterministically(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            entries = autotuner.autotune(["bucket_index"], na=512, reps=1)
+            assert list(entries) == ["bucket_index|b512|float64"]
+            entry = entries["bucket_index|b512|float64"]
+            assert entry["choice"] in ("scan", "sort")
+            assert set(entry["walls_us"]) == {"scan", "sort"}
+            doc1 = autotuner.load_cache()
+            doc2 = autotuner.load_cache()
+            assert doc1 == doc2
+            assert doc1["entries"]["bucket_index|b512|float64"]["choice"] \
+                == entry["choice"]
+            # Resolution consults the persisted entry, not process state.
+            got = autotuner.resolve_route("bucket_index", "scan", na=512,
+                                          dtype=jnp.float64)
+            assert got == entry["choice"]
+
+    def test_explain_reproduces_choice_from_walls(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter",
+                    walls={"scatter": 2.0, "transpose": 5.0, "banded": 9.0})
+        with autotuner.configure(cache_path=str(cache)):
+            rows = {r["knob"]: r for r in autotuner.explain()}
+        pf = rows["pushforward"]
+        assert pf["source"] == "measured"
+        assert pf["choice"] == "scatter"
+        assert pf["reproduced_choice"] == "scatter"
+        assert pf["consistent"] is True
+        # Knobs without measurements render their shipped default.
+        assert rows["egm_kernel"]["source"] == "default"
+        assert rows["egm_kernel"]["choice"] == "xla"
+
+    def test_explain_surfaces_inconsistent_entry(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="banded",
+                    walls={"scatter": 2.0, "banded": 9.0})
+        with autotuner.configure(cache_path=str(cache)):
+            pf = {r["knob"]: r for r in autotuner.explain()}["pushforward"]
+        assert pf["consistent"] is False
+        assert pf["reproduced_choice"] == "scatter"
+
+
+class TestResolveSources:
+    def test_measured_beats_default(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter")
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            got = autotuner.resolve_route("pushforward", "transpose",
+                                          na=512, dtype=jnp.float64)
+        assert got == "scatter"
+        assert _counter_value("aiyagari_tuning_cache_hits_total",
+                              knob="pushforward") >= 1
+
+    def test_nearest_bucket_fallback(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, bucket="b512", choice="scatter")
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            # No exact b2048 entry: the nearest measured bucket serves.
+            assert autotuner.resolve_route("pushforward", "transpose",
+                                           na=2048,
+                                           dtype=jnp.float64) == "scatter"
+            # And a context-free (dispatch-boundary) lookup still finds it.
+            assert autotuner.resolve_route("pushforward",
+                                           "transpose") == "scatter"
+
+    def test_miss_falls_back_to_default_on_unmodeled_platform(self, tmp_path):
+        with autotuner.configure(enabled=True,
+                                 cache_path=str(tmp_path / "none.json")):
+            before = _counter_value("aiyagari_tuning_cache_misses_total",
+                                    knob="pushforward")
+            got = autotuner.resolve_route("pushforward", "transpose",
+                                          na=512, dtype=jnp.float64)
+        assert got == "transpose"   # CPU has no chip model: no prior
+        assert _counter_value("aiyagari_tuning_cache_misses_total",
+                              knob="pushforward") == before + 1
+
+    def test_prior_on_modeled_platform(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(autotuner, "_platform", lambda: "tpu")
+        led = RunLedger(tmp_path / "led.jsonl")
+        with autotuner.configure(enabled=True,
+                                 cache_path=str(tmp_path / "none.json")):
+            with activate(led):
+                got = autotuner.resolve_route("pushforward", "transpose",
+                                              na=4096, dtype=jnp.float32)
+        prior = autotuner._prior_choice("pushforward", 4096, jnp.float32,
+                                        "tpu")
+        assert prior is not None
+        assert got == prior[0]
+        ev = [e for e in read_ledger(led.path)
+              if e["kind"] == "route_decision"]
+        assert len(ev) == 1
+        assert ev[0]["source"] == "prior"
+        assert set(ev[0]["evidence"]["predicted_us"]) >= {"scatter",
+                                                          "transpose"}
+
+    def test_prior_ranks_by_roofline_time(self):
+        choice, evidence = autotuner._prior_choice(
+            "pushforward", 4096, jnp.float32, "tpu")
+        pred = evidence["predicted_us"]
+        assert choice == min(pred, key=pred.get)
+
+
+class TestCacheHygiene:
+    def test_stale_jax_version_invalidates(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        doc = _seed_cache(cache, choice="scatter")
+        doc["jax_version"] = "0.0.0-stale"
+        cache.write_text(json.dumps(doc))
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            before = _counter_value("aiyagari_tuning_cache_invalidated_total")
+            got = autotuner.resolve_route("pushforward", "transpose",
+                                          na=512, dtype=jnp.float64)
+        assert got == "transpose"
+        assert _counter_value("aiyagari_tuning_cache_invalidated_total") \
+            == before + 1
+
+    def test_stale_fingerprint_invalidates(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        doc = _seed_cache(cache, choice="scatter")
+        doc["fingerprint"] = "other-box-0000000000"
+        cache.write_text(json.dumps(doc))
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            assert autotuner.resolve_route(
+                "pushforward", "transpose", na=512,
+                dtype=jnp.float64) == "transpose"
+
+    def test_torn_cache_is_loud_but_non_fatal(self, tmp_path):
+        cache = tmp_path / "torn.json"
+        cache.write_text('{"version": 1, "entr')
+        autotuner._torn_warned.discard(str(cache))
+        led = RunLedger(tmp_path / "led.jsonl")
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            before = _counter_value("aiyagari_tuning_cache_torn_total")
+            with activate(led):
+                with pytest.warns(RuntimeWarning, match="torn/corrupt"):
+                    got = autotuner.resolve_route("pushforward", "transpose",
+                                                  na=512, dtype=jnp.float64)
+        assert got == "transpose"
+        assert _counter_value("aiyagari_tuning_cache_torn_total") \
+            == before + 1
+        degr = [e for e in read_ledger(led.path)
+                if e["kind"] == "degradation"]
+        assert any(e.get("event") == "tuning_cache_torn" for e in degr)
+
+    def test_empty_cache_path_disables_persistence(self, tmp_path):
+        """configure(cache_path="") mirrors the env kill switch: no file
+        is read or written, resolution keeps the defaults."""
+        with autotuner.configure(enabled=True, cache_path=""):
+            assert autotuner.tuning_cache_path() is None
+            assert autotuner.resolve_route(
+                "pushforward", "transpose", na=512,
+                dtype=jnp.float64) == "transpose"
+            doc = autotuner.load_cache()
+            doc["entries"]["pushforward|b512|float64"] = {"choice": "banded"}
+            assert autotuner.save_cache(doc) is None
+
+    def test_explain_renders_malformed_walls_without_crashing(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter",
+                    walls={"scatter": None, "transpose": "10"})
+        with autotuner.configure(cache_path=str(cache)):
+            rows = {r["knob"]: r for r in autotuner.explain()}
+            pf = rows["pushforward"]
+            assert pf["reproduced_choice"] is None
+            assert pf["consistent"] is False
+            # And the text renderer survives the same entry.
+            assert "malformed" in autotuner._render_rows([pf])
+
+    def test_save_cache_is_atomic_and_valid_json(self, tmp_path):
+        cache = tmp_path / "c.json"
+        with autotuner.configure(cache_path=str(cache)):
+            doc = autotuner.load_cache()
+            doc["entries"]["pushforward|b512|float64"] = {"choice": "banded"}
+            autotuner.save_cache(doc)
+            assert json.loads(cache.read_text())["entries"]
+            assert not list(tmp_path.glob("*.tmp"))
+
+
+class TestDispatchEndToEnd:
+    def test_route_decisions_exactly_once_per_knob(self, tmp_path):
+        path = tmp_path / "led.jsonl"
+        _tiny_solve(ledger=str(path))
+        decisions = [e for e in read_ledger(path)
+                     if e["kind"] == "route_decision"]
+        by_knob = {}
+        for ev in decisions:
+            by_knob.setdefault(ev["knob"], []).append(ev)
+        # All three knobs resolve at the dispatch boundary every run (the
+        # trace-time resolutions inside the plan build dedupe against
+        # them — and jit caching may skip them entirely on re-runs, which
+        # is exactly why the boundary emission exists).
+        assert set(by_knob) == {"pushforward", "egm_kernel", "bucket_index"}
+        for knob, evs in by_knob.items():
+            assert len(evs) == 1, (knob, evs)
+            assert evs[0]["source"] == "default"
+            assert "evidence" in evs[0]
+        assert by_knob["pushforward"][0]["choice"] == "transpose"
+        assert by_knob["egm_kernel"][0]["choice"] == "xla"
+        assert by_knob["bucket_index"][0]["choice"] == "scan"
+        # The boundary resolution carries the run's own context: grid
+        # bucket + solve dtype, not the context-free "any" cell.
+        assert by_knob["pushforward"][0]["bucket"] == "b32"
+        assert by_knob["pushforward"][0]["dtype"] == "float64"
+
+    def test_rerun_on_same_ledger_emits_again(self, tmp_path):
+        path = tmp_path / "led.jsonl"
+        led = RunLedger(path)
+        _tiny_solve(ledger=led)
+        _tiny_solve(ledger=led)
+        decisions = [e for e in read_ledger(path)
+                     if e["kind"] == "route_decision"
+                     and e["knob"] == "pushforward"]
+        # Each activation scope is one run: two solves, two decisions.
+        assert len(decisions) == 2
+
+    def test_measured_decision_through_dispatch_solve(self, tmp_path):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter",
+                    walls={"scatter": 1.0, "transpose": 2.0})
+        path = tmp_path / "led.jsonl"
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            res = _tiny_solve(ledger=str(path))
+        assert res.converged or res.r is not None
+        decisions = {e["knob"]: e for e in read_ledger(path)
+                     if e["kind"] == "route_decision"}
+        pf = decisions["pushforward"]
+        assert pf["source"] == "measured"
+        assert pf["choice"] == "scatter"
+        assert pf["evidence"]["walls_us"] == {"scatter": 1.0,
+                                              "transpose": 2.0}
+        assert _counter_value("aiyagari_route_decisions_total",
+                              knob="pushforward", choice="scatter",
+                              source="measured") >= 1
+
+    def test_measured_route_and_default_route_agree(self, tmp_path):
+        """A measured winner actually reroutes the solve — and because
+        every DistributionBackend computes the same operator, the
+        measured-route result matches the default-route one to roundoff."""
+        ref = _tiny_solve()
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter")
+        with autotuner.configure(enabled=True, cache_path=str(cache)):
+            got = _tiny_solve()
+        assert abs(float(ref.r) - float(got.r)) < 1e-9
+
+
+class TestCli:
+    def test_tune_explain_renders_cached_table(self, tmp_path, capsys):
+        cache = tmp_path / "cache.json"
+        _seed_cache(cache, choice="scatter",
+                    walls={"scatter": 2.0, "transpose": 5.0})
+        from aiyagari_tpu.tuning.autotuner import tune_main
+
+        rc = tune_main(["--explain", "--cache", str(cache), "--json"])
+        assert rc == 0
+        rows = json.loads(capsys.readouterr().out)
+        by_knob = {r["knob"]: r for r in rows}
+        assert by_knob["pushforward"]["choice"] == "scatter"
+        assert by_knob["pushforward"]["source"] == "measured"
+        assert by_knob["bucket_index"]["source"] == "default"
