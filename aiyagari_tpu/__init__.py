@@ -24,6 +24,7 @@ from aiyagari_tpu.config import (
     HouseholdPreferences,
     IncomeProcess,
     KrusellSmithConfig,
+    MeshConfig,
     KSShockProcess,
     MITShock,
     SimConfig,
@@ -95,4 +96,5 @@ __all__ = [
     "EquilibriumConfig",
     "ALMConfig",
     "BackendConfig",
+    "MeshConfig",
 ]

@@ -105,6 +105,13 @@ def _model_prices() -> Dict[str, Tuple[Optional[Callable], Optional[float]]]:
                             DEFAULT_FLAG_RATIO),
         "egm/sweep_sharded": (lambda: egm_sweep_cost(_NZ, _sharded_na(), 8),
                               None),
+        # The 2-D (scenarios x grid) sweep: S=2 lanes of the grid-sharded
+        # operator (registry traces at na=64 on a 2x2 mesh). Mesh-padded
+        # like the 1-D sharded program — joined, never flagged.
+        "egm/sweep_2d": (lambda: 2 * egm_sweep_cost(_NZ, _sharded_na(), 8),
+                         None),
+        "egm/sweep_2d_sentinel": (
+            lambda: 2 * egm_sweep_cost(_NZ, _sharded_na(), 8), None),
         "vfi/step": (lambda: vfi_sweep_cost(_NZ, _NA, 8),
                      DEFAULT_FLAG_RATIO),
         "distribution/step_scatter": (

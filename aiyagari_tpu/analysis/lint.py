@@ -7,7 +7,14 @@ the source DISCIPLINE that keeps them auditable and fast:
     and every sharding symbol goes through the one version probe in
     parallel/mesh.py. A direct `from jax.sharding import ...` elsewhere
     compiles today and breaks on the next jax bump — the exact class of
-    breakage PR 1 spent 39 test failures un-doing.
+    breakage PR 1 spent 39 test failures un-doing. Since the partition-
+    rule module landed (ISSUE 13), raw `PartitionSpec(...)` CONSTRUCTION
+    outside parallel/ is flagged too: ad-hoc specs bypass the rule
+    matcher (parallel/rules.py) that keeps placements declarative and
+    topology-portable — build them via match_partition_rules /
+    mesh.named_sharding, or use the shard_map in-spec alias idiom
+    (`from ...mesh import PartitionSpec as P`), which stays sanctioned
+    for shard-local program specs.
   * no-host-scalar-in-hot-module (AIYA202) — `.item()` and
     `float(x[i])`-style element fetches cost one ~100 ms host round trip
     EACH on the remote TPU transport (solvers/egm._cached_grid_bounds
@@ -43,6 +50,11 @@ __all__ = ["lint_file", "lint_tree", "hot_module", "iter_package_files"]
 
 # Modules exempt from mesh-shim-discipline: the shim itself.
 _MESH_SHIM = "parallel/mesh.py"
+
+# Raw-PartitionSpec-construction scope (the ISSUE 13 extension of
+# AIYA201): the whole parallel/ layer owns spec construction — the shim,
+# the rule matcher, and the ring/halo programs it backs.
+_PARALLEL_DIR = "parallel/"
 
 # Hot-module scope of AIYA202: the directories whose code runs per sweep
 # or per solve. numpy_backend.py is the HOST reference implementation
@@ -112,6 +124,12 @@ class _Linter(ast.NodeVisitor):
         rel_norm = rel_path.replace("\\", "/")
         exempt = rel_norm.endswith(_MESH_SHIM)
         self.mesh_exempt = exempt if mesh_exempt is None else mesh_exempt
+        # Raw PartitionSpec construction is sanctioned in all of
+        # parallel/ (shim + rule matcher + sharded programs); fixtures
+        # linted with an explicit mesh_exempt follow that flag.
+        in_parallel = f"/{_PARALLEL_DIR}" in f"/{rel_norm}"
+        self.spec_exempt = (self.mesh_exempt or in_parallel
+                            if mesh_exempt is None else mesh_exempt)
         # AIYA204 scope for this file: the sanctioned resolver functions
         # (when this IS one of the resolver modules) and the tuning layer.
         self.route_exempt = any(f"/{d}" in f"/{rel_norm}"
@@ -278,6 +296,29 @@ class _Linter(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call):
         func = node.func
+        # AIYA201 extension (ISSUE 13): raw PartitionSpec construction
+        # outside parallel/. The bare-Name form is the ad-hoc spec the
+        # rule matcher exists to replace; attribute forms whose chain is
+        # a forbidden jax module are already flagged by visit_Attribute
+        # (no double report). The `as P` shard_map in-spec alias stays
+        # sanctioned (module docstring).
+        if not self.spec_exempt:
+            raw = (isinstance(func, ast.Name)
+                   and func.id == "PartitionSpec")
+            if not raw and isinstance(func, ast.Attribute):
+                chain = _attr_chain(func)
+                raw = (chain is not None
+                       and chain.endswith(".PartitionSpec")
+                       and not any(chain.startswith(m + ".")
+                                   for m in _FORBIDDEN_MODULES))
+            if raw:
+                self._emit(
+                    "mesh-shim-discipline", node,
+                    "raw PartitionSpec(...) construction outside "
+                    "parallel/; build placements through the rule "
+                    "matcher (parallel/rules.match_partition_rules) or "
+                    "mesh.named_sharding — ad-hoc specs bypass the "
+                    "declarative placement layer")
         if self.hot:
             if (isinstance(func, ast.Attribute) and func.attr == "item"
                     and not node.args):

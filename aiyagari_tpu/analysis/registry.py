@@ -208,6 +208,39 @@ def _build_egm_sharded(telemetry=None):
                 _f(), _f(), _f())
 
 
+def _build_egm_sweep_2d(telemetry=None, sentinel=None):
+    import jax
+
+    import numpy as np
+
+    if len(jax.devices()) < 4:
+        raise ProgramUnavailable(
+            "the 2-D (scenarios x grid) sweep needs a >= 4-device mesh "
+            "(2 x 2 minimum; run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8, as tier-1 "
+            "does, to audit it on a CPU host)")
+    from aiyagari_tpu.parallel.mesh import GRID_AXIS, SCENARIOS_AXIS, make_mesh_2d
+    from aiyagari_tpu.solvers.egm_sharded import _egm_sweep_2d_program
+    from aiyagari_tpu.utils.grids import power_grid
+
+    S, na = 2, 64   # trace-only shapes; the entry point's slab-fit guard
+                    # is a runtime-geometry gate, not a trace constraint
+    mesh = make_mesh_2d(scenarios=2, grid=2,
+                        devices=np.array(jax.devices()[:4]))
+    grid = power_grid(0.0, 20.0, na, 2.0)
+    lo, hi = float(grid[0]), float(grid[-1])
+    run = _egm_sweep_2d_program(
+        mesh, SCENARIOS_AXIS, GRID_AXIS, _NZ, na, lo, hi, 2.0, 2.0, 1,
+        0.9, 0.96, 1e-6, 50, False, 0.0, "float64",
+        telemetry=telemetry, sentinel=sentinel)
+
+    def fn(C, a_grid, s, P, r, w, amin):
+        return run(C, a_grid, s, P, r, w, amin)
+
+    return fn, (_f((S, _NZ, na)), _f((na,)), _f((_NZ,)), _f((_NZ, _NZ)),
+                _f((S,)), _f((S,)), _f((S,)))
+
+
 def _build_ge_round():
     import jax.numpy as jnp
     import numpy as np
@@ -326,6 +359,23 @@ def _build_registry() -> List[ProgramSpec]:
             build_off=partial(_build_egm_sharded),
             build_on=lambda: _build_egm_sharded(telemetry=tele()),
             stage_dtype="float64"),
+        # The 2-D (scenarios x grid) sweep program (ISSUE 13): scenario
+        # lanes vmapped over the ring-sharded grid solve inside one 2-D
+        # shard_map. AIYA101-107 certify the COMPOSED artifact — the
+        # batched while_loop still NaN-exits per lane (AIYA107), the
+        # telemetry ring stays compiled out when off (AIYA104), and the
+        # grid-axis collectives live in the same audited sub-jaxprs as the
+        # 1-D program (the body IS _make_egm_local). The per-lane sentinel
+        # variant is traced through the same builder; <4-device hosts
+        # report it skipped (ProgramUnavailable), like egm/sweep_sharded.
+        ProgramSpec(
+            name="egm/sweep_2d", family="egm",
+            build_off=partial(_build_egm_sweep_2d),
+            build_on=lambda: _build_egm_sweep_2d(telemetry=tele()),
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="egm/sweep_2d_sentinel", family="egm",
+            build_off=lambda: _build_egm_sweep_2d(sentinel=_sentinel_cfg())),
         ProgramSpec(
             name="vfi/step", family="vfi",
             build_off=partial(_build_vfi),

@@ -150,7 +150,12 @@ RULES: Tuple[Rule, ...] = (
             "No direct jax.sharding / jax.experimental.shard_map imports "
             "or attribute references outside parallel/mesh.py: jax is "
             "pinned at 0.4.x here and every new-API symbol goes through "
-            "the one version-probe shim (ROADMAP discipline)."),
+            "the one version-probe shim (ROADMAP discipline). Raw "
+            "PartitionSpec(...) construction outside parallel/ is flagged "
+            "too (ISSUE 13): ad-hoc specs bypass the declarative "
+            "partition-rule matcher (parallel/rules.py); the shard_map "
+            "in-spec alias idiom (`import PartitionSpec as P` from the "
+            "shim) stays sanctioned."),
     ),
     Rule(
         id="AIYA202",

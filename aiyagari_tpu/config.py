@@ -38,6 +38,7 @@ __all__ = [
     "EquilibriumConfig",
     "ALMConfig",
     "BackendConfig",
+    "MeshConfig",
     "MITShock",
     "TransitionConfig",
 ]
@@ -548,6 +549,39 @@ class TransitionConfig:
     tol: float = 1e-6
     damping: float = 0.5
     method: str = "newton"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """2-D (scenarios x grid) device-mesh request for the sweep entry
+    points (dispatch.sweep / dispatch.sweep_transitions, the `mesh=` knob):
+    the scenario batch splits over the "scenarios" axis while each
+    scenario's asset-grid axis splits over "grid" — one program composing
+    both parallelism axes (parallel/mesh.make_mesh_2d; placement by the
+    partition-rule matcher, parallel/rules.py).
+
+    None sizes are derived from the device count (both None -> balanced
+    factorization, scenarios-major; one given -> the exact quotient), and
+    every mismatch — a size that does not factor the devices, a scenario
+    count or grid size the axes do not divide — is a loud error at the
+    dispatch boundary, never a silent 1-D degeneration. The knob's default
+    absence (mesh=None) keeps today's behavior bit-identical: no mesh is
+    built and the legacy BackendConfig.mesh_axes path (1-D scenario
+    sharding) is untouched. On a multi-host pod the same config shards
+    scenarios across hosts (DCN) and the grid within each host (ICI) via
+    jax.distributed.initialize — no code change (docs/USAGE.md "Pod-scale
+    2-D sharding")."""
+
+    scenarios: Optional[int] = None
+    grid: Optional[int] = None
+
+    def __post_init__(self):
+        for name in ("scenarios", "grid"):
+            v = getattr(self, name)
+            if v is not None and (not isinstance(v, int) or v < 1):
+                raise ValueError(
+                    f"MeshConfig.{name} must be a positive int or None, "
+                    f"got {v!r}")
 
 
 @dataclasses.dataclass(frozen=True)

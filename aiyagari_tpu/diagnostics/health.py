@@ -331,6 +331,12 @@ def report_main(argv) -> int:
                       + (f" (x{ratio})" if ratio is not None else "")
                       + (" ** FUSION-REGRESSION FLAG **"
                          if ev.get("flagged") else ""))
+            elif k == "mesh_topology":
+                axes = ev.get("axes") or {}
+                shape = " x ".join(f"{a}={s}" for a, s in axes.items())
+                print(f"  mesh {ev.get('entry', '-')}: {shape} "
+                      f"({ev.get('devices')} device(s), "
+                      f"{ev.get('processes')} process(es))")
             elif k == "tuning_probe":
                 walls = ev.get("walls_us") or {}
                 detail = "  ".join(f"{r}={w:.1f}us" for r, w in

@@ -38,6 +38,7 @@ __all__ = [
     "achieved_bandwidth_gbs",
     "distribution_sweep_cost",
     "dtype_itemsize",
+    "mesh2d_collective_cost",
     "vfi_sweep_cost",
     "vfi_slab_cost",
     "egm_sweep_cost",
@@ -45,6 +46,15 @@ __all__ = [
     "panel_step_cost",
     "utilization",
 ]
+
+# Interconnect peaks for the 2-D mesh collective model (public numbers,
+# like the ChipPeaks): a v5e chip's ICI is 4 links x 100 GB/s-class
+# bidirectional within a slice; DCN between hosts is ~25 GB/s-class per
+# host NIC. Order-of-magnitude honest — the model's job is the BYTES (an
+# analytic lower bound like every cost model here); the peaks only rank
+# which axis a topology stresses.
+ICI_BYTES_PER_SEC = 4.0e11
+DCN_BYTES_PER_SEC = 2.5e10
 
 
 def dtype_itemsize(dtype) -> int:
@@ -283,6 +293,72 @@ def distribution_sweep_cost(N: int, na: int, itemsize: int = 8,
     else:
         raise ValueError(f"unknown pushforward route {route!r}")
     return KernelCost(mxu, vpu, bytes_)
+
+
+def mesh2d_collective_cost(S: int, N: int, na: int, *, scenarios: int,
+                           grid: int, itemsize: int = 8, sweeps: int = 1,
+                           rounds: int = 1,
+                           devices_per_host: int | None = None) -> dict:
+    """Cross-axis collective bytes of a 2-D (scenarios x grid) sweep —
+    the price of composing both parallelism axes in one program, split by
+    the link each axis actually rides so the scaling claim is certified,
+    not asserted (ISSUE 13).
+
+    Grid axis (ICI — a host's chips): per scenario lane per sweep, the
+    ring-sharded EGM program's collectives (solvers/egm_sharded.py):
+
+      * the ring slab rotation — (grid-1) ppermute hops each moving one
+        [N, na/grid] knot shard,
+      * the cummax-tail / head-pair all_gathers — O(grid * N) stacked
+        rows,
+      * the pmax'd sup-norm / escape / bracket-start reductions —
+        O(grid) scalars.
+
+    All of it multiplies by S lanes x `sweeps` (lanes are independent,
+    so the 2-D program's ICI traffic is exactly S parallel copies of the
+    1-D grid-sharded program's — nothing new crosses chips).
+
+    Scenario axis (DCN — across hosts, when the mesh spans more than one):
+    NOTHING per sweep — lanes never communicate — which is the design
+    point: the only cross-host traffic is the per-ROUND lockstep
+    synchronization (each host's per-lane gap/supply scalars read back
+    for the host-side bracket update, 2 scalars per lane per round).
+    `devices_per_host` defaults to the grid-axis size when grid > 1 (the
+    natural pod layout: one host's chips = one lane's grid shards) and to
+    the WHOLE mesh otherwise (a scenarios-only mesh on one host); a
+    1-host topology prices dcn_bytes at 0.
+
+    Lower-bound honesty at the degenerate sizes: a grid axis of 1 has NO
+    grid collectives (every gather/reduce over a size-1 axis moves zero
+    bytes), so a scenarios-only topology prices at exactly 0/0 on one
+    host — the zero-communication claim, stated as a number rather than
+    rounded up past it.
+
+    Returns {"ici_bytes", "dcn_bytes", "ici_seconds", "dcn_seconds",
+    "hosts", "grid_bytes_per_lane_sweep"} — bytes are analytic lower
+    bounds (module docstring), seconds use the public-order interconnect
+    peaks above (ICI_BYTES_PER_SEC / DCN_BYTES_PER_SEC)."""
+    if scenarios < 1 or grid < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got scenarios={scenarios} "
+            f"grid={grid}")
+    dph = (devices_per_host if devices_per_host
+           else (grid if grid > 1 else scenarios * grid))
+    hosts = -(-scenarios * grid // dph)
+    per_lane_sweep = 0.0 if grid == 1 else itemsize * (
+        (grid - 1) * float(N) * (na / grid)                 # ring rotation
+        + 3.0 * grid * N                                    # tail/head gathers
+        + float(grid))                                      # scalar reductions
+    ici = float(S) * sweeps * per_lane_sweep
+    dcn = (rounds * 2.0 * S * itemsize) if hosts > 1 else 0.0
+    return {
+        "ici_bytes": ici,
+        "dcn_bytes": dcn,
+        "ici_seconds": ici / ICI_BYTES_PER_SEC,
+        "dcn_seconds": dcn / DCN_BYTES_PER_SEC,
+        "hosts": int(hosts),
+        "grid_bytes_per_lane_sweep": per_lane_sweep,
+    }
 
 
 def achieved_bandwidth_gbs(cost: KernelCost | None,

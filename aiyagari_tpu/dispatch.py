@@ -186,6 +186,61 @@ def _resolve_routes(solver: Optional[SolverConfig], *,
     return solver
 
 
+def _observe_mesh(m, led, *, entry: str) -> None:
+    """The mesh-activation flight record (ISSUE 13 satellite, the PR 12
+    route_decision pattern applied to placement): one `mesh_topology`
+    ledger event naming every axis and its size plus the process topology,
+    and an aiyagari_mesh_axis_size{axis=} gauge per axis — so a sweep's
+    artifact says WHAT topology ran, not just how fast. Rendered by
+    `python -m aiyagari_tpu report`."""
+    import jax
+
+    from aiyagari_tpu.diagnostics import metrics
+
+    axes = {name: int(m.shape[name]) for name in m.axis_names}
+    for name, size in axes.items():
+        metrics.gauge("aiyagari_mesh_axis_size", axis=name).set(size)
+    if led is not None:
+        led.event("mesh_topology", entry=entry, axes=axes,
+                  devices=int(m.devices.size),
+                  processes=int(jax.process_count()))
+
+
+def _sweep_mesh(backend: BackendConfig, mesh, led, *, entry: str):
+    """Resolve the sweep entry points' device mesh. `mesh` is the new 2-D
+    knob: a MeshConfig requesting a (scenarios x grid) mesh
+    (parallel/mesh.make_mesh_2d; placement through the partition-rule
+    matcher downstream) — validated loudly here, at the dispatch boundary.
+    Without it, the legacy 1-D BackendConfig.mesh_axes path is untouched,
+    and mesh=None with no mesh_axes builds nothing: the default is today's
+    behavior bit-identical (no mesh object, no event, same programs)."""
+    from aiyagari_tpu.config import MeshConfig
+
+    if mesh is not None:
+        if not isinstance(mesh, MeshConfig):
+            raise TypeError(
+                f"mesh must be a MeshConfig (or None), got "
+                f"{type(mesh).__name__}")
+        if backend.backend != "jax":
+            raise ValueError("mesh=MeshConfig(...) requires backend='jax'")
+        if backend.mesh_axes:
+            raise ValueError(
+                "pass either mesh=MeshConfig(...) or BackendConfig."
+                "mesh_axes, not both (the MeshConfig owns both axes)")
+        from aiyagari_tpu.parallel.mesh import make_mesh_2d
+
+        m = make_mesh_2d(scenarios=mesh.scenarios, grid=mesh.grid)
+        _observe_mesh(m, led, entry=entry)
+        return m
+    if "scenarios" in backend.mesh_axes:
+        from aiyagari_tpu.parallel.mesh import make_mesh
+
+        m = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+        _observe_mesh(m, led, entry=entry)
+        return m
+    return None
+
+
 def _resolve_rescue(rescue):
     """Normalize the `rescue` argument: None (off), True (the default
     ladder), or a RescueConfig."""
@@ -391,6 +446,7 @@ def solve(
                     from aiyagari_tpu.parallel.mesh import make_mesh
 
                     mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
+                    _observe_mesh(mesh, led, entry="solve")
                 with precision_scope(backend.dtype):
                     if solver.ladder is not None:
                         # Loud guard, BEFORE any solve: a backend configuration
@@ -546,6 +602,7 @@ def sweep(
     ledger=None,
     rescue=None,
     quarantine: bool = True,
+    mesh=None,
     **param_grids,
 ):
     """Solve MANY Aiyagari economies to general equilibrium as one batched
@@ -570,6 +627,18 @@ def sweep(
     "scenarios", the scenario axis is sharded across the device mesh —
     scenarios/sec then scales with the device count; the result records
     `scenarios_per_sec` either way.
+
+    `mesh` (a MeshConfig — docs/USAGE.md "Pod-scale 2-D sharding") opts
+    into the 2-D (scenarios x grid) mesh instead: the scenario batch
+    splits over the "scenarios" axis (hosts, on a pod) while every
+    scenario's asset-grid axis splits over "grid" (a host's chips), in the
+    SAME compiled round program — placement by the partition-rule matcher
+    (parallel/rules.py), sizes derived/validated loudly
+    (parallel/mesh.make_mesh_2d), results within reassociation noise
+    (<= 1e-12) of the unsharded sweep, quarantine still per-lane. Each
+    activated mesh (1-D or 2-D) is recorded: a `mesh_topology` ledger
+    event plus aiyagari_mesh_axis_size{axis=} gauges. mesh=None (default)
+    is today's behavior bit-identical.
 
     aggregation="distribution" (default) closes each scenario with the
     deterministic Young-histogram supply; "simulation" uses per-scenario
@@ -632,13 +701,9 @@ def sweep(
     )
     from aiyagari_tpu.models.aiyagari import AiyagariModel
 
-    mesh = None
-    if "scenarios" in backend.mesh_axes:
-        from aiyagari_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
     rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, base, solver, equilibrium, entry="sweep")
+    mesh = _sweep_mesh(backend, mesh, led, entry="sweep")
     with _observe(led, "aiyagari_sweep", scenarios=len(configs),
                   method=method, aggregation=aggregation):
         solver = _resolve_routes(solver, na=base.grid.n_points,
@@ -870,6 +935,7 @@ def sweep_transitions(
     ledger=None,
     rescue=None,
     quarantine: bool = True,
+    mesh=None,
     **kwargs,
 ):
     """Solve MANY MIT-shock scenarios of one economy in lockstep, every
@@ -889,7 +955,14 @@ def sweep_transitions(
     One stationary anchor and ONE fake-news Jacobian serve every scenario
     (the ss linearization is shock-independent); with
     BackendConfig(mesh_axes=("scenarios",)) the stacked shock paths shard
-    across the device mesh and rounds run scenario-parallel.
+    across the device mesh and rounds run scenario-parallel. `mesh` (a
+    MeshConfig) opts into the 2-D (scenarios x grid) mesh instead: the
+    stacked [S, T] paths split over "scenarios" while the shared
+    stationary anchors (terminal policy, initial distribution, asset
+    grid) split over "grid" through the partition-rule matcher
+    (parallel/rules.TRANSITION_SWEEP_RULES) — one program, both axes; a
+    `mesh_topology` ledger event + per-axis gauges record the activated
+    topology. mesh=None (default) keeps today's behavior bit-identical.
     """
     backend = _transition_backend(backend)
     if shocks is None:
@@ -904,17 +977,13 @@ def sweep_transitions(
         raise ValueError(
             "pass either shocks=[...] or params/sizes/rhos grids, not both")
 
-    mesh = None
-    if "scenarios" in backend.mesh_axes:
-        from aiyagari_tpu.parallel.mesh import make_mesh
-
-        mesh = make_mesh(backend.mesh_axes, backend.mesh_shape or None)
     from aiyagari_tpu.config import precision_scope
     from aiyagari_tpu.transition.mit import solve_transitions_sweep as _sweep
 
     rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, model, transition, solver,
                      entry="sweep_transitions")
+    mesh = _sweep_mesh(backend, mesh, led, entry="sweep_transitions")
     # Injected poisoned scenario (diagnostics/faults.py): one scenario's
     # shock is replaced with an untempered unit TFP drop whose path
     # evaluation overflows — the quarantine freezes that lane, and the

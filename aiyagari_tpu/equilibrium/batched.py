@@ -490,6 +490,17 @@ def stack_scenarios(models: Sequence[AiyagariModel], *, mesh=None) -> ScenarioBa
     the invariants the one-compilation contract needs. With `mesh` (carrying
     a "scenarios" axis), the stacked arrays are placed sharded over it, so
     the vmapped kernel runs scenario-parallel across devices.
+
+    A 2-D mesh (a "grid" axis of size > 1 beside "scenarios" —
+    parallel/mesh.make_mesh_2d, the dispatch.sweep `mesh=` knob) places
+    the batch through the partition-rule matcher (parallel/rules.
+    SCENARIO_BATCH_RULES) instead: the scenario axis still splits over
+    "scenarios", and every trailing asset-grid axis (a_grid [S, na], the
+    GE round's policy/warm carries [S, N, na] by sharding propagation)
+    additionally splits over "grid" — so one compiled round program
+    composes scenario parallelism ACROSS hosts with grid parallelism
+    WITHIN a host. The asset grid must divide the "grid" axis evenly
+    (loud, like the scenario-count check).
     """
     if not models:
         raise ValueError("stack_scenarios needs at least one scenario")
@@ -528,13 +539,38 @@ def stack_scenarios(models: Sequence[AiyagariModel], *, mesh=None) -> ScenarioBa
         size=len(models),
     )
     if mesh is not None:
-        from aiyagari_tpu.parallel.mesh import shard_scenario_arrays
+        from aiyagari_tpu.parallel.mesh import (
+            GRID_AXIS,
+            SCENARIOS_AXIS,
+            shard_scenario_arrays,
+        )
 
-        batch = dataclasses.replace(batch, **shard_scenario_arrays(
-            mesh, batch.size,
-            **{f.name: getattr(batch, f.name)
-               for f in dataclasses.fields(batch)
-               if isinstance(getattr(batch, f.name), jax.Array)}))
+        arrays = {f.name: getattr(batch, f.name)
+                  for f in dataclasses.fields(batch)
+                  if isinstance(getattr(batch, f.name), jax.Array)}
+        if GRID_AXIS in mesh.shape and int(mesh.shape[GRID_AXIS]) > 1:
+            # 2-D placement through the rule matcher (docstring above).
+            from aiyagari_tpu.parallel.rules import (
+                SCENARIO_BATCH_RULES,
+                shard_by_rules,
+            )
+
+            S_ax = int(mesh.shape[SCENARIOS_AXIS])
+            G_ax = int(mesh.shape[GRID_AXIS])
+            na = int(batch.a_grid.shape[-1])
+            if batch.size % S_ax:
+                raise ValueError(
+                    f"scenario count {batch.size} must divide evenly over "
+                    f"the {S_ax}-wide '{SCENARIOS_AXIS}' mesh axis")
+            if na % G_ax:
+                raise ValueError(
+                    f"asset grid of {na} points must divide evenly over "
+                    f"the {G_ax}-wide '{GRID_AXIS}' mesh axis")
+            batch = dataclasses.replace(
+                batch, **shard_by_rules(mesh, arrays, SCENARIO_BATCH_RULES))
+        else:
+            batch = dataclasses.replace(batch, **shard_scenario_arrays(
+                mesh, batch.size, **arrays))
     return batch
 
 

@@ -119,7 +119,7 @@ def _pack_arrays(arrays: Optional[dict]) -> tuple[dict, dict]:
 
 
 def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
-                  dtype=None):
+                  dtype=None, *, mesh=None, rules=None):
     """Reassemble array `name` from a checkpoint's (scalars, arrays) pair.
 
     Plain entries return as stored. Per-shard entries (written by the
@@ -129,7 +129,34 @@ def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
     on its device. With a different sharding — or none — the shards are
     assembled into one host array first (the resharding fallback, which
     does materialize; callers resuming a mesh run pass the mesh's
-    sharding). Returns None when the name is absent entirely."""
+    sharding). Returns None when the name is absent entirely.
+
+    `mesh` + `rules` derive the sharding through the partition-rule
+    matcher (parallel/rules.match_rule) by the array's NAME instead of a
+    hand-built NamedSharding per call site — so a resume onto a DIFFERENT
+    topology (a 2x4 save restored on a 4x2 mesh, or a 1-D save resumed
+    under the 2-D sweep mesh) re-derives the placement from the same rule
+    set the live sweep used, and the restore stays per-shard wherever the
+    stored boxes match the new layout. Mutually exclusive with an
+    explicit `sharding`."""
+    if mesh is not None or rules is not None:
+        if sharding is not None:
+            raise ValueError(
+                "pass either sharding= or the rule matcher pair "
+                "(mesh= + rules=), not both")
+        if mesh is None or rules is None:
+            raise ValueError(
+                "rule-matched restore needs BOTH mesh= and rules=")
+        from aiyagari_tpu.parallel.mesh import NamedSharding as _NS
+        from aiyagari_tpu.parallel.rules import match_rule
+
+        probe = _restore_shape_probe(scalars, arrays, name)
+        if probe is not None:
+            # Zero-alloc shape carrier: the matcher only reads shape/size.
+            spec = match_rule(rules, name, np.broadcast_to(np.uint8(0),
+                                                           probe),
+                              mesh=mesh)
+            sharding = _NS(mesh, spec)
     meta = (scalars.get(_SHARD_META_KEY) or {}).get(name)
     if meta is None:
         v = arrays.get(name)
@@ -176,6 +203,19 @@ def restore_array(scalars: dict, arrays: dict, name: str, sharding=None,
 
         return jax.make_array_from_callback(shape, sharding, cb)
     return _assemble({k: _fetch(kn) for k, kn in keymap.items()}, shape)
+
+
+def _restore_shape_probe(scalars: dict, arrays: dict,
+                         name: str) -> Optional[tuple]:
+    """The stored full shape of entry `name` (shard meta for per-shard
+    entries, the plain array otherwise), or None when absent — what the
+    rule-matched restore needs to pick a PartitionSpec before any data
+    loads."""
+    meta = (scalars.get(_SHARD_META_KEY) or {}).get(name)
+    if meta is not None:
+        return tuple(meta["shape"])
+    v = arrays.get(name)
+    return None if v is None else tuple(np.shape(v))
 
 
 def _assemble(lookup: dict, shape) -> np.ndarray:

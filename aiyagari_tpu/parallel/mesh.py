@@ -23,7 +23,9 @@ __all__ = [
     "Mesh",
     "NamedSharding",
     "PartitionSpec",
+    "factor_axis_sizes",
     "make_mesh",
+    "make_mesh_2d",
     "named_sharding",
     "agents_sharding",
     "grid_sharding",
@@ -78,17 +80,92 @@ def force_host_device_count(n: int) -> None:
         os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
 
 
+def factor_axis_sizes(ndevices: int,
+                      sizes: Sequence[Optional[int]]) -> tuple:
+    """Resolve a per-axis size request against a device count.
+
+    `sizes` has one entry per mesh axis; `None` entries are FILLED so the
+    product equals `ndevices` — one None takes the whole remaining quotient,
+    several Nones split it as evenly as the prime factorization allows
+    (largest prime factors assigned to the currently-smallest axis, then
+    sorted descending, so the FIRST axis gets the larger share — the
+    data-parallel-major convention). Every mismatch is loud: a fixed
+    request whose product does not divide (or, fully specified, does not
+    EQUAL) the device count raises instead of silently truncating to a 1-D
+    mesh — the exact degeneration the old `[ndevices, 1, ...]` default
+    produced for multi-axis requests."""
+    ndevices = int(ndevices)
+    if ndevices < 1:
+        raise ValueError(f"need at least one device, got {ndevices}")
+    fixed = 1
+    free = 0
+    for s in sizes:
+        if s is None:
+            free += 1
+        elif int(s) < 1:
+            raise ValueError(f"mesh axis sizes must be >= 1, got {sizes}")
+        else:
+            fixed *= int(s)
+    if ndevices % fixed:
+        raise ValueError(
+            f"{ndevices} devices do not factor over the requested axis "
+            f"sizes {tuple(sizes)}: the fixed axes multiply to {fixed}, "
+            f"which does not divide {ndevices}")
+    rem = ndevices // fixed
+    if free == 0:
+        if rem != 1:
+            raise ValueError(
+                f"axis sizes {tuple(sizes)} cover only {fixed} of "
+                f"{ndevices} devices; sizes must multiply to the device "
+                "count (or leave an axis None to derive it)")
+        return tuple(int(s) for s in sizes)
+    # Balanced split of the remaining quotient over the free axes: peel the
+    # prime factors (largest first) onto whichever free axis is currently
+    # smallest.
+    factors = []
+    n, p = rem, 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    split = [1] * free
+    for f in sorted(factors, reverse=True):
+        split[split.index(min(split))] *= f
+    split.sort(reverse=True)
+    out = []
+    it = iter(split)
+    for s in sizes:
+        out.append(next(it) if s is None else int(s))
+    return tuple(out)
+
+
 def make_mesh(axis_names: Sequence[str] = (AGENTS_AXIS,),
               axis_sizes: Optional[Sequence[int]] = None,
               devices=None) -> Mesh:
     """Build a named mesh over the available devices.
 
-    Default: a 1-D mesh over all devices named "agents". axis_sizes=None uses
-    all devices on the first axis.
-    """
+    Default: all devices, split over the named axes by factor_axis_sizes —
+    one axis gets every device (the historical behavior); a MULTI-axis
+    request with axis_sizes=None is factorized balanced-descending (8
+    devices over two axes -> 4 x 2) instead of the old silent
+    `[ndevices, 1, ...]` degeneration to a 1-D mesh. axis_sizes entries
+    may be None (derived, loud when the device count does not factor);
+    fully-explicit sizes pass through unchanged — jax.make_mesh
+    legitimately sub-selects the first prod(axis_sizes) devices, the
+    mesh_shape=(4,)-on-8-devices idiom. make_mesh_2d adds the strict
+    every-device-covered check the sweep meshes want."""
     devices = np.asarray(devices if devices is not None else jax.devices())
     if axis_sizes is None:
-        axis_sizes = [len(devices)] + [1] * (len(axis_names) - 1)
+        axis_sizes = factor_axis_sizes(len(devices),
+                                       (None,) * len(axis_names))
+    elif any(s is None for s in axis_sizes):
+        axis_sizes = factor_axis_sizes(len(devices), axis_sizes)
+    # Fully-explicit sizes pass through: jax.make_mesh legitimately
+    # sub-selects the first prod(axis_sizes) devices (the mesh_shape=(4,)
+    # on-an-8-device-host idiom tests rely on).
     # Auto axis types: classic GSPMD sharding propagation. (jax 0.9's
     # make_mesh defaults to Explicit sharding-in-types, which rejects gathers
     # whose output sharding is ambiguous.) Older jax (< 0.5) predates
@@ -100,6 +177,27 @@ def make_mesh(axis_names: Sequence[str] = (AGENTS_AXIS,),
     return jax.make_mesh(
         tuple(axis_sizes), tuple(axis_names), devices=devices.ravel(), **kwargs
     )
+
+
+def make_mesh_2d(scenarios: Optional[int] = None,
+                 grid: Optional[int] = None,
+                 devices=None) -> Mesh:
+    """A 2-D ("scenarios", "grid") mesh over all devices — the pod-scale
+    composition: the scenario batch splits across the first axis (hosts,
+    on a multi-host mesh: jax.make_mesh lays processes out major-first)
+    while each scenario's asset grid splits across the second (a host's
+    chips, ICI-linked).
+
+    None sizes are derived by factor_axis_sizes: both None -> balanced
+    factorization with scenarios getting the larger share (8 devices ->
+    4 x 2); one given -> the other is the exact quotient. Unlike the 1-D
+    make_mesh passthrough, this mesh must cover EVERY device — a size
+    that does not factor the device count raises loudly (a silently
+    smaller sweep mesh would leave chips idle while reporting pod-scale
+    throughput)."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    sizes = factor_axis_sizes(len(devices), (scenarios, grid))
+    return make_mesh((SCENARIOS_AXIS, GRID_AXIS), sizes, devices=devices)
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

@@ -1,0 +1,203 @@
+"""Declarative partition rules: regex path -> PartitionSpec for whole
+pytrees, so a ScenarioBatch / solver carry / checkpoint tree is placed on a
+(2-D) mesh in ONE call instead of one hand-built NamedSharding per leaf.
+
+The pattern is the match_partition_rules / make_shard_and_gather_fns idiom
+of the large-model JAX training stacks (SNIPPETS.md [1]), adapted to this
+framework's mesh-shim discipline: every sharding symbol still flows through
+parallel/mesh.py (AIYA201), rules name MESH AXES ("scenarios" / "grid" /
+None), and an UNMATCHED non-scalar leaf is a loud error — a silently
+replicated solver state is exactly the kind of placement bug that shows up
+only as a 10x memory or DCN-traffic surprise on a pod.
+
+Rule format: an ordered sequence of (regex, spec) pairs, spec a tuple of
+axis names (or None) acceptable to PartitionSpec. Leaf paths are built from
+pytree keys joined with "/" ("batch/a_grid", "mu"); the FIRST matching rule
+wins (precedence = order), `re.search` semantics like the reference
+pattern. Scalars (0-d or single-element leaves) are never partitioned and
+match no rule — they place replicated, as in the reference idiom.
+
+Shipped rule sets:
+
+  * SCENARIO_BATCH_RULES — the batched-GE sweep's ScenarioBatch
+    (equilibrium/batched.py) on a 2-D (scenarios x grid) mesh: scenario-
+    major arrays split over "scenarios", the trailing asset-grid axis of
+    a_grid (and any [S, N, na] policy/warm carry) additionally over "grid";
+    the income-process arrays ride the scenario axis alone (their trailing
+    axes are N-sized, not grid-sized).
+  * TRANSITION_SWEEP_RULES — the transition sweep's stationary anchors
+    (transition/mit.py): terminal policy / initial distribution / asset
+    grid split over "grid" and replicated over "scenarios"; the stacked
+    [S, T] parameter paths over "scenarios".
+
+Checkpoint restore shardings route through the same matcher
+(io_utils/checkpoint.restore_array(mesh=, rules=)), so a resume onto a
+DIFFERENT topology re-derives each array's placement from the rules
+instead of a hand-carried NamedSharding per call site.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from aiyagari_tpu.parallel.mesh import (
+    GRID_AXIS,
+    Mesh,
+    NamedSharding,
+    PartitionSpec,
+    SCENARIOS_AXIS,
+    named_sharding,
+)
+
+__all__ = [
+    "PartitionRule",
+    "SCENARIO_BATCH_RULES",
+    "TRANSITION_SWEEP_RULES",
+    "tree_paths",
+    "match_rule",
+    "match_partition_rules",
+    "make_shard_and_gather_fns",
+    "shard_by_rules",
+    "gather_tree",
+]
+
+# One rule: (path regex, PartitionSpec axes). The spec tuple may be SHORTER
+# than a leaf's rank — PartitionSpec is a prefix, trailing dims replicate —
+# which keeps rules rank-agnostic where only leading axes shard.
+PartitionRule = Tuple[str, Tuple[Optional[str], ...]]
+
+SCENARIO_BATCH_RULES: Tuple[PartitionRule, ...] = (
+    # [S, na]: the per-scenario asset grids — both mesh axes.
+    (r"(^|/)a_grid$", (SCENARIOS_AXIS, GRID_AXIS)),
+    # [S, N, na] scenario-major policy/value/warm carries and stationary
+    # distributions: grid is the TRAILING axis.
+    (r"(^|/)(warm|C|mu|policy_\w+|v)$", (SCENARIOS_AXIS, None, GRID_AXIS)),
+    # Income-process / labor-grid arrays: trailing axes are N- (or nl-)
+    # sized, so only the scenario axis shards.
+    (r"(^|/)(s|P|labor_grid)$", (SCENARIOS_AXIS,)),
+    # Per-scenario scalars stacked to [S] (sigma/beta/psi/eta/amin/
+    # labor_raw) and anything else scenario-major.
+    (r".*", (SCENARIOS_AXIS,)),
+)
+
+TRANSITION_SWEEP_RULES: Tuple[PartitionRule, ...] = (
+    # The shared stationary anchors: [N, na] policy/distribution, [na]
+    # grid — grid-sharded, replicated across scenario lanes.
+    (r"(^|/)(policy_c|C_term|mu0?|mu_ss)$", (None, GRID_AXIS)),
+    (r"(^|/)a_grid$", (GRID_AXIS,)),
+    (r"(^|/)(s|P)$", ()),
+    # The stacked [S, T]-family parameter/price paths.
+    (r"(^|/)(r_ext|w|beta|sigma|amin|x|\w*_paths?)$", (SCENARIOS_AXIS,)),
+)
+
+
+def _key_str(k) -> str:
+    """One pytree key entry as a path segment (DictKey('a') -> 'a',
+    GetAttrKey -> name, SequenceKey -> index)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def tree_paths(tree, sep: str = "/"):
+    """[(path, leaf)] with paths joined from the pytree keys — the names
+    the rule regexes match against."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(sep.join(_key_str(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def _is_scalar(leaf) -> bool:
+    shape = np.shape(leaf)
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_rule(rules: Sequence[PartitionRule], name: str, leaf=None,
+               mesh: Optional[Mesh] = None) -> PartitionSpec:
+    """The PartitionSpec for one named leaf: scalars replicate, otherwise
+    the FIRST rule whose regex `re.search`-matches `name` wins. No match is
+    LOUD (module docstring). With `mesh`, spec axes absent from the mesh
+    are dropped (a 2-D rule set serves a 1-D mesh unchanged) and a spec
+    longer than the leaf's rank is rejected here, with the leaf named,
+    instead of deep inside device_put."""
+    if leaf is not None and _is_scalar(leaf):
+        return PartitionSpec()
+    for pattern, spec in rules:
+        if re.search(pattern, name) is not None:
+            if mesh is not None:
+                axes = set(mesh.axis_names)
+                spec = tuple(a if (a is None or a in axes) else None
+                             for a in spec)
+            if leaf is not None and len(spec) > len(np.shape(leaf)):
+                raise ValueError(
+                    f"partition rule {pattern!r} -> {spec} has more axes "
+                    f"than leaf {name!r} of shape {np.shape(leaf)}")
+            return PartitionSpec(*spec)
+    raise ValueError(
+        f"no partition rule matches leaf {name!r}; every non-scalar leaf "
+        "must be placed deliberately (add a rule, or an explicit catch-all "
+        "like (r'.*', ()) for replication)")
+
+
+def match_partition_rules(rules: Sequence[PartitionRule], tree,
+                          mesh: Optional[Mesh] = None):
+    """Pytree of PartitionSpec mirroring `tree` (the SNIPPETS.md [1]
+    pattern): scalars -> P(), everything else by first-matching rule,
+    unmatched leaves loud."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = [match_rule(rules, "/".join(_key_str(k) for k in path),
+                        leaf, mesh=mesh)
+             for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs):
+    """(shard_fns, gather_fns) pytrees mirroring `specs`: shard places a
+    leaf under NamedSharding(mesh, spec) (jax.device_put — committed, so
+    jit programs consume the placement instead of re-deciding it); gather
+    brings a leaf back replicated (the inverse, for host-side reads and
+    resharding boundaries)."""
+    import jax
+
+    def shard_fn(spec):
+        sharding = NamedSharding(mesh, spec)
+        return lambda x: jax.device_put(x, sharding)
+
+    def gather_fn(_spec):
+        rep = named_sharding(mesh)
+        return lambda x: jax.device_put(x, rep)
+
+    return (jax.tree_util.tree_map(shard_fn, specs,
+                                   is_leaf=lambda s: isinstance(s, PartitionSpec)),
+            jax.tree_util.tree_map(gather_fn, specs,
+                                   is_leaf=lambda s: isinstance(s, PartitionSpec)))
+
+
+def shard_by_rules(mesh: Mesh, tree, rules: Sequence[PartitionRule]):
+    """Place a whole pytree on `mesh` in one call: rule-match every leaf,
+    device_put each under its NamedSharding. The one-call placement the
+    2-D sweeps use for ScenarioBatch / anchors (module docstring)."""
+    import jax
+
+    specs = match_partition_rules(rules, tree, mesh=mesh)
+    return jax.tree_util.tree_map(
+        lambda spec, x: jax.device_put(x, NamedSharding(mesh, spec)),
+        specs, tree,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
+def gather_tree(mesh: Mesh, tree):
+    """Replicate every leaf of a (possibly sharded) pytree — the gather
+    half of the round trip, host-read-ready."""
+    import jax
+
+    rep = named_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), tree)

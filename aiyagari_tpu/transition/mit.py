@@ -350,10 +350,18 @@ class _StageAnchors:
     """Per-dtype cache of the stationary anchors (terminal policy, initial
     distribution, model arrays) the path program consumes — cast once per
     ladder stage, with the distribution re-normalized on the simplex at the
-    cast (a hot-dtype mass defect must not bias the certified rounds)."""
+    cast (a hot-dtype mass defect must not bias the certified rounds).
 
-    def __init__(self, model: AiyagariModel, ss):
+    With `mesh` carrying a "grid" axis of size > 1 (the 2-D scenario x
+    grid sweep), the anchors are placed through the partition-rule matcher
+    (parallel/rules.TRANSITION_SWEEP_RULES): terminal policy / initial
+    distribution / asset grid split over "grid" and replicate across the
+    scenario lanes, so the vmapped path program's [S, T, N, na] working
+    set shards over BOTH axes by propagation."""
+
+    def __init__(self, model: AiyagariModel, ss, mesh=None):
         self.model, self.ss = model, ss
+        self.mesh = mesh
         self._cache: dict = {}
 
     def get(self, dt_name: str):
@@ -361,10 +369,24 @@ class _StageAnchors:
             dt = jnp.dtype(dt_name)
             mu = self.ss.mu.astype(dt)
             mu = mu / jnp.sum(mu)
-            self._cache[dt_name] = (
-                self.ss.solution.policy_c.astype(dt), mu,
-                self.model.a_grid.astype(dt), self.model.s.astype(dt),
-                self.model.P.astype(dt))
+            anchors = {"policy_c": self.ss.solution.policy_c.astype(dt),
+                       "mu": mu,
+                       "a_grid": self.model.a_grid.astype(dt),
+                       "s": self.model.s.astype(dt),
+                       "P": self.model.P.astype(dt)}
+            if self.mesh is not None:
+                from aiyagari_tpu.parallel.mesh import GRID_AXIS
+                from aiyagari_tpu.parallel.rules import (
+                    TRANSITION_SWEEP_RULES,
+                    shard_by_rules,
+                )
+
+                if (GRID_AXIS in self.mesh.shape
+                        and int(self.mesh.shape[GRID_AXIS]) > 1):
+                    anchors = shard_by_rules(self.mesh, anchors,
+                                             TRANSITION_SWEEP_RULES)
+            self._cache[dt_name] = tuple(
+                anchors[k] for k in ("policy_c", "mu", "a_grid", "s", "P"))
         return self._cache[dt_name]
 
 
@@ -631,8 +653,18 @@ def solve_transitions_sweep(
         [stacked["sigma"],
          np.full((S, 1), model.preferences.sigma)], axis=1)
 
+    if mesh is not None:
+        from aiyagari_tpu.parallel.mesh import GRID_AXIS
+
+        if GRID_AXIS in mesh.shape and int(mesh.shape[GRID_AXIS]) > 1:
+            na = int(model.a_grid.shape[0])
+            if na % int(mesh.shape[GRID_AXIS]):
+                raise ValueError(
+                    f"asset grid of {na} points must divide evenly over "
+                    f"the {int(mesh.shape[GRID_AXIS])}-wide "
+                    f"'{GRID_AXIS}' mesh axis")
     stage_names = _stage_dtype_names(model, ladder)
-    anchors = _StageAnchors(model, ss)
+    anchors = _StageAnchors(model, ss, mesh=mesh)
     stage = 0
     hot_rounds = 0
     switch_excess = 0.0

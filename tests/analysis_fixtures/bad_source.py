@@ -15,7 +15,8 @@ def leaky(a_grid, dist):
     tol = dist.item()              # AIYA202: .item() device sync
     jax.debug.print("lo={}", lo)   # AIYA203: bare debug print
     spec = jax.sharding.PartitionSpec()   # AIYA201: direct attribute chain
-    return lo, tol, spec, PartitionSpec
+    spec2 = PartitionSpec("scenarios")    # AIYA201: raw spec construction
+    return lo, tol, spec, spec2, PartitionSpec
 
 
 def deliberate(host_probes):

@@ -1,0 +1,436 @@
+"""Pod-scale 2-D sharding (ISSUE 13): the partition-rule matcher, the
+(scenarios x grid) mesh, the 2-D sweep entry points, and the rule-matched
+checkpoint restore.
+
+Everything runs on the 8-virtual-device CPU mesh (conftest forces it —
+SURVEY.md §4.4: same shardings and collectives as a v5e-8 slice, no
+hardware). The parity contract throughout: a 2-D-sharded sweep reproduces
+the unsharded sweep to reassociation noise (<= 1e-12 in f64), healthy
+lanes bitwise under quarantine.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu import MeshConfig, sweep, sweep_transitions
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    FaultPlan,
+    GridSpecConfig,
+    MITShock,
+    SentinelConfig,
+    SolverConfig,
+    TransitionConfig,
+)
+from aiyagari_tpu.parallel.mesh import (
+    GRID_AXIS,
+    PartitionSpec as P,
+    SCENARIOS_AXIS,
+    factor_axis_sizes,
+    make_mesh,
+    make_mesh_2d,
+)
+from aiyagari_tpu.parallel import rules as prules
+
+CFG = AiyagariConfig(grid=GridSpecConfig(n_points=64))
+EQ = EquilibriumConfig(max_iter=8, tol=1e-4)
+BETAS = [0.94, 0.95, 0.955, 0.96]
+SWEEP_KW = dict(method="egm", beta=BETAS, equilibrium=EQ)
+
+
+@pytest.fixture(scope="module")
+def serial_sweep():
+    return sweep(CFG, **SWEEP_KW)
+
+
+@pytest.fixture(scope="module")
+def sweep_2x4():
+    """One clean 2-D (2 x 4) sweep shared by the parity and quarantine
+    pins (the compiled round program is the expensive part of each)."""
+    return sweep(CFG, mesh=MeshConfig(scenarios=2, grid=4),
+                 solver=SolverConfig(method="egm"), **SWEEP_KW)
+
+
+class TestFactorization:
+    def test_balanced_default(self):
+        assert factor_axis_sizes(8, (None, None)) == (4, 2)
+        assert factor_axis_sizes(12, (None, None)) == (4, 3)
+        assert factor_axis_sizes(7, (None, None)) == (7, 1)
+        assert factor_axis_sizes(12, (None, None, None)) == (3, 2, 2)
+
+    def test_partial_request_derives_quotient(self):
+        assert factor_axis_sizes(8, (2, None)) == (2, 4)
+        assert factor_axis_sizes(8, (None, 8)) == (1, 8)
+
+    def test_loud_when_devices_do_not_factor(self):
+        with pytest.raises(ValueError, match="do not factor"):
+            factor_axis_sizes(8, (3, None))
+        with pytest.raises(ValueError, match="multiply to the device"):
+            factor_axis_sizes(8, (2, 2))
+        with pytest.raises(ValueError, match=">= 1"):
+            factor_axis_sizes(8, (0, None))
+
+    def test_make_mesh_multi_axis_default_no_longer_degenerates(self):
+        # The old default sized only the first axis ([ndevices, 1, ...]):
+        # a two-axis request silently became a 1-D mesh. Now it factors.
+        m = make_mesh(("scenarios", "grid"))
+        assert dict(m.shape) == {"scenarios": 4, "grid": 2}
+
+    def test_make_mesh_2d(self):
+        assert dict(make_mesh_2d().shape) == {"scenarios": 4, "grid": 2}
+        assert dict(make_mesh_2d(scenarios=2).shape) == {
+            "scenarios": 2, "grid": 4}
+        assert dict(make_mesh_2d(grid=8).shape) == {
+            "scenarios": 1, "grid": 8}
+        with pytest.raises(ValueError, match="factor"):
+            make_mesh_2d(scenarios=3)
+        # Unlike the 1-D passthrough, the 2-D mesh must cover every device.
+        with pytest.raises(ValueError, match="multiply to the device"):
+            make_mesh_2d(scenarios=2, grid=2)
+
+
+class TestRuleMatcher:
+    def test_first_match_wins_precedence(self):
+        rules = ((r"a_grid", (SCENARIOS_AXIS, GRID_AXIS)),
+                 (r"a_.*", ()),          # later, broader — must not win
+                 (r".*", (SCENARIOS_AXIS,)))
+        spec = prules.match_rule(rules, "a_grid", np.zeros((4, 64)))
+        assert spec == P(SCENARIOS_AXIS, GRID_AXIS)
+        assert prules.match_rule(rules, "a_other",
+                                 np.zeros((4, 64))) == P()
+        assert prules.match_rule(rules, "beta",
+                                 np.zeros((4,))) == P(SCENARIOS_AXIS)
+
+    def test_scalars_never_partition(self):
+        rules = ((r".*", (SCENARIOS_AXIS,)),)
+        assert prules.match_rule(rules, "alpha", np.float64(0.36)) == P()
+        assert prules.match_rule(rules, "one", np.zeros((1,))) == P()
+
+    def test_unmatched_leaf_is_loud(self):
+        with pytest.raises(ValueError, match="no partition rule matches"):
+            prules.match_rule(((r"^a$", ()),), "b", np.zeros((3,)))
+        with pytest.raises(ValueError, match="no partition rule matches"):
+            prules.match_partition_rules((), {"x": np.zeros((3,))})
+
+    def test_spec_longer_than_leaf_rank_is_loud(self):
+        rules = ((r".*", (SCENARIOS_AXIS, None, GRID_AXIS)),)
+        with pytest.raises(ValueError, match="more axes than leaf"):
+            prules.match_rule(rules, "x", np.zeros((4,)))
+
+    def test_axes_absent_from_mesh_drop(self):
+        # A 2-D rule set serves a 1-D mesh unchanged: the missing axis
+        # replicates instead of erroring.
+        mesh = make_mesh((SCENARIOS_AXIS,))
+        rules = ((r".*", (SCENARIOS_AXIS, GRID_AXIS)),)
+        spec = prules.match_rule(rules, "a_grid", np.zeros((4, 64)),
+                                 mesh=mesh)
+        assert spec == P(SCENARIOS_AXIS, None)
+
+    def test_shard_and_gather_round_trip(self):
+        mesh = make_mesh_2d(scenarios=2, grid=4)
+        tree = {"a_grid": jnp.arange(4 * 64, dtype=jnp.float64
+                                     ).reshape(4, 64),
+                "warm": jnp.arange(4 * 3 * 64, dtype=jnp.float64
+                                   ).reshape(4, 3, 64),
+                "beta": jnp.asarray(BETAS),
+                "alpha": 0.36}
+        placed = prules.shard_by_rules(mesh, tree,
+                                       prules.SCENARIO_BATCH_RULES)
+        assert placed["a_grid"].sharding.spec == P(SCENARIOS_AXIS,
+                                                   GRID_AXIS)
+        assert placed["warm"].sharding.spec == P(SCENARIOS_AXIS, None,
+                                                 GRID_AXIS)
+        gathered = prules.gather_tree(mesh, placed)
+        for k in ("a_grid", "warm", "beta"):
+            assert gathered[k].sharding.is_fully_replicated
+            np.testing.assert_array_equal(np.asarray(gathered[k]),
+                                          np.asarray(tree[k]))
+
+    def test_make_shard_and_gather_fns_mirror_specs(self):
+        mesh = make_mesh_2d(scenarios=2, grid=4)
+        tree = {"a_grid": jnp.zeros((4, 64)), "beta": jnp.zeros((4,))}
+        specs = prules.match_partition_rules(
+            prules.SCENARIO_BATCH_RULES, tree, mesh=mesh)
+        shard_fns, gather_fns = prules.make_shard_and_gather_fns(mesh,
+                                                                 specs)
+        x = shard_fns["a_grid"](tree["a_grid"])
+        assert x.sharding.spec == P(SCENARIOS_AXIS, GRID_AXIS)
+        back = gather_fns["a_grid"](x)
+        assert back.sharding.is_fully_replicated
+
+
+class TestSweep2D:
+    @pytest.mark.parametrize("axes", [(2, 4), (4, 2)])
+    def test_sweep_matches_serial_on_2d_mesh(self, serial_sweep, sweep_2x4,
+                                             axes):
+        res = (sweep_2x4 if axes == (2, 4) else
+               sweep(CFG, mesh=MeshConfig(scenarios=axes[0], grid=axes[1]),
+                     solver=SolverConfig(method="egm"), **SWEEP_KW))
+        # The bracket path is host arithmetic on device gaps: identical
+        # sign decisions -> identical rates; capital differs only by the
+        # sharded matmul/cumsum reassociation.
+        np.testing.assert_array_equal(res.r, serial_sweep.r)
+        assert np.max(np.abs(np.asarray(res.capital)
+                             - np.asarray(serial_sweep.capital))) <= 1e-12
+        assert res.rounds == serial_sweep.rounds
+        assert list(res.verdicts) == list(serial_sweep.verdicts)
+
+    def test_quarantined_lane_bitwise_parity_on_2d_mesh(self, sweep_2x4):
+        clean = sweep_2x4
+        poisoned = sweep(
+            CFG, mesh=MeshConfig(scenarios=2, grid=4),
+            solver=SolverConfig(method="egm",
+                                faults=FaultPlan(poison_scenario=1)),
+            **SWEEP_KW)
+        quar = np.asarray(poisoned.quarantined)
+        assert quar.tolist() == [False, True, False, False]
+        assert poisoned.verdicts[1] == "nan"
+        others = [0, 2, 3]
+        # Healthy lanes BITWISE equal to the clean 2-D sweep — the ISSUE
+        # 10 quarantine contract, unchanged by the 2-D placement.
+        np.testing.assert_array_equal(np.asarray(poisoned.r)[others],
+                                      np.asarray(clean.r)[others])
+        np.testing.assert_array_equal(
+            np.asarray(poisoned.capital)[others],
+            np.asarray(clean.capital)[others])
+
+    def test_validation_is_loud(self):
+        with pytest.raises(TypeError, match="MeshConfig"):
+            sweep(CFG, mesh="2x4", **SWEEP_KW)
+        with pytest.raises(ValueError, match="positive int"):
+            MeshConfig(scenarios=0)
+        # 3 scenarios over a 2-wide scenario axis.
+        with pytest.raises(ValueError, match="divide evenly"):
+            sweep(CFG, mesh=MeshConfig(scenarios=2, grid=4), method="egm",
+                  beta=BETAS[:3], equilibrium=EQ)
+        # Grid of 60 points over an 8-wide grid axis.
+        with pytest.raises(ValueError, match="divide evenly"):
+            sweep(dataclasses.replace(
+                CFG, grid=GridSpecConfig(n_points=60)),
+                mesh=MeshConfig(scenarios=1, grid=8), **SWEEP_KW)
+        with pytest.raises(ValueError, match="not both"):
+            from aiyagari_tpu.config import BackendConfig
+
+            sweep(CFG, backend=BackendConfig(mesh_axes=("scenarios",)),
+                  mesh=MeshConfig(), **SWEEP_KW)
+
+    def test_mesh_topology_event_and_gauges(self, tmp_path):
+        from aiyagari_tpu.diagnostics import metrics
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "ledger.jsonl"
+        sweep(CFG, method="egm", beta=BETAS, ledger=str(led),
+              mesh=MeshConfig(scenarios=2, grid=4),
+              equilibrium=EquilibriumConfig(max_iter=2, tol=1e-4))
+        events = [e for e in read_ledger(led)
+                  if e["kind"] == "mesh_topology"]
+        assert len(events) == 1
+        assert events[0]["axes"] == {"scenarios": 2, "grid": 4}
+        assert events[0]["devices"] == 8
+        assert metrics.gauge("aiyagari_mesh_axis_size",
+                             axis="scenarios").value == 2
+        assert metrics.gauge("aiyagari_mesh_axis_size",
+                             axis="grid").value == 4
+
+    def test_no_mesh_no_event(self, tmp_path):
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "ledger.jsonl"
+        # Two un-converged rounds suffice: the event (or its absence) is
+        # written at mesh activation, before any round runs.
+        sweep(CFG, method="egm", beta=BETAS, ledger=str(led),
+              equilibrium=EquilibriumConfig(max_iter=2, tol=1e-4))
+        assert not [e for e in read_ledger(led)
+                    if e["kind"] == "mesh_topology"]
+
+
+class TestTransitionSweep2D:
+    def test_transition_sweep_matches_serial_on_2d_mesh(self):
+        shocks = [MITShock("tfp", 0.01, 0.8), MITShock("beta", 0.002, 0.8)]
+        tc = TransitionConfig(T=12, tol=1e-7, method="newton", max_iter=10)
+        ref = sweep_transitions(CFG, shocks, transition=tc)
+        res = sweep_transitions(CFG, shocks, transition=tc,
+                                mesh=MeshConfig(scenarios=2, grid=4),
+                                ss=ref.ss, jacobian=ref.jacobian)
+        assert res.rounds == ref.rounds
+        assert np.max(np.abs(np.asarray(res.r_paths)
+                             - np.asarray(ref.r_paths))) <= 1e-12
+        assert np.max(np.abs(np.asarray(res.K_ts)
+                             - np.asarray(ref.K_ts))) <= 1e-12
+
+
+class TestCheckpointRuleRestore:
+    def test_restore_across_topology_change_via_rules(self, tmp_path):
+        from aiyagari_tpu.io_utils.checkpoint import (
+            load_checkpoint,
+            restore_array,
+            save_checkpoint,
+        )
+
+        mesh_24 = make_mesh_2d(scenarios=2, grid=4)
+        tree = {"a_grid": jnp.arange(4 * 64, dtype=jnp.float64
+                                     ).reshape(4, 64),
+                "warm": jnp.arange(4 * 3 * 64, dtype=jnp.float64
+                                   ).reshape(4, 3, 64)}
+        placed = prules.shard_by_rules(mesh_24, tree,
+                                       prules.SCENARIO_BATCH_RULES)
+        path = tmp_path / "mesh.ckpt.npz"
+        save_checkpoint(path, scalars={"round": 3}, arrays=placed)
+        scalars, arrays = load_checkpoint(path)
+        assert scalars["round"] == 3
+        # Restore onto the TRANSPOSED topology: the rule matcher derives
+        # the 4x2 placement from the same rule set — no hand-built
+        # NamedSharding at the call site.
+        mesh_42 = make_mesh_2d(scenarios=4, grid=2)
+        for name in ("a_grid", "warm"):
+            out = restore_array(scalars, arrays, name, mesh=mesh_42,
+                                rules=prules.SCENARIO_BATCH_RULES)
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(tree[name]))
+            assert out.sharding.mesh.shape[SCENARIOS_AXIS] == 4
+            assert out.sharding.spec[0] == SCENARIOS_AXIS
+
+    def test_rule_restore_validation(self, tmp_path):
+        from aiyagari_tpu.io_utils.checkpoint import (
+            load_checkpoint,
+            restore_array,
+            save_checkpoint,
+        )
+
+        path = tmp_path / "v.ckpt.npz"
+        save_checkpoint(path, scalars={},
+                        arrays={"a_grid": np.zeros((4, 64))})
+        scalars, arrays = load_checkpoint(path)
+        mesh = make_mesh_2d(scenarios=2, grid=4)
+        with pytest.raises(ValueError, match="not both"):
+            restore_array(scalars, arrays, "a_grid",
+                          sharding=jax.sharding.NamedSharding(  # noqa: AIYA201 — test-only probe
+                              mesh, P()),
+                          mesh=mesh, rules=prules.SCENARIO_BATCH_RULES)
+        with pytest.raises(ValueError, match="BOTH"):
+            restore_array(scalars, arrays, "a_grid", mesh=mesh)
+        # Absent names still return None through the rule path.
+        assert restore_array(scalars, arrays, "missing", mesh=mesh,
+                             rules=prules.SCENARIO_BATCH_RULES) is None
+
+
+class TestCollectiveCost:
+    def test_prices_both_axes(self):
+        from aiyagari_tpu.diagnostics.roofline import mesh2d_collective_cost
+
+        c = mesh2d_collective_cost(8, 7, 1024, scenarios=2, grid=4,
+                                   itemsize=8, sweeps=100, rounds=5,
+                                   devices_per_host=4)
+        assert c["ici_bytes"] > 0 and c["hosts"] == 2
+        assert c["dcn_bytes"] > 0
+        assert c["ici_seconds"] > 0 and c["dcn_seconds"] > 0
+        # Single-host layouts pay no DCN at all.
+        one = mesh2d_collective_cost(8, 7, 1024, scenarios=1, grid=8,
+                                     itemsize=8, sweeps=100, rounds=5)
+        assert one["hosts"] == 1 and one["dcn_bytes"] == 0.0
+        # A wider grid axis moves more ring bytes per lane sweep.
+        narrow = mesh2d_collective_cost(8, 7, 1024, scenarios=4, grid=2)
+        wide = mesh2d_collective_cost(8, 7, 1024, scenarios=1, grid=8)
+        assert (wide["grid_bytes_per_lane_sweep"]
+                > narrow["grid_bytes_per_lane_sweep"])
+        # Scenarios-only (grid=1) on one host is the zero-communication
+        # design point and must price at EXACTLY zero — a size-1 axis's
+        # gathers/reduces move no bytes (the lower-bound contract).
+        zero = mesh2d_collective_cost(8, 7, 1024, scenarios=8, grid=1)
+        assert zero["ici_bytes"] == 0.0 and zero["dcn_bytes"] == 0.0
+        assert zero["hosts"] == 1
+        with pytest.raises(ValueError, match=">= 1"):
+            mesh2d_collective_cost(8, 7, 1024, scenarios=0, grid=8)
+
+
+class TestSweep2DProgram:
+    """The 2-D shard_map EGM sweep program (solvers/egm_sharded.
+    solve_aiyagari_egm_sweep_2d): scenario lanes vmapped over the
+    ring-sharded grid solve."""
+
+    def test_registry_audits_2d_program(self):
+        from aiyagari_tpu.analysis.jaxpr_audit import audit_program
+        from aiyagari_tpu.analysis.registry import registered_programs
+
+        specs = [p for p in registered_programs() if "2d" in p.name]
+        assert {p.name for p in specs} == {"egm/sweep_2d",
+                                           "egm/sweep_2d_sentinel"}
+        for spec in specs:
+            assert audit_program(spec) == []
+
+    def test_validation_is_loud(self):
+        from aiyagari_tpu.solvers.egm_sharded import (
+            solve_aiyagari_egm_sweep_2d,
+        )
+
+        mesh_1d = make_mesh((GRID_AXIS,))
+        C0 = jnp.zeros((2, 3, 64))
+        with pytest.raises(ValueError, match="carrying both"):
+            solve_aiyagari_egm_sweep_2d(
+                mesh_1d, C0, jnp.zeros(64), jnp.zeros(3),
+                jnp.eye(3), jnp.zeros(2), jnp.ones(2), jnp.zeros(2),
+                sigma=5.0, beta=0.96, tol=1e-6, max_iter=10,
+                grid_power=2.0)
+        mesh = make_mesh_2d(scenarios=4, grid=2)
+        with pytest.raises(ValueError, match="divide evenly"):
+            solve_aiyagari_egm_sweep_2d(
+                mesh, C0, jnp.zeros(64), jnp.zeros(3),
+                jnp.eye(3), jnp.zeros(2), jnp.ones(2), jnp.zeros(2),
+                sigma=5.0, beta=0.96, tol=1e-6, max_iter=10,
+                grid_power=2.0)
+
+    @pytest.mark.slow
+    def test_lane_parity_and_per_lane_sentinel(self):
+        """Each lane of the 2-D program reproduces the single-device
+        solver's TRAJECTORY over a fixed sweep budget (<= 1e-12, the 1-D
+        ring program's band x 30 sweeps), and a NaN lane's sentinel
+        verdict is PER LANE — its neighbor solves bitwise identically to
+        the clean run. Slow and sweep-bounded: every collective on the
+        8-virtual-device host pays a thread-rendezvous (~0.3s/sweep
+        measured), so a run-to-convergence test would take minutes;
+        tier-1 covers the same artifact structurally through the
+        registry audit above and the dispatch-level 2-D sweep parity."""
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+        from aiyagari_tpu.solvers.egm import (
+            initial_consumption_guess,
+            solve_aiyagari_egm,
+        )
+        from aiyagari_tpu.solvers.egm_sharded import (
+            solve_aiyagari_egm_sweep_2d,
+        )
+
+        m = aiyagari_preset(grid_size=4096, dtype=jnp.float64)
+        mesh = make_mesh_2d(scenarios=2, grid=4)
+        rs = np.array([0.02, 0.03])
+        ws = np.array([1.2, 1.15])
+        C0 = jnp.stack([initial_consumption_guess(m.a_grid, m.s, rs[i],
+                                                  ws[i])
+                        for i in range(2)])
+        kw = dict(sigma=5.0, beta=0.96, tol=1e-6, max_iter=30,
+                  grid_power=2.0)
+        sol = solve_aiyagari_egm_sweep_2d(
+            mesh, C0, m.a_grid, m.s, m.P, rs, ws, np.zeros(2),
+            capacity=1.0, sentinel=SentinelConfig(), **kw)
+        assert not np.asarray(sol.escaped).any()
+        assert np.asarray(sol.iterations).tolist() == [30, 30]
+        for i in range(2):
+            ref = solve_aiyagari_egm(C0[i], m.a_grid, m.s, m.P, rs[i],
+                                     ws[i], 0.0, **kw)
+            assert float(jnp.max(jnp.abs(sol.policy_c[i]
+                                         - ref.policy_c))) <= 1e-12
+        poisoned = solve_aiyagari_egm_sweep_2d(
+            mesh, C0.at[0].set(jnp.nan), m.a_grid, m.s, m.P, rs, ws,
+            np.zeros(2), capacity=1.0, sentinel=SentinelConfig(), **kw)
+        verdicts = np.asarray(poisoned.sentinel.verdict)
+        # Lane 0's sentinel fires "nan"; lane 1 never notices — and its
+        # whole policy is BITWISE the clean run's (the per-lane freeze +
+        # globally-synced trip count of _make_egm_local).
+        assert verdicts[0] != 0 and verdicts[1] == 0
+        assert int(np.asarray(poisoned.iterations)[0]) < 30
+        np.testing.assert_array_equal(np.asarray(poisoned.policy_c[1]),
+                                      np.asarray(sol.policy_c[1]))

@@ -228,7 +228,9 @@ class TestLintFixtures:
         assert set(by_rule) == {"mesh-shim-discipline",
                                 "no-host-scalar-in-hot-module",
                                 "no-bare-debug-print"}
-        assert len(by_rule["mesh-shim-discipline"]) == 2   # import + attr
+        # import + attribute chain + raw PartitionSpec construction (the
+        # ISSUE 13 extension).
+        assert len(by_rule["mesh-shim-discipline"]) == 3
         assert len(by_rule["no-host-scalar-in-hot-module"]) == 2
         assert len(by_rule["no-bare-debug-print"]) == 1
 
@@ -285,8 +287,10 @@ class TestLintFixtures:
         p.write_text(src)
         findings = lint_file(p, "bypass.py", hot=False, mesh_exempt=False)
         mesh = [f for f in findings if f.rule.name == "mesh-shim-discipline"]
-        assert len(mesh) == 2, findings
-        assert {f.line for f in mesh} == {1, 2}
+        # Both import forms fire, and the aliased construction on line 3
+        # now fires the ISSUE 13 raw-PartitionSpec extension too.
+        assert len(mesh) == 3, findings
+        assert {f.line for f in mesh} == {1, 2, 3}
 
     def test_debug_print_in_else_branch_of_guard_fires(self, tmp_path):
         """The else branch of an `if *DEBUG*:` is the production path —
