@@ -11,9 +11,15 @@ workflows end-to-end (solve -> simulate -> statistics -> figures).
 Defaults reproduce the reference problem scales (BASELINE.md); outputs land in
 --outdir as figures + summary.json + run log (JSONL).
 
-Observability (diagnostics/ledger.py + health.py):
+Observability (diagnostics/ledger.py + health.py + watch.py):
 
-  python -m aiyagari_tpu report <ledger.jsonl>          # render a run ledger
+  python -m aiyagari_tpu report <ledger.jsonl> [...]    # render a run ledger
+                                                        # (host shards merged)
+  python -m aiyagari_tpu watch <ledger|shard-glob>      # live-merge + tail a
+                                                        # running sweep's
+                                                        # shards into a
+                                                        # per-scenario/per-host
+                                                        # table
 
 Route observatory (tuning/autotuner.py; docs/USAGE.md "Route observatory
 & autotuning"):
@@ -50,6 +56,13 @@ def main(argv=None) -> int:
         from aiyagari_tpu.tuning.autotuner import tune_main
 
         return tune_main(argv[1:])
+    # `watch` tails + live-merges ledger shards into a per-scenario /
+    # per-host progress table (diagnostics/watch.watch_main) — the pod
+    # observatory's live view.
+    if argv[:1] == ["watch"]:
+        from aiyagari_tpu.diagnostics.watch import watch_main
+
+        return watch_main(argv[1:])
     ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])
@@ -88,8 +101,25 @@ def main(argv=None) -> int:
                     help="append this run's flight record (config "
                          "fingerprint, spans, telemetry, verdicts) to a "
                          "JSONL run ledger; render it later with "
-                         "`python -m aiyagari_tpu report <path>`")
+                         "`python -m aiyagari_tpu report <path>` (on a "
+                         "multi-host pod each host writes its own "
+                         "<path>.p{k}.jsonl shard)")
+    ap.add_argument("--heartbeat", type=int, default=0, metavar="N",
+                    help="live-watch cadence: emit every Nth solver "
+                         "progress record to the ledger as a `heartbeat` "
+                         "event (requires --ledger; tail the run with "
+                         "`python -m aiyagari_tpu watch <ledger>`). Also "
+                         "sets progress_every=N, compiling the in-jit "
+                         "progress callback into the solve — only the "
+                         "ledger stride itself is program-neutral (a run "
+                         "with progress already on pays nothing extra)")
     args = ap.parse_args(argv)
+    if args.heartbeat and not args.ledger:
+        # Without a ledger the stride has nowhere to land, yet
+        # progress_every would still compile host callbacks into the
+        # solve — a silent cost with zero output. Refuse loudly.
+        ap.error("--heartbeat requires --ledger (heartbeat events land "
+                 "on the run ledger)")
 
     if args.platform:
         import jax
@@ -141,6 +171,12 @@ def main(argv=None) -> int:
         led = RunLedger(args.ledger,
                         meta={"entry": f"{args.model}/{args.method}",
                               "outdir": outdir})
+    if args.heartbeat:
+        # Host-side only: the stride gates which delivered records reach
+        # the ledger; the traced programs depend on progress_every alone.
+        from aiyagari_tpu.diagnostics.progress import configure_heartbeat
+
+        configure_heartbeat(args.heartbeat)
     from aiyagari_tpu.dispatch import _ledger_result, _observe
 
     if args.model in ("aiyagari", "aiyagari-labor"):
@@ -170,7 +206,8 @@ def main(argv=None) -> int:
         with _observe(led, "aiyagari_ge", method=args.method):
             res = solve_equilibrium(
                 model,
-                solver=SolverConfig(method=args.method, ladder=ladder),
+                solver=SolverConfig(method=args.method, ladder=ladder,
+                                    progress_every=args.heartbeat),
                 sim=SimConfig(periods=args.periods, n_agents=args.agents, seed=args.seed),
                 eq=EquilibriumConfig(),
                 on_iteration=sink,
@@ -192,9 +229,18 @@ def main(argv=None) -> int:
                             max_iter=args.alm_iters, seed=args.seed,
                             acceleration=args.acceleration)
         with _observe(led, "krusell_smith", method=args.method):
+            import dataclasses as _dc
+
+            from aiyagari_tpu.equilibrium.alm import _default_ks_solver_config
+
             res = solve_krusell_smith(
                 KrusellSmithConfig(k_size=args.k_size),
                 method=args.method,
+                # The reference-tolerance KS solver config, with only the
+                # heartbeat progress stride overridden (progress_every=0
+                # keeps it identical to the historical default).
+                solver=_dc.replace(_default_ks_solver_config(args.method),
+                                   progress_every=args.heartbeat),
                 alm=alm_cfg,
                 backend=backend,
                 on_iteration=sink,

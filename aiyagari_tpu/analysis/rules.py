@@ -42,7 +42,7 @@ CALLBACK_TAG_ATTR = "__aiyagari_callback_tag__"
 # degradation event: an async, fire-and-forget jax.debug.callback that
 # increments a process metrics counter — the device program never blocks
 # on it, so it is a sanctioned exception to no-host-sync-in-loop.
-CALLBACK_WHITELIST = frozenset({"pushforward-degradation"})
+CALLBACK_WHITELIST = frozenset({"pushforward-degradation", "progress"})
 
 
 @dataclasses.dataclass(frozen=True)

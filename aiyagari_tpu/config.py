@@ -574,6 +574,11 @@ class MeshConfig:
 
     scenarios: Optional[int] = None
     grid: Optional[int] = None
+    # Pod observatory (diagnostics/skew.py): time a fenced psum rendezvous
+    # per mesh axis around activation, emitting host_skew ledger events +
+    # aiyagari_host_skew_seconds{axis=} gauges and a straggler verdict.
+    # Off by default — the probe compiles and runs two tiny collectives.
+    skew_probe: bool = False
 
     def __post_init__(self):
         for name in ("scenarios", "grid"):
@@ -582,6 +587,10 @@ class MeshConfig:
                 raise ValueError(
                     f"MeshConfig.{name} must be a positive int or None, "
                     f"got {v!r}")
+        if not isinstance(self.skew_probe, bool):
+            raise ValueError(
+                f"MeshConfig.skew_probe must be a bool, got "
+                f"{self.skew_probe!r}")
 
 
 @dataclasses.dataclass(frozen=True)

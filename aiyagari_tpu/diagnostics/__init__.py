@@ -14,13 +14,17 @@ from aiyagari_tpu.diagnostics.logging import (
 )
 from aiyagari_tpu.diagnostics.progress import (
     capture_progress,
+    configure_heartbeat,
     device_progress,
+    heartbeat_stride,
     subscribe,
 )
 
 __all__ = [
     "capture_progress",
+    "configure_heartbeat",
     "device_progress",
+    "heartbeat_stride",
     "subscribe",
     "ConvergenceError",
     "ConvergenceWarning",
@@ -37,6 +41,9 @@ __all__ = [
     #   diagnostics.trace     — nested wall-clock spans
     #   diagnostics.metrics   — process-wide counter/gauge/histogram registry
     #   diagnostics.health    — health certificates + report CLI
+    #   diagnostics.skew      — mesh rendezvous / straggler probes
+    #   diagnostics.watch     — live sweep watch CLI (shard tail + merge)
+    #   diagnostics.bench_history — frozen-bench regression watchdog
     #   diagnostics.sentinel  — device-resident failure sentinels
     #   diagnostics.faults    — deterministic fault injection (CI harness)
     #   diagnostics.rescue    — the host-side rescue ladder

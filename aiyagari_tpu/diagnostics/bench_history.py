@@ -1,0 +1,240 @@
+"""Bench-history regression watchdog: diff fresh benchmark records against
+the frozen BENCH_r*.json trajectory (ISSUE 14 satellite).
+
+Every bench round freezes artifacts (BENCHMARKS.md), but nothing ever READ
+them back — a structural regression (a parity band blown, a fusion flag
+raised, an attribution table shrinking, a formerly-working metric now
+OOM-skipping) only surfaced when a human diffed the JSON. This module is
+the automatic reader: `load_history()` collects every frozen record by
+metric name, `check_records(fresh)` matches fresh records against the
+newest frozen record of the SAME metric name and flags fields outside
+their per-metric noise band.
+
+Two severities, because the frozen trajectory mixes quiet-box full-size
+rounds with ci-produced artifacts:
+
+  structural — scale-independent claims (parities, bitwise pins, table
+      sizes, flag lists, skip status). Checked whenever metric names
+      match; gated at ZERO by tests/test_bench_ci.py.
+  wall — absolute timings. Checked ONLY when the record's sizing fields
+      (the check's `match` keys: grid, rounds, ...) are equal between
+      fresh and frozen — a ci battery must never be timed against a
+      full-size round — and with a deliberately catastrophic band (10x):
+      the one-core host's scheduler noise is measured at 13%+, so walls
+      here catch an accidental host sync, not a wiggle.
+
+`bench.py --check-history` (on in `--preset ci`) runs this after the
+battery, emits one `bench_regression` ledger event per finding, and prints
+a `bench_history_check` record whose value is the finding count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Optional
+
+__all__ = ["Check", "check_records", "default_bench_dir", "load_history"]
+
+# Catastrophe band for wall checks (see module docstring).
+_WALL_BAND = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Check:
+    """One banded field comparison. `field` is a dotted path into the
+    record; kinds:
+
+      bool      — frozen truthy => fresh must stay truthy
+      empty     — fresh list/dict must stay empty when frozen was empty
+      count_min — fresh numeric >= frozen / band
+      keys_min  — fresh dict keys must cover the frozen dict's keys
+      max_abs   — fresh numeric <= max(band * frozen, floor)  (parities)
+      wall      — fresh numeric <= band * frozen, only when every `match`
+                  sizing key is equal between the records
+    """
+
+    field: str
+    kind: str
+    band: float = 1.0
+    floor: float = 0.0
+    match: tuple = ()
+
+    @property
+    def structural(self) -> bool:
+        return self.kind != "wall"
+
+
+def _mesh2d_checks() -> tuple:
+    out = [Check("value", "wall", band=_WALL_BAND,
+                 match=("grid", "rounds", "scenarios", "devices"))]
+    for topo in ("scenarios8", "grid8", "2x4"):
+        out.append(Check(f"topologies.{topo}.r_equal", "bool"))
+        out.append(Check(f"topologies.{topo}.parity_vs_unsharded",
+                         "max_abs", band=1.0, floor=1e-10))
+    return tuple(out)
+
+
+# Per-metric-name-PREFIX check specs (metric names carry grid sizes; the
+# history match itself is by exact name, so a ci-sized record never meets
+# a full-size one — the prefix only selects which checks apply).
+SPECS = {
+    "mesh2d_sweep": _mesh2d_checks(),
+    "route_attribution": (
+        Check("value", "count_min"),
+        Check("flagged", "empty"),
+        Check("programs", "keys_min"),
+        Check("knobs", "keys_min"),
+    ),
+    "pod_observatory": (
+        Check("merge.ordered", "bool"),
+        Check("merge.run_joined", "bool"),
+        Check("merge.shards", "count_min"),
+        Check("heartbeat.off_jaxpr_identical", "bool"),
+        Check("heartbeat.off_bit_identical", "bool"),
+        Check("skew.axes", "keys_min"),
+        Check("value", "wall", band=_WALL_BAND,
+              match=("devices", "scenarios")),
+    ),
+    "telemetry_recorder": (
+        Check("off_bit_identical", "bool"),
+        Check("off_jaxpr_noop", "bool"),
+    ),
+    "pushforward_sweep": (
+        Check("routes", "keys_min"),
+        Check("vs_baseline", "count_min", band=1.5),
+    ),
+    "egm_fused_sweep": (
+        Check("routes", "keys_min"),
+        Check("parity_vs_xla", "max_abs", band=10.0, floor=1e-9),
+    ),
+    "static_analysis_findings": (
+        Check("value", "max_abs", band=1.0, floor=0.0),
+    ),
+}
+
+
+def default_bench_dir() -> str:
+    """The repo root (where bench.py freezes its BENCH_r*.json)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_history(bench_dir: Optional[str] = None) -> dict:
+    """Every frozen record, keyed by metric name: {metric: [{"record",
+    "source"}, ...]} in round order (filename sort). Handles both frozen
+    shapes: the modern flat record and the early rounds' {"parsed":
+    <record>} wrapper. Unreadable files are skipped (history is advisory
+    input, not a crash surface) — but an empty history is loud at the
+    check level via the matched-metrics count."""
+    bench_dir = bench_dir or default_bench_dir()
+    out: dict = {}
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+        name = rec.get("metric") if isinstance(rec, dict) else None
+        if name:
+            out.setdefault(name, []).append(
+                {"record": rec, "source": os.path.basename(path)})
+    return out
+
+
+def _get(record: dict, dotted: str):
+    cur = record
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _check_one(check: Check, fresh: dict, frozen: dict) -> Optional[str]:
+    """None when inside the band, else a short reason."""
+    fz = _get(frozen, check.field)
+    if fz is None:
+        return None          # older rounds predate the field: nothing to hold
+    fr = _get(fresh, check.field)
+    if check.kind == "wall":
+        if any(_get(fresh, k) != _get(frozen, k) for k in check.match):
+            return None      # different sizing: walls are incomparable
+        if fr is None:
+            return "wall field vanished"
+        if float(fr) > check.band * float(fz):
+            return (f"wall {fr} > {check.band}x frozen {fz}")
+        return None
+    if fr is None:
+        return "field vanished from the fresh record"
+    if check.kind == "bool":
+        return None if (not fz or bool(fr)) else f"was {fz}, now {fr}"
+    if check.kind == "empty":
+        return (None if (len(fz) > 0 or len(fr) == 0)
+                else f"was empty, now {fr}")
+    if check.kind == "count_min":
+        return (None if float(fr) >= float(fz) / check.band
+                else f"{fr} < frozen {fz} / band {check.band}")
+    if check.kind == "keys_min":
+        missing = sorted(set(fz) - set(fr))
+        return None if not missing else f"lost keys {missing}"
+    if check.kind == "max_abs":
+        bound = max(check.band * float(fz), check.floor)
+        return (None if abs(float(fr)) <= bound
+                else f"|{fr}| > max({check.band}x frozen {fz}, "
+                     f"{check.floor})")
+    raise ValueError(f"unknown check kind {check.kind!r}")
+
+
+def _specs_for(metric: str) -> tuple:
+    for prefix, checks in SPECS.items():
+        if metric.startswith(prefix):
+            return checks
+    return ()
+
+
+def check_records(records, *, history: Optional[dict] = None,
+                  bench_dir: Optional[str] = None) -> tuple:
+    """Diff `records` (this battery's fresh metric records) against the
+    frozen history. Returns (findings, matched): `findings` is a list of
+    {"metric", "field", "kind", "severity", "reason", "fresh", "frozen",
+    "source"} dicts (empty on a healthy tree), `matched` the sorted metric
+    names that had a frozen counterpart."""
+    if history is None:
+        history = load_history(bench_dir)
+    findings: list = []
+    matched: set = set()
+    for rec in records:
+        name = rec.get("metric")
+        if not name or name not in history:
+            continue
+        matched.add(name)
+        frozen_entry = history[name][-1]     # the newest frozen round wins
+        frozen = frozen_entry["record"]
+        # Generic skip regression: a metric that used to produce values
+        # and now OOM-skips is always structural.
+        if "skipped" in rec and "skipped" not in frozen:
+            findings.append({
+                "metric": name, "field": "skipped", "kind": "skip",
+                "severity": "structural",
+                "reason": f"previously-working metric now skipped: "
+                          f"{rec['skipped']}",
+                "fresh": rec.get("skipped"), "frozen": None,
+                "source": frozen_entry["source"]})
+            continue
+        for check in _specs_for(name):
+            reason = _check_one(check, rec, frozen)
+            if reason is not None:
+                findings.append({
+                    "metric": name, "field": check.field,
+                    "kind": check.kind,
+                    "severity": ("structural" if check.structural
+                                 else "wall"),
+                    "reason": reason,
+                    "fresh": _get(rec, check.field),
+                    "frozen": _get(frozen, check.field),
+                    "source": frozen_entry["source"]})
+    return findings, sorted(matched)

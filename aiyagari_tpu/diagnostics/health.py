@@ -247,19 +247,45 @@ def render_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def _has_shards(path) -> bool:
+    """Whether a requested ledger path needs the merge path: real host
+    shards (integer-indexed, per ledger.shard_paths) exist beside it, or
+    the path itself is a glob / not a plain file. A non-shard sibling
+    like `ledger.prod.jsonl` must NOT flip the single-file read into the
+    tolerant merge — the strict torn-line semantics are the point."""
+    from pathlib import Path
+
+    from aiyagari_tpu.diagnostics.ledger import shard_paths
+
+    found = shard_paths(path)
+    return found != [Path(path)]
+
+
 def report_main(argv) -> int:
-    """`python -m aiyagari_tpu report <ledger.jsonl>`: render a run ledger —
-    runs, spans, verdicts, telemetry summaries, degradations — to stdout."""
+    """`python -m aiyagari_tpu report <ledger.jsonl> [shard2 ...]`: render
+    a run ledger — runs, spans, verdicts, telemetry summaries,
+    degradations, pod-observatory events — to stdout. Multiple paths (or a
+    base path whose host shards exist on disk) are implicitly merged by
+    run id in timestamp order (diagnostics/ledger.merge_ledgers), so the
+    existing report workflow reads pod output unchanged."""
     import argparse
 
-    from aiyagari_tpu.diagnostics.ledger import read_ledger
+    from aiyagari_tpu.diagnostics.ledger import merge_ledgers, read_ledger
 
     ap = argparse.ArgumentParser(prog="aiyagari_tpu report")
-    ap.add_argument("ledger", help="path to a run-ledger JSONL file")
+    ap.add_argument("ledger", nargs="+",
+                    help="run-ledger JSONL file(s); host shards "
+                         "(ledger.p{k}.jsonl) and glob patterns are "
+                         "merged by run id")
     ap.add_argument("--json", action="store_true",
                     help="emit the parsed events as one JSON document")
     args = ap.parse_args(argv)
-    events = read_ledger(args.ledger)
+    if len(args.ledger) == 1 and not _has_shards(args.ledger[0]):
+        # The historical single-file path keeps its strict torn-line
+        # semantics (a post-hoc ledger that cannot round-trip is loud).
+        events = read_ledger(args.ledger[0])
+    else:
+        events = merge_ledgers(args.ledger)
     if args.json:
         import json
 
@@ -271,8 +297,11 @@ def report_main(argv) -> int:
         by_run.setdefault(ev.get("run_id", "?"), []).append(ev)
     for run_id, evs in by_run.items():
         start = next((e for e in evs if e["kind"] == "run_start"), {})
+        hosts = {e.get("process_index", 0) for e in evs}
+        host_bit = f"  hosts={len(hosts)}" if len(hosts) > 1 else ""
         print(f"run {run_id}  events={len(evs)}  "
-              f"fingerprint={start.get('config_fingerprint', '-')}")
+              f"fingerprint={start.get('config_fingerprint', '-')}"
+              + host_bit)
         for ev in evs:
             k = ev["kind"]
             if k == "run_start":
@@ -337,6 +366,29 @@ def report_main(argv) -> int:
                 print(f"  mesh {ev.get('entry', '-')}: {shape} "
                       f"({ev.get('devices')} device(s), "
                       f"{ev.get('processes')} process(es))")
+            elif k == "host_skew":
+                rec = ev.get("reconciliation") or {}
+                bit = (f" vs priced {rec.get('link')} "
+                       f"{rec.get('priced_seconds'):.2e}s"
+                       if rec.get("priced_seconds") else "")
+                strag = (f" (host {ev['straggler']})"
+                         if ev.get("straggler") is not None else "")
+                print(f"  skew {ev.get('axis')}: rendezvous "
+                      f"{ev.get('rendezvous_seconds')}s  lag spread "
+                      f"{ev.get('lag_spread_seconds')}s  "
+                      f"{ev.get('verdict')}{strag}{bit}")
+            elif k == "heartbeat":
+                where = (f"@p{ev['process_index']}"
+                         if ev.get("process_count", 1) > 1 else "")
+                gap = ev.get("gap", ev.get("distance"))
+                n = ev.get("round", ev.get("iteration"))
+                print(f"  heartbeat {ev.get('context')}{where}: "
+                      f"sweep {n}  residual {gap}  "
+                      f"dtype {ev.get('dtype', '-')}")
+            elif k == "bench_regression":
+                print(f"  bench regression [{ev.get('severity')}] "
+                      f"{ev.get('metric')}.{ev.get('field')}: "
+                      f"{ev.get('reason')} (frozen in {ev.get('source')})")
             elif k == "tuning_probe":
                 walls = ev.get("walls_us") or {}
                 detail = "  ".join(f"{r}={w:.1f}us" for r, w in

@@ -8,13 +8,14 @@ that story: one JSONL file per run (or shared across runs — events carry
 their run id), each line one event:
 
     {"run_id": "r1a2...", "seq": 3, "ts": 1722700000.1, "kind": "span",
-     ...event fields...}
+     "process_index": 0, "process_count": 1, ...event fields...}
 
 Standard event kinds written by the wired entry points (dispatch.solve /
 solve_transition / bench.py):
 
   run_start    — config fingerprint (io_utils.checkpoint.config_fingerprint)
-                 + free-form metadata, first event of every run
+                 + free-form metadata + the runtime identity (jax/jaxlib
+                 versions, platform fingerprint), first event of every run
   span         — a named wall-clock span (diagnostics/trace.py), nested
                  spans carried as children
   telemetry    — a SolveTelemetry summary (diagnostics/telemetry.py) for one
@@ -26,6 +27,20 @@ solve_transition / bench.py):
                  these through the active-ledger hook below
   metric       — a benchmark record (bench.py writes every metric line it
                  prints)
+  heartbeat    — a live progress record (diagnostics/progress.py heartbeat
+                 stride; rendered by `python -m aiyagari_tpu watch`)
+  host_skew    — a mesh rendezvous probe (diagnostics/skew.py)
+
+Pod sharding (the multi-host story, docs/USAGE.md "Pod observatory"):
+every event is stamped with this host's `process_index`/`process_count`,
+and under a multi-process JAX runtime each host writes its OWN shard —
+`ledger.jsonl` becomes `ledger.p{k}.jsonl` — so hosts never interleave
+writes into one file across DCN filesystems. `merge_ledgers(paths)` joins
+the shards back into one run-id-grouped, time-ordered stream (torn tail
+lines on live files tolerated), and `read_ledger(..., follow=True)` tails
+ONE growing shard as a generator (the single-file tail primitive; the
+watch CLI instead re-merges the whole shard set every frame so
+late-joining hosts' shards appear).
 
 Reading back: `read_ledger(path)` returns the parsed events;
 `python -m aiyagari_tpu report <ledger.jsonl>` renders them
@@ -41,6 +56,7 @@ on this thread (`with ledger.activate(led): ...`) and is a no-op otherwise.
 from __future__ import annotations
 
 import contextlib
+import glob as _glob
 import json
 import os
 import threading
@@ -56,7 +72,10 @@ __all__ = [
     "activate",
     "active_ledger",
     "emit",
+    "merge_ledgers",
     "read_ledger",
+    "shard_path",
+    "shard_paths",
 ]
 
 
@@ -64,21 +83,144 @@ def new_run_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _process_topology() -> tuple:
+    """This host's (process_index, process_count) — backend-init-free (the
+    distributed global state, parallel/distributed.peek_process_topology).
+    (0, 1) whenever jax (or the distributed runtime) is not up."""
+    try:
+        from aiyagari_tpu.parallel.distributed import peek_process_topology
+
+        return peek_process_topology()
+    except Exception:
+        return 0, 1
+
+
+def _runtime_identity() -> dict:
+    """jax/jaxlib versions + platform fingerprint for run_start — the
+    identity a merged pod ledger needs per shard (a version-skewed host is
+    the FIRST thing a straggler investigation rules out). Backend-init-free
+    and best-effort: fields are omitted rather than guessed."""
+    out: dict = {}
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+    except Exception:
+        return out
+    try:
+        import jaxlib
+
+        out["jaxlib_version"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        # The compile/tuning caches' host identity (backend + CPU
+        # stepping). platform_fingerprint resolves jax.default_backend(),
+        # which INITIALIZES a backend on first call — on a pod that would
+        # wreck a jax.distributed.initialize still to come (and stamp a
+        # (0, 1) topology), so the field is recorded only when a backend
+        # already exists; omitted otherwise.
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            from aiyagari_tpu.tuning.autotuner import platform_fingerprint
+
+            out["platform_fingerprint"] = platform_fingerprint()
+    except Exception:
+        pass
+    return out
+
+
+def shard_path(path, k: int) -> Path:
+    """The per-host shard file of a requested ledger path: `ledger.jsonl`
+    -> `ledger.p{k}.jsonl` (suffix preserved so shards stay JSONL-typed)."""
+    p = Path(path)
+    if p.suffix:
+        return p.with_name(f"{p.stem}.p{int(k)}{p.suffix}")
+    return p.with_name(f"{p.name}.p{int(k)}")
+
+
+def _shard_glob(path) -> str:
+    """The glob matching a path's host shards. Built by the same name
+    surgery as shard_path — never by substring replacement over the whole
+    path, which would corrupt directories or stems that themselves
+    contain \".p0\". `[0-9]*` over-matches (e.g. `.p1x`); callers filter
+    by the integer-index parse."""
+    p = Path(path)
+    if p.suffix:
+        return str(p.with_name(f"{p.stem}.p[0-9]*{p.suffix}"))
+    return str(p.with_name(f"{p.name}.p[0-9]*"))
+
+
+def shard_paths(path) -> list:
+    """Every on-disk file belonging to a requested ledger path: the base
+    file (single-process runs) plus any host shards, shard-index ordered."""
+    p = Path(path)
+    out = [p] if p.exists() else []
+    shards = []
+    for s in _glob.glob(_shard_glob(p)):
+        stem = Path(s).stem
+        try:
+            idx = int(stem.rsplit(".p", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        shards.append((idx, Path(s)))
+    out.extend(sp for _, sp in sorted(shards))
+    return out
+
+
+def _shared_run_id() -> str:
+    """One run id for every host of a multi-process job: process 0 draws it
+    and broadcasts (the SPMD channel that already synchronizes every mesh
+    program). Falls back to a local id if the collective is unavailable —
+    merge_ledgers then still merges, it just cannot join the shards into
+    one run."""
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        local = np.frombuffer(uuid.uuid4().bytes, np.uint8).copy()
+        shared = np.asarray(multihost_utils.broadcast_one_to_all(local))
+        return shared.tobytes().hex()[:16]
+    except Exception:
+        return new_run_id()
+
+
 class RunLedger:
     """Append-only JSONL event store for one run.
 
     Thread-safe; append-only by construction (the file is opened in "a"
-    mode per event, so concurrent writers from different processes
-    interleave whole lines — POSIX O_APPEND — rather than corrupt)."""
+    mode per event, so concurrent writers from different threads
+    interleave whole lines — POSIX O_APPEND — rather than corrupt).
+
+    Under a multi-process JAX runtime each host writes its own shard
+    (`shard_path(path, process_index)`) under a SHARED run id (process 0
+    broadcasts it), and every event carries the host stamp. Tests (and
+    single-process shard simulations) may pass `process_index` /
+    `process_count` explicitly; an explicit `process_index` always selects
+    the shard file."""
 
     def __init__(self, path, *, run_id: Optional[str] = None,
-                 config=None, meta: Optional[dict] = None):
-        self.path = Path(path)
+                 config=None, meta: Optional[dict] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        pid, count = _process_topology()
+        if process_count is not None:
+            count = int(process_count)
+        sharded = process_index is not None or count > 1
+        if process_index is not None:
+            pid = int(process_index)
+        self.process_index = pid
+        self.process_count = count
+        self.base_path = Path(path)
+        self.path = shard_path(path, pid) if sharded else Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self.run_id = run_id or new_run_id()
+        if run_id is None:
+            run_id = _shared_run_id() if count > 1 else new_run_id()
+        self.run_id = run_id
         self._seq = 0
         self._lock = threading.Lock()
-        start = {"pid": os.getpid(), **(meta or {})}
+        start = {"pid": os.getpid(), **_runtime_identity(), **(meta or {})}
         if config is not None:
             from aiyagari_tpu.io_utils.checkpoint import config_fingerprint
 
@@ -92,6 +234,8 @@ class RunLedger:
         with self._lock:
             rec = {"run_id": self.run_id, "seq": self._seq,
                    "ts": round(time.time(), 4), "kind": kind,
+                   "process_index": self.process_index,
+                   "process_count": self.process_count,
                    **coerce_record(fields)}
             self._seq += 1
             with self.path.open("a") as f:
@@ -125,17 +269,117 @@ class RunLedger:
         self.event("metric", **record)
 
 
-def read_ledger(path) -> list:
-    """Parse a ledger JSONL back into its event dicts (the round-trip the
-    bench CI test pins). Blank lines are skipped; a torn final line (a
-    crashed writer) raises — a ledger that cannot round-trip must be loud."""
+def _parse_lines(path, *, tolerate_torn: bool) -> list:
+    """Parse one shard's lines. A torn FINAL line is a live writer's
+    in-flight event: tolerated (skipped) when asked; a torn line anywhere
+    else is corruption and always raises."""
     events = []
     with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if ln:
-                events.append(json.loads(ln))
+        lines = f.readlines()
+    for i, ln in enumerate(lines):
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            events.append(json.loads(ln))
+        except json.JSONDecodeError:
+            if tolerate_torn and i == len(lines) - 1:
+                break
+            raise
     return events
+
+
+def read_ledger(path, *, follow: bool = False, poll_seconds: float = 0.25,
+                stop=None, tolerate_torn: bool = False):
+    """Parse a ledger JSONL back into its event dicts (the round-trip the
+    bench CI test pins). Blank lines are skipped; a torn final line (a
+    crashed writer) raises — a ledger that cannot round-trip must be loud —
+    unless `tolerate_torn` opts into skipping it (live files).
+
+    follow=True returns a GENERATOR that tails the file instead: complete
+    lines are yielded as events as they are appended (a torn tail stays
+    buffered until its writer finishes the line), polling every
+    `poll_seconds`; `stop` (a nullary callable) ends the tail. This is
+    the single-shard tail primitive (external consumers streaming one
+    host's events); the watch CLI re-merges whole shard sets per frame
+    instead so late-joining hosts appear."""
+    if follow:
+        return _follow_ledger(path, poll_seconds=poll_seconds, stop=stop)
+    return _parse_lines(path, tolerate_torn=tolerate_torn)
+
+
+def _follow_ledger(path, *, poll_seconds: float, stop) -> Iterator[dict]:
+    buf = ""
+    pos = 0
+    while True:
+        try:
+            with open(path) as f:
+                f.seek(pos)
+                buf += f.read()
+                pos = f.tell()
+        except FileNotFoundError:
+            pass
+        while "\n" in buf:
+            ln, buf = buf.split("\n", 1)
+            if ln.strip():
+                yield json.loads(ln)
+        if stop is not None and stop():
+            return
+        time.sleep(poll_seconds)
+
+
+def _merge_files(paths) -> list:
+    """Expand the requested paths into concrete shard files: existing
+    files pass through, glob patterns expand, and a base path whose host
+    shards exist on disk expands to them (the pod case: the operator names
+    `ledger.jsonl`, the hosts wrote `ledger.p{k}.jsonl`). De-duplicated,
+    deterministic order."""
+    files: list = []
+    for p in paths:
+        p = str(p)
+        if os.path.exists(p):
+            expanded = shard_paths(p) or [Path(p)]
+        elif _glob.glob(p):
+            expanded = [Path(g) for g in sorted(_glob.glob(p))]
+        else:
+            expanded = shard_paths(p)
+            if not expanded:
+                raise FileNotFoundError(
+                    f"no ledger file, shard, or glob match for {p!r}")
+        files.extend(expanded)
+    seen = set()
+    out = []
+    for f in files:
+        key = os.path.abspath(str(f))
+        if key not in seen:
+            seen.add(key)
+            out.append(Path(key))
+    return out
+
+
+def merge_ledgers(paths, *, tolerate_torn: bool = True) -> list:
+    """Join host shards into ONE event stream: events are grouped by run
+    id (a pod run's shards share the broadcast run id, so its hosts join
+    into a single run), each run's events ordered monotonically by
+    timestamp (ties broken by host then per-host sequence — each shard's
+    own order is always preserved), and runs ordered by first appearance.
+    `paths` may mix concrete files, glob patterns, and base paths with
+    on-disk shards. Torn tail lines (live writers) are tolerated by
+    default; pass tolerate_torn=False for the strict post-hoc read."""
+    events: list = []
+    for f in _merge_files(paths if isinstance(paths, (list, tuple))
+                          else [paths]):
+        events.extend(_parse_lines(f, tolerate_torn=tolerate_torn))
+    groups: dict = {}
+    for ev in events:
+        groups.setdefault(ev.get("run_id", "?"), []).append(ev)
+    key = lambda e: (e.get("ts", 0.0), e.get("process_index", 0),  # noqa: E731
+                     e.get("seq", 0))
+    merged: list = []
+    for run_id, evs in sorted(groups.items(),
+                              key=lambda kv: min(key(e) for e in kv[1])):
+        merged.extend(sorted(evs, key=key))
+    return merged
 
 
 # -- active-ledger hook (thread-local + process fallback) ------------------

@@ -17,6 +17,7 @@ kwargs; a (name, sorted labels) pair is one time series.
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -41,7 +42,41 @@ _DEFAULT_BUCKETS = (1e-10, 1e-8, 1e-6, 1e-4, 1e-2, 1.0, 100.0)
 _Key = Tuple[str, Tuple[Tuple[str, str], ...]]
 
 
+_HOST_LABELS_CACHE: Optional[dict] = None
+
+
+def _host_labels() -> dict:
+    """The pod host label: {"host": "<process_index>"} on a multi-process
+    JAX runtime, {} single-process — so one host's scrape (or a merged
+    dump) attributes every series to the process that produced it, while
+    single-host exposition stays byte-identical to the historical output.
+    Consulted only when jax is already imported (backend-init-free:
+    parallel/distributed.peek_process_topology reads the distributed
+    global state) — the registry stays usable by jax-free unit code. The
+    label is CACHED once multi-process is observed (the topology never
+    changes after jax.distributed.initialize), keeping the per-call cost
+    of every counter/gauge off the re-resolve path; series touched before
+    the distributed init keep the unlabeled identity, same as ledger
+    events stamped (0, 1) before it."""
+    global _HOST_LABELS_CACHE
+    if _HOST_LABELS_CACHE is not None:
+        return _HOST_LABELS_CACHE
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        from aiyagari_tpu.parallel.distributed import peek_process_topology
+
+        pid, count = peek_process_topology()
+    except Exception:
+        return {}
+    if count > 1:
+        _HOST_LABELS_CACHE = {"host": str(pid)}
+        return _HOST_LABELS_CACHE
+    return {}
+
+
 def _key(name: str, labels: dict) -> _Key:
+    labels = {**_host_labels(), **labels}
     return name, tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
@@ -165,11 +200,19 @@ class MetricsRegistry:
         """Prometheus text exposition format v0.0.4 — the string a serve
         layer returns from /metrics."""
 
+        def esc(v):
+            # Label-value escaping per the text format: backslash first
+            # (or it would re-escape the other two), then quote and
+            # newline — a route name / path landing in a label must not
+            # produce unparseable exposition.
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
         def fmt_labels(labels, extra=()):
             items = list(labels) + list(extra)
             if not items:
                 return ""
-            return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+            return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
 
         lines = []
         # ONE "# TYPE" line per metric NAME, not per label-set series — the

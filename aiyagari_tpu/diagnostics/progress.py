@@ -10,24 +10,56 @@ giving up the device-resident design: solvers call device_progress() every
 sinks are subscribed (the same sink objects as diagnostics.logging). Off by
 default — callbacks serialize host<->device traffic, so benchmarks and
 production runs pay nothing.
+
+Heartbeats (the pod observatory's live layer, docs/USAGE.md "Pod
+observatory"): with `configure_heartbeat(stride)` armed, every stride-th
+delivered progress record ALSO lands on the ACTIVE run ledger
+(diagnostics/ledger.py) as a `heartbeat` event — host-stamped by the
+ledger, carrying the residual's dtype (the live stage-dtype signal for the
+mixed-precision ladder) — and lockstep sweep round loops publish their
+per-scenario state through `sweep_heartbeat`. `python -m aiyagari_tpu
+watch` tails and renders them. Heartbeats are PURE host-side fan-out: the
+stride is never traced, so heartbeat-off (and heartbeat-on) programs are
+bit-identical to the historical ones — only `progress_every` shapes the
+compiled program, exactly as before (jaxpr-pinned by
+tests/test_pod_observatory.py).
 """
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 from functools import partial
 from typing import Callable
 
 import jax
 
-__all__ = ["subscribe", "capture_progress", "device_progress", "reset"]
+__all__ = [
+    "subscribe",
+    "capture_progress",
+    "configure_heartbeat",
+    "device_progress",
+    "heartbeat_stride",
+    "reset",
+    "sweep_heartbeat",
+]
 
 _SINKS: list[Callable[[dict], None]] = []
+
+# Heartbeat state: stride 0 = off (the default — no ledger interaction at
+# all); stride N emits every Nth delivered record per context. Host-side
+# only, never traced. The counter map is written from jax debug-callback
+# threads, so its read-modify-write takes a lock (concurrent deliveries
+# for one context would otherwise drop counts and drift the stride).
+_HEARTBEAT = {"stride": 0}
+_HEARTBEAT_COUNTS: dict = {}
+_HEARTBEAT_LOCK = threading.Lock()
 
 
 def subscribe(sink: Callable[[dict], None]) -> Callable[[], None]:
     """Register a sink for in-jit progress records; returns an unsubscribe
-    function. Records are dicts {"context", "iteration", "distance"}."""
+    function. Records are dicts {"context", "iteration", "distance",
+    "dtype"}."""
     _SINKS.append(sink)
 
     def unsubscribe() -> None:
@@ -39,13 +71,33 @@ def subscribe(sink: Callable[[dict], None]) -> Callable[[], None]:
     return unsubscribe
 
 
+def configure_heartbeat(stride: int) -> None:
+    """Arm (or disarm) ledger heartbeats: every `stride`-th delivered
+    progress record per context — and every `stride`-th lockstep sweep
+    round (sweep_heartbeat) — is appended to the ACTIVE ledger as a
+    `heartbeat` event. 0 (the default) disables; the delivery counters
+    reset on every call so a re-armed watch starts on the next record."""
+    stride = int(stride)
+    if stride < 0:
+        raise ValueError(f"heartbeat stride must be >= 0, got {stride}")
+    _HEARTBEAT["stride"] = stride
+    _HEARTBEAT_COUNTS.clear()
+
+
+def heartbeat_stride() -> int:
+    return _HEARTBEAT["stride"]
+
+
 def reset() -> None:
-    """Drop every subscribed sink. _SINKS is module-global state shared
-    across threads and test cases; an autouse fixture calling reset() makes
-    a leaked subscription (a test that crashed before its unsubscribe, a
+    """Drop every subscribed sink and disarm heartbeats. _SINKS (and the
+    heartbeat stride) are module-global state shared across threads and
+    test cases; an autouse fixture calling reset() makes a leaked
+    subscription (a test that crashed before its unsubscribe, a
     capture_progress block interrupted mid-teardown) impossible to carry
     into the next test."""
     _SINKS.clear()
+    _HEARTBEAT["stride"] = 0
+    _HEARTBEAT_COUNTS.clear()
 
 
 @contextmanager
@@ -67,14 +119,69 @@ def capture_progress(sink: Callable[[dict], None]):
             unsubscribe()
 
 
+def _maybe_heartbeat(context: str, record: dict) -> None:
+    """Land every stride-th record per context on the active ledger. A
+    no-op (zero ledger interaction) when heartbeats are off or no ledger
+    is active — and ALWAYS host-side, so the compiled programs cannot
+    depend on it."""
+    stride = _HEARTBEAT["stride"]
+    if not stride:
+        return
+    from aiyagari_tpu.diagnostics import ledger
+
+    if ledger.active_ledger() is None:
+        return
+    with _HEARTBEAT_LOCK:
+        n = _HEARTBEAT_COUNTS.get(context, 0)
+        _HEARTBEAT_COUNTS[context] = n + 1
+    if n % stride == 0:
+        ledger.emit("heartbeat", **record)
+
+
+def sweep_heartbeat(context: str, *, round_index: int, **fields) -> None:
+    """Host-side heartbeat from a lockstep sweep's round loop
+    (equilibrium/batched.py, transition/mit.py): publishes the round's
+    per-scenario state ({"gap": [...], "converged": [...], ...}) as a
+    `heartbeat` event on the active ledger every `stride` rounds. The
+    round loops are host code, so this never touches a traced program; off
+    (the default) it is one dict lookup per round."""
+    stride = _HEARTBEAT["stride"]
+    if not stride or round_index % stride:
+        return
+    from aiyagari_tpu.diagnostics import ledger
+
+    if ledger.active_ledger() is None:
+        return
+    ledger.emit("heartbeat", context=context, round=int(round_index),
+                **fields)
+
+
 def _deliver(context: str, iteration, distance) -> None:
+    import numpy as np
+
+    it = np.asarray(iteration)
+    dist = np.asarray(distance)
     record = {
         "context": context,
-        "iteration": int(iteration),
-        "distance": float(distance),
+        # vmapped callers deliver batched iterations/distances (jax batches
+        # the callback's operands); per-lane values ride as lists so a
+        # sweep's heartbeat renders per-scenario rows.
+        "iteration": int(it) if it.ndim == 0 else it.tolist(),
+        "distance": (float(dist) if dist.ndim == 0
+                     else [float(x) for x in np.ravel(dist)]),
+        # The residual's on-device dtype IS the live stage-dtype signal:
+        # a mixed-ladder solve heartbeats float32 until the switch fires.
+        "dtype": str(dist.dtype),
     }
     for sink in list(_SINKS):
         sink(record)
+    _maybe_heartbeat(context, record)
+
+
+# The AIYA103 whitelist contract (analysis/rules.CALLBACK_TAG_ATTR): the
+# progress callback is a sanctioned in-loop host callback — the dunder is
+# set literally so this module needs no analysis import.
+_deliver.__aiyagari_callback_tag__ = "progress"
 
 
 def device_progress(context: str, iteration, distance, *, every: int) -> None:

@@ -1,0 +1,215 @@
+"""Mesh skew & straggler probes: fenced per-axis rendezvous timings around
+mesh activation (ISSUE 14 tentpole).
+
+A 2-D (scenarios x grid) sweep forces a mesh-wide collective rendezvous
+every sweep (the lane_sync_axis trip-count pmax, DESIGN.md §4a): on a pod,
+ONE slow host stalls every chip, and nothing in the post-hoc ledger says
+which host it was or whether the wall was DCN sync rather than compute.
+These probes answer that at the only place it can be answered cheaply —
+the dispatch boundary, once per mesh activation, NOT inside the solve loop
+(a per-sweep probe would itself be a host sync inside a hot loop, exactly
+what rule AIYA103 forbids; DESIGN.md "Why skew probes live at the dispatch
+boundary").
+
+Per mesh axis, the probe times a fenced psum rendezvous over that axis
+alone (interleaved best-of-reps with rotated order — the PR 6/10
+one-burst-skews-a-ratio lesson), gathers every host's arrival lag (the
+host-side delay reaching the rendezvous, a per-host duration so clock
+offsets cancel), and renders a straggler verdict when one host's lag
+exceeds the configured band. Each axis emits one `host_skew` ledger event
+plus an `aiyagari_host_skew_seconds{axis=}` gauge; when the caller prices
+the sweep (dispatch.sweep passes its S/N/na), the event carries a
+reconciliation row against `roofline.mesh2d_collective_cost`'s priced
+ICI/DCN sync so measured-vs-modeled is one comparison, not two artifacts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SkewConfig", "probe_mesh_skew", "straggler_verdict"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SkewConfig:
+    """Knobs for one probe pass.
+
+    reps: fenced rendezvous repetitions per axis (interleaved, best-of).
+    straggler_band_seconds: absolute arrival-lag spread floor below which
+        no host is ever called a straggler (scheduler noise).
+    straggler_band_factor: relative band — a host must lag the median by
+        more than factor x the measured rendezvous itself."""
+
+    reps: int = 5
+    straggler_band_seconds: float = 5e-3
+    straggler_band_factor: float = 3.0
+
+    def __post_init__(self):
+        if self.reps < 1:
+            raise ValueError(f"SkewConfig.reps must be >= 1, got {self.reps}")
+        if self.straggler_band_seconds < 0 or self.straggler_band_factor < 0:
+            raise ValueError("SkewConfig straggler bands must be >= 0")
+
+
+def straggler_verdict(lags, rendezvous_seconds: float,
+                      config: SkewConfig = SkewConfig()) -> dict:
+    """The per-axis verdict from every host's arrival lag: "straggler"
+    (naming the host) when the worst lag exceeds the median by more than
+    the band — max(absolute floor, factor x measured rendezvous) — else
+    "balanced". Pure so the band logic is unit-testable with synthetic
+    multi-host lags."""
+    lags = np.asarray(lags, np.float64).reshape(-1)
+    if lags.size == 0:
+        return {"verdict": "balanced", "straggler": None,
+                "lag_spread_seconds": 0.0}
+    spread = float(np.max(lags) - np.median(lags))
+    band = max(config.straggler_band_seconds,
+               config.straggler_band_factor * float(rendezvous_seconds))
+    if lags.size > 1 and spread > band:
+        return {"verdict": "straggler", "straggler": int(np.argmax(lags)),
+                "lag_spread_seconds": round(spread, 6),
+                "band_seconds": round(band, 6)}
+    return {"verdict": "balanced", "straggler": None,
+            "lag_spread_seconds": round(spread, 6),
+            "band_seconds": round(band, 6)}
+
+
+def _gather_host_lags(my_lag: float) -> list:
+    """Every host's arrival lag, index = process id. Single-process (the
+    virtual-device mesh) is just this host; multi-process rides the same
+    SPMD allgather channel the mesh programs use."""
+    import jax
+
+    from aiyagari_tpu.parallel.distributed import peek_process_topology
+
+    _, count = peek_process_topology()
+    if count <= 1:
+        return [float(my_lag)]
+    from jax.experimental import multihost_utils  # pragma: no cover - pod
+
+    import jax.numpy as jnp
+
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray([my_lag], jnp.float32))
+    return [float(x) for x in np.ravel(np.asarray(gathered))]
+
+
+def _reconcile(axis: str, measured_s: float, price: dict) -> Optional[dict]:
+    """The measured-vs-priced row: the scenario axis's rendezvous against
+    mesh2d_collective_cost's per-round DCN sync, the grid axis's against
+    its per-lane-sweep ICI bytes. ratio None when the priced side is zero
+    (a one-host topology prices DCN at exactly 0 — the honest degenerate
+    case)."""
+    from aiyagari_tpu.diagnostics.roofline import (
+        ICI_BYTES_PER_SEC,
+        mesh2d_collective_cost,
+    )
+
+    cost = mesh2d_collective_cost(
+        price["S"], price["N"], price["na"],
+        scenarios=price["scenarios"], grid=price["grid"],
+        itemsize=price.get("itemsize", 8), sweeps=1, rounds=1)
+    if axis == "scenarios":
+        priced = cost["dcn_seconds"]
+        link = "dcn"
+    else:
+        priced = cost["grid_bytes_per_lane_sweep"] / ICI_BYTES_PER_SEC
+        link = "ici"
+    return {
+        "link": link,
+        "hosts": cost["hosts"],
+        "measured_seconds": round(measured_s, 6),
+        "priced_seconds": priced,
+        "ratio": (round(measured_s / priced, 2) if priced > 0 else None),
+    }
+
+
+def probe_mesh_skew(mesh, *, config: SkewConfig = SkewConfig(),
+                    price: Optional[dict] = None, ledger=None,
+                    emit: bool = True) -> dict:
+    """Time one fenced psum rendezvous per mesh axis and judge host skew.
+
+    Returns {"axes": [per-axis records], "mesh": {axis: size},
+    "processes": P}; each axis record carries the best-of-reps rendezvous
+    wall, every host's arrival lag, the straggler verdict, and (with
+    `price` = {"S", "N", "na"[, "scenarios", "grid", "itemsize"]} — the
+    axis sizes default to the mesh's own) the reconciliation row against
+    the roofline's priced collectives. With `emit`, each axis lands a
+    `host_skew` event on `ledger` (or the active ledger) and sets
+    aiyagari_host_skew_seconds{axis=}."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.diagnostics import metrics
+    from aiyagari_tpu.diagnostics import ledger as ledger_mod
+    from aiyagari_tpu.diagnostics.profiler import fence
+    from aiyagari_tpu.parallel.distributed import peek_process_topology
+    from aiyagari_tpu.parallel.mesh import PartitionSpec as P, shard_map
+
+    axes = list(mesh.axis_names)
+    if price is not None:
+        price = {"scenarios": int(mesh.shape.get("scenarios", 1)),
+                 "grid": int(mesh.shape.get("grid", 1)), **price}
+    fns, xs = {}, {}
+    for ax in axes:
+        def _body(x, _ax=ax):
+            return jax.lax.psum(x, _ax)
+
+        fns[ax] = jax.jit(shard_map(_body, mesh=mesh,
+                                    in_specs=P(ax), out_specs=P()))
+        xs[ax] = jnp.arange(int(mesh.shape[ax]), dtype=jnp.float32)
+    # Compile outside the timed reps: the probe measures rendezvous, not
+    # tracing.
+    for ax in axes:
+        fence(fns[ax](xs[ax]))
+    walls: dict = {ax: [] for ax in axes}
+    lags: dict = {ax: [] for ax in axes}
+    t_prev = time.perf_counter()
+    for rep in range(config.reps):
+        k = rep % len(axes)
+        for ax in axes[k:] + axes[:k]:
+            t_arrive = time.perf_counter()
+            out = fns[ax](xs[ax])
+            fence(out)
+            t_done = time.perf_counter()
+            walls[ax].append(t_done - t_arrive)
+            # Host-side delay from the previous barrier's completion to
+            # this dispatch: the previous fenced collective synchronizes
+            # every host, so this duration is comparable across hosts
+            # without clock sync.
+            lags[ax].append(t_arrive - t_prev)
+            t_prev = t_done
+
+    _, processes = peek_process_topology()
+    records = []
+    for ax in axes:
+        best = float(np.min(walls[ax]))
+        host_lags = _gather_host_lags(float(np.median(lags[ax])))
+        rec = {
+            "axis": ax,
+            "size": int(mesh.shape[ax]),
+            "rendezvous_seconds": round(best, 6),
+            "mean_seconds": round(float(np.mean(walls[ax])), 6),
+            "reps": config.reps,
+            "processes": processes,
+            "arrival_lag_seconds": [round(v, 6) for v in host_lags],
+            **straggler_verdict(host_lags, best, config),
+        }
+        if price is not None:
+            rec["reconciliation"] = _reconcile(ax, best, price)
+        metrics.gauge("aiyagari_host_skew_seconds", axis=ax).set(best)
+        if emit:
+            if ledger is not None:
+                ledger.event("host_skew", **rec)
+            else:
+                ledger_mod.emit("host_skew", **rec)
+        records.append(rec)
+    return {
+        "axes": records,
+        "mesh": {name: int(mesh.shape[name]) for name in axes},
+        "processes": processes,
+    }

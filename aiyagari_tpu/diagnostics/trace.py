@@ -32,6 +32,16 @@ __all__ = ["collect_spans", "span", "timed"]
 
 _tls = threading.local()
 
+# Run-id-keyed collectors (process-global, lock-guarded): spans closed on
+# ANY thread whose active ledger carries that run id are delivered here —
+# without this, concurrent runs (a serve layer's worker threads, a rescue
+# ladder re-solving on a helper thread) pooled their wall-clock into
+# whichever collector happened to be thread-local, and a merged multi-host
+# report attributed one run's spans to another. The thread-local sinks
+# below keep their historical semantics for run-less collection.
+_run_lock = threading.Lock()
+_run_sinks: dict = {}
+
 
 def _stack() -> list:
     if not hasattr(_tls, "stack"):
@@ -45,19 +55,47 @@ def _sinks() -> list:
     return _tls.sinks
 
 
+def _active_run_id():
+    try:
+        from aiyagari_tpu.diagnostics.ledger import active_ledger
+
+        led = active_ledger()
+    except Exception:
+        return None
+    return None if led is None else led.run_id
+
+
 @contextlib.contextmanager
-def collect_spans() -> Iterator[List[dict]]:
+def collect_spans(run_id: str = None) -> Iterator[List[dict]]:
     """Scope a span collector: every TOP-LEVEL span closed inside the block
     is appended to the yielded list (children ride inside their parent's
     "children" field). Nested collectors each receive the spans closed in
     their scope. Exception-safe: the collector is removed even when the
-    block raises."""
+    block raises.
+
+    `run_id` keys the collector to one run (thread-safe): spans closed on
+    any thread whose ACTIVE ledger (diagnostics/ledger.py) carries that
+    run id are delivered here too — so a run's wall-clock is attributed to
+    the run, not to whichever thread happened to host the collector. Each
+    such span record is stamped with its "run_id"."""
     out: List[dict] = []
     _sinks().append(out)
+    if run_id is not None:
+        with _run_lock:
+            _run_sinks.setdefault(run_id, []).append(out)
     try:
         yield out
     finally:
         _sinks().remove(out)
+        if run_id is not None:
+            with _run_lock:
+                lst = _run_sinks.get(run_id, [])
+                for i in range(len(lst) - 1, -1, -1):
+                    if lst[i] is out:
+                        del lst[i]
+                        break
+                if not lst:
+                    _run_sinks.pop(run_id, None)
 
 
 @contextlib.contextmanager
@@ -95,7 +133,19 @@ def span(name: str, **attrs) -> Iterator[dict]:
         if parent is not None:
             parent.setdefault("children", []).append(rec)
         else:
-            for sink in _sinks():
+            targets = list(_sinks())
+            run_id = _active_run_id()
+            if run_id is not None:
+                rec.setdefault("run_id", run_id)
+                with _run_lock:
+                    keyed = list(_run_sinks.get(run_id, ()))
+                for sink in keyed:
+                    # A collector registered BOTH thread-locally and under
+                    # the run id (the dispatch _observe scope) receives the
+                    # span once.
+                    if not any(sink is t for t in targets):
+                        targets.append(sink)
+            for sink in targets:
                 sink.append(rec)
 
 

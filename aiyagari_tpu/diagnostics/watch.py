@@ -1,0 +1,233 @@
+"""Live sweep watch: tail + merge ledger shards into a per-scenario /
+per-host progress table (`python -m aiyagari_tpu watch`, ISSUE 14).
+
+The ledger is a post-hoc flight record; a pod validation run needs to SEE
+the sweep while it runs — which lane is still iterating, in which stage
+dtype, which host is the straggler, what got quarantined — without
+printf archaeology. This module is pure host-side consumption: it re-reads
+and live-merges the shard files every frame (diagnostics/ledger.
+merge_ledgers tolerates the torn tail a live writer leaves), builds a
+state table from the observatory's event kinds, and renders it:
+
+  heartbeat   -> per-scenario rows (sweep/round count, residual, stage
+                 dtype — progress.sweep_heartbeat's per-lane arrays — or a
+                 per-context scalar row for single solves)
+  quarantine  -> the lane's verdict column
+  verdict     -> the run's closing status line
+  host_skew   -> per-axis rendezvous + straggler lines
+  mesh_topology / run_start -> the header
+
+A single-process ledger (no shards, no mesh) degrades to the same table
+with one host column — the CLI works identically on a laptop run.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["build_state", "render_state", "watch_main"]
+
+
+def build_state(events) -> dict:
+    """Fold a merged event stream into the render state: one entry per
+    run id, in stream order."""
+    runs: dict = {}
+    for ev in events:
+        run = runs.setdefault(ev.get("run_id", "?"), {
+            "meta": {}, "mesh": None, "skew": [], "rows": {},
+            "verdicts": [], "events": 0, "hosts": set(),
+            "regressions": 0, "last_ts": None,
+        })
+        run["events"] += 1
+        run["last_ts"] = ev.get("ts", run["last_ts"])
+        if "process_index" in ev:
+            run["hosts"].add(int(ev["process_index"]))
+        kind = ev.get("kind")
+        if kind == "run_start":
+            run["meta"] = {k: ev.get(k) for k in
+                           ("entry", "config_fingerprint", "jax_version",
+                            "platform_fingerprint", "process_count")
+                           if ev.get(k) is not None}
+        elif kind == "mesh_topology":
+            run["mesh"] = {"axes": ev.get("axes") or {},
+                           "devices": ev.get("devices"),
+                           "processes": ev.get("processes")}
+        elif kind == "host_skew":
+            run["skew"].append(ev)
+        elif kind == "heartbeat":
+            _fold_heartbeat(run, ev)
+        elif kind == "quarantine":
+            sc = ev.get("scenario")
+            if sc is not None:
+                _quarantine(run, int(sc), ev.get("process_index", 0),
+                            ev.get("verdict") or "quarantined",
+                            context=ev.get("context"))
+        elif kind == "verdict":
+            run["verdicts"].append(ev)
+        elif kind == "bench_regression":
+            run["regressions"] += 1
+    return runs
+
+
+def _row(run: dict, scenario, host, *, context=None) -> dict:
+    # Rows are keyed per context too: one run can carry several sweep
+    # contexts (a transition sweep's stationary-anchor GE rounds heartbeat
+    # as "aiyagari_sweep", its own rounds as "mit_transition_sweep"), and
+    # a shared (scenario, host) key would let them overwrite each other.
+    return run["rows"].setdefault(
+        (scenario if scenario is not None else "-", int(host),
+         context or "-"),
+        {"context": context, "sweeps": None, "residual": None,
+         "dtype": None, "verdict": "running", "quarantined": False})
+
+
+def _quarantine(run: dict, scenario, host, verdict, *, context=None) -> None:
+    """Mark a lane's verdict. A quarantine event without a context applies
+    to every context's row for that (scenario, host) lane — the lane is
+    quarantined, whichever loop is reporting it."""
+    matched = [row for (sc, h, c), row in run["rows"].items()
+               if sc == scenario and h == int(host)
+               and (context is None or c == (context or "-"))]
+    if not matched:
+        matched = [_row(run, scenario, host, context=context)]
+    for row in matched:
+        row["verdict"] = verdict
+
+
+def _fold_heartbeat(run: dict, ev: dict) -> None:
+    host = ev.get("process_index", 0)
+    gap = ev.get("gap", ev.get("distance"))
+    sweeps = ev.get("round", ev.get("iteration"))
+    if isinstance(gap, list):
+        # A lockstep sweep round (or a vmapped solve's batched progress
+        # record): one row per scenario lane. A list-shaped iteration
+        # count is per-lane too — index it alongside the residual.
+        conv = ev.get("converged") or [None] * len(gap)
+        quar = ev.get("quarantined") or [False] * len(gap)
+        for i, g in enumerate(gap):
+            row = _row(run, i, host, context=ev.get("context"))
+            row.update(context=ev.get("context"),
+                       sweeps=(sweeps[i] if isinstance(sweeps, list)
+                               and i < len(sweeps) else sweeps),
+                       residual=g, dtype=ev.get("dtype"))
+            if i < len(quar) and quar[i]:
+                row["quarantined"] = True
+                row["verdict"] = "quarantined"
+            elif i < len(conv) and conv[i]:
+                row["verdict"] = "converged"
+            elif row["verdict"] != "quarantined":
+                row["verdict"] = "running"
+    else:
+        row = _row(run, None, host, context=ev.get("context"))
+        row.update(context=ev.get("context"), sweeps=sweeps, residual=gap,
+                   dtype=ev.get("dtype"))
+
+
+def _fmt(v, width, float_fmt="{:.3e}") -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = float_fmt.format(v)
+    else:
+        s = str(v)
+    return s.ljust(width)
+
+
+def render_state(runs: dict) -> str:
+    """One text frame for every run in the state."""
+    lines = []
+    for run_id, run in runs.items():
+        hosts = sorted(run["hosts"]) or [0]
+        head = [f"run {run_id}", f"events={run['events']}",
+                f"hosts={len(hosts)}"]
+        if run["meta"].get("entry"):
+            head.insert(1, f"entry={run['meta']['entry']}")
+        mesh = run.get("mesh")
+        if mesh and mesh["axes"]:
+            head.append("mesh=" + " x ".join(
+                f"{a}={s}" for a, s in mesh["axes"].items()))
+        lines.append("  ".join(head))
+        for ev in run["skew"]:
+            bit = (f"  skew {ev.get('axis')}: rendezvous "
+                   f"{ev.get('rendezvous_seconds')}s  "
+                   f"lag spread {ev.get('lag_spread_seconds')}s  "
+                   f"{ev.get('verdict')}")
+            if ev.get("straggler") is not None:
+                bit += f" (host {ev['straggler']})"
+            lines.append(bit)
+        if run["rows"]:
+            lines.append("  scenario  host  sweeps  residual   dtype     "
+                         "verdict      quarantine  context")
+            # Numeric scenario ids sort numerically (10 after 9, not
+            # after 1); the "-" single-solve placeholder sorts last.
+            for (sc, host, _ctx), row in sorted(
+                    run["rows"].items(),
+                    key=lambda kv: ((1, str(kv[0][0]))
+                                    if isinstance(kv[0][0], str)
+                                    else (0, kv[0][0]),
+                                    kv[0][1], str(kv[0][2]))):
+                lines.append(
+                    "  " + _fmt(sc, 10) + _fmt(host, 6)
+                    + _fmt(row["sweeps"], 8) + _fmt(row["residual"], 11)
+                    + _fmt(row["dtype"], 10) + _fmt(row["verdict"], 13)
+                    + _fmt("yes" if row["quarantined"] else "-", 12)
+                    + _fmt(row["context"], 1).rstrip())
+        for ev in run["verdicts"]:
+            status = "converged" if ev.get("converged") else "NOT CONVERGED"
+            lines.append(f"  done {ev.get('context')}: {status} after "
+                         f"{ev.get('iterations')} iterations")
+        if run["regressions"]:
+            lines.append(f"  bench regressions: {run['regressions']}")
+    return "\n".join(lines) if lines else "(no events yet)"
+
+
+def watch_main(argv) -> int:
+    """`python -m aiyagari_tpu watch <ledger|shard|glob>...`: tail and
+    live-merge the shards, re-rendering the table every --interval
+    seconds. --once renders a single frame (scripts, tests); --json dumps
+    the folded state instead of the table."""
+    import argparse
+
+    from aiyagari_tpu.diagnostics.ledger import merge_ledgers
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu watch")
+    ap.add_argument("paths", nargs="+",
+                    help="ledger files, host shards, or glob patterns; a "
+                         "base path with on-disk .p{k} shards expands to "
+                         "them (re-expanded every frame, so shards from "
+                         "late-joining hosts appear live)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded state as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    def frame() -> str:
+        try:
+            events = merge_ledgers(args.paths, tolerate_torn=True)
+        except FileNotFoundError:
+            return f"(waiting for {', '.join(args.paths)})"
+        runs = build_state(events)
+        if args.json:
+            for run in runs.values():
+                run["hosts"] = sorted(run["hosts"])
+                run["rows"] = {f"{sc}/{host}/{ctx}": row
+                               for (sc, host, ctx), row
+                               in run["rows"].items()}
+            return json.dumps(runs, indent=2, default=str)
+        return render_state(runs)
+
+    if args.once:
+        print(frame())
+        return 0
+    try:
+        while True:
+            print(frame(), flush=True)
+            print("---", flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0

@@ -66,7 +66,8 @@ def _observe(led, name: str, **attrs):
     from aiyagari_tpu.diagnostics.ledger import activate
     from aiyagari_tpu.diagnostics.trace import collect_spans, span
 
-    with activate(led), collect_spans() as spans:
+    run_id = led.run_id if led is not None else None
+    with activate(led), collect_spans(run_id=run_id) as spans:
         try:
             with span(name, **attrs) as rec:
                 yield rec
@@ -239,6 +240,29 @@ def _sweep_mesh(backend: BackendConfig, mesh, led, *, entry: str):
         _observe_mesh(m, led, entry=entry)
         return m
     return None
+
+
+def _probe_skew(m, mesh_cfg, led, *, price: Optional[dict] = None) -> None:
+    """The pod observatory's mesh rendezvous probe (ISSUE 14): when the
+    activated MeshConfig asked for it, time one fenced per-axis barrier
+    probe HERE — at the dispatch boundary, once per mesh activation, never
+    inside the solve loop (DESIGN.md "Why skew probes live at the dispatch
+    boundary") — emitting `host_skew` ledger events, per-axis
+    aiyagari_host_skew_seconds gauges, a straggler verdict, and (when the
+    sweep's sizes are known) the reconciliation row against
+    roofline.mesh2d_collective_cost. Runs INSIDE the _observe scope so the
+    events carry the run's id."""
+    from aiyagari_tpu.config import MeshConfig
+
+    if m is None or not isinstance(mesh_cfg, MeshConfig) \
+            or not mesh_cfg.skew_probe:
+        return
+    from aiyagari_tpu.diagnostics.skew import probe_mesh_skew
+
+    if price is not None:
+        price = {**price, "scenarios": int(m.shape["scenarios"]),
+                 "grid": int(m.shape["grid"])}
+    probe_mesh_skew(m, price=price, ledger=led)
 
 
 def _resolve_rescue(rescue):
@@ -703,6 +727,7 @@ def sweep(
 
     rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, base, solver, equilibrium, entry="sweep")
+    mesh_cfg = mesh
     mesh = _sweep_mesh(backend, mesh, led, entry="sweep")
     with _observe(led, "aiyagari_sweep", scenarios=len(configs),
                   method=method, aggregation=aggregation):
@@ -717,6 +742,9 @@ def sweep(
             models = [AiyagariModel.from_config(c, dtype=_dtype_of(backend))
                       for c in configs]
             batch = stack_scenarios(models, mesh=mesh)
+            _probe_skew(mesh, mesh_cfg, led, price={
+                "S": batch.size, "N": int(batch.P.shape[-1]),
+                "na": int(batch.a_grid.shape[-1])})
             # Injected poisoned scenario (diagnostics/faults.py): one
             # lane's labor endowment is NaN'd AFTER stacking, so that
             # lane's excess demand is NaN every round — the per-scenario
@@ -983,6 +1011,7 @@ def sweep_transitions(
     rescue = _resolve_rescue(rescue)
     led = _as_ledger(ledger, model, transition, solver,
                      entry="sweep_transitions")
+    mesh_cfg = mesh
     mesh = _sweep_mesh(backend, mesh, led, entry="sweep_transitions")
     # Injected poisoned scenario (diagnostics/faults.py): one scenario's
     # shock is replaced with an untempered unit TFP drop whose path
@@ -1002,6 +1031,7 @@ def sweep_transitions(
         shocks_run[pi] = MITShock(param="tfp", size=float("nan"), rho=0.0)
     with _observe(led, "mit_transition_sweep", scenarios=len(shocks),
                   method=transition.method, T=transition.T):
+        _probe_skew(mesh, mesh_cfg, led)
         solver = _resolve_routes(solver, na=model.grid.n_points,
                                  dtype=_dtype_of(backend))
         with precision_scope(backend.dtype):

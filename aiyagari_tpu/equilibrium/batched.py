@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from aiyagari_tpu.config import EquilibriumConfig, SimConfig, SolverConfig
+from aiyagari_tpu.diagnostics.progress import heartbeat_stride, sweep_heartbeat
 from aiyagari_tpu.equilibrium.bisection import EquilibriumResult
 from aiyagari_tpu.models.aiyagari import AiyagariModel
 from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
@@ -690,6 +691,18 @@ def solve_equilibrium_sweep(
                                      initial=0.0)))
         newly = ~quar & np.isfinite(gaps) & (np.abs(gaps) < eq.tol)
         conv = conv | newly
+        # Pod-observatory heartbeat (diagnostics/progress.py): publish this
+        # round's per-scenario state on the active ledger at the configured
+        # stride — host code, so the compiled round program is untouched;
+        # the stride guard keeps the off path at one function call.
+        if heartbeat_stride():
+            sweep_heartbeat(
+                "aiyagari_sweep", round_index=rnd,
+                gap=[float(g) for g in gaps],
+                r=[float(v) for v in r_mid],
+                converged=[bool(c) for c in conv],
+                quarantined=[bool(q) for q in quar],
+                dtype=str(out["gap"].dtype))
         if (conv | quar).all():
             break
         step = ~(conv | quar)

@@ -21,7 +21,12 @@ from typing import Optional, Sequence
 
 import jax
 
-__all__ = ["DistributedContext", "initialize_distributed", "process_info"]
+__all__ = [
+    "DistributedContext",
+    "initialize_distributed",
+    "peek_process_topology",
+    "process_info",
+]
 
 
 @dataclass(frozen=True)
@@ -51,6 +56,27 @@ def _distributed_is_initialized() -> bool:
     if state is None:  # pragma: no cover - very old layouts
         from jax._src.distributed import global_state as state
     return getattr(state, "client", None) is not None
+
+
+def peek_process_topology() -> tuple:
+    """(process_index, process_count) WITHOUT initializing a backend.
+
+    jax.process_index()/process_count() force backend initialization on
+    first call — too heavy a side effect for the observability layers
+    (ledger event stamping, metrics host labels) that only need to know
+    whether this is a multi-host job. The distributed runtime's global
+    state answers that directly: multi-process requires
+    jax.distributed.initialize, whose client handle (the same predicate
+    _distributed_is_initialized reads) carries the topology. Single
+    process — including every not-yet-initialized interpreter — is
+    (0, 1)."""
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:  # pragma: no cover - very old layouts
+        from jax._src.distributed import global_state as state
+    if getattr(state, "client", None) is None:
+        return 0, 1
+    return (int(getattr(state, "process_id", 0) or 0),
+            int(getattr(state, "num_processes", 1) or 1))
 
 
 def initialize_distributed(

@@ -45,6 +45,7 @@ from aiyagari_tpu.config import (
     SolverConfig,
     TransitionConfig,
 )
+from aiyagari_tpu.diagnostics.progress import heartbeat_stride, sweep_heartbeat
 from aiyagari_tpu.models.aiyagari import AiyagariModel
 from aiyagari_tpu.sim.distribution import aggregate_capital
 from aiyagari_tpu.transition.jacobian import fake_news_jacobian, newton_jacobian
@@ -739,6 +740,16 @@ def solve_transitions_sweep(
                           "quarantined": int(np.sum(quar)),
                           "dtype": dt_name,
                           "seconds": time.perf_counter() - it_t0})
+        # Pod-observatory heartbeat (diagnostics/progress.py): per-scenario
+        # round state on the active ledger at the configured stride — host
+        # code only, the round program is untouched.
+        if heartbeat_stride():
+            sweep_heartbeat(
+                "mit_transition_sweep", round_index=rnd,
+                gap=[float(v) for v in max_d],
+                converged=[bool(c) for c in conv],
+                quarantined=[bool(q) for q in quar],
+                dtype=dt_name)
         if not final_stage and np.all(np.isfinite(max_d[live])):
             floor = (float(ladder.switch_ulp)
                      * float(jnp.finfo(dt).eps)

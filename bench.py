@@ -2215,24 +2215,24 @@ def bench_mesh2d(quick: bool, grid_size: int = 1024, scenarios: int = 8,
     return record
 
 
-def _bench_mesh2d_leg(args) -> dict:
-    """The mesh2d leg of a real `--metric all` battery, in its OWN
-    interpreter: the 8-virtual-device request is an XLA_FLAGS env flag that
-    must precede jax init and is process-wide, so forcing it in the battery
-    session would re-topologize every other metric's environment (see the
-    scoping note in main). The child (`--metric mesh2d`) forces it itself
-    and still freezes BENCH_r12_mesh2d.json; this parent relays its record
-    into the battery output."""
+def _bench_virtual_mesh_leg(args, metric: str) -> dict:
+    """A virtual-mesh leg (mesh2d / observatory) of a real `--metric all`
+    battery, in its OWN interpreter: the 8-virtual-device request is an
+    XLA_FLAGS env flag that must precede jax init and is process-wide, so
+    forcing it in the battery session would re-topologize every other
+    metric's environment (see the scoping note in main). The child
+    (`--metric <name>`) forces it itself and still freezes its artifact;
+    this parent relays the record into the battery output."""
     import subprocess
 
-    cmd = [sys.executable, os.path.abspath(__file__), "--metric", "mesh2d"]
+    cmd = [sys.executable, os.path.abspath(__file__), "--metric", metric]
     if args.quick:
         cmd.append("--quick")
     if args.platform:
         cmd += ["--platform", args.platform]
     if args.ledger:
         # Append-only JSONL (RunLedger opens "a" per event): the child's
-        # mesh_topology events interleave whole-line-safe with the parent's.
+        # events interleave whole-line-safe with the parent's.
         cmd += ["--ledger", args.ledger]
     out = subprocess.run(
         cmd, capture_output=True, text=True, timeout=900,
@@ -2242,7 +2242,7 @@ def _bench_mesh2d_leg(args) -> dict:
         if line.startswith('{"metric"'):
             return json.loads(line)
     raise RuntimeError(
-        f"mesh2d child produced no metric record (rc={out.returncode}):\n"
+        f"{metric} child produced no metric record (rc={out.returncode}):\n"
         f"{(out.stderr or out.stdout)[-800:]}")
 
 
@@ -2350,6 +2350,199 @@ def bench_attribution(quick: bool) -> dict:
     return record
 
 
+def bench_observatory(quick: bool, grid_size: int = 64,
+                      scenarios: int = 4) -> dict:
+    """Pod observatory (ISSUE 14): exercise the whole multi-host toolchain
+    on the 8-virtual-device mesh so an on-pod validation run inherits
+    working tooling instead of printf archaeology. Four legs, one record:
+
+      skew      — fenced per-axis rendezvous probes on the 2x4 mesh
+                  (diagnostics/skew.py), gauges + straggler verdicts;
+      heartbeat — the live-watch path: a ledger'd sweep with stride-1
+                  heartbeats, plus the structural pin that arming
+                  heartbeats changes NO compiled program (the stride is
+                  host-side fan-out only — jaxpr-identical, bitwise
+                  results);
+      merge     — a simulated two-host shard pair (shared run id,
+                  interleaved writes, one torn tail line) merged back
+                  into one ordered stream (ledger.merge_ledgers);
+      watch     — the `python -m aiyagari_tpu watch` table rendered from
+                  the sweep's own ledger (per-scenario/per-host rows).
+
+    value = the observatory wall (all four legs). EVERY run (the ci
+    preset included) freezes BENCH_r13_observatory.json — the ci battery
+    is the canonical producer, the attribution/mesh2d pattern."""
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu import dispatch
+    from aiyagari_tpu.config import (
+        AiyagariConfig,
+        EquilibriumConfig,
+        GridSpecConfig,
+        MeshConfig,
+        SolverConfig,
+    )
+    from aiyagari_tpu.diagnostics.ledger import (
+        RunLedger,
+        merge_ledgers,
+        read_ledger,
+    )
+    from aiyagari_tpu.diagnostics.progress import configure_heartbeat
+    from aiyagari_tpu.diagnostics.skew import SkewConfig, probe_mesh_skew
+    from aiyagari_tpu.diagnostics.watch import build_state, render_state
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.parallel.mesh import make_mesh_2d
+    from aiyagari_tpu.solvers.egm import (
+        initial_consumption_guess,
+        solve_aiyagari_egm,
+    )
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    ndev = len(jax.devices())
+    if ndev < 8:
+        return {"metric": "pod_observatory",
+                "skipped": f"needs >= 8 devices, found {ndev} (the battery "
+                           "forces the 8-virtual-device host mesh; a bare "
+                           "run must set XLA_FLAGS)"}
+    t_start = time.perf_counter()
+
+    # Leg 1 — skew probes on the 2-D mesh (host_skew events land on the
+    # battery's active ledger; gauges per axis).
+    mesh = make_mesh_2d(scenarios=2, grid=4)
+    probe = probe_mesh_skew(
+        mesh, config=SkewConfig(reps=2 if quick else 5),
+        price={"S": scenarios, "N": 7, "na": grid_size})
+    axes = {}
+    for rec in probe["axes"]:
+        axes[rec["axis"]] = {
+            "size": rec["size"],
+            "rendezvous_seconds": rec["rendezvous_seconds"],
+            "lag_spread_seconds": rec["lag_spread_seconds"],
+            "verdict": rec["verdict"],
+            "reconciliation": rec.get("reconciliation"),
+        }
+
+    # Leg 2 — heartbeat structural pins: with the in-jit progress callback
+    # COMPILED IN (progress_every > 0), arming the ledger heartbeat stride
+    # must not touch the program (it is host-side fan-out), and the
+    # iterates must stay bitwise identical.
+    dtype = jnp.float32 if jax.default_backend() == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    r = 0.04
+    w = float(wage_from_r(r, model.config.technology.alpha,
+                          model.config.technology.delta))
+    C0 = initial_consumption_guess(model.a_grid, model.s, r, w)
+
+    def egm_run(C):
+        return solve_aiyagari_egm(
+            C, model.a_grid, model.s, model.P, r, w, model.amin,
+            sigma=model.preferences.sigma, beta=model.preferences.beta,
+            tol=1e-6, max_iter=200, progress_every=5)
+
+    configure_heartbeat(0)
+    jaxpr_off = str(jax.make_jaxpr(egm_run)(C0))
+    sol_off = egm_run(C0)
+    configure_heartbeat(3)
+    jaxpr_on = str(jax.make_jaxpr(egm_run)(C0))
+    sol_on = egm_run(C0)
+    configure_heartbeat(0)
+    jax.effects_barrier()
+    off_jaxpr_identical = jaxpr_on == jaxpr_off
+    off_bit_identical = bool(
+        jnp.all(sol_on.policy_c == sol_off.policy_c)
+        & (sol_on.distance == sol_off.distance))
+
+    # Leg 3 — a ledger'd sweep with stride-1 heartbeats + the skew knob on
+    # its own 2-D mesh activation, then the watch table from its shards.
+    tmp = tempfile.mkdtemp(prefix="aiyagari-observatory-")
+    sweep_ledger = os.path.join(tmp, "sweep.jsonl")
+    betas = np.linspace(0.94, 0.955, scenarios)
+    configure_heartbeat(1)
+    try:
+        dispatch.sweep(
+            AiyagariConfig(grid=GridSpecConfig(n_points=grid_size)),
+            method="egm", beta=[float(b) for b in betas],
+            solver=SolverConfig(method="egm", tol=1e-6, max_iter=200),
+            equilibrium=EquilibriumConfig(max_iter=2 if quick else 3,
+                                          tol=0.0),
+            mesh=MeshConfig(scenarios=2, grid=4, skew_probe=True),
+            ledger=sweep_ledger)
+    finally:
+        configure_heartbeat(0)
+    sweep_events = read_ledger(sweep_ledger)
+    heartbeat_events = [e for e in sweep_events if e["kind"] == "heartbeat"]
+    state = build_state(sweep_events)
+    table = render_state(state)
+    watch_rows = sum(len(run["rows"]) for run in state.values())
+
+    # Leg 4 — simulated two-host shard merge: one run id across two
+    # shards, interleaved writes, a torn tail on the live shard.
+    base = os.path.join(tmp, "pod.jsonl")
+    run_id = "podrun0000000001"
+    led0 = RunLedger(base, run_id=run_id, process_index=0, process_count=2,
+                     meta={"entry": "observatory-sim"})
+    led1 = RunLedger(base, run_id=run_id, process_index=1, process_count=2,
+                     meta={"entry": "observatory-sim"})
+    written = 2  # the two run_start events
+    for k in range(4):
+        (led0 if k % 2 == 0 else led1).event(
+            "heartbeat", context="sim", round=k, gap=[0.1 * (k + 1)])
+        written += 1
+    with open(led1.path, "a") as f:
+        f.write('{"run_id": "podrun0000000001", "torn')
+    merged = merge_ledgers([base])
+    # Independent ordering pins (NOT a re-derivation of the merge's own
+    # sort key): timestamps must never go backwards, and each host's
+    # events must appear in their original per-shard sequence.
+    ts_ok = all(merged[i]["ts"] <= merged[i + 1]["ts"]
+                for i in range(len(merged) - 1))
+    host_seqs: dict = {}
+    for e in merged:
+        host_seqs.setdefault(e["process_index"], []).append(e["seq"])
+    seq_ok = all(s == sorted(set(s)) for s in host_seqs.values())
+    merge_rec = {
+        "shards": 2,
+        "events_written": written,
+        "events_merged": len(merged),
+        "run_joined": len({e["run_id"] for e in merged}) == 1,
+        "ordered": bool(ts_ok and seq_ok),
+        "torn_tolerated": len(merged) == written,
+    }
+
+    wall = time.perf_counter() - t_start
+    record = {
+        "metric": "pod_observatory",
+        "value": round(wall, 4),
+        "unit": "seconds",
+        "devices": ndev,
+        "scenarios": scenarios,
+        "grid": grid_size,
+        "platform": jax.default_backend(),
+        "skew": {"axes": axes, "processes": probe["processes"]},
+        "heartbeat": {
+            "off_jaxpr_identical": off_jaxpr_identical,
+            "off_bit_identical": off_bit_identical,
+            "events": len(heartbeat_events),
+            "per_scenario": all(
+                len(e.get("gap", [])) == scenarios
+                for e in heartbeat_events),
+        },
+        "merge": merge_rec,
+        "watch": {"rows": watch_rows, "rendered_chars": len(table)},
+        "sweep_event_kinds": sorted({e["kind"] for e in sweep_events}),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r13_observatory.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    return record
+
+
 def _run_in_child(timeout_s: float) -> int | None:
     """Re-exec this benchmark in a child process with a hard timeout and relay
     its JSON line. Returns the exit code, or None if the child timed out or
@@ -2440,7 +2633,7 @@ def main() -> int:
                              "transition", "accel", "precision",
                              "pushforward", "egm_fused", "telemetry",
                              "resilience", "mesh2d", "attribution",
-                             "analysis"],
+                             "observatory", "analysis"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -2479,6 +2672,15 @@ def main() -> int:
                          "fingerprint and spans) to a JSONL run ledger "
                          "(diagnostics/ledger.py); render with "
                          "`python -m aiyagari_tpu report <path>`")
+    ap.add_argument("--check-history", action="store_true",
+                    help="after the battery, diff this run's records "
+                         "against the frozen BENCH_r*.json trajectory "
+                         "(diagnostics/bench_history.py): structural "
+                         "regressions (parities, pins, table sizes, skip "
+                         "status) and catastrophic walls are flagged as a "
+                         "final bench_history_check record + "
+                         "bench_regression ledger events. On by default "
+                         "in --preset ci")
     ap.add_argument("--preset", choices=["ci"], default=None,
                     help="'ci': tiny-grid CPU smoke battery (in-process, no "
                          "device child) covering every bench code path that "
@@ -2501,8 +2703,13 @@ def main() -> int:
         args.quick = True
         args.grid = min(args.grid, 100)
         args.grid_scale = min(args.grid_scale, 8000)
+        # The bench-history watchdog is part of the ci contract: the
+        # battery's own records are diffed against the frozen trajectory
+        # before the process exits (tests/test_bench_ci.py gates zero
+        # findings).
+        args.check_history = True
 
-    if args.metric == "mesh2d" or args.preset == "ci":
+    if args.metric in ("mesh2d", "observatory") or args.preset == "ci":
         # The mesh2d battery needs a multi-device mesh; on hosts without
         # accelerators this is the 8-virtual-device CPU mesh (SURVEY.md
         # §4.4 — same shardings and collectives as a v5e-8 slice). Must
@@ -2582,12 +2789,15 @@ def main() -> int:
         "resilience": lambda: bench_resilience(args.quick,
                                                min(args.grid, 100)),
         # In-process only when this session WAS topologized for it (the
-        # mesh2d-only invocation or the ci smoke preset); a real `all`
-        # battery runs the leg in its own interpreter instead.
+        # metric-only invocation or the ci smoke preset); a real `all`
+        # battery runs the virtual-mesh legs in their own interpreters.
         "mesh2d": (lambda: bench_mesh2d(args.quick))
         if (args.metric == "mesh2d" or args.preset == "ci")
-        else (lambda: _bench_mesh2d_leg(args)),
+        else (lambda: _bench_virtual_mesh_leg(args, "mesh2d")),
         "attribution": lambda: bench_attribution(args.quick),
+        "observatory": (lambda: bench_observatory(args.quick))
+        if (args.metric == "observatory" or args.preset == "ci")
+        else (lambda: _bench_virtual_mesh_leg(args, "observatory")),
         "analysis": lambda: bench_analysis(),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
@@ -2604,13 +2814,14 @@ def main() -> int:
         # cost the static gate its record.
         names = (("vfi", "scale", "ge", "sweep", "transition", "accel",
                   "precision", "pushforward", "egm_fused", "telemetry",
-                  "resilience", "mesh2d", "attribution", "analysis")
+                  "resilience", "mesh2d", "attribution", "observatory",
+                  "analysis")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
                  "transition", "accel", "precision", "pushforward",
                  "egm_fused", "telemetry", "resilience", "mesh2d",
-                 "attribution", "ks_fine", "scale_vfi")
+                 "attribution", "observatory", "ks_fine", "scale_vfi")
     else:
         names = (args.metric,)
     led = None
@@ -2621,6 +2832,17 @@ def main() -> int:
                         meta={"entry": "bench", "metric": args.metric,
                               "preset": args.preset or "",
                               "platform": args.platform or "auto"})
+    produced: list = []
+    history = None
+    if getattr(args, "check_history", False):
+        # Snapshot the frozen trajectory BEFORE the battery runs: several
+        # legs (mesh2d, attribution, observatory) refreeze their own
+        # BENCH_r*.json in place, and a watchdog that read the refrozen
+        # files afterwards would only ever compare a record against
+        # itself — a regression could never be flagged.
+        from aiyagari_tpu.diagnostics.bench_history import load_history
+
+        history = load_history(os.path.dirname(os.path.abspath(__file__)))
     for name in names:
         try:
             if led is not None:
@@ -2643,7 +2865,32 @@ def main() -> int:
             result = {"metric": name, "skipped": "oom", "error": msg[:300]}
         if led is not None:
             led.metric(result)
+        produced.append(result)
         print(json.dumps(result), flush=True)
+
+    if history is not None:
+        # The bench-history watchdog (ISSUE 14 satellite): diff what this
+        # battery just produced against the trajectory as it stood BEFORE
+        # this run — any finding is a real structural drift from the last
+        # frozen round (or a catastrophic wall).
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        findings, matched = check_records(produced, history=history)
+        hist_rec = {
+            "metric": "bench_history_check",
+            "value": float(len(findings)),
+            "unit": "findings",
+            "structural_findings": sum(
+                1 for f in findings if f["severity"] == "structural"),
+            "matched_metrics": matched,
+            "history_metrics": len(history),
+            "findings": findings,
+        }
+        if led is not None:
+            for f in findings:
+                led.event("bench_regression", **f)
+            led.metric(hist_rec)
+        print(json.dumps(hist_rec), flush=True)
     return 0
 
 

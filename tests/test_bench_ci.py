@@ -20,7 +20,8 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
               "precision", "pushforward", "egm_fused", "telemetry",
-              "resilience", "mesh2d", "attribution", "analysis")
+              "resilience", "mesh2d", "attribution", "observatory",
+              "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -36,6 +37,10 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         f"stdout:\n{out.stdout[-2000:]}\nstderr:\n{out.stderr[-2000:]}")
     records = [json.loads(l) for l in out.stdout.splitlines()
                if l.startswith('{"metric"')]
+    # The ci preset closes with the bench-history watchdog's own record
+    # (ISSUE 14 satellite) — split it off the per-metric battery.
+    hist = next(r for r in records if r["metric"] == "bench_history_check")
+    records = [r for r in records if r["metric"] != "bench_history_check"]
     assert len(records) == len(CI_METRICS), (
         f"expected {len(CI_METRICS)} metric records, got {len(records)}:\n"
         + out.stdout[-2000:])
@@ -44,14 +49,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-10]
+    tr = records[-11]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-9]
+    ac = records[-10]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -65,7 +70,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-8]
+    pr = records[-9]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -89,7 +94,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-7]
+    pw = records[-8]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -117,7 +122,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The host WALL is advisory only: off-TPU the fused route runs the
     # Pallas interpreter — a correctness vehicle — so no speedup is gated
     # here; the speedup claim is TPU-side (docs/USAGE.md).
-    ef = records[-6]
+    ef = records[-7]
     assert ef["metric"].startswith("egm_fused_sweep")
     assert set(ef["routes"]) == {"xla", "pallas_fused"}
     for name, route in ef["routes"].items():
@@ -143,7 +148,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-5]
+    tm = records[-6]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -160,7 +165,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # sweep quarantined EXACTLY its one poisoned lane with every other
     # lane parity-equal to the clean sweep, and the quarantine machinery
     # costs <= 1.1x a clean sweep (host-side masks only).
-    rs = records[-4]
+    rs = records[-5]
     assert rs["metric"] == "resilience_fault_battery"
     assert rs["value"] == 1.0, rs
     assert rs["recovered"] == rs["points"]
@@ -191,7 +196,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # measure partitioning overhead at equal total work (the frozen
     # BENCH_r12_mesh2d.json documents the measured ordering); the
     # chips-scale claim rides the priced-bytes column.
-    m2 = records[-3]
+    m2 = records[-4]
     assert m2["metric"] == "mesh2d_sweep"
     assert m2["devices"] >= 8, m2
     assert set(m2["topologies"]) == {"unsharded", "scenarios8", "grid8",
@@ -233,7 +238,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # stops fusing and materializes its broadcasts lands at 10-100x), a
     # measured probe with per-candidate walls for every contested knob,
     # and the frozen BENCH_r11_attribution.json artifact.
-    at = records[-2]
+    at = records[-3]
     assert at["metric"] == "route_attribution"
     assert at["value"] >= 10, at
     assert not at["flagged"], at
@@ -264,6 +269,51 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert frozen["metric"] == "route_attribution"
     assert len(frozen["programs"]) >= 10
     assert len(frozen["knobs"]) >= 3
+    # The observatory record carries the ISSUE 14 acceptance telemetry:
+    # the whole pod toolchain exercised on the 8-virtual-device mesh.
+    # Skew probes timed a fenced rendezvous on BOTH mesh axes with a
+    # priced reconciliation row each; arming ledger heartbeats changed NO
+    # compiled program (jaxpr-identical, bitwise iterates); the simulated
+    # two-host shard pair merged back into one run-id-joined, ordered
+    # stream with its torn tail tolerated; and the watch table rendered a
+    # row per scenario.
+    ob = records[-2]
+    assert ob["metric"] == "pod_observatory"
+    assert ob["devices"] >= 8, ob
+    assert set(ob["skew"]["axes"]) == {"scenarios", "grid"}
+    for axis, skew in ob["skew"]["axes"].items():
+        assert skew["rendezvous_seconds"] > 0, (axis, skew)
+        assert skew["verdict"] in ("balanced", "straggler"), (axis, skew)
+        rc = skew["reconciliation"]
+        assert rc["link"] == ("dcn" if axis == "scenarios" else "ici")
+        assert rc["priced_seconds"] > 0, (axis, rc)
+    hb = ob["heartbeat"]
+    assert hb["off_jaxpr_identical"] is True, hb
+    assert hb["off_bit_identical"] is True, hb
+    assert hb["events"] > 0 and hb["per_scenario"] is True, hb
+    mg = ob["merge"]
+    assert mg["shards"] == 2 and mg["run_joined"] is True, mg
+    assert mg["ordered"] is True and mg["torn_tolerated"] is True, mg
+    assert mg["events_merged"] == mg["events_written"], mg
+    assert ob["watch"]["rows"] >= ob["scenarios"], ob
+    assert {"heartbeat", "host_skew", "mesh_topology"} <= \
+        set(ob["sweep_event_kinds"]), ob
+    # The frozen artifact the ci battery owns (ISSUE 14 acceptance).
+    with open(os.path.join(bench_dir, "BENCH_r13_observatory.json")) as f:
+        frozen_ob = json.load(f)
+    assert frozen_ob["metric"] == "pod_observatory"
+    assert set(frozen_ob["skew"]["axes"]) == {"scenarios", "grid"}
+    # The bench-history watchdog ran against the frozen BENCH_r*.json
+    # trajectory and found NOTHING: zero structural regressions is part
+    # of the ci contract (ISSUE 14 acceptance) — a blown parity band, a
+    # shrunken attribution table, a heartbeat pin gone false, or a
+    # formerly-working metric now skipping all land here.
+    assert hist["value"] == 0, hist
+    assert hist["structural_findings"] == 0, hist
+    assert hist["findings"] == [], hist
+    # The battery's in-ci artifacts have frozen counterparts to check.
+    assert {"mesh2d_sweep", "route_attribution", "pod_observatory"} <= \
+        set(hist["matched_metrics"]), hist
     # The analysis record carries the ISSUE 9 acceptance gate: the static
     # analyzer ran over the kernel zoo + source tree and found NOTHING —
     # a scatter regression, a precision leak, a host sync in a loop, a
@@ -283,9 +333,12 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     events = read_ledger(ledger_path)
     assert events[0]["kind"] == "run_start"
     metric_events = [e for e in events if e["kind"] == "metric"]
-    assert len(metric_events) == len(CI_METRICS)
-    assert [e["metric"] for e in metric_events] == [r["metric"]
-                                                    for r in records]
+    # Every battery record plus the closing bench_history_check record.
+    assert len(metric_events) == len(CI_METRICS) + 1
+    assert [e["metric"] for e in metric_events] == \
+        [r["metric"] for r in records] + ["bench_history_check"]
+    # A clean battery writes no bench_regression events.
+    assert sum(e["kind"] == "bench_regression" for e in events) == 0
     # run_analysis also emitted its own `analysis` event (per-rule counts)
     # on the active ledger — the ISSUE 9 observability satellite.
     analysis_events = [e for e in events if e["kind"] == "analysis"]

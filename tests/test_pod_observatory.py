@@ -1,0 +1,727 @@
+"""Pod observatory (ISSUE 14): host-stamped sharded ledgers + merge, the
+mesh skew / straggler probes, heartbeat off-path pins, the live watch CLI,
+run-id-keyed span scoping, and the bench-history watchdog.
+
+The multi-host surfaces run single-process here by design: every sharding
+behavior has an explicit-process-index simulation path (RunLedger takes
+`process_index`/`process_count` so two "hosts" can write shards from one
+interpreter), the skew probes run on the 8-virtual-device CPU mesh the
+conftest forces (same collectives as a v5e-8 slice), and the merge/watch
+layer is pure host-side file consumption either way — so the on-pod
+validation run inherits a toolchain whose every piece is already pinned.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aiyagari_tpu.diagnostics.ledger import (
+    RunLedger,
+    activate,
+    merge_ledgers,
+    read_ledger,
+    shard_path,
+    shard_paths,
+)
+
+
+def _write_pod_shards(tmp_path, *, torn=False):
+    """Two simulated host shards of ONE run (shared run id, interleaved
+    timestamps via alternating writers), optionally with a torn tail line
+    on host 1's live shard. Returns (base_path, events_written)."""
+    base = tmp_path / "ledger.jsonl"
+    run_id = "podrun0000000001"
+    leds = [RunLedger(base, run_id=run_id, process_index=k, process_count=2,
+                      meta={"entry": "sim"}) for k in (0, 1)]
+    written = 2  # the two run_start events
+    for k in range(6):
+        leds[k % 2].event("heartbeat", context="sim", round=k,
+                          gap=[0.1 * (k + 1), 0.2 * (k + 1)])
+        written += 1
+    if torn:
+        with open(leds[1].path, "a") as f:
+            f.write('{"run_id": "podrun0000000001", "kind": "torn')
+    return base, written
+
+
+class TestShardedLedger:
+    def test_events_carry_host_stamp_and_runtime_identity(self, tmp_path):
+        led = RunLedger(tmp_path / "l.jsonl", meta={"entry": "t"})
+        led.event("verdict", converged=True)
+        events = read_ledger(led.path)
+        for ev in events:
+            assert ev["process_index"] == 0
+            assert ev["process_count"] == 1
+        start = events[0]
+        assert start["kind"] == "run_start"
+        # The runtime identity a merged pod ledger needs per shard.
+        assert start["jax_version"] == jax.__version__
+
+    def test_single_process_writes_the_base_path(self, tmp_path):
+        led = RunLedger(tmp_path / "l.jsonl")
+        assert led.path == tmp_path / "l.jsonl"
+
+    def test_explicit_process_index_selects_the_shard_file(self, tmp_path):
+        led = RunLedger(tmp_path / "l.jsonl", process_index=3,
+                        process_count=4)
+        assert led.path == tmp_path / "l.p3.jsonl"
+        assert led.process_index == 3 and led.process_count == 4
+        ev = read_ledger(led.path)[0]
+        assert ev["process_index"] == 3 and ev["process_count"] == 4
+
+    def test_shard_path_preserves_the_jsonl_suffix(self, tmp_path):
+        assert shard_path(tmp_path / "run.jsonl", 2).name == "run.p2.jsonl"
+        assert shard_path(tmp_path / "run", 0).name == "run.p0"
+
+    def test_shard_paths_discovers_base_plus_shards_in_index_order(
+            self, tmp_path):
+        base = tmp_path / "run.jsonl"
+        base.write_text('{"run_id": "x", "seq": 0, "ts": 1.0}\n')
+        for k in (10, 1, 0):
+            shard_path(base, k).write_text(
+                f'{{"run_id": "x", "seq": 0, "ts": 1.0, '
+                f'"process_index": {k}}}\n')
+        found = shard_paths(base)
+        assert found[0] == base
+        assert [p.name for p in found[1:]] == [
+            "run.p0.jsonl", "run.p1.jsonl", "run.p10.jsonl"]
+
+    def test_shard_discovery_ignores_non_shard_siblings(self, tmp_path):
+        base = tmp_path / "run.jsonl"
+        base.write_text('{"run_id": "x", "seq": 0, "ts": 1.0}\n')
+        # Same prefix, not an integer-indexed host shard.
+        (tmp_path / "run.prod.jsonl").write_text("{}\n")
+        assert shard_paths(base) == [base]
+
+    def test_shard_glob_survives_p0_in_directory_names(self, tmp_path):
+        # A ".p0" in a DIRECTORY component (or the stem) must not corrupt
+        # the shard glob into matching sibling directories.
+        exp = tmp_path / "exp.p0"
+        other = tmp_path / "exp.px"
+        exp.mkdir()
+        other.mkdir()
+        base = exp / "ledger.jsonl"
+        led = RunLedger(base, run_id="e" * 16, process_index=1,
+                        process_count=2)
+        (other / "ledger.p1.jsonl").write_text('{"run_id": "z"}\n')
+        found = shard_paths(base)
+        assert found == [led.path]
+        merged = merge_ledgers([base])
+        assert {e["run_id"] for e in merged} == {"e" * 16}
+
+
+class TestMergeLedgers:
+    def test_two_shard_round_trip_is_run_joined_and_ordered(self, tmp_path):
+        base, written = _write_pod_shards(tmp_path)
+        merged = merge_ledgers([base])
+        assert len(merged) == written
+        # Run-id joined: both hosts' shards collapse into ONE run.
+        assert {e["run_id"] for e in merged} == {"podrun0000000001"}
+        assert {e["process_index"] for e in merged} == {0, 1}
+        # Monotonically ordered: timestamps ascend, ties broken by host
+        # then per-host sequence, so each shard's own order is preserved.
+        keys = [(e["ts"], e["process_index"], e["seq"]) for e in merged]
+        assert keys == sorted(keys)
+        for host in (0, 1):
+            seqs = [e["seq"] for e in merged if e["process_index"] == host]
+            assert seqs == sorted(seqs)
+
+    def test_torn_tail_line_is_tolerated_on_live_shards(self, tmp_path):
+        base, written = _write_pod_shards(tmp_path, torn=True)
+        merged = merge_ledgers([base])
+        assert len(merged) == written   # the torn in-flight line is skipped
+        with pytest.raises(json.JSONDecodeError):
+            merge_ledgers([base], tolerate_torn=False)
+
+    def test_torn_line_mid_file_is_always_corruption(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        p.write_text('{"run_id": "x", "seq": 0, "ts": 1.0}\n'
+                     '{"torn\n'
+                     '{"run_id": "x", "seq": 1, "ts": 2.0}\n')
+        with pytest.raises(json.JSONDecodeError):
+            merge_ledgers([p], tolerate_torn=True)
+
+    def test_base_path_without_a_base_file_expands_to_shards(self, tmp_path):
+        # The pod case: the operator names `ledger.jsonl`, only the
+        # per-host `ledger.p{k}.jsonl` shards exist on disk.
+        base, written = _write_pod_shards(tmp_path)
+        assert not base.exists()
+        assert len(merge_ledgers([base])) == written
+        # And a glob pattern reaches the same files.
+        assert len(merge_ledgers([str(tmp_path / "ledger.p*.jsonl")])) \
+            == written
+
+    def test_duplicate_paths_are_deduplicated(self, tmp_path):
+        base, written = _write_pod_shards(tmp_path)
+        shards = [str(p) for p in shard_paths(base)]
+        assert len(merge_ledgers([base, *shards])) == written
+
+    def test_distinct_runs_stay_grouped_in_first_appearance_order(
+            self, tmp_path):
+        a = RunLedger(tmp_path / "a.jsonl", run_id="a" * 16)
+        b = RunLedger(tmp_path / "b.jsonl", run_id="b" * 16)
+        a.event("verdict", converged=True)
+        b.event("verdict", converged=False)
+        merged = merge_ledgers([a.path, b.path])
+        run_seq = [e["run_id"] for e in merged]
+        # Each run's events are contiguous, runs ordered by first ts.
+        assert run_seq == ["a" * 16] * 2 + ["b" * 16] * 2
+
+    def test_missing_path_is_loud(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            merge_ledgers([tmp_path / "nope.jsonl"])
+
+
+class TestFollowMode:
+    def test_follow_tails_appended_events_and_buffers_torn_lines(
+            self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        p.write_text('{"seq": 0}\n')
+        state = {"done": False}
+        tail = read_ledger(p, follow=True, poll_seconds=0.01,
+                           stop=lambda: state["done"])
+        assert next(tail) == {"seq": 0}
+        # A torn line stays buffered until its writer finishes it.
+        with open(p, "a") as f:
+            f.write('{"seq": 1')
+            f.flush()
+            f.write(', "kind": "late"}\n')
+        assert next(tail) == {"seq": 1, "kind": "late"}
+        state["done"] = True
+        assert list(tail) == []
+
+
+class TestSkewProbes:
+    def test_straggler_verdict_band(self):
+        from aiyagari_tpu.diagnostics.skew import SkewConfig, straggler_verdict
+
+        cfg = SkewConfig(straggler_band_seconds=5e-3,
+                         straggler_band_factor=3.0)
+        # Inside the absolute floor: scheduler noise, never a straggler.
+        v = straggler_verdict([0.001, 0.002, 0.003], 1e-4, cfg)
+        assert v["verdict"] == "balanced" and v["straggler"] is None
+        # One host far outside the band: named by index.
+        v = straggler_verdict([0.001, 0.002, 0.5], 1e-4, cfg)
+        assert v["verdict"] == "straggler" and v["straggler"] == 2
+        assert v["lag_spread_seconds"] > v["band_seconds"]
+        # The band scales with the measured rendezvous itself: the same
+        # spread is balanced when the collective is slow anyway.
+        v = straggler_verdict([0.001, 0.002, 0.5], 0.2, cfg)
+        assert v["verdict"] == "balanced"
+        # Degenerate inputs.
+        assert straggler_verdict([], 1.0, cfg)["verdict"] == "balanced"
+        assert straggler_verdict([9.9], 1e-4, cfg)["verdict"] == "balanced"
+
+    def test_skew_config_validates(self):
+        from aiyagari_tpu.diagnostics.skew import SkewConfig
+
+        with pytest.raises(ValueError):
+            SkewConfig(reps=0)
+        with pytest.raises(ValueError):
+            SkewConfig(straggler_band_factor=-1.0)
+
+    def test_probe_emits_events_and_gauges_for_both_axes(self, tmp_path):
+        from aiyagari_tpu.diagnostics import metrics
+        from aiyagari_tpu.diagnostics.skew import SkewConfig, probe_mesh_skew
+        from aiyagari_tpu.parallel.mesh import make_mesh_2d
+
+        led = RunLedger(tmp_path / "l.jsonl")
+        mesh = make_mesh_2d(scenarios=2, grid=4)
+        out = probe_mesh_skew(mesh, config=SkewConfig(reps=2),
+                              price={"S": 4, "N": 7, "na": 64},
+                              ledger=led)
+        assert out["mesh"] == {"scenarios": 2, "grid": 4}
+        by_axis = {r["axis"]: r for r in out["axes"]}
+        assert set(by_axis) == {"scenarios", "grid"}
+        events = [e for e in read_ledger(led.path)
+                  if e["kind"] == "host_skew"]
+        assert {e["axis"] for e in events} == {"scenarios", "grid"}
+        for rec in by_axis.values():
+            assert rec["rendezvous_seconds"] > 0
+            assert rec["reps"] == 2
+            assert rec["verdict"] in ("balanced", "straggler")
+            assert len(rec["arrival_lag_seconds"]) == rec["processes"] == 1
+            # The priced reconciliation row: scenario axis against DCN
+            # sync, grid axis against per-lane-sweep ICI bytes.
+            rc = rec["reconciliation"]
+            assert rc["link"] == ("dcn" if rec["axis"] == "scenarios"
+                                  else "ici")
+            assert rc["measured_seconds"] > 0
+            # Per-axis gauge, one series per axis label (the event rounds
+            # to microseconds; the gauge keeps the raw wall).
+            g = metrics.gauge("aiyagari_host_skew_seconds", axis=rec["axis"])
+            assert g.value == pytest.approx(rec["rendezvous_seconds"],
+                                            abs=1e-6)
+
+    def test_dispatch_sweep_probe_knob_lands_host_skew_events(
+            self, tmp_path):
+        from aiyagari_tpu.config import (
+            AiyagariConfig,
+            EquilibriumConfig,
+            GridSpecConfig,
+            MeshConfig,
+            SolverConfig,
+        )
+        from aiyagari_tpu.diagnostics.progress import configure_heartbeat
+        from aiyagari_tpu.dispatch import sweep
+
+        # ONE sweep (shape-matched to test_mesh2d's 2x4 sweep for
+        # compiled-program reuse under tier-1's wall budget) serves both
+        # dispatch-wiring pins: the skew-probe knob and the lockstep
+        # per-scenario heartbeats.
+        betas = [0.94, 0.95, 0.955, 0.96]
+        path = tmp_path / "sweep.jsonl"
+        configure_heartbeat(1)
+        sweep(AiyagariConfig(grid=GridSpecConfig(n_points=64)),
+              method="egm", beta=betas,
+              solver=SolverConfig(method="egm"),
+              equilibrium=EquilibriumConfig(max_iter=2, tol=0.0),
+              mesh=MeshConfig(scenarios=2, grid=4, skew_probe=True),
+              ledger=path)
+        events = read_ledger(path)
+        skews = [e for e in events if e["kind"] == "host_skew"]
+        assert {e["axis"] for e in skews} == {"scenarios", "grid"}
+        # Probe events ride the run's own ledger scope (one shared run id)
+        # and carry the priced reconciliation (the sweep knows its sizes).
+        assert {e["run_id"] for e in events} == {events[0]["run_id"]}
+        for e in skews:
+            assert e["reconciliation"]["measured_seconds"] > 0
+        # The lockstep GE round loop heartbeat at stride 1, one entry per
+        # scenario lane per round.
+        beats = [e for e in events if e["kind"] == "heartbeat"
+                 and e["context"] == "aiyagari_sweep"]
+        assert beats, "lockstep GE rounds must heartbeat at stride 1"
+        for ev in beats:
+            assert len(ev["gap"]) == len(betas)
+            assert len(ev["converged"]) == len(betas)
+            assert len(ev["r"]) == len(betas)
+
+    def test_mesh_config_validates_skew_probe(self):
+        from aiyagari_tpu.config import MeshConfig
+
+        with pytest.raises(ValueError):
+            MeshConfig(skew_probe=1)
+
+
+def _egm_run(model, progress_every=5):
+    from aiyagari_tpu.solvers.egm import (
+        initial_consumption_guess,
+        solve_aiyagari_egm,
+    )
+    from aiyagari_tpu.utils.firm import wage_from_r
+
+    r = 0.04
+    w = float(wage_from_r(r, model.config.technology.alpha,
+                          model.config.technology.delta))
+    C0 = initial_consumption_guess(model.a_grid, model.s, r, w)
+
+    def run(C):
+        return solve_aiyagari_egm(
+            C, model.a_grid, model.s, model.P, r, w, model.amin,
+            sigma=model.preferences.sigma, beta=model.preferences.beta,
+            tol=1e-6, max_iter=100, progress_every=progress_every)
+
+    return run, C0
+
+
+class TestHeartbeat:
+    def test_configure_heartbeat_validates_and_reset_disarms(self):
+        from aiyagari_tpu.diagnostics.progress import (
+            configure_heartbeat,
+            heartbeat_stride,
+            reset,
+        )
+
+        with pytest.raises(ValueError):
+            configure_heartbeat(-1)
+        configure_heartbeat(4)
+        assert heartbeat_stride() == 4
+        reset()
+        assert heartbeat_stride() == 0
+
+    def test_off_path_is_jaxpr_and_bitwise_identical(self):
+        # THE telemetry-discipline pin: arming the heartbeat stride is
+        # host-side fan-out of already-delivered progress records — the
+        # traced program depends on progress_every alone, so stride on/off
+        # programs are the same jaxpr and the iterates bitwise equal.
+        from aiyagari_tpu.diagnostics.progress import configure_heartbeat
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+        run, C0 = _egm_run(aiyagari_preset(grid_size=40))
+        configure_heartbeat(0)
+        jaxpr_off = str(jax.make_jaxpr(run)(C0))
+        sol_off = run(C0)
+        configure_heartbeat(3)
+        jaxpr_on = str(jax.make_jaxpr(run)(C0))
+        sol_on = run(C0)
+        jax.effects_barrier()
+        assert jaxpr_on == jaxpr_off
+        assert bool(jnp.all(sol_on.policy_c == sol_off.policy_c))
+        assert float(sol_on.distance) == float(sol_off.distance)
+
+    def test_strided_records_land_on_the_active_ledger(self, tmp_path):
+        from aiyagari_tpu.diagnostics.progress import configure_heartbeat
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+        run, C0 = _egm_run(aiyagari_preset(grid_size=40), progress_every=5)
+        led = RunLedger(tmp_path / "l.jsonl")
+        configure_heartbeat(2)
+        with activate(led):
+            sol = run(C0)
+            jax.block_until_ready(sol.policy_c)
+            jax.effects_barrier()
+        beats = [e for e in read_ledger(led.path) if e["kind"] == "heartbeat"]
+        delivered = int(sol.iterations) // 5
+        assert len(beats) == (delivered + 1) // 2   # every 2nd, from the 1st
+        for ev in beats:
+            assert ev["context"] == "aiyagari_egm"
+            assert ev["iteration"] % 5 == 0
+            assert ev["distance"] > 0
+            # The live stage-dtype signal + the host stamp.
+            assert ev["dtype"] == str(C0.dtype)
+            assert ev["process_index"] == 0
+
+    def test_off_means_zero_ledger_interaction(self, tmp_path):
+        from aiyagari_tpu.diagnostics.progress import heartbeat_stride
+        from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+        assert heartbeat_stride() == 0   # conftest reset
+        run, C0 = _egm_run(aiyagari_preset(grid_size=40), progress_every=5)
+        led = RunLedger(tmp_path / "l.jsonl")
+        with activate(led):
+            jax.block_until_ready(run(C0).policy_c)
+            jax.effects_barrier()
+        assert all(e["kind"] != "heartbeat" for e in read_ledger(led.path))
+
+    def test_sweep_heartbeat_strides_rounds_onto_the_ledger(self, tmp_path):
+        from aiyagari_tpu.diagnostics.progress import (
+            configure_heartbeat,
+            sweep_heartbeat,
+        )
+
+        led = RunLedger(tmp_path / "l.jsonl")
+        configure_heartbeat(2)
+        with activate(led):
+            for rnd in range(5):
+                sweep_heartbeat("aiyagari_sweep", round_index=rnd,
+                                gap=[0.1, 0.2], converged=[False, True],
+                                quarantined=[False, False], dtype="float64")
+        beats = [e for e in read_ledger(led.path) if e["kind"] == "heartbeat"]
+        assert [e["round"] for e in beats] == [0, 2, 4]
+        assert beats[0]["gap"] == [0.1, 0.2]
+        assert beats[0]["converged"] == [False, True]
+
+class TestWatch:
+    def _state(self, tmp_path):
+        from aiyagari_tpu.diagnostics.watch import build_state
+
+        base, _ = _write_pod_shards(tmp_path)
+        leds = {k: RunLedger(base, run_id="podrun0000000001",
+                             process_index=k, process_count=2)
+                for k in (0,)}
+        leds[0].event("host_skew", axis="scenarios", size=2,
+                      rendezvous_seconds=0.001, lag_spread_seconds=0.5,
+                      verdict="straggler", straggler=1)
+        leds[0].event("quarantine", scenario=1, verdict="rescued")
+        leds[0].event("verdict", context="aiyagari_sweep", converged=True,
+                      iterations=6)
+        return build_state(merge_ledgers([base]))
+
+    def test_build_state_folds_rows_skew_and_verdicts(self, tmp_path):
+        runs = self._state(tmp_path)
+        assert set(runs) == {"podrun0000000001"}
+        run = runs["podrun0000000001"]
+        assert run["hosts"] == {0, 1}
+        # Per-scenario/per-host/per-context rows from the list-shaped
+        # heartbeats: 2 scenarios x 2 writing hosts, one context.
+        assert set(run["rows"]) == {(0, 0, "sim"), (0, 1, "sim"),
+                                    (1, 0, "sim"), (1, 1, "sim")}
+        # The freshest heartbeat wins the row; a context-less quarantine
+        # event overrides the lane's verdict in every context.
+        assert run["rows"][(1, 0, "sim")]["verdict"] == "rescued"
+        assert run["skew"][0]["straggler"] == 1
+        assert run["verdicts"][0]["converged"] is True
+
+    def test_render_state_is_a_per_scenario_per_host_table(self, tmp_path):
+        from aiyagari_tpu.diagnostics.watch import render_state
+
+        text = render_state(self._state(tmp_path))
+        assert "hosts=2" in text
+        assert "scenario  host  sweeps  residual" in text
+        assert "skew scenarios" in text and "straggler (host 1)" in text
+        assert "done aiyagari_sweep: converged after 6 iterations" in text
+        # One row per (scenario, host) pair.
+        assert len([ln for ln in text.splitlines()
+                    if ln.startswith("  0 ") or ln.startswith("  1 ")]) == 4
+
+    def test_watch_cli_once_renders_and_json_folds(self, tmp_path, capsys):
+        from aiyagari_tpu.diagnostics.watch import watch_main
+
+        base, _ = _write_pod_shards(tmp_path, torn=True)
+        assert watch_main(["--once", str(base)]) == 0
+        out = capsys.readouterr().out
+        assert "run podrun0000000001" in out
+        assert "scenario  host" in out
+        assert watch_main(["--once", "--json", str(base)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["podrun0000000001"]["hosts"] == [0, 1]
+        assert "0/0/sim" in doc["podrun0000000001"]["rows"]
+
+    def test_batched_iteration_lists_index_per_lane(self, tmp_path):
+        # A vmapped solve's batched progress record carries list-shaped
+        # iteration AND distance — each lane's row gets ITS entry, not
+        # the whole list.
+        from aiyagari_tpu.diagnostics.watch import build_state
+
+        led = RunLedger(tmp_path / "l.jsonl", run_id="f" * 16)
+        led.event("heartbeat", context="aiyagari_egm", iteration=[12, 9],
+                  distance=[1e-3, 2e-4], dtype="float64")
+        run = build_state(read_ledger(led.path))["f" * 16]
+        assert run["rows"][(0, 0, "aiyagari_egm")]["sweeps"] == 12
+        assert run["rows"][(1, 0, "aiyagari_egm")]["sweeps"] == 9
+        assert run["rows"][(1, 0, "aiyagari_egm")]["residual"] == 2e-4
+
+    def test_rows_sort_numerically_past_ten_scenarios(self, tmp_path):
+        from aiyagari_tpu.diagnostics.watch import build_state, render_state
+
+        led = RunLedger(tmp_path / "l.jsonl", run_id="g" * 16)
+        led.event("heartbeat", context="s", round=1,
+                  gap=[0.1] * 12, dtype="float64")
+        text = render_state(build_state(read_ledger(led.path)))
+        order = [int(ln.split()[0]) for ln in text.splitlines()
+                 if ln.strip() and ln.split()[0].isdigit()]
+        assert order == list(range(12))
+
+    def test_distinct_contexts_keep_distinct_rows(self, tmp_path):
+        # One run carrying two sweep contexts (a transition sweep's
+        # stationary-anchor GE rounds + its own rounds) must not fold
+        # them into one flip-flopping row.
+        from aiyagari_tpu.diagnostics.watch import build_state
+
+        led = RunLedger(tmp_path / "l.jsonl", run_id="d" * 16)
+        led.event("heartbeat", context="aiyagari_sweep", round=1,
+                  gap=[0.5], dtype="float64")
+        led.event("heartbeat", context="mit_transition_sweep", round=2,
+                  gap=[0.25], dtype="float64")
+        run = build_state(read_ledger(led.path))["d" * 16]
+        assert set(run["rows"]) == {(0, 0, "aiyagari_sweep"),
+                                    (0, 0, "mit_transition_sweep")}
+        assert run["rows"][(0, 0, "aiyagari_sweep")]["residual"] == 0.5
+        assert run["rows"][(0, 0, "mit_transition_sweep")][
+            "residual"] == 0.25
+
+    def test_watch_cli_waits_for_missing_paths(self, tmp_path, capsys):
+        from aiyagari_tpu.diagnostics.watch import watch_main
+
+        assert watch_main(["--once", str(tmp_path / "nope.jsonl")]) == 0
+        assert "waiting for" in capsys.readouterr().out
+
+    def test_single_process_ledger_degrades_to_one_host_column(
+            self, tmp_path, capsys):
+        from aiyagari_tpu.diagnostics.watch import watch_main
+
+        led = RunLedger(tmp_path / "solo.jsonl", meta={"entry": "t"})
+        led.event("heartbeat", context="aiyagari_egm", iteration=10,
+                  distance=1e-3, dtype="float64")
+        assert watch_main(["--once", str(led.path)]) == 0
+        out = capsys.readouterr().out
+        assert "hosts=1" in out
+        assert "aiyagari_egm" in out
+
+
+class TestSpanRunScoping:
+    def test_spans_attribute_to_the_run_not_the_thread(self, tmp_path):
+        # Two runs on two threads: each run-keyed collector receives
+        # exactly its own run's spans (pre-fix, both pooled into whichever
+        # collector was thread-local where the span closed — a merged
+        # multi-host report then billed one run's wall-clock to another).
+        from aiyagari_tpu.diagnostics.trace import collect_spans, span
+
+        led_a = RunLedger(tmp_path / "a.jsonl", run_id="a" * 16)
+        led_b = RunLedger(tmp_path / "b.jsonl", run_id="b" * 16)
+
+        def work(led, name):
+            with activate(led), span(name):
+                time.sleep(0.01)
+
+        with collect_spans(run_id=led_a.run_id) as got_a, \
+                collect_spans(run_id=led_b.run_id) as got_b:
+            threads = [threading.Thread(target=work, args=(led_a, "span-a")),
+                       threading.Thread(target=work, args=(led_b, "span-b"))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert [r["name"] for r in got_a] == ["span-a"]
+        assert [r["name"] for r in got_b] == ["span-b"]
+        # Each span record is stamped with its run id for merged reports.
+        assert got_a[0]["run_id"] == "a" * 16
+        assert got_b[0]["run_id"] == "b" * 16
+
+    def test_dual_registration_delivers_once(self, tmp_path):
+        # A collector that is BOTH thread-local and run-keyed (the dispatch
+        # _observe scope) must not receive the span twice.
+        from aiyagari_tpu.diagnostics.trace import collect_spans, span
+
+        led = RunLedger(tmp_path / "l.jsonl", run_id="c" * 16)
+        with activate(led), collect_spans(run_id=led.run_id) as got:
+            with span("once"):
+                pass
+        assert [r["name"] for r in got] == ["once"]
+
+    def test_runless_collection_keeps_thread_local_semantics(self):
+        from aiyagari_tpu.diagnostics.trace import collect_spans, span
+
+        with collect_spans() as got:
+            with span("plain"):
+                pass
+        assert [r["name"] for r in got] == ["plain"]
+        assert "run_id" not in got[0]
+
+
+class TestReportCLI:
+    def test_report_merges_shards_and_renders_observatory_events(
+            self, tmp_path, capsys):
+        from aiyagari_tpu.diagnostics.health import report_main
+
+        base, _ = _write_pod_shards(tmp_path, torn=True)
+        led = RunLedger(base, run_id="podrun0000000001", process_index=0,
+                        process_count=2)
+        led.event("host_skew", axis="grid", size=4,
+                  rendezvous_seconds=0.002, lag_spread_seconds=0.0001,
+                  verdict="balanced", straggler=None)
+        led.event("bench_regression", metric="pod_observatory",
+                  field="merge.ordered", severity="structural",
+                  reason="was true, now false",
+                  source="BENCH_r13_observatory.json")
+        # The operator names the BASE path; the shards merge implicitly.
+        assert report_main([str(base)]) == 0
+        out = capsys.readouterr().out
+        assert "hosts=2" in out
+        assert "skew grid: rendezvous 0.002s" in out and "balanced" in out
+        assert "heartbeat sim" in out and "@p0" in out
+        assert ("bench regression [structural] "
+                "pod_observatory.merge.ordered") in out
+        # Explicit multi-path invocation reads the same stream.
+        shards = [str(p) for p in shard_paths(base)]
+        assert report_main(shards) == 0
+        assert "hosts=2" in capsys.readouterr().out
+
+    def test_report_single_file_keeps_strict_torn_semantics(
+            self, tmp_path, capsys):
+        from aiyagari_tpu.diagnostics.health import report_main
+
+        led = RunLedger(tmp_path / "solo.jsonl")
+        led.event("verdict", context="x", converged=True, iterations=3)
+        with open(led.path, "a") as f:
+            f.write('{"torn')
+        # No shards on disk: the historical single-file path still refuses
+        # a ledger that cannot round-trip.
+        with pytest.raises(json.JSONDecodeError):
+            report_main([str(led.path)])
+        # A non-shard sibling sharing the prefix must NOT flip the read
+        # into the tolerant merge path.
+        (tmp_path / "solo.prod.jsonl").write_text("{}\n")
+        with pytest.raises(json.JSONDecodeError):
+            report_main([str(led.path)])
+
+
+class TestBenchHistory:
+    def _frozen(self):
+        return {
+            "metric": "pod_observatory", "value": 2.0, "unit": "seconds",
+            "devices": 8, "scenarios": 4, "grid": 64,
+            "skew": {"axes": {"scenarios": {}, "grid": {}}},
+            "heartbeat": {"off_jaxpr_identical": True,
+                          "off_bit_identical": True},
+            "merge": {"shards": 2, "run_joined": True, "ordered": True},
+        }
+
+    def _history(self):
+        return {"pod_observatory": [
+            {"record": self._frozen(), "source": "BENCH_r13.json"}]}
+
+    def test_matching_record_is_clean(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        findings, matched = check_records([self._frozen()],
+                                          history=self._history())
+        assert findings == [] and matched == ["pod_observatory"]
+
+    def test_unmatched_metric_names_are_ignored(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        findings, matched = check_records(
+            [{"metric": "never_frozen", "value": 1.0}],
+            history=self._history())
+        assert findings == [] and matched == []
+
+    def test_structural_regressions_are_flagged(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        fresh = self._frozen()
+        fresh["heartbeat"]["off_bit_identical"] = False   # bool check
+        fresh["merge"]["shards"] = 1                      # count_min
+        del fresh["skew"]["axes"]["grid"]                 # keys_min
+        findings, _ = check_records([fresh], history=self._history())
+        flagged = {f["field"] for f in findings}
+        assert flagged == {"heartbeat.off_bit_identical", "merge.shards",
+                           "skew.axes"}
+        assert all(f["severity"] == "structural" for f in findings)
+        assert all(f["source"] == "BENCH_r13.json" for f in findings)
+
+    def test_wall_checks_need_equal_sizing_and_a_catastrophic_band(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        fresh = self._frozen()
+        fresh["value"] = 15.0    # < 10x frozen 2.0? no: 15 < 20 — inside
+        findings, _ = check_records([fresh], history=self._history())
+        assert findings == []
+        fresh["value"] = 25.0    # outside the 10x catastrophe band
+        findings, _ = check_records([fresh], history=self._history())
+        assert [f["severity"] for f in findings] == ["wall"]
+        # A differently-sized record is never timed against the frozen one.
+        fresh["devices"] = 16
+        findings, _ = check_records([fresh], history=self._history())
+        assert findings == []
+
+    def test_previously_working_metric_now_skipping_is_structural(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        findings, _ = check_records(
+            [{"metric": "pod_observatory", "skipped": "oom"}],
+            history=self._history())
+        assert len(findings) == 1
+        assert findings[0]["kind"] == "skip"
+        assert findings[0]["severity"] == "structural"
+
+    def test_frozen_fields_absent_from_history_hold_nothing(self):
+        from aiyagari_tpu.diagnostics.bench_history import check_records
+
+        history = {"pod_observatory": [
+            {"record": {"metric": "pod_observatory"},
+             "source": "BENCH_r13.json"}]}
+        findings, matched = check_records([self._frozen()], history=history)
+        assert findings == [] and matched == ["pod_observatory"]
+
+    def test_repo_history_loads_and_matches_itself(self):
+        # The real frozen trajectory: every artifact parses, the round-13
+        # observatory record is present, and checking a frozen record
+        # against its own history finds nothing (the watchdog's fixed
+        # point — what `bench.py --preset ci` gates at zero).
+        from aiyagari_tpu.diagnostics.bench_history import (
+            check_records,
+            load_history,
+        )
+
+        history = load_history()
+        assert "pod_observatory" in history
+        frozen = [h[-1]["record"] for h in history.values()]
+        findings, matched = check_records(frozen, history=history)
+        assert findings == []
+        assert "pod_observatory" in matched

@@ -580,6 +580,27 @@ class TestMetrics:
         reg.reset()
         assert reg.render_json()["counters"] == []
 
+    def test_prometheus_label_values_are_escaped(self):
+        # Exposition-format escaping (ISSUE 14 satellite): a route name or
+        # path landing in a label may carry backslashes, quotes, or
+        # newlines — pre-fix these produced unparseable exposition. Per
+        # the text format v0.0.4, label values escape backslash, quote,
+        # and newline (backslash FIRST, or the other two re-escape).
+        from aiyagari_tpu.diagnostics import metrics
+
+        reg = metrics.MetricsRegistry()
+        reg.counter("routes_total", route='say "hi"').inc()
+        reg.counter("routes_total", route="C:\\tmp\\ledger").inc(2)
+        reg.counter("routes_total", route="two\nlines").inc(3)
+        txt = reg.render_prometheus()
+        assert 'routes_total{route="say \\"hi\\""} 1' in txt
+        assert 'routes_total{route="C:\\\\tmp\\\\ledger"} 2' in txt
+        assert 'routes_total{route="two\\nlines"} 3' in txt
+        # The exposition stays line-parseable: no raw newline or naked
+        # quote escapes a label value onto its own line.
+        for line in txt.splitlines():
+            assert line.count('"') % 2 == 0, line
+
     def test_module_registry_reset_between_tests(self):
         # The autouse conftest fixture resets the process registry: a
         # counter from a previous test must not be visible here.
