@@ -28,6 +28,15 @@ Route observatory (tuning/autotuner.py; docs/USAGE.md "Route observatory
                                              # persist the tuning cache
   python -m aiyagari_tpu tune --explain      # render the decision table
                                              # from the cached probe data
+
+Persistent solve service (serve/; docs/USAGE.md "Persistent solve
+service"):
+
+  python -m aiyagari_tpu warmup [--na N]     # precompile the kernel zoo
+                                             # into the compile cache
+  python -m aiyagari_tpu serve --port 8799   # HTTP front: POST /solve,
+                                             # GET /metrics, GET /healthz
+  python -m aiyagari_tpu serve --load 32     # synthetic open-loop load
 """
 
 from __future__ import annotations
@@ -63,6 +72,21 @@ def main(argv=None) -> int:
         from aiyagari_tpu.diagnostics.watch import watch_main
 
         return watch_main(argv[1:])
+    # `warmup` precompiles the registry catalogue (plus --na sized hot
+    # programs) into the persistent compile cache and reports per-program
+    # compile walls — the standalone warm pool (serve/warmup.warm_pool;
+    # the server runs the same function at startup).
+    if argv[:1] == ["warmup"]:
+        from aiyagari_tpu.serve.warmup import warmup_main
+
+        return warmup_main(argv[1:])
+    # `serve` runs the persistent solve service (serve/service.py): the
+    # HTTP front (--port: POST /solve, GET /metrics, GET /healthz) or the
+    # synthetic open-loop load driver (--load N).
+    if argv[:1] == ["serve"]:
+        from aiyagari_tpu.serve.service import serve_main
+
+        return serve_main(argv[1:])
     ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])

@@ -250,7 +250,14 @@ def _build_ge_round():
     from aiyagari_tpu.models.aiyagari import aiyagari_preset
 
     model = aiyagari_preset(grid_size=_NA, dtype=jnp.float64)
-    solver = SolverConfig(method="egm", tol=1e-6, max_iter=50)
+    # The push-forward route is PINNED to the scatter-free transpose
+    # form: the batched "auto" resolution is platform-contextual (CPU
+    # hosts take the scatter form under vmap — resolve_backend's batched
+    # split, ISSUE 15), and this audit certifies the accelerator-shaped
+    # artifact (AIYA101 scatter-free) deterministically regardless of
+    # the tracing host.
+    solver = SolverConfig(method="egm", tol=1e-6, max_iter=50,
+                          pushforward="transpose")
 
     def fn(r_batch):
         gap, _ = excess_demand_batch(model, r_batch, solver=solver,

@@ -113,6 +113,20 @@ SPECS = {
     "static_analysis_findings": (
         Check("value", "max_abs", band=1.0, floor=0.0),
     ),
+    "serve_load": (
+        # Structural: the regimes/ledger-trail/gauge surfaces must not
+        # shrink, and the two acceptance ratios hold with bands wide
+        # enough for host timing noise (the hard gates live in
+        # tests/test_bench_ci.py at the same thresholds every run).
+        Check("regimes", "keys_min"),
+        Check("ledger_events", "keys_min"),
+        Check("prometheus_gauges", "keys_min"),
+        Check("warm_vs_cold_p50", "max_abs", band=1.0, floor=0.5),
+        Check("coalesced_vs_serial", "count_min", band=2.0),
+        # value is a THROUGHPUT (requests/sec — higher is better), so the
+        # catastrophe band is a count_min at 10x, not a wall check.
+        Check("value", "count_min", band=_WALL_BAND),
+    ),
 }
 
 

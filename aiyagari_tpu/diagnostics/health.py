@@ -389,6 +389,27 @@ def report_main(argv) -> int:
                 print(f"  bench regression [{ev.get('severity')}] "
                       f"{ev.get('metric')}.{ev.get('field')}: "
                       f"{ev.get('reason')} (frozen in {ev.get('source')})")
+            elif k == "serve_request":
+                print(f"  serve {ev.get('id')} [{ev.get('request_kind')}]: "
+                      f"{ev.get('status')}  cache={ev.get('cache')}  "
+                      f"wait {ev.get('queue_wait_s')}s  "
+                      f"wall {ev.get('wall_s')}s  "
+                      f"batch {ev.get('batch')}")
+            elif k == "coalesce":
+                print(f"  coalesce [{ev.get('request_kind')}]: "
+                      f"batch {ev.get('batch')}  queue wait "
+                      f"{ev.get('queue_wait_min_s')}-"
+                      f"{ev.get('queue_wait_max_s')}s")
+            elif k == "cache_hit":
+                print(f"  cache {ev.get('outcome')} "
+                      f"[{ev.get('lookup')}] for {ev.get('id')}")
+            elif k == "warmup":
+                if ev.get("skipped"):
+                    print(f"  warmup {ev.get('program')}: skipped "
+                          f"({ev.get('skipped')})")
+                else:
+                    print(f"  warmup {ev.get('program')}: "
+                          f"{ev.get('compile_seconds')}s")
             elif k == "tuning_probe":
                 walls = ev.get("walls_us") or {}
                 detail = "  ".join(f"{r}={w:.1f}us" for r, w in

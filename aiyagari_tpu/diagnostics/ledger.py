@@ -30,6 +30,10 @@ solve_transition / bench.py):
   heartbeat    — a live progress record (diagnostics/progress.py heartbeat
                  stride; rendered by `python -m aiyagari_tpu watch`)
   host_skew    — a mesh rendezvous probe (diagnostics/skew.py)
+  serve_request / cache_hit / coalesce / warmup
+               — the persistent solve service's per-request trail, cache
+                 lookups, batch formations, and warm-pool compiles
+                 (serve/; rendered by report and summarized by watch)
 
 Pod sharding (the multi-host story, docs/USAGE.md "Pod observatory"):
 every event is stamped with this host's `process_index`/`process_count`,

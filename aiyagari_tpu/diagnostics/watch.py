@@ -16,6 +16,10 @@ state table from the observatory's event kinds, and renders it:
   verdict     -> the run's closing status line
   host_skew   -> per-axis rendezvous + straggler lines
   mesh_topology / run_start -> the header
+  serve_request / cache_hit / coalesce -> the serving summary line
+                 (requests, per-status and per-cache-outcome counts, max
+                 batch, last queue wait — a serving run is readable with
+                 the same CLI, ISSUE 15 satellite)
 
 A single-process ledger (no shards, no mesh) degrades to the same table
 with one host column — the CLI works identically on a laptop run.
@@ -37,7 +41,7 @@ def build_state(events) -> dict:
         run = runs.setdefault(ev.get("run_id", "?"), {
             "meta": {}, "mesh": None, "skew": [], "rows": {},
             "verdicts": [], "events": 0, "hosts": set(),
-            "regressions": 0, "last_ts": None,
+            "regressions": 0, "last_ts": None, "serve": None,
         })
         run["events"] += 1
         run["last_ts"] = ev.get("ts", run["last_ts"])
@@ -67,7 +71,36 @@ def build_state(events) -> dict:
             run["verdicts"].append(ev)
         elif kind == "bench_regression":
             run["regressions"] += 1
+        elif kind in ("serve_request", "cache_hit", "coalesce"):
+            _fold_serve(run, kind, ev)
     return runs
+
+
+def _fold_serve(run: dict, kind: str, ev: dict) -> None:
+    """Fold the serving events (ISSUE 15) into one summary block:
+    request/status/cache tallies, coalescing batch sizes, queue waits."""
+    sv = run["serve"]
+    if sv is None:
+        sv = run["serve"] = {
+            "requests": 0, "statuses": {}, "cache": {},
+            "lookups": {}, "coalesced_batches": 0, "max_batch": 0,
+            "last_queue_wait_s": None,
+        }
+    if kind == "serve_request":
+        sv["requests"] += 1
+        st = ev.get("status") or "?"
+        sv["statuses"][st] = sv["statuses"].get(st, 0) + 1
+        ca = ev.get("cache") or "?"
+        sv["cache"][ca] = sv["cache"].get(ca, 0) + 1
+        sv["max_batch"] = max(sv["max_batch"], int(ev.get("batch") or 1))
+        if ev.get("queue_wait_s") is not None:
+            sv["last_queue_wait_s"] = ev["queue_wait_s"]
+    elif kind == "cache_hit":
+        oc = ev.get("outcome") or "?"
+        sv["lookups"][oc] = sv["lookups"].get(oc, 0) + 1
+    elif kind == "coalesce":
+        sv["coalesced_batches"] += 1
+        sv["max_batch"] = max(sv["max_batch"], int(ev.get("batch") or 1))
 
 
 def _row(run: dict, scenario, host, *, context=None) -> dict:
@@ -173,6 +206,20 @@ def render_state(runs: dict) -> str:
                     + _fmt(row["dtype"], 10) + _fmt(row["verdict"], 13)
                     + _fmt("yes" if row["quarantined"] else "-", 12)
                     + _fmt(row["context"], 1).rstrip())
+        sv = run.get("serve")
+        if sv:
+            bits = [f"serve: {sv['requests']} request(s)"]
+            if sv["cache"]:
+                bits.append("cache " + "/".join(
+                    f"{k}={v}" for k, v in sorted(sv["cache"].items())))
+            if sv["statuses"]:
+                bits.append("status " + "/".join(
+                    f"{k}={v}" for k, v in sorted(sv["statuses"].items())))
+            bits.append(f"batches={sv['coalesced_batches']}")
+            bits.append(f"max batch={sv['max_batch']}")
+            if sv["last_queue_wait_s"] is not None:
+                bits.append(f"last wait={sv['last_queue_wait_s']}s")
+            lines.append("  " + "  ".join(bits))
         for ev in run["verdicts"]:
             status = "converged" if ev.get("converged") else "NOT CONVERGED"
             lines.append(f"  done {ev.get('context')}: {status} after "

@@ -140,7 +140,8 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
 
 def _resolve_routes(solver: Optional[SolverConfig], *,
                     na: Optional[int] = None, dtype=None,
-                    egm: bool = True) -> Optional[SolverConfig]:
+                    egm: bool = True,
+                    batched: bool = False) -> Optional[SolverConfig]:
     """Resolve the contested route knobs ("auto" pushforward /
     egm_kernel / searchsorted method) at the dispatch boundary, INSIDE
     the _observe scope, so every solve/sweep run records exactly one
@@ -167,7 +168,19 @@ def _resolve_routes(solver: Optional[SolverConfig], *,
     egm=False skips the egm_kernel knob (the endogenous-labor family
     routes through require_xla_egm_kernel, a constraint rather than a
     decision — a measured fused-route winner must not be recorded, let
-    alone applied, for a chain the fused kernel does not implement)."""
+    alone applied, for a chain the fused kernel does not implement).
+
+    batched=True is the vmapped-program context (sweeps and the batched
+    GE): the push-forward decision then goes through resolve_backend's
+    batched split — scatter on CPU hosts, where the transpose route's
+    gathers batch catastrophically under vmap (measured, ISSUE 15) — so
+    the recorded decision matches what the round programs execute
+    (equilibrium/batched._ge_round_program resolves with the same
+    context). The resolved route is deliberately NOT threaded back into
+    the SolverConfig here: the deep resolver applies the identical
+    context-aware default, and threading a batched-only route into a
+    config that may also drive serial re-solves (quarantine rescue)
+    would pin the wrong route there."""
     from aiyagari_tpu.ops.egm import resolve_egm_kernel
     from aiyagari_tpu.ops.interp import searchsorted_method
     from aiyagari_tpu.ops.pushforward import resolve_backend
@@ -175,14 +188,15 @@ def _resolve_routes(solver: Optional[SolverConfig], *,
 
     pf_in = solver.pushforward if solver is not None else "auto"
     ek_in = solver.egm_kernel if solver is not None else "auto"
-    pf = resolve_backend(pf_in, na=na, dtype=dtype)
+    pf = resolve_backend(pf_in, na=na, dtype=dtype, batched=batched)
     ek = resolve_egm_kernel(ek_in, na=na, dtype=dtype) if egm else ek_in
     # The searchsorted split has no SolverConfig knob but every
     # push-forward plan build exercises it (_segment_bounds): resolving
     # it here records the run's decision even when jit caching skips the
     # trace-time resolver.
     searchsorted_method(na)
-    if solver is not None and tuning_active() and (pf, ek) != (pf_in, ek_in):
+    if (solver is not None and tuning_active() and not batched
+            and (pf, ek) != (pf_in, ek_in)):
         solver = dataclasses.replace(solver, pushforward=pf, egm_kernel=ek)
     return solver
 
@@ -294,6 +308,7 @@ def solve(
     on_nonconvergence: str = "warn",
     ledger=None,
     rescue=None,
+    warm_start=None,
 ):
     """Solve a full model to general equilibrium.
 
@@ -359,6 +374,13 @@ def solve(
     that carries the full attempt history — with a rescue ladder attached
     the exhaustion behavior is always a raise, regardless of
     `on_nonconvergence`.
+
+    `warm_start` seeds the bisection's initial household solve with a
+    previous solve's state (the VFI value function or the EGM consumption
+    policy — the serve layer's solution cache passes its memoized
+    neighbor here, docs/USAGE.md "Persistent solve service"); Aiyagari
+    family on the jax serial paths only, None is bit-identical to the
+    historical cold start.
     """
     if isinstance(backend, str):
         backend = BackendConfig(backend=backend)
@@ -386,7 +408,8 @@ def solve(
         def attempt(s2, b2, o2):
             return solve(model, backend=b2, solver=s2, sim=sim,
                          equilibrium=o2, alm=alm, aggregation=aggregation,
-                         on_nonconvergence="raise", ledger=led, rescue=None)
+                         on_nonconvergence="raise", ledger=led, rescue=None,
+                         warm_start=warm_start)
 
         return run_rescue(attempt, rescue=rescue, solver=solver_r,
                           backend=backend, outer=eq_r,
@@ -410,6 +433,14 @@ def solve(
             "expected 'ignore', 'warn', or 'raise'"
         )
 
+    if warm_start is not None and (
+            not isinstance(model, AiyagariConfig)
+            or backend.backend != "jax"
+            or (equilibrium is not None and equilibrium.batch >= 2)):
+        raise ValueError(
+            "warm_start= covers the Aiyagari family's serial bisection on "
+            "the jax backend (the seeded pass is the bisection's r_init "
+            "household solve); drop it for this solve")
     if isinstance(model, AiyagariConfig):
         solver = _with_ladder(solver, method, backend)
         sim = sim or SimConfig()
@@ -458,7 +489,8 @@ def solve(
                 solver = _resolve_routes(
                     solver, na=model.grid.n_points,
                     dtype=_dtype_of(backend),
-                    egm=not model.endogenous_labor)
+                    egm=not model.endogenous_labor,
+                    batched=equilibrium.batch >= 2)
 
                 # Honor dtype="float64" even when global x64 is off (see
                 # precision_scope — without it the request silently truncates).
@@ -503,10 +535,12 @@ def solve(
                             aggregation=aggregation)
                     elif aggregation == "distribution":
                         result = solve_equilibrium_distribution(
-                            m, solver=solver, eq=equilibrium, mesh=mesh)
+                            m, solver=solver, eq=equilibrium, mesh=mesh,
+                            warm_start=warm_start)
                     else:
                         result = solve_equilibrium(
-                            m, solver=solver, sim=sim, eq=equilibrium, mesh=mesh)
+                            m, solver=solver, sim=sim, eq=equilibrium,
+                            mesh=mesh, warm_start=warm_start)
         # The solver's own stopping quantity: the batched rounds stop on the
         # round's BEST candidate gap (per_iteration "best_gap"), the serial
         # bisection on its single candidate ("gap"); the last-candidate
@@ -733,7 +767,8 @@ def sweep(
                   method=method, aggregation=aggregation):
         solver = _resolve_routes(solver, na=base.grid.n_points,
                                  dtype=_dtype_of(backend),
-                                 egm=not base.endogenous_labor)
+                                 egm=not base.endogenous_labor,
+                                 batched=True)
         with precision_scope(backend.dtype):
             if solver.ladder is not None:
                 from aiyagari_tpu.ops.precision import require_x64

@@ -110,6 +110,15 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
     (tol, max_iter, howard_steps, relative_tol, alpha, delta,
      dist_tol, dist_max_iter, periods, n_agents, discard, accel,
      ladder, pushforward, telemetry, sentinel, faults, egm_kernel) = knobs
+    # Resolve the push-forward route in the VMAPPED context (the
+    # batched=True split, ops/pushforward.resolve_backend): "auto" pins
+    # the scatter form on CPU hosts, where the transpose route's gathers
+    # batch catastrophically under vmap (measured — ISSUE 15). Resolved
+    # once per cached program build, so the traced program carries the
+    # concrete route.
+    from aiyagari_tpu.ops.pushforward import resolve_backend
+
+    pushforward = resolve_backend(pushforward, batched=True)
     if method == "egm":
         from aiyagari_tpu.ops.egm import (
             require_xla_egm_kernel,
@@ -136,8 +145,8 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                 "inversion route requires; use 'auto', 'xla', or "
                 "'pallas_fused'")
 
-    def one(warm, r, key, a_grid, s, P, labor_grid, sigma, beta, psi, eta,
-            amin, labor_raw):
+    def one(warm, mu_warm, r, key, a_grid, s, P, labor_grid, sigma, beta,
+            psi, eta, amin, labor_raw):
         from aiyagari_tpu.sim.distribution import (
             aggregate_capital,
             stationary_distribution,
@@ -194,8 +203,16 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
                "solver_iterations": sol.iterations,
                "solver_distance": sol.distance}
         if aggregation == "distribution":
+            # Warm-start the stationary distribution from the previous
+            # round's converged mu — the serial _DistributionAggregator
+            # has always done this (mu_init=self.mu); without it every
+            # lockstep round re-iterated the distribution from uniform,
+            # which measured ~2-3x per-lane-round against the serial
+            # bisection at small grids (ISSUE 15). Cold first rounds have
+            # no previous mu and keep the uniform start.
             dist_sol = stationary_distribution(
                 sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
+                mu_init=(None if cold else mu_warm),
                 accel=accel, ladder=ladder, pushforward=pushforward,
                 telemetry=telemetry, sentinel=sentinel, faults=faults)
             supply = aggregate_capital(dist_sol.mu, a_grid)
@@ -215,16 +232,21 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
         return out
 
     mx = 0 if scenario_axes else None       # model arrays / scalars axis
-    in_axes = (0, 0, 0, mx, mx, mx, mx, mx, mx, mx, mx, mx, mx)
+    in_axes = (0, 0, 0, 0, mx, mx, mx, mx, mx, mx, mx, mx, mx, mx)
     batched = jax.vmap(one, in_axes=in_axes)
 
-    def round_fn(r_new, r_prev, warm_prev, keys, a_grid, s, P, labor_grid,
-                 sigma, beta, psi, eta, amin, labor_raw):
+    def round_fn(r_new, r_prev, warm_prev, mu_prev, keys, a_grid, s, P,
+                 labor_grid, sigma, beta, psi, eta, amin, labor_raw):
+        B = r_new.shape[0]
+        mu = mu_prev
         if cold:
             # First round: no previous candidates. VFI starts at v=0 (the
             # reference's init); EGM at the consume-cash-on-hand guess
             # evaluated at each candidate's own prices (Aiyagari_EGM.m:64).
-            B = r_new.shape[0]
+            # The mu operand is unread (the cold program's distribution
+            # starts uniform) but the vmapped call still needs a
+            # B-leading placeholder.
+            mu = jnp.zeros((B, s.shape[-1], a_grid.shape[-1]), a_grid.dtype)
             if method == "vfi":
                 shape = ((B,) + warm_prev.shape[-2:])
                 warm = jnp.zeros(shape, a_grid.dtype)
@@ -245,9 +267,19 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
             # nearest previous candidate (the round-k survivors bracket
             # round k+1's interior points, so this is the closest converged
             # state available — the serial loop's warm-start carried over).
+            # The distribution iterate rides the same nearest-candidate
+            # selection.
             j = jnp.argmin(jnp.abs(r_new[:, None] - r_prev[None, :]), axis=1)
             warm = jnp.take(warm_prev, j, axis=0)
-        return batched(warm, r_new, keys, a_grid, s, P, labor_grid,
+            if mu.shape[0] == r_prev.shape[0]:
+                mu = jnp.take(mu_prev, j, axis=0)
+        if mu.shape[0] != B:
+            # The simulation closure carries no mu (out["mu"] never
+            # updates the caller's size-1 placeholder): broadcast it to
+            # the batch width so the vmapped call is well-formed — the
+            # operand is unread there and XLA drops it.
+            mu = jnp.broadcast_to(mu, (B,) + mu.shape[1:])
+        return batched(warm, mu, r_new, keys, a_grid, s, P, labor_grid,
                        sigma, beta, psi, eta, amin, labor_raw)
 
     return jax.jit(round_fn)
@@ -269,7 +301,7 @@ def _round_keys(seed: int, rnd: int, n: int):
 def excess_demand_batch(model: AiyagariModel, r_batch, *,
                         solver: SolverConfig = SolverConfig(),
                         aggregation: str = "distribution",
-                        warm=None, r_warm=None,
+                        warm=None, r_warm=None, mu_warm=None,
                         sim: SimConfig = SimConfig(),
                         dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
                         keys=None):
@@ -297,13 +329,25 @@ def excess_demand_batch(model: AiyagariModel, r_batch, *,
     r_new = jnp.asarray(r_batch, model.dtype)
     if keys is None:
         keys = _round_keys(sim.seed, 0, B)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
     if cold:
-        # Shape-only placeholder: the cold program reads nothing but its
-        # trailing (N, na) shape (VFI) or ignores it entirely (EGM).
-        N, na = model.P.shape[0], model.a_grid.shape[0]
+        # Shape-only placeholders: the cold program reads nothing but the
+        # warm state's trailing (N, na) shape (VFI) — its distribution
+        # starts uniform, so the mu operand is never read.
         warm = jnp.zeros((1, N, na), model.dtype)
+        mu_warm = jnp.zeros((1, N, na), model.dtype)
         r_warm = r_new
-    out = fn(r_new, jnp.asarray(r_warm, model.dtype), warm, keys, *ops)
+    elif mu_warm is None and aggregation == "distribution":
+        # The warm program READS mu_warm as the distribution's starting
+        # iterate (a zero mu would satisfy the residual immediately and
+        # report zero supply) — loud, like the r_warm check above.
+        raise ValueError(
+            "warm-started distribution rounds need the previous round's "
+            "distributions: pass mu_warm (aux['mu'])")
+    elif mu_warm is None:
+        mu_warm = jnp.zeros((np.shape(r_batch)[0], 1, 1), model.dtype)
+    out = fn(r_new, jnp.asarray(r_warm, model.dtype), warm, mu_warm, keys,
+             *ops)
     return out["gap"], out
 
 
@@ -359,6 +403,7 @@ def solve_equilibrium_batched(
 
     r_prev = None
     warm_prev = jnp.zeros((1, N, na), model.dtype)
+    mu_prev = jnp.zeros((1, N, na), model.dtype)
     out = None
     r_hist, ks_hist, kd_hist, records = [], [], [], []
     converged = False
@@ -374,7 +419,7 @@ def solve_equilibrium_batched(
         fn = _ge_round_program(solver.method, labor, aggregation, knobs,
                                False, rnd == 0)
         out = fn(r_dev, r_prev if r_prev is not None else r_dev,
-                 warm_prev, keys, *ops)
+                 warm_prev, mu_prev, keys, *ops)
         gaps, supplies, demands, sol_iters = jax.device_get(
             (out["gap"], out["supply"], out["demand"],
              out["solver_iterations"]))
@@ -424,6 +469,8 @@ def solve_equilibrium_batched(
             new_lo, new_hi = lo, float(r_cand[0])
         lo, hi = new_lo, new_hi
         r_prev, warm_prev = r_dev, out["warm"]
+        if "mu" in out:
+            mu_prev = out["mu"]
 
     take = lambda x: jax.tree_util.tree_map(lambda l: l[best], x)
     sol_best = take(out["sol"])
@@ -664,6 +711,9 @@ def solve_equilibrium_sweep(
                    sim)
     warm = jnp.zeros((1,) + tuple(batch.P.shape[-1:]) + tuple(
         batch.a_grid.shape[-1:]), batch.dtype)
+    # Per-lane distribution warm start, carried across rounds exactly like
+    # the household policy (the serial aggregator's mu_init, lockstepped).
+    mu_carry = jnp.zeros_like(warm)
     out = None
     rounds = 0
     gap_hist: list = []
@@ -674,8 +724,10 @@ def solve_equilibrium_sweep(
         keys = _round_keys(sim.seed, rnd, S)
         fn = _ge_round_program(solver.method, batch.endogenous_labor,
                                aggregation, knobs, True, rnd == 0)
-        out = fn(r_dev, r_dev, warm, keys, *batch.operands())
+        out = fn(r_dev, r_dev, warm, mu_carry, keys, *batch.operands())
         warm = out["warm"]
+        if "mu" in out:
+            mu_carry = out["mu"]
         gaps, supplies = (np.asarray(x, np.float64) for x in
                           jax.device_get((out["gap"], out["supply"])))
         rounds = rnd + 1

@@ -412,7 +412,7 @@ class _DistributionAggregator:
 def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
             eq: EquilibriumConfig, on_iteration: Optional[Callable],
             checkpoint_dir: Optional[str], checkpoint_configs,
-            mesh=None) -> EquilibriumResult:
+            mesh=None, warm_start=None) -> EquilibriumResult:
     """Shared GE bisection driver (Aiyagari_VFI.m:133-206): bracket r, re-solve
     the household problem warm-started at each midpoint, ask the aggregator for
     capital supply, compare against the firm FOC demand curve. Checkpoint/
@@ -467,9 +467,13 @@ def _bisect(model: AiyagariModel, aggregator, *, solver: SolverConfig,
         aggregator.restore(start_it, sc, arrays)
         sol = None
     else:
-        # Warm-start pass at r_init, as the reference does before its loop (:63-129).
-        sol = solve_household(model, eq.r_init, solver=solver, warm_start=None,
-                              mesh=mesh)
+        # Warm-start pass at r_init, as the reference does before its loop
+        # (:63-129). `warm_start` (a previous solve's value function / EGM
+        # consumption policy — the serve layer's solution cache passes the
+        # cached C here) seeds even this first pass; None keeps the
+        # reference cold start bit-identical.
+        sol = solve_household(model, eq.r_init, solver=solver,
+                              warm_start=warm_start, mesh=mesh)
         warm = _warm_state(sol, solver.method)
 
     converged = False
@@ -567,7 +571,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
                       sim: SimConfig = SimConfig(), eq: EquilibriumConfig = EquilibriumConfig(),
                       on_iteration: Optional[Callable] = None,
                       checkpoint_dir: Optional[str] = None,
-                      mesh=None) -> EquilibriumResult:
+                      mesh=None, warm_start=None) -> EquilibriumResult:
     """Bisection on r over [r_low, min(r_high, 1/beta - 1)] with <= eq.max_iter
     midpoints; stops when |K_supply - K_demand| < eq.tol (Aiyagari_VFI.m:133-206).
 
@@ -583,7 +587,7 @@ def solve_equilibrium(model: AiyagariModel, *, solver: SolverConfig = SolverConf
     return _bisect(
         model, _SimulationAggregator(model, sim), solver=solver, eq=eq,
         on_iteration=on_iteration, checkpoint_dir=checkpoint_dir,
-        checkpoint_configs=(sim,), mesh=mesh,
+        checkpoint_configs=(sim,), mesh=mesh, warm_start=warm_start,
     )
 
 
@@ -594,6 +598,7 @@ def solve_equilibrium_distribution(
     on_iteration: Optional[Callable] = None,
     checkpoint_dir: Optional[str] = None,
     mesh=None,
+    warm_start=None,
 ) -> EquilibriumResult:
     """Non-stochastic GE closure: same r-bisection as solve_equilibrium, but
     capital supply is E[a] under the stationary distribution computed by the
@@ -619,4 +624,5 @@ def solve_equilibrium_distribution(
         solver=solver, eq=eq, on_iteration=on_iteration,
         checkpoint_dir=checkpoint_dir,
         checkpoint_configs=(dist_tol, dist_max_iter), mesh=mesh,
+        warm_start=warm_start,
     )

@@ -121,7 +121,8 @@ def _record_fallback(route: str) -> None:
 
 
 def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
-                    dtype=None, f32_sim: bool = False) -> str:
+                    dtype=None, f32_sim: bool = False,
+                    batched: bool = False) -> str:
     """Validate a DistributionBackend name and resolve "auto".
 
     The shipped "auto" default is "transpose" on every platform: it is
@@ -145,6 +146,22 @@ def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
     there regardless of any measured wall. A correctness constraint, not
     a perf choice; the tuning cache is never consulted for it.
 
+    batched=True is the VMAPPED-program context (the lockstep GE sweep /
+    parallel-bracket rounds, equilibrium/batched.py): under vmap the
+    transpose route's per-sweep take_along_axis gathers batch onto
+    XLA:CPU's generic gather path and run ~5.5x per lane SLOWER than
+    solo (measured at the ISSUE 15 ci calibration: 100 sweeps x 6 lanes —
+    transpose 39.4 ms vs 6 x 1.2 ms solo; the scatter reference scales
+    exactly linearly and wins the batched wall), so batched "auto" pins
+    the scatter form on CPU hosts. Accelerators keep the standard
+    resolution — no chip measurement of the batched context exists yet
+    (the pallas_inverse lesson), and TPU scatter is the documented
+    pathology the scatter-free routes exist to avoid. Like f32_sim, this
+    is a recorded decision: the ledger explains why a sweep's
+    distribution steps scatter on the host. Solo-context tuning probes
+    are deliberately NOT consulted for batched programs — a measured
+    solo winner is exactly the number the vmapped context invalidates.
+
     `na`/`dtype` are optional resolution context (grid-bucket keying of
     the tuning cache); plan-build call sites pass them, the dispatch
     validation boundary does not.
@@ -157,6 +174,32 @@ def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
             f"{BACKENDS}")
     if backend != "auto":
         return backend
+    if batched:
+        import jax
+
+        from aiyagari_tpu.tuning.autotuner import _record_decision
+
+        if jax.default_backend() == "cpu":
+            _record_decision(
+                "pushforward", "scatter", "default",
+                {"constraint": "vmapped transpose gathers batch ~5.5x/lane "
+                               "slower than solo on hosts; scatter scales "
+                               "linearly (resolve_backend docstring, "
+                               "ISSUE 15 measurement)"},
+                na=na, dtype=dtype)
+            return "scatter"
+        # Accelerators: the shipped scatter-free default, WITHOUT
+        # consulting the tuning cache — its probes are solo-context, and
+        # a measured solo winner is exactly the number the vmapped
+        # context invalidates (docstring contract; a batched probe suite
+        # is the ROADMAP follow-up).
+        _record_decision(
+            "pushforward", "transpose", "default",
+            {"constraint": "batched context: solo tuning probes not "
+                           "consulted (no batched-context measurement "
+                           "exists yet)"},
+            na=na, dtype=dtype)
+        return "transpose"
     if f32_sim:
         # Still a recorded decision — source "default" with the
         # constraint named as evidence, so a K-S mixed-mode run's ledger
@@ -423,6 +466,15 @@ def shard_banded_plan(plan: PushforwardPlan, mesh, P):
     parallel/mesh.shard_map version shim (jax is pinned at 0.4.x here;
     never import new-API symbols directly).
 
+    Placement goes through the declarative rule matcher
+    (parallel/rules.BANDED_PLAN_RULES — the PR 13 idiom), so the SAME
+    call serves a 1-D ("grid",) mesh and a 2-D make_mesh_2d
+    (scenarios x grid) mesh: on the 2-D mesh the band's tile axis still
+    splits over "grid" while the unnamed "scenarios" axis replicates —
+    parity-pinned against the 1-D apply by tests/test_pushforward.py. A
+    mesh without a "grid" axis is rejected loudly (a silently replicated
+    band is exactly the placement bug the rules layer exists to prevent).
+
     Returns apply(mu) -> mu' with mu' sharded over its asset axis. Valid
     banded plans only (the cond fallback would need the full lottery on
     every device, defeating the sharding) — callers check `plan.ok` via
@@ -432,9 +484,15 @@ def shard_banded_plan(plan: PushforwardPlan, mesh, P):
         PartitionSpec as Pspec,
         shard_map,
     )
+    from aiyagari_tpu.parallel.rules import BANDED_PLAN_RULES, match_rule
 
     if plan.kind != "banded":
         raise ValueError("shard_banded_plan requires a 'banded' plan")
+    if GRID_AXIS not in mesh.shape:
+        raise ValueError(
+            f"shard_banded_plan needs a mesh with a '{GRID_AXIS}' axis "
+            f"(the band's tile axis shards over it); got axes "
+            f"{tuple(mesh.axis_names)}")
     na = plan.idx.shape[-1]
 
     def local(mu, band, starts, Pt):
@@ -442,10 +500,12 @@ def shard_banded_plan(plan: PushforwardPlan, mesh, P):
                                   jax.lax.Precision.HIGHEST, na)
         return jnp.matmul(Pt.T, out, precision=jax.lax.Precision.HIGHEST)
 
+    spec_of = lambda name, leaf: match_rule(  # noqa: E731
+        BANDED_PLAN_RULES, name, leaf, mesh=mesh)
     fn = shard_map(
         local, mesh=mesh,
-        in_specs=(Pspec(), Pspec(None, GRID_AXIS, None, None),
-                  Pspec(None, GRID_AXIS), Pspec()),
+        in_specs=(Pspec(), spec_of("band", plan.band),
+                  spec_of("starts", plan.starts), spec_of("P", P)),
         out_specs=Pspec(None, GRID_AXIS),
     )
 

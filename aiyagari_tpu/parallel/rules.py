@@ -54,6 +54,7 @@ from aiyagari_tpu.parallel.mesh import (
 
 __all__ = [
     "PartitionRule",
+    "BANDED_PLAN_RULES",
     "SCENARIO_BATCH_RULES",
     "TRANSITION_SWEEP_RULES",
     "tree_paths",
@@ -81,6 +82,22 @@ SCENARIO_BATCH_RULES: Tuple[PartitionRule, ...] = (
     # Per-scenario scalars stacked to [S] (sigma/beta/psi/eta/amin/
     # labor_raw) and anything else scenario-major.
     (r".*", (SCENARIOS_AXIS,)),
+)
+
+# The banded push-forward plan (ops/pushforward.shard_banded_plan): the
+# block band [N, nt, bw, tb] and its per-tile source starts [N, nt] split
+# over the TILE axis — each device owns nt/D target tiles and their
+# operator blocks — while mu and P replicate (source windows may read
+# across tile boundaries, so the source side cannot shard without halos).
+# Written full-rank so the specs pass straight into shard_map in_specs;
+# on a 2-D (scenarios x grid) mesh the unnamed "scenarios" axis simply
+# replicates, which is what routes the banded distribution step onto
+# make_mesh_2d meshes (ISSUE 15 satellite; the 1-D grid mesh behavior is
+# unchanged — match_rule drops nothing there).
+BANDED_PLAN_RULES: Tuple[PartitionRule, ...] = (
+    (r"(^|/)band$", (None, GRID_AXIS, None, None)),
+    (r"(^|/)starts$", (None, GRID_AXIS)),
+    (r"(^|/)(mu|P)$", ()),
 )
 
 TRANSITION_SWEEP_RULES: Tuple[PartitionRule, ...] = (

@@ -1,0 +1,50 @@
+"""Persistent solve service (ISSUE 15): the serving layer that cashes in
+the operational substrate of PRs 6-13 for measured requests/sec.
+
+Three load-bearing pieces, each usable standalone:
+
+  * `serve.warmup` — the warm pool: pre-lower/compile the kernel zoo
+    (analysis/registry.py's ProgramSpec catalogue) through the persistent
+    compile cache at startup, so a fresh server's first request is a cache
+    hit instead of a cold XLA compile. CLI: `python -m aiyagari_tpu warmup`.
+  * `serve.cache` — the solution cache: steady states and sequence-space
+    anchors (ss + fake-news Jacobian) memoized under a QUANTIZED
+    calibration fingerprint with an LRU byte budget; bucket collisions and
+    nearest-neighbor misses return warm-start material, never stale
+    results.
+  * `serve.service` — the solve service itself: an admission queue that
+    coalesces compatible requests into lockstep `dispatch.sweep()` /
+    `sweep_transitions` batches on a deadline, warm-starts cache
+    neighbors with a short secant ("Newton") polish, runs the rescue
+    ladder as the server-side retry policy, and reports through the
+    existing ledger/metrics surface. CLI: `python -m aiyagari_tpu serve`.
+
+`serve.load` is the synthetic open-loop load driver `bench.py --metric
+serve` measures requests/sec with.
+"""
+
+from aiyagari_tpu.serve.cache import (
+    SolutionCache,
+    calibration_key,
+    calibration_params,
+    payload_nbytes,
+)
+from aiyagari_tpu.serve.service import (
+    ServeConfig,
+    SolveRequest,
+    SolveResponse,
+    SolveService,
+)
+from aiyagari_tpu.serve.warmup import warm_pool
+
+__all__ = [
+    "ServeConfig",
+    "SolveRequest",
+    "SolveResponse",
+    "SolveService",
+    "SolutionCache",
+    "calibration_key",
+    "calibration_params",
+    "payload_nbytes",
+    "warm_pool",
+]

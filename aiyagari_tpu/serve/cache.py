@@ -1,0 +1,304 @@
+"""Quantized solution cache: steady states and sequence-space anchors
+memoized under a calibration fingerprint, LRU-bounded by a byte budget.
+
+The serving story (ROADMAP "persistent solve service") rests on one
+economic fact: near a cached steady state, a new request is a short polish,
+not a cold fixed-point solve — the sequence-space literature (BKM 2018,
+ABRS 2021 in PAPERS.md) makes transition requests cheap by construction
+once the stationary anchor and the fake-news Jacobian exist. This module
+owns the memo:
+
+  * Keys are QUANTIZED calibration fingerprints: the r-relevant scalars
+    (dispatch._SWEEP_PARAMS — beta/sigma/psi/eta/borrowing_limit/rho/
+    sigma_e) are bucketed at `resolution`, while every structural knob
+    (grid geometry, income-state count, technology, labor flags) keys
+    EXACTLY — two economies in one bucket share a warm start only when
+    their compiled programs and firm curves are literally identical.
+  * A bucket HIT with the same exact parameters replays the cached
+    payload ("hit"). A bucket COLLISION (same bucket, different exact
+    parameters) or a NEAREST-NEIGHBOR match within `neighbor_radius`
+    buckets returns the cached payload as WARM-START MATERIAL only
+    ("warm") — the service polishes from it and stores the polished
+    result under the request's own key, so a collision can never serve a
+    stale answer (tests/test_serve.py pins this).
+  * Entries are LRU-evicted against `byte_budget`: payload sizes are
+    measured over their array leaves (`payload_nbytes`), the budget is a
+    hard ceiling, and every eviction is a counted metric.
+
+Thread-safe (the service's worker and any metrics scraper share it).
+Observability: `aiyagari_solution_cache_{hits,warm,misses,evictions}_total`
+counters plus `aiyagari_solution_cache_{bytes,entries}` gauges; the
+service's per-lookup `cache_hit` ledger events are emitted at the call
+site, where the request id is known.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+__all__ = [
+    "CacheEntry",
+    "SolutionCache",
+    "calibration_key",
+    "calibration_params",
+    "payload_nbytes",
+]
+
+# The r-relevant calibration scalars, in fingerprint order — deliberately
+# the same vocabulary dispatch.sweep()'s parameter grids accept
+# (dispatch._SWEEP_PARAMS), so "what the service quantizes" and "what a
+# sweep can vary" stay one concept.
+PARAM_FIELDS = ("beta", "sigma", "psi", "eta", "borrowing_limit", "rho",
+                "sigma_e")
+
+
+def calibration_params(config) -> Tuple[float, ...]:
+    """The exact r-relevant parameter vector of an AiyagariConfig, in
+    PARAM_FIELDS order."""
+    p, i = config.preferences, config.income
+    return (float(p.beta), float(p.sigma), float(p.psi), float(p.eta),
+            float(config.borrowing_limit), float(i.rho), float(i.sigma_e))
+
+
+def _structural_key(config) -> tuple:
+    """Everything that must match EXACTLY for two economies to share a
+    warm start: grid geometry, income-state structure, technology (the
+    firm curves are compiled statically into the sweep programs —
+    equilibrium/batched.stack_scenarios), and the labor flags."""
+    g, t, i = config.grid, config.technology, config.income
+    return (g.n_points, float(g.power), g.amin, g.amax,
+            i.n_states, i.method, float(t.alpha), float(t.delta),
+            bool(config.endogenous_labor), config.labor_grid_n,
+            tuple(config.labor_grid_bounds))
+
+
+def calibration_key(config, *, resolution: float = 1e-3,
+                    kind: str = "ss", extra: tuple = ()) -> tuple:
+    """The quantized cache key of one request: (kind, structural knobs,
+    per-parameter buckets, extra). `resolution` is the bucket width in
+    NATIVE parameter units (beta and sigma are both macro-calibration
+    scalars of order one, so one absolute width serves the whole vector);
+    `extra` carries request-shape keys that must match exactly (a
+    transition's (T, method), a shock's quantized tuple)."""
+    if resolution <= 0.0:
+        raise ValueError(f"resolution must be > 0, got {resolution}")
+    buckets = tuple(int(math.floor(v / resolution + 0.5))
+                    for v in calibration_params(config))
+    return (kind, _structural_key(config), buckets, tuple(extra))
+
+
+def payload_nbytes(payload) -> int:
+    """Approximate byte size of a cache payload: array leaves count their
+    `.nbytes`, scalars a flat 64-byte overhead. A hand-rolled recursive
+    walk rather than jax.tree_util: result objects (EquilibriumResult,
+    solver Solutions) are NOT registered pytrees, and tree_leaves would
+    price a whole cached anchor — megabytes of mu/policy arrays — as one
+    64-byte opaque leaf, so the LRU byte budget would never evict
+    (exactly the unbounded-growth bug the budget exists to prevent).
+    Containers, dataclasses, and plain __dict__ objects recurse; cycles
+    and shared arrays are counted once via the id-visited set."""
+    total = 0
+    visited: set = set()
+    stack = [payload]
+    while stack:
+        obj = stack.pop()
+        if obj is None:
+            continue
+        oid = id(obj)
+        if oid in visited:
+            continue
+        visited.add(oid)
+        nb = getattr(obj, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+            continue
+        if isinstance(obj, dict):
+            stack.extend(obj.values())
+        elif isinstance(obj, (list, tuple, set, frozenset)):
+            stack.extend(obj)
+        elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            stack.extend(getattr(obj, f.name)
+                         for f in dataclasses.fields(obj))
+        elif hasattr(obj, "__dict__") and not callable(obj):
+            stack.extend(vars(obj).values())
+        else:
+            total += 64
+    return total
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One memoized solve. `exact` disambiguates bucket collisions: a
+    lookup whose exact parameter vector differs gets this entry as
+    warm-start material, never as the answer."""
+
+    key: tuple
+    exact: Tuple[float, ...]
+    payload: object
+    nbytes: int
+    stored_at: float
+    hits: int = 0
+
+
+class SolutionCache:
+    """LRU byte-budgeted memo of solve payloads under quantized keys
+    (module docstring). `byte_budget <= 0` disables storage entirely
+    (every lookup is a miss) — the bench's cold-regime knob."""
+
+    def __init__(self, byte_budget: int = 256 * 1024 * 1024, *,
+                 resolution: float = 1e-3, neighbor_radius: float = 50.0):
+        if resolution <= 0.0:
+            raise ValueError(f"resolution must be > 0, got {resolution}")
+        self.byte_budget = int(byte_budget)
+        self.resolution = float(resolution)
+        self.neighbor_radius = float(neighbor_radius)
+        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.warm = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- keys --------------------------------------------------------------
+
+    def key_for(self, config, *, kind: str = "ss",
+                extra: tuple = ()) -> tuple:
+        return calibration_key(config, resolution=self.resolution,
+                               kind=kind, extra=extra)
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, config, *, kind: str = "ss", extra: tuple = ()):
+        """(outcome, entry): outcome in {"hit", "warm", "miss"}; entry is
+        None only on "miss". "hit" = same bucket AND same exact parameter
+        vector (replay the payload); "warm" = a bucket collision or the
+        nearest neighbor within `neighbor_radius` buckets (polish from the
+        payload, then `put` the polished result under this request's own
+        key)."""
+        key = self.key_for(config, kind=kind, extra=extra)
+        exact = calibration_params(config)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                entry.hits += 1
+                if entry.exact == exact:
+                    self.hits += 1
+                    self._count("hits")
+                    return "hit", entry
+                self.warm += 1
+                self._count("warm")
+                return "warm", entry
+            entry = self._nearest_locked(key, exact)
+            if entry is not None:
+                self.warm += 1
+                self._count("warm")
+                return "warm", entry
+            self.misses += 1
+            self._count("misses")
+            return "miss", None
+
+    def _nearest_locked(self, key: tuple, exact: Tuple[float, ...]):
+        """The nearest same-kind/same-structure entry within
+        `neighbor_radius` (L2 over parameter distance in bucket units).
+        Linear scan — the cache holds at most a few thousand entries
+        (byte-budgeted), and the scan is pure host arithmetic."""
+        kind, structural = key[0], key[1]
+        best, best_d = None, float("inf")
+        for entry in self._entries.values():
+            if entry.key[0] != kind or entry.key[1] != structural \
+                    or entry.key[3] != key[3]:
+                continue
+            d = math.sqrt(sum((a - b) ** 2 for a, b in
+                              zip(entry.exact, exact))) / self.resolution
+            if d < best_d:
+                best, best_d = entry, d
+        if best is not None and best_d <= self.neighbor_radius:
+            return best
+        return None
+
+    # -- store -------------------------------------------------------------
+
+    def put(self, config, payload, *, kind: str = "ss",
+            extra: tuple = ()) -> Optional[CacheEntry]:
+        """Store (or replace) the payload under the request's quantized
+        key, then evict least-recently-used entries until the byte budget
+        holds. A payload larger than the whole budget is not stored (it
+        would evict everything and then itself — the metric records the
+        refusal as an eviction)."""
+        key = self.key_for(config, kind=kind, extra=extra)
+        nbytes = payload_nbytes(payload)
+        entry = CacheEntry(key=key, exact=calibration_params(config),
+                           payload=payload, nbytes=nbytes,
+                           stored_at=time.time())
+        with self._lock:
+            if self.byte_budget <= 0:
+                return None
+            if nbytes > self.byte_budget:
+                self.evictions += 1
+                self._count("evictions")
+                self._gauges()
+                return None
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += nbytes
+            while self._bytes > self.byte_budget and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+                self._count("evictions")
+            self._gauges()
+        return entry
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def hit_rate(self) -> float:
+        """Exact-hit fraction of all lookups (the gauge the service
+        exports; warm lookups are counted as non-hits — they still solve)."""
+        total = self.hits + self.warm + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits, "warm": self.warm,
+                    "misses": self.misses, "evictions": self.evictions,
+                    "hit_rate": round(self.hit_rate(), 4)}
+
+    # -- observability (must never fail a solve) ---------------------------
+
+    def _count(self, outcome: str) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter(
+                f"aiyagari_solution_cache_{outcome}_total").inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+
+    def _gauges(self) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.gauge("aiyagari_solution_cache_bytes").set(self._bytes)
+            metrics.gauge("aiyagari_solution_cache_entries").set(
+                len(self._entries))
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass

@@ -1,0 +1,98 @@
+"""Synthetic open-loop load driver: the requests/sec + latency-percentile
+measurement `bench.py --metric serve` (and `python -m aiyagari_tpu serve
+--load N`) runs against an in-process SolveService.
+
+Open loop means arrivals follow the SCHEDULE, not the server: request i is
+submitted at t0 + i/rps whether or not earlier requests finished, so queue
+buildup shows up as latency (the production-realistic regime — a closed
+loop would let a slow server throttle its own offered load and report
+flattering percentiles). rps=None degenerates to submit-all-at-once, the
+coalescing regime's natural drive.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["percentiles", "run_load", "synthetic_requests"]
+
+
+def synthetic_requests(base, n: int, *, seed: int = 0,
+                       resolution: float = 1e-3, spread: float = 0.02,
+                       kind: str = "steady_state", shock=None) -> list:
+    """N requests over distinct calibrations of `base`: betas drawn
+    uniformly within +/- spread of the base value (clipped into (0, 1)),
+    rounded to half-resolution so repeated draws exercise both cache hits
+    and near-bucket warm starts."""
+    import dataclasses
+
+    from aiyagari_tpu.serve.service import SolveRequest
+
+    rng = np.random.default_rng(seed)
+    beta0 = base.preferences.beta
+    out = []
+    for _ in range(n):
+        beta = float(np.clip(beta0 + rng.uniform(-spread, spread),
+                             0.80, 0.995))
+        beta = round(beta / (0.5 * resolution)) * (0.5 * resolution)
+        cfg = dataclasses.replace(
+            base, preferences=dataclasses.replace(base.preferences,
+                                                  beta=beta))
+        out.append(SolveRequest(cfg, kind=kind, shock=shock))
+    return out
+
+
+def percentiles(latencies) -> dict:
+    xs = np.asarray(sorted(float(v) for v in latencies), np.float64)
+    if xs.size == 0:
+        return {"p50_s": None, "p90_s": None, "p99_s": None, "mean_s": None}
+    return {
+        "p50_s": round(float(np.percentile(xs, 50)), 6),
+        "p90_s": round(float(np.percentile(xs, 90)), 6),
+        "p99_s": round(float(np.percentile(xs, 99)), 6),
+        "mean_s": round(float(xs.mean()), 6),
+    }
+
+
+def run_load(service, requests: Sequence, *, rps: Optional[float] = None,
+             closed: bool = False, timeout: float = 600.0) -> dict:
+    """Drive `requests` through `service` on the open-loop schedule and
+    assemble the latency/throughput report. Latency is client-observed:
+    submit -> response, queue wait included (SolveResponse.latency_s).
+
+    closed=True runs a CLOSED loop instead — each request waits for the
+    previous response before submitting — which measures pure per-request
+    service latency with no queueing (the one-at-a-time regime the serve
+    bench's cold/warm percentiles are defined on); `rps` is ignored."""
+    t0 = time.perf_counter()
+    if closed:
+        responses = [service.submit(req).result(timeout)
+                     for req in requests]
+    else:
+        futures = []
+        for i, req in enumerate(requests):
+            if rps:
+                target = t0 + i / float(rps)
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            futures.append(service.submit(req))
+        responses = [f.result(timeout) for f in futures]
+    wall = time.perf_counter() - t0
+    lat = [r.latency_s for r in responses]
+    return {
+        "requests": len(responses),
+        "wall_s": round(wall, 6),
+        "rps": round(len(responses) / wall, 4) if wall > 0 else None,
+        "offered_rps": rps,
+        **percentiles(lat),
+        "statuses": dict(Counter(r.status for r in responses)),
+        "cache_outcomes": dict(Counter(r.cache for r in responses)),
+        "batch_sizes": sorted({r.batch for r in responses}),
+        "max_queue_wait_s": round(max((r.queue_wait_s for r in responses),
+                                      default=0.0), 6),
+    }

@@ -1,0 +1,969 @@
+"""The persistent solve service: warm-pool precompile, deadline
+coalescing, a quantized solution cache, and SLO-aware execution on top of
+the dispatch layer (ISSUE 15 tentpole).
+
+Architecture (docs/DESIGN.md "Why coalescing sits above dispatch and the
+cache below it"):
+
+  * COALESCING sits ABOVE dispatch: the admission queue groups compatible
+    incoming requests (same grid shapes, income-state structure, and
+    technology — exactly `stack_scenarios`' one-compilation invariants)
+    and lowers each group to ONE lockstep `dispatch.sweep()` /
+    `dispatch.sweep_transitions()` call on a deadline (`max_wait_s` /
+    `max_batch` knobs). PR 10's scenario quarantine is what makes this
+    safe to do to strangers' requests: one pathological calibration
+    degrades its own lane — with a structured verdict — never its
+    batchmates, and the rescue ladder re-solves it serially as the
+    server-side retry policy.
+  * The SOLUTION CACHE sits BELOW dispatch conceptually: it stores solve
+    OUTPUTS (equilibrium scalars, the warm-start policy, the stationary
+    anchor + fake-news Jacobian) under quantized calibration fingerprints
+    (serve/cache.py), and warm lookups re-enter dispatch as cheaper
+    solves — a narrowed secant polish seeded with the cached consumption
+    policy for steady states, an anchor/Jacobian reuse (`ss=`/`jacobian=`)
+    for transitions — so a typical near-cached request does ~10x less
+    work than a cold fixed-point solve, through the SAME observed dispatch
+    boundary (route decisions, spans, verdicts all still recorded).
+
+Response statuses reuse the resilience verdict taxonomy (ISSUE 10):
+"converged" | "rescued" | "nan" | "stall" | "explode" | "max_iter" |
+"error". Every request leaves a ledger trail — `serve_request` (id, cache
+outcome, status, queue wait, wall), `cache_hit` (per lookup), `coalesce`
+(per batch), plus dispatch's own spans/route_decision/verdict events — and
+the metrics registry exports `aiyagari_serve_queue_depth`,
+`aiyagari_serve_batch_size`, and `aiyagari_serve_cache_hit_rate` gauges
+beside the request counters and latency histogram.
+
+`python -m aiyagari_tpu serve` (serve_main) runs the service standalone:
+an stdlib HTTP front (`--port`: POST /solve, GET /metrics /healthz) or the
+synthetic open-loop load driver (`--load`, serve/load.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Optional, Tuple
+
+import numpy as np
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    BackendConfig,
+    EquilibriumConfig,
+    MITShock,
+    SolverConfig,
+    TransitionConfig,
+)
+
+__all__ = [
+    "ServeConfig",
+    "SolveRequest",
+    "SolveResponse",
+    "SolveService",
+    "serve_main",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The service's knobs. Frozen like every other config object.
+
+    max_batch / max_wait_s are the deadline-coalescing pair: the worker
+    takes the oldest queued request, then holds the batch open for AT MOST
+    `max_wait_s` (or until `max_batch` compatible requests joined) before
+    dispatching — max_batch=1 disables coalescing (the serial A/B the
+    bench measures against). cache_bytes <= 0 disables the solution cache
+    (every request solves cold)."""
+
+    method: str = "egm"
+    dtype: str = "float64"
+    aggregation: str = "distribution"
+    max_batch: int = 8
+    max_wait_s: float = 0.05
+    cache_bytes: int = 256 * 1024 * 1024
+    resolution: float = 1e-3           # calibration quantization bucket
+    neighbor_radius: float = 50.0      # nearest-neighbor radius, in buckets
+    polish_steps: int = 8              # secant evaluations before the
+                                       # warm path falls back to a cold solve
+    rescue: bool = True                # the server-side retry policy
+    warm_pool: bool = True             # precompile the kernel zoo at start()
+    warm_families: Optional[Tuple[str, ...]] = None
+    warm_na: Optional[int] = None      # also precompile sized hot programs
+    solver: Optional[SolverConfig] = None
+    equilibrium: EquilibriumConfig = EquilibriumConfig()
+    transition: TransitionConfig = TransitionConfig()
+
+    def __post_init__(self):
+        if self.method not in ("vfi", "egm"):
+            raise ValueError(f"unknown method {self.method!r}")
+        if self.aggregation not in ("distribution", "simulation"):
+            raise ValueError(f"unknown aggregation {self.aggregation!r}")
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_wait_s < 0:
+            raise ValueError(
+                f"max_wait_s must be >= 0, got {self.max_wait_s}")
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One admission-queue entry. kind selects the solve family:
+    "steady_state" (GE fixed point of `config`) or "transition" (MIT-shock
+    path of `config` under `shock`)."""
+
+    config: AiyagariConfig
+    kind: str = "steady_state"
+    shock: Optional[MITShock] = None
+    id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:8])
+    submitted: float = 0.0             # stamped by submit()
+
+    def __post_init__(self):
+        if self.kind not in ("steady_state", "transition"):
+            raise ValueError(f"unknown request kind {self.kind!r}")
+        if self.kind == "transition" and self.shock is None:
+            raise ValueError("transition requests need a shock=MITShock(...)")
+
+
+@dataclasses.dataclass
+class SolveResponse:
+    """One served request's result + its flight-record scalars."""
+
+    id: str
+    kind: str
+    status: str                        # the verdict taxonomy (module doc)
+    cache: str                         # "hit" | "warm" | "cold"
+    converged: bool
+    r: Optional[float] = None
+    w: Optional[float] = None
+    capital: Optional[float] = None
+    gap: Optional[float] = None
+    r_path: Optional[np.ndarray] = None
+    queue_wait_s: float = 0.0
+    wall_s: float = 0.0                # service-side solve wall
+    latency_s: float = 0.0             # submit -> response, queue included
+    batch: int = 1
+    error: Optional[str] = None
+    result: object = None              # the underlying result object
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out.pop("result")
+        if out.get("r_path") is not None:
+            out["r_path"] = [float(v) for v in np.asarray(out["r_path"])]
+        return out
+
+
+def _compat_key(req: SolveRequest, cfg: ServeConfig):
+    """Requests coalesce iff this matches: steady-state batches need
+    stack_scenarios' invariants (shared shapes + technology); transition
+    batches share ONE economy (one anchor, one Jacobian), so the whole
+    config keys."""
+    c = req.config
+    if req.kind == "transition":
+        return ("transition", c)
+    return ("steady_state", c.grid.n_points, c.income.n_states,
+            c.endogenous_labor, c.labor_grid_n, c.technology)
+
+
+def _status_of(result) -> str:
+    if getattr(result, "converged", False):
+        if getattr(result, "rescue_attempts", None):
+            return "rescued"
+        return "converged"
+    return getattr(result, "verdict", "") or "max_iter"
+
+
+class SolveService:
+    """The persistent solve service (module docstring). Usage:
+
+        svc = SolveService(ServeConfig(max_batch=8), ledger="serve.jsonl")
+        svc.start()
+        fut = svc.submit(SolveRequest(AiyagariConfig()))
+        resp = fut.result()
+        svc.stop()
+
+    or as a context manager. `solve(config)` is the synchronous one-liner.
+    All device work happens on the single worker thread; submission is
+    thread-safe from any number of clients."""
+
+    def __init__(self, config: ServeConfig = ServeConfig(), *,
+                 ledger=None):
+        from aiyagari_tpu.serve.cache import SolutionCache
+
+        self.config = config
+        self.cache = SolutionCache(config.cache_bytes,
+                                   resolution=config.resolution,
+                                   neighbor_radius=config.neighbor_radius)
+        self._led = self._as_ledger(ledger)
+        self._queue: list = []          # [(SolveRequest, Future)]
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.warmup_report: Optional[dict] = None
+        self.requests_served = 0
+
+    def _as_ledger(self, ledger):
+        if ledger is None:
+            return None
+        from aiyagari_tpu.diagnostics.ledger import RunLedger
+
+        if isinstance(ledger, RunLedger):
+            return ledger
+        return RunLedger(ledger, config=[self.config.equilibrium,
+                                         self.config.transition],
+                         meta={"entry": "serve"})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SolveService":
+        if self._running:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            # A timed-out stop() left the worker draining a long solve:
+            # resurrect it instead of spawning a racing second worker.
+            with self._cond:
+                self._running = True
+                self._cond.notify_all()
+            if self._thread.is_alive():
+                return self
+            # The worker exited between the checks — fall through and
+            # spawn a fresh one.
+            self._thread = None
+        if self.config.warm_pool:
+            from aiyagari_tpu.serve.warmup import warm_pool
+
+            self.warmup_report = warm_pool(
+                self.config.warm_families, na=self.config.warm_na,
+                dtype=("float64" if self.config.dtype in ("float64", "mixed")
+                       else "float32"),
+                ledger=self._led)
+        self._running = True
+        self._thread = threading.Thread(target=self._worker,
+                                        name="aiyagari-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Drain the queue, then stop the worker. If the worker is still
+        mid-solve after `timeout`, the handle is KEPT (a later start()
+        resurrects it; a later stop() re-joins) — clearing it would let
+        start() spawn a second worker racing the still-draining first."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if not self._thread.is_alive():
+                self._thread = None
+
+    def __enter__(self) -> "SolveService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, request: SolveRequest) -> Future:
+        if not self._running:
+            raise RuntimeError("service not started (call start())")
+        request.submitted = time.perf_counter()
+        fut: Future = Future()
+        with self._cond:
+            self._queue.append((request, fut))
+            self._gauge_queue_depth()
+            self._cond.notify_all()
+        return fut
+
+    def solve(self, config: AiyagariConfig, *, kind: str = "steady_state",
+              shock: Optional[MITShock] = None,
+              timeout: Optional[float] = None) -> SolveResponse:
+        return self.submit(
+            SolveRequest(config, kind=kind, shock=shock)).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def metrics_text(self) -> str:
+        from aiyagari_tpu.diagnostics import metrics
+
+        return metrics.render_prometheus()
+
+    # -- worker ------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and self._running:
+                    self._cond.wait(0.1)
+                if not self._queue:
+                    if not self._running:
+                        return
+                    continue
+                first = self._queue.pop(0)
+                self._gauge_queue_depth()
+            try:
+                served = self._try_hit(first)
+            except Exception as e:  # noqa: BLE001 — the worker must survive
+                # A failing fast path (e.g. a ledger write hitting ENOSPC)
+                # must resolve the popped request and keep the worker
+                # alive — an unhandled raise here would kill the single
+                # worker with _running still True and hang every later
+                # submit() silently.
+                req, fut = first
+                if not fut.done():
+                    fut.set_result(self._finish(req, SolveResponse(
+                        id=req.id, kind=req.kind, status="error",
+                        cache="cold", converged=False,
+                        error=f"{type(e).__name__}: {e}"[:500]), batch=1))
+                served = True
+            if served:
+                continue
+            batch = [first]
+            # Deadline coalescing: hold the batch open for compatible
+            # requests until max_wait_s from the FIRST pop, or max_batch.
+            key = _compat_key(first[0], self.config)
+            deadline = time.perf_counter() + self.config.max_wait_s
+            while (len(batch) < self.config.max_batch
+                   and self.config.max_batch > 1):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0.0:
+                    break
+                with self._cond:
+                    idx = next(
+                        (i for i, (req, _) in enumerate(self._queue)
+                         if _compat_key(req, self.config) == key), None)
+                    if idx is not None:
+                        batch.append(self._queue.pop(idx))
+                        self._gauge_queue_depth()
+                        continue
+                    self._cond.wait(min(remaining, 0.005))
+            try:
+                self._serve_batch(batch)
+            except Exception as e:  # noqa: BLE001 — the worker must survive
+                for req, fut in batch:
+                    if not fut.done():
+                        fut.set_result(self._finish(
+                            req, SolveResponse(
+                                id=req.id, kind=req.kind, status="error",
+                                cache="cold", converged=False,
+                                error=f"{type(e).__name__}: {e}"[:500]),
+                            batch=len(batch)))
+
+    def _try_hit(self, item) -> bool:
+        """Serve an exact cache hit IMMEDIATELY, before any coalescing
+        wait: a replayed payload needs no batchmates, and holding it to
+        the deadline would put the coalescing knob's max_wait_s on the
+        cheapest requests' latency floor. Peeks without consuming the
+        warm/miss outcome (the real lookup in _serve_batch still counts
+        those)."""
+        from aiyagari_tpu.diagnostics.ledger import activate
+
+        req, fut = item
+        if self.config.cache_bytes <= 0:
+            return False
+        key_kind = "transition" if req.kind == "transition" else "ss"
+        extra = (self._transition_extra(req.shock)
+                 if req.kind == "transition" else ())
+        from aiyagari_tpu.serve.cache import calibration_params
+
+        entry = self.cache._entries.get(
+            self.cache.key_for(req.config, kind=key_kind, extra=extra))
+        if entry is None or entry.exact != calibration_params(req.config):
+            return False
+        with activate(self._led):
+            outcome, entry = self._lookup(req, kind=key_kind, extra=extra)
+            if outcome != "hit":
+                # Defensive only: evictions happen in cache.put, which
+                # only THIS worker thread calls, so nothing can evict
+                # between the peek and the lookup today — this branch
+                # exists for a future multi-worker service (where its
+                # transition leg would double-count one lookup; accepted
+                # as unreachable-now). Serve on the spot — a warm steady
+                # state polishes, anything else solves serially.
+                if req.kind == "steady_state" and outcome == "warm":
+                    fut.set_result(self._finish(
+                        req, self._steady_polish(req, entry), batch=1))
+                elif req.kind == "steady_state":
+                    fut.set_result(self._finish(
+                        req, self._steady_serial(req), batch=1))
+                else:
+                    self._serve_transitions([item])
+                return True
+            p = entry.payload
+            fut.set_result(self._finish(req, SolveResponse(
+                id=req.id, kind=req.kind, status=p["status"], cache="hit",
+                converged=bool(p["converged"]), r=p.get("r"),
+                w=p.get("w"), capital=p.get("capital"), gap=p.get("gap"),
+                r_path=p.get("r_path"), wall_s=0.0), batch=1))
+        return True
+
+    # -- batch serving -----------------------------------------------------
+
+    def _serve_batch(self, batch) -> None:
+        from aiyagari_tpu.diagnostics.ledger import activate
+
+        t0 = time.perf_counter()
+        n = len(batch)
+        self._gauge("aiyagari_serve_batch_size", n)
+        waits = [t0 - req.submitted for req, _ in batch]
+        if self._led is not None:
+            self._led.event(
+                "coalesce", batch=n, request_kind=batch[0][0].kind,
+                queue_wait_max_s=round(max(waits), 6),
+                queue_wait_min_s=round(min(waits), 6),
+                requests=[req.id for req, _ in batch])
+        with activate(self._led):
+            if batch[0][0].kind == "transition":
+                self._serve_transitions(batch)
+            else:
+                self._serve_steady(batch)
+        if self._led is not None:
+            self._led.span({"name": "serve_batch", "batch": n,
+                            "kind": batch[0][0].kind,
+                            "seconds": round(time.perf_counter() - t0, 6)})
+
+    def _lookup(self, req: SolveRequest, *, kind: str, extra: tuple = ()):
+        if self.config.cache_bytes <= 0:
+            return "miss", None
+        outcome, entry = self.cache.lookup(req.config, kind=kind,
+                                           extra=extra)
+        self._gauge("aiyagari_serve_cache_hit_rate", self.cache.hit_rate())
+        if self._led is not None:
+            self._led.event("cache_hit", id=req.id, request_kind=req.kind,
+                            lookup=kind, outcome=outcome)
+        return outcome, entry
+
+    def _finish(self, req: SolveRequest, resp: SolveResponse, *,
+                batch: int) -> SolveResponse:
+        from aiyagari_tpu.diagnostics import metrics
+
+        now = time.perf_counter()
+        resp.queue_wait_s = round(
+            max(0.0, (now - req.submitted) - resp.wall_s), 6)
+        resp.latency_s = round(now - req.submitted, 6)
+        resp.batch = batch
+        self.requests_served += 1
+        metrics.counter("aiyagari_serve_requests_total", kind=req.kind,
+                        status=resp.status, cache=resp.cache).inc()
+        metrics.histogram("aiyagari_serve_latency_seconds",
+                          kind=req.kind).observe(resp.latency_s)
+        if self._led is not None:
+            self._led.event("serve_request", id=req.id,
+                            request_kind=req.kind,
+                            cache=resp.cache, status=resp.status,
+                            converged=resp.converged,
+                            queue_wait_s=resp.queue_wait_s,
+                            wall_s=round(resp.wall_s, 6),
+                            latency_s=resp.latency_s, batch=batch)
+        return resp
+
+    # -- steady states -----------------------------------------------------
+
+    def _serve_steady(self, batch) -> None:
+        cold, warm = [], []
+        n = len(batch)
+        for req, fut in batch:
+            outcome, entry = self._lookup(req, kind="ss")
+            if outcome == "hit":
+                p = entry.payload
+                fut.set_result(self._finish(req, SolveResponse(
+                    id=req.id, kind=req.kind, status=p["status"],
+                    cache="hit", converged=bool(p["converged"]),
+                    r=p["r"], w=p["w"], capital=p["capital"],
+                    gap=p["gap"], wall_s=0.0), batch=n))
+            elif outcome == "warm":
+                warm.append((req, fut, entry))
+            else:
+                cold.append((req, fut))
+        if len(cold) == 1:
+            req, fut = cold[0]
+            fut.set_result(self._finish(
+                req, self._steady_serial(req), batch=n))
+        elif cold:
+            self._steady_sweep(cold, batch_size=n)
+        for req, fut, entry in warm:
+            fut.set_result(self._finish(
+                req, self._steady_polish(req, entry), batch=n))
+
+    def _solve_kwargs(self) -> dict:
+        return dict(method=self.config.method, solver=self.config.solver,
+                    backend=BackendConfig(dtype=self.config.dtype),
+                    aggregation=self.config.aggregation, ledger=self._led)
+
+    def _put_steady(self, config, result, status: str,
+                    slope: Optional[float] = None) -> None:
+        # Only converged solves are worth memoizing: replaying a failure
+        # as a "hit" would pin one bad attempt as the bucket's permanent
+        # answer, and its iterate is poor warm-start material.
+        if self.config.cache_bytes <= 0 or not result.converged:
+            return
+        gap = (float(result.k_supply[-1] - result.k_demand[-1])
+               if result.k_supply else float("nan"))
+        warm_state = None
+        sol = getattr(result, "solution", None)
+        if sol is not None:
+            ws = (sol.v if self.config.method == "vfi"
+                  else getattr(sol, "policy_c", None))
+            if ws is not None:
+                warm_state = np.asarray(ws)
+        if slope is None:
+            slope = self._slope_from_history(result)
+        self.cache.put(config, {
+            "r": float(result.r), "w": float(result.w),
+            "capital": float(result.capital), "gap": gap,
+            "converged": bool(result.converged), "status": status,
+            "slope": slope, "warm": warm_state,
+        }, kind="ss")
+
+    @staticmethod
+    def _slope_from_history(result) -> Optional[float]:
+        """d(gap)/dr from the solve's last two bisection evaluations —
+        the secant seed a later warm polish starts from."""
+        try:
+            rs = result.r_history
+            gaps = [s - d for s, d in zip(result.k_supply, result.k_demand)]
+        except (AttributeError, TypeError):
+            return None
+        for i in range(len(rs) - 1, 0, -1):
+            dr = rs[i] - rs[i - 1]
+            if dr != 0.0 and np.isfinite(dr):
+                s = (gaps[i] - gaps[i - 1]) / dr
+                if np.isfinite(s) and s != 0.0:
+                    return float(s)
+        return None
+
+    def _steady_serial(self, req: SolveRequest) -> SolveResponse:
+        from aiyagari_tpu import dispatch
+        from aiyagari_tpu.diagnostics.errors import ConvergenceError
+
+        t0 = time.perf_counter()
+        try:
+            res = dispatch.solve(req.config,
+                                 equilibrium=self.config.equilibrium,
+                                 on_nonconvergence="ignore",
+                                 rescue=(True if self.config.rescue
+                                         else None),
+                                 **self._solve_kwargs())
+        except ConvergenceError as e:
+            return SolveResponse(
+                id=req.id, kind=req.kind,
+                status=(e.verdict or "max_iter"), cache="cold",
+                converged=False, error=str(e)[:500],
+                wall_s=time.perf_counter() - t0)
+        status = _status_of(res)
+        self._put_steady(req.config, res, status)
+        return SolveResponse(
+            id=req.id, kind=req.kind, status=status, cache="cold",
+            converged=bool(res.converged), r=float(res.r), w=float(res.w),
+            capital=float(res.capital),
+            gap=(float(res.k_supply[-1] - res.k_demand[-1])
+                 if res.k_supply else None),
+            wall_s=time.perf_counter() - t0, result=res)
+
+    def _steady_sweep(self, cold, *, batch_size: int) -> None:
+        """The coalesced path: one lockstep dispatch.sweep over every cold
+        request — quarantine isolates a poisoned lane, rescue re-solves it
+        serially (the server-side retry policy)."""
+        from aiyagari_tpu import dispatch
+
+        t0 = time.perf_counter()
+        configs = [req.config for req, _ in cold]
+        res = dispatch.sweep(configs[0], configs=configs,
+                             equilibrium=self.config.equilibrium,
+                             quarantine=True,
+                             rescue=(True if self.config.rescue else None),
+                             **self._solve_kwargs())
+        wall = time.perf_counter() - t0
+        verdicts = (res.verdicts if res.verdicts is not None
+                    else ["converged" if c else "max_iter"
+                          for c in res.converged])
+        for i, (req, fut) in enumerate(cold):
+            status = verdicts[i]
+            converged = bool(res.converged[i])
+            resp = SolveResponse(
+                id=req.id, kind=req.kind, status=status, cache="cold",
+                converged=converged, r=float(res.r[i]), w=float(res.w[i]),
+                capital=float(res.capital[i]), gap=float(res.gap[i]),
+                wall_s=wall, result=res)
+            if converged:
+                # The lane's converged household policy from the batched
+                # solutions pytree: sweep-produced entries must be
+                # first-class warm-start material, same as the serial
+                # path's (no per-lane secant history exists — the polish
+                # bootstraps its slope on first use).
+                warm_state = None
+                sol = getattr(res, "solutions", None)
+                if sol is not None:
+                    ws = (getattr(sol, "v", None)
+                          if self.config.method == "vfi"
+                          else getattr(sol, "policy_c", None))
+                    if ws is not None:
+                        warm_state = np.asarray(ws[i])
+                self.cache.put(req.config, {
+                    "r": float(res.r[i]), "w": float(res.w[i]),
+                    "capital": float(res.capital[i]),
+                    "gap": float(res.gap[i]), "converged": True,
+                    "status": status, "slope": None, "warm": warm_state,
+                }, kind="ss")
+            fut.set_result(self._finish(req, resp, batch=batch_size))
+
+    def _steady_polish(self, req: SolveRequest, entry) -> SolveResponse:
+        """The warm path: a short secant polish on the market-clearing
+        rate, seeded at the cached neighbor's equilibrium (and its
+        consumption policy as the household warm start) — each evaluation
+        is one max_iter=1 dispatch.solve at a pinned rate, so the whole
+        polish is a handful of warm-started household+distribution solves
+        instead of a cold bisection from the full bracket. Falls back to
+        the cold path when the polish does not close within polish_steps
+        (correctness never depends on the cache)."""
+        from aiyagari_tpu import dispatch
+
+        t0 = time.perf_counter()
+        eq0 = self.config.equilibrium
+        payload = entry.payload
+        r = float(payload["r"])
+        slope = payload.get("slope")
+        warm_state = payload.get("warm")
+        beta = float(req.config.preferences.beta)
+        r_cap = 1.0 / beta - 1.0 - 1e-4
+        r_floor = float(eq0.r_low)
+        probe = max(4.0 * self.config.resolution, 1e-3)
+        pts: list = []
+        res = None
+        for _ in range(max(1, self.config.polish_steps)):
+            r = float(np.clip(r, r_floor, r_cap))
+            # batch=1 pinned: the polish evaluation is a single-rate
+            # serial pass regardless of the service's configured batched
+            # GE (dispatch rejects warm_start= on the batched closure).
+            eq = dataclasses.replace(eq0, r_low=r, r_high=r, r_init=r,
+                                     max_iter=1, batch=1)
+            res = dispatch.solve(req.config, equilibrium=eq,
+                                 on_nonconvergence="ignore",
+                                 warm_start=warm_state,
+                                 **self._solve_kwargs())
+            sol = getattr(res, "solution", None)
+            if sol is not None:
+                ws = (sol.v if self.config.method == "vfi"
+                      else getattr(sol, "policy_c", None))
+                if ws is not None:
+                    warm_state = ws
+            gap = float(res.k_supply[-1] - res.k_demand[-1])
+            if res.converged:
+                status = _status_of(res)
+                self._put_steady(req.config, res, status, slope=slope)
+                return SolveResponse(
+                    id=req.id, kind=req.kind, status=status, cache="warm",
+                    converged=True, r=float(res.r), w=float(res.w),
+                    capital=float(res.capital), gap=gap,
+                    wall_s=time.perf_counter() - t0, result=res)
+            pts.append((r, gap))
+            if len(pts) >= 2:
+                dr = pts[-1][0] - pts[-2][0]
+                dg = pts[-1][1] - pts[-2][1]
+                if dr != 0.0 and dg != 0.0 and np.isfinite(dg / dr):
+                    slope = dg / dr
+            if not (slope and np.isfinite(slope) and slope != 0.0):
+                # No usable slope yet: probe a nearby rate to bootstrap
+                # the secant (supply slopes up in r, demand down, so the
+                # gap is increasing — step against the gap's sign).
+                r = r + (probe if gap < 0.0 else -probe)
+                continue
+            step = gap / slope
+            r = r - step
+        # Polish exhausted: the neighbor was too far (or the slope
+        # estimate bad) — serve the request cold, honestly labeled warm
+        # (the cache outcome) with the full wall.
+        resp = self._steady_serial(req)
+        resp.cache = "warm"
+        resp.wall_s = time.perf_counter() - t0
+        return resp
+
+    # -- transitions -------------------------------------------------------
+
+    def _transition_extra(self, shock: MITShock) -> tuple:
+        t = self.config.transition
+        return (t.T, t.method, shock.param, float(shock.size),
+                float(shock.rho))
+
+    def _serve_transitions(self, batch) -> None:
+        from aiyagari_tpu import dispatch
+
+        n = len(batch)
+        todo = []
+        for req, fut in batch:
+            outcome, entry = self._lookup(
+                req, kind="transition", extra=self._transition_extra(req.shock))
+            if outcome == "hit":
+                p = entry.payload
+                fut.set_result(self._finish(req, SolveResponse(
+                    id=req.id, kind=req.kind, status=p["status"],
+                    cache="hit", converged=bool(p["converged"]),
+                    r_path=p["r_path"], wall_s=0.0), batch=n))
+            else:
+                todo.append((req, fut))
+        if not todo:
+            return
+        # The anchor memo: the stationary equilibrium + fake-news Jacobian
+        # are shock-independent, so ONE exact-calibration anchor serves
+        # every queued shock of this economy (ss reuse across calibration
+        # buckets would silently anchor the wrong model — exact hits only).
+        cfg = todo[0][0].config
+        t_cfg = self.config.transition
+        anchor_outcome, anchor = self._lookup(
+            todo[0][0], kind="anchor", extra=(t_cfg.T,))
+        ss = jacobian = None
+        if anchor_outcome == "hit":
+            ss = anchor.payload.get("ss")
+            jacobian = anchor.payload.get("jacobian")
+        cache_label = "warm" if ss is not None else "cold"
+        t0 = time.perf_counter()
+        # equilibrium= is deliberately NOT threaded through: with eq=None
+        # the anchor solve applies transition/mit.stationary_anchor's own
+        # TIGHTER defaults (max_iter=48, tol=1e-8) — anchor error floors
+        # the whole path's flatness, so the service's (possibly loosened)
+        # steady-state serving tolerance must not degrade it.
+        kwargs = dict(transition=t_cfg,
+                      backend=BackendConfig(dtype=self.config.dtype),
+                      solver=self.config.solver, ledger=self._led,
+                      rescue=(True if self.config.rescue else None))
+        if ss is not None:
+            kwargs.update(ss=ss, jacobian=jacobian)
+        try:
+            if len(todo) == 1:
+                res = dispatch.solve_transition(
+                    cfg, todo[0][0].shock, on_nonconvergence="ignore",
+                    **kwargs)
+                walls = time.perf_counter() - t0
+                responses = [self._transition_response(
+                    todo[0][0], res, res.r_path, _status_of(res),
+                    bool(res.converged), cache_label, walls)]
+                new_ss, new_j = res.ss, res.jacobian
+            else:
+                res = dispatch.sweep_transitions(
+                    cfg, [req.shock for req, _ in todo],
+                    quarantine=True, **kwargs)
+                walls = time.perf_counter() - t0
+                verdicts = (res.verdicts if res.verdicts is not None
+                            else ["converged" if c else "max_iter"
+                                  for c in res.converged])
+                responses = [
+                    self._transition_response(
+                        req, res, np.asarray(res.r_paths[i]), verdicts[i],
+                        bool(res.converged[i]), cache_label, walls)
+                    for i, (req, _) in enumerate(todo)]
+                new_ss, new_j = res.ss, res.jacobian
+        except Exception as e:  # noqa: BLE001 — per-request error responses
+            from aiyagari_tpu.diagnostics.errors import ConvergenceError
+
+            status = ((e.verdict or "max_iter")
+                      if isinstance(e, ConvergenceError) else "error")
+            for req, fut in todo:
+                fut.set_result(self._finish(req, SolveResponse(
+                    id=req.id, kind=req.kind, status=status,
+                    cache=cache_label, converged=False,
+                    error=f"{type(e).__name__}: {e}"[:500],
+                    wall_s=time.perf_counter() - t0), batch=n))
+            return
+        if self.config.cache_bytes > 0 and new_ss is not None:
+            self.cache.put(cfg, {"ss": new_ss, "jacobian": new_j},
+                           kind="anchor", extra=(t_cfg.T,))
+        for (req, fut), resp in zip(todo, responses):
+            if self.config.cache_bytes > 0 and resp.converged:
+                self.cache.put(req.config, {
+                    "r_path": np.asarray(resp.r_path),
+                    "status": resp.status, "converged": True,
+                }, kind="transition",
+                    extra=self._transition_extra(req.shock))
+            fut.set_result(self._finish(req, resp, batch=n))
+
+    def _transition_response(self, req, res, r_path, status, converged,
+                             cache, wall) -> SolveResponse:
+        return SolveResponse(
+            id=req.id, kind=req.kind, status=status, cache=cache,
+            converged=converged, r_path=np.asarray(r_path),
+            wall_s=wall, result=res)
+
+    # -- metrics helpers ---------------------------------------------------
+
+    def _gauge_queue_depth(self) -> None:
+        self._gauge("aiyagari_serve_queue_depth", len(self._queue))
+
+    @staticmethod
+    def _gauge(name: str, value) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.gauge(name).set(float(value))
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+
+
+# -- the CLI front ---------------------------------------------------------
+
+
+def _http_server(service: SolveService, base: AiyagariConfig, port: int):
+    """Minimal stdlib HTTP front: POST /solve (JSON body with optional
+    "params" overrides over the base config, optional "shock"), GET
+    /metrics (Prometheus text), GET /healthz. No dependencies — the
+    container constraint — and the service's own queue provides the
+    backpressure."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from aiyagari_tpu.dispatch import _SWEEP_PARAMS, _scenario_config
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet: the ledger is the record
+            pass
+
+        def _send(self, code: int, body: str,
+                  ctype: str = "application/json"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, service.metrics_text(),
+                           "text/plain; version=0.0.4")
+            elif self.path == "/healthz":
+                self._send(200, json.dumps({
+                    "ok": True, "queue_depth": service.queue_depth,
+                    "requests_served": service.requests_served,
+                    "cache": service.cache.stats()}))
+            else:
+                self._send(404, json.dumps({"error": "not found"}))
+
+        def do_POST(self):
+            if self.path != "/solve":
+                self._send(404, json.dumps({"error": "not found"}))
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                params = body.get("params") or {}
+                unknown = set(params) - set(_SWEEP_PARAMS)
+                if unknown:
+                    raise ValueError(f"unknown params {sorted(unknown)}")
+                cfg = _scenario_config(base, params)
+                shock = None
+                kind = body.get("kind", "steady_state")
+                if body.get("shock"):
+                    shock = MITShock(**body["shock"])
+                    kind = "transition"
+                resp = service.solve(cfg, kind=kind, shock=shock,
+                                     timeout=float(body.get("timeout", 600)))
+                self._send(200, json.dumps(resp.to_json()))
+            except Exception as e:  # noqa: BLE001 — HTTP boundary
+                self._send(400, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"[:500]}))
+
+    return ThreadingHTTPServer(("127.0.0.1", port), Handler)
+
+
+def serve_main(argv) -> int:
+    """`python -m aiyagari_tpu serve`: run the service with the HTTP front
+    (--port) or drive it with the synthetic open-loop load (--load N)."""
+    import argparse
+    import json
+
+    from aiyagari_tpu.config import GridSpecConfig
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu serve")
+    ap.add_argument("--grid", type=int, default=400,
+                    help="asset grid points of the base economy")
+    ap.add_argument("--method", choices=["vfi", "egm"], default="egm")
+    ap.add_argument("--dtype", choices=["float32", "float64", "mixed"],
+                    default="float64")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="coalescing batch cap (1 = serial)")
+    ap.add_argument("--max-wait", type=float, default=0.05,
+                    help="coalescing deadline, seconds")
+    ap.add_argument("--cache-mb", type=float, default=256.0,
+                    help="solution-cache byte budget (0 disables)")
+    ap.add_argument("--resolution", type=float, default=1e-3,
+                    help="calibration quantization bucket width")
+    ap.add_argument("--tol", type=float, default=None,
+                    help="GE market-clearing tolerance (default: the "
+                         "library's EquilibriumConfig.tol; coarse grids "
+                         "need a looser tol to converge — see "
+                         "BENCHMARKS.md round 14)")
+    ap.add_argument("--max-iter", type=int, default=None,
+                    help="GE bisection round cap (default: "
+                         "EquilibriumConfig.max_iter)")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip the warm-pool precompile at startup")
+    ap.add_argument("--ledger", default=None,
+                    help="append the serving flight record to this JSONL "
+                         "ledger (render: python -m aiyagari_tpu report)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="HTTP front port (POST /solve, GET /metrics, "
+                         "GET /healthz)")
+    ap.add_argument("--load", type=int, default=None, metavar="N",
+                    help="instead of serving HTTP, drive N synthetic "
+                         "open-loop requests and print the latency report")
+    ap.add_argument("--rps", type=float, default=None,
+                    help="open-loop arrival rate for --load (default: "
+                         "as fast as the queue accepts)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.port is None and args.load is None:
+        ap.error("pick a mode: --port (HTTP front) or --load N "
+                 "(synthetic load)")
+
+    import jax
+
+    if args.dtype in ("float64", "mixed"):
+        jax.config.update("jax_enable_x64", True)
+    base = AiyagariConfig(grid=GridSpecConfig(n_points=args.grid))
+    eq = EquilibriumConfig()
+    if args.tol is not None or args.max_iter is not None:
+        eq = dataclasses.replace(
+            eq, **{k: v for k, v in (("tol", args.tol),
+                                     ("max_iter", args.max_iter))
+                   if v is not None})
+    cfg = ServeConfig(
+        method=args.method, dtype=args.dtype, max_batch=args.max_batch,
+        max_wait_s=args.max_wait,
+        cache_bytes=int(args.cache_mb * 1024 * 1024),
+        resolution=args.resolution, warm_pool=not args.no_warm,
+        warm_na=args.grid, equilibrium=eq)
+    service = SolveService(cfg, ledger=args.ledger)
+    service.start()
+    try:
+        if args.load is not None:
+            from aiyagari_tpu.serve.load import synthetic_requests, run_load
+
+            reqs = synthetic_requests(base, args.load, seed=args.seed,
+                                      resolution=args.resolution)
+            report = run_load(service, reqs, rps=args.rps)
+            report["cache"] = service.cache.stats()
+            if service.warmup_report is not None:
+                report["warm_pool"] = {
+                    "compiled": service.warmup_report["compiled"],
+                    "wall_seconds": service.warmup_report["wall_seconds"]}
+            print(json.dumps(report, indent=2))
+            return 0
+        httpd = _http_server(service, base, args.port)
+        print(f"serving on http://127.0.0.1:{args.port}  "
+              f"(POST /solve, GET /metrics, GET /healthz)")
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return 0
+    finally:
+        service.stop()

@@ -1,0 +1,203 @@
+"""Warm pool: pre-lower/compile the kernel zoo into the persistent compile
+cache, so a fresh server process (or a fresh bench session) pays first-
+request latency as a cache HIT instead of a cold XLA compile.
+
+The warm-up list is `analysis/registry.py`'s ProgramSpec catalogue — the
+same traceable entry points the jaxpr auditor and the attribution table
+already walk, so "what the audit certifies" and "what the server
+precompiles" are one list by construction. Each program is lowered and
+compiled exactly as `analysis/attribution.attribute_program` does
+(`jax.jit(fn).lower(*args).compile()`), under
+`io_utils/compile_cache.enable_compilation_cache`, which persists the
+executable: the FIRST warm-up on a host does the compiles, every later
+process loads them.
+
+The registry traces at fixed small shapes; a server knows its real grid
+sizes, so `warm_pool(na=...)` additionally compiles the size-sensitive hot
+programs (the EGM sweep and the stationary-distribution family) at the
+CONFIGURED grid size and dtype — the shapes its solve requests will
+actually hit.
+
+CLI (the satellite): `python -m aiyagari_tpu warmup [--na N --dtype D
+--families f1,f2 --json]` runs the same function standalone and reports
+per-program compile walls; `SolveService.start()` calls it at boot.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+__all__ = ["warm_pool", "warmup_main"]
+
+
+def _sized_builders(na: int, dtype_name: str):
+    """(name, build) pairs for the size-sensitive hot programs at the
+    caller's OWN grid size — the registry's shapes cover the audit, these
+    cover the serve traffic. Mirrors the registry builders (same solver
+    entry points, same closure discipline) with na/dtype parameterized."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype_name)
+    nz = 7   # the reference income-state count (IncomeProcess.n_states)
+
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    def build_egm():
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
+
+        def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
+            return solve_aiyagari_egm(C, a_grid, s, P, r, w, amin,
+                                      sigma=sigma, beta=beta, tol=1e-6,
+                                      max_iter=50)
+
+        return fn, (sds((nz, na)), sds((na,)), sds((nz,)), sds((nz, nz)),
+                    sds(()), sds(()), sds(()), sds(()), sds(()))
+
+    def build_stationary():
+        from aiyagari_tpu.sim.distribution import stationary_distribution
+
+        def fn(policy_k, a_grid, P):
+            return stationary_distribution(policy_k, a_grid, P, tol=1e-8,
+                                           max_iter=200)
+
+        return fn, (sds((nz, na)), sds((na,)), sds((nz, nz)))
+
+    def build_step(backend):
+        from aiyagari_tpu.sim.distribution import distribution_step
+
+        def fn(mu, idx, w_lo, P):
+            return distribution_step(mu, idx, w_lo, P, backend=backend)
+
+        return fn, (sds((nz, na)),
+                    jax.ShapeDtypeStruct((nz, na), jnp.int32),
+                    sds((nz, na)), sds((nz, nz)))
+
+    return [
+        (f"egm/sweep@na{na}", build_egm),
+        (f"distribution/stationary@na{na}", build_stationary),
+        (f"distribution/step_transpose@na{na}",
+         lambda: build_step("transpose")),
+        (f"distribution/step_scatter@na{na}",
+         lambda: build_step("scatter")),
+    ]
+
+
+def warm_pool(families: Optional[Tuple[str, ...]] = None, *,
+              na: Optional[int] = None, dtype: str = "float64",
+              cache_dir: Optional[str] = None, ledger=None) -> dict:
+    """Precompile the registry catalogue (plus, with `na`, the sized hot
+    programs) into the persistent compile cache. Returns the warm-up
+    report: per-program compile walls, skipped programs (environment-
+    dependent builders raise ProgramUnavailable, exactly like the audit),
+    and the cache directory used.
+
+    Every compiled program emits a `warmup` ledger event (active ledger
+    or the explicit `ledger` argument) and an
+    `aiyagari_warmup_compile_seconds{program=}` gauge, so a server's boot
+    is a readable flight record, not a silent pause."""
+    import jax
+
+    from aiyagari_tpu.analysis.registry import (
+        ProgramUnavailable,
+        registered_programs,
+    )
+    from aiyagari_tpu.diagnostics import ledger as ledger_mod, metrics
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    t0 = time.perf_counter()
+    cache_used = enable_compilation_cache(cache_dir)
+
+    def emit(kind, **fields):
+        if ledger is not None:
+            ledger.event(kind, **fields)
+        else:
+            ledger_mod.emit(kind, **fields)
+
+    jobs = [(spec.name, spec.build_off)
+            for spec in registered_programs(families)]
+    if na is not None:
+        if na < 4:
+            raise ValueError(f"warm_pool na must be >= 4, got {na}")
+        jobs.extend(_sized_builders(int(na), dtype))
+
+    programs: dict = {}
+    skipped: list = []
+    for name, build in jobs:
+        p0 = time.perf_counter()
+        try:
+            fn, args = build()
+            jax.jit(fn).lower(*args).compile()
+        except ProgramUnavailable as e:
+            skipped.append((name, str(e)))
+            emit("warmup", program=name, skipped=str(e)[:200])
+            continue
+        wall = time.perf_counter() - p0
+        programs[name] = {"compile_seconds": round(wall, 4)}
+        metrics.gauge("aiyagari_warmup_compile_seconds",
+                      program=name).set(wall)
+        metrics.counter("aiyagari_warmup_programs_total").inc()
+        emit("warmup", program=name, compile_seconds=round(wall, 4))
+    return {
+        "programs": programs,
+        "skipped": skipped,
+        "compiled": len(programs),
+        "cache_dir": cache_used,
+        "wall_seconds": round(time.perf_counter() - t0, 4),
+    }
+
+
+def warmup_main(argv) -> int:
+    """`python -m aiyagari_tpu warmup [--na ... --dtype ...]`: precompile
+    the catalogue standalone and print per-program compile walls (the
+    server calls the same warm_pool at startup)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu warmup")
+    ap.add_argument("--families", default=None,
+                    help="comma-separated registry families to warm "
+                         "(default: the whole catalogue)")
+    ap.add_argument("--na", type=int, default=None,
+                    help="also compile the size-sensitive hot programs "
+                         "(EGM sweep, stationary distribution, "
+                         "push-forward steps) at this asset-grid size")
+    ap.add_argument("--dtype", choices=["float32", "float64"],
+                    default="float64",
+                    help="dtype for the sized programs (--na)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="compile-cache directory (default: "
+                         "io_utils/compile_cache.py resolution order)")
+    ap.add_argument("--ledger", default=None,
+                    help="append warmup events to this JSONL run ledger")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    args = ap.parse_args(argv)
+
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    led = None
+    if args.ledger:
+        from aiyagari_tpu.diagnostics.ledger import RunLedger
+
+        led = RunLedger(args.ledger, meta={"entry": "warmup"})
+    families = (tuple(f for f in args.families.split(",") if f)
+                if args.families else None)
+    report = warm_pool(families, na=args.na, dtype=args.dtype,
+                       cache_dir=args.cache_dir, ledger=led)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    print(f"warm pool: {report['compiled']} program(s) compiled in "
+          f"{report['wall_seconds']}s"
+          + (f" -> {report['cache_dir']}" if report["cache_dir"] else ""))
+    for name, rec in sorted(report["programs"].items(),
+                            key=lambda kv: -kv[1]["compile_seconds"]):
+        print(f"  {name:44s} {rec['compile_seconds']:8.3f}s")
+    for name, reason in report["skipped"]:
+        print(f"  {name:44s} skipped: {reason[:60]}")
+    return 0

@@ -21,7 +21,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
               "precision", "pushforward", "egm_fused", "telemetry",
               "resilience", "mesh2d", "attribution", "observatory",
-              "analysis")
+              "serve", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -49,14 +49,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-11]
+    tr = records[-12]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-10]
+    ac = records[-11]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -70,7 +70,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-9]
+    pr = records[-10]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -94,7 +94,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-8]
+    pw = records[-9]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -122,7 +122,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The host WALL is advisory only: off-TPU the fused route runs the
     # Pallas interpreter — a correctness vehicle — so no speedup is gated
     # here; the speedup claim is TPU-side (docs/USAGE.md).
-    ef = records[-7]
+    ef = records[-8]
     assert ef["metric"].startswith("egm_fused_sweep")
     assert set(ef["routes"]) == {"xla", "pallas_fused"}
     for name, route in ef["routes"].items():
@@ -148,7 +148,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-6]
+    tm = records[-7]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -165,7 +165,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # sweep quarantined EXACTLY its one poisoned lane with every other
     # lane parity-equal to the clean sweep, and the quarantine machinery
     # costs <= 1.1x a clean sweep (host-side masks only).
-    rs = records[-5]
+    rs = records[-6]
     assert rs["metric"] == "resilience_fault_battery"
     assert rs["value"] == 1.0, rs
     assert rs["recovered"] == rs["points"]
@@ -196,7 +196,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # measure partitioning overhead at equal total work (the frozen
     # BENCH_r12_mesh2d.json documents the measured ordering); the
     # chips-scale claim rides the priced-bytes column.
-    m2 = records[-4]
+    m2 = records[-5]
     assert m2["metric"] == "mesh2d_sweep"
     assert m2["devices"] >= 8, m2
     assert set(m2["topologies"]) == {"unsharded", "scenarios8", "grid8",
@@ -238,7 +238,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # stops fusing and materializes its broadcasts lands at 10-100x), a
     # measured probe with per-candidate walls for every contested knob,
     # and the frozen BENCH_r11_attribution.json artifact.
-    at = records[-3]
+    at = records[-4]
     assert at["metric"] == "route_attribution"
     assert at["value"] >= 10, at
     assert not at["flagged"], at
@@ -277,7 +277,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # two-host shard pair merged back into one run-id-joined, ordered
     # stream with its torn tail tolerated; and the watch table rendered a
     # row per scenario.
-    ob = records[-2]
+    ob = records[-3]
     assert ob["metric"] == "pod_observatory"
     assert ob["devices"] >= 8, ob
     assert set(ob["skew"]["axes"]) == {"scenarios", "grid"}
@@ -314,6 +314,53 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The battery's in-ci artifacts have frozen counterparts to check.
     assert {"mesh2d_sweep", "route_attribution", "pod_observatory"} <= \
         set(hist["matched_metrics"]), hist
+    # The serve record carries the ISSUE 15 acceptance telemetry: the
+    # persistent solve service's measured regimes. Warm-cache requests (a
+    # secant polish from a quantized-cache neighbor) must cost <= 0.5x a
+    # cold solve at p50; exact hits replay with no solve; coalesced
+    # transition requests — one lockstep sweep where ONE stationary
+    # anchor + ONE fake-news Jacobian serve the whole batch — must beat
+    # one-at-a-time serial throughput (measured well above the 2x
+    # acceptance bar; gated at the satellite's >= serial with the 2x
+    # claim frozen in BENCH_r14_serve.json). Every request leaves a
+    # ledger trail and the serve gauges export.
+    sv = records[-2]
+    assert sv["metric"] == "serve_load"
+    reg = sv["regimes"]
+    assert reg["warm"]["p50_s"] <= 0.5 * reg["cold"]["p50_s"], sv
+    assert sv["warm_vs_cold_p50"] <= 0.5, sv
+    assert sv["coalesced_vs_serial"] >= 1.0, sv
+    assert reg["coalesced"]["rps"] >= reg["serial_transition"]["rps"], sv
+    # Exact hits replay from the cache — orders of magnitude under a cold
+    # solve (no solve at all); every cold/warm/hit steady request
+    # converged at this calibration.
+    assert sv["hit_p50_s"] < 0.1 * reg["cold"]["p50_s"], sv
+    for name in ("cold", "warm", "hit"):
+        assert reg[name]["statuses"] == {"converged": reg[name]["requests"]}
+    assert reg["cold"]["cache_outcomes"] == {"cold": reg["cold"]["requests"]}
+    assert reg["warm"]["cache_outcomes"] == {"warm": reg["warm"]["requests"]}
+    assert reg["hit"]["cache_outcomes"] == {"hit": reg["hit"]["requests"]}
+    # The coalesced batch really coalesced (one batch of n_trans).
+    assert reg["coalesced"]["batch_sizes"] == [sv["transition_requests"]]
+    assert reg["serial_transition"]["batch_sizes"] == [1]
+    # The flight record: every request wrote serve_request + cache_hit
+    # events, batches wrote coalesce, and dispatch's route decisions +
+    # spans landed on the same ledger (the "every served request leaves a
+    # ledger trail" acceptance).
+    ev = sv["ledger_events"]
+    assert ev["serve_request"] > 0 and ev["cache_hit"] > 0, sv
+    assert ev["coalesce"] > 0 and ev["route_decision"] > 0, sv
+    assert ev["span"] > 0 and ev["verdict"] > 0, sv
+    # The Prometheus scrape surface: queue depth, batch size, cache hit
+    # rate all exported (the acceptance's named series).
+    assert all(sv["prometheus_gauges"].values()), sv
+    assert sv["cache"]["hits"] > 0 and sv["cache"]["warm"] > 0, sv
+    # The frozen artifact the ci battery owns (ISSUE 15 acceptance).
+    with open(os.path.join(bench_dir, "BENCH_r14_serve.json")) as f:
+        frozen_sv = json.load(f)
+    assert frozen_sv["metric"] == "serve_load"
+    assert frozen_sv["warm_vs_cold_p50"] <= 0.5
+    assert frozen_sv["coalesced_vs_serial"] >= 2.0
     # The analysis record carries the ISSUE 9 acceptance gate: the static
     # analyzer ran over the kernel zoo + source tree and found NOTHING —
     # a scatter regression, a precision leak, a host sync in a loop, a

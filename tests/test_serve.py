@@ -1,0 +1,392 @@
+"""Persistent solve service (ISSUE 15): the quantized solution cache's
+contracts (bucket collisions polish, LRU byte budget, warm-vs-cold noise
+cone), the warm pool, deadline coalescing with quarantine isolation, and
+the serving flight record.
+
+Service tests run at a tiny calibration (grid 40, tol 2e-4 — the serve
+bench's measured always-converges point) so the whole file stays
+tier-1-sized; every solve is CPU f64 under the suite's virtual-device
+conftest."""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    MITShock,
+    TransitionConfig,
+)
+from aiyagari_tpu.serve import (
+    ServeConfig,
+    SolveRequest,
+    SolveService,
+    SolutionCache,
+    calibration_key,
+    calibration_params,
+    payload_nbytes,
+)
+
+BASE = AiyagariConfig(grid=GridSpecConfig(n_points=40))
+EQ = EquilibriumConfig(max_iter=48, tol=2e-4)
+
+
+def with_beta(beta, base=BASE):
+    return dataclasses.replace(
+        base, preferences=dataclasses.replace(base.preferences,
+                                              beta=round(float(beta), 6)))
+
+
+def svc_config(**kw):
+    kw.setdefault("method", "egm")
+    kw.setdefault("equilibrium", EQ)
+    kw.setdefault("warm_pool", False)
+    kw.setdefault("rescue", False)
+    return ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# solution cache units (no solves)
+# ---------------------------------------------------------------------------
+
+
+class TestCalibrationKey:
+    def test_same_bucket_for_nearby_calibrations(self):
+        a = calibration_key(with_beta(0.9500), resolution=1e-3)
+        b = calibration_key(with_beta(0.95004), resolution=1e-3)
+        assert a == b
+
+    def test_distinct_buckets_across_resolution(self):
+        a = calibration_key(with_beta(0.950), resolution=1e-3)
+        b = calibration_key(with_beta(0.953), resolution=1e-3)
+        assert a != b
+
+    def test_structural_knobs_key_exactly(self):
+        a = calibration_key(BASE)
+        b = calibration_key(dataclasses.replace(
+            BASE, grid=GridSpecConfig(n_points=41)))
+        c = calibration_key(dataclasses.replace(
+            BASE, technology=dataclasses.replace(BASE.technology,
+                                                 alpha=0.35)))
+        assert a != b and a != c
+
+    def test_kind_and_extra_separate_namespaces(self):
+        assert calibration_key(BASE, kind="ss") \
+            != calibration_key(BASE, kind="anchor")
+        assert calibration_key(BASE, kind="transition", extra=(32,)) \
+            != calibration_key(BASE, kind="transition", extra=(64,))
+
+    def test_zero_resolution_rejected(self):
+        with pytest.raises(ValueError, match="resolution"):
+            calibration_key(BASE, resolution=0.0)
+
+
+class TestSolutionCache:
+    def test_hit_requires_exact_params(self):
+        cache = SolutionCache(1 << 20, resolution=1e-3)
+        cache.put(with_beta(0.9500), {"r": 0.01})
+        outcome, entry = cache.lookup(with_beta(0.9500))
+        assert outcome == "hit" and entry.payload["r"] == 0.01
+
+    def test_bucket_collision_is_warm_not_stale(self):
+        """Two calibrations in ONE quantization bucket: the second lookup
+        must come back as warm-start material ('warm'), never as the
+        first's answer — and storing the second's own result must not
+        clobber correctness for either contract."""
+        cache = SolutionCache(1 << 20, resolution=1e-3)
+        a, b = with_beta(0.9500), with_beta(0.95004)
+        assert calibration_key(a, resolution=1e-3) \
+            == calibration_key(b, resolution=1e-3)
+        cache.put(a, {"r": 0.0100})
+        outcome, entry = cache.lookup(b)
+        assert outcome == "warm"
+        assert entry.exact == calibration_params(a) != calibration_params(b)
+        # The polished result replaces the bucket entry; the EXACT match
+        # now hits for b and warms for a.
+        cache.put(b, {"r": 0.0101})
+        assert cache.lookup(b)[0] == "hit"
+        assert cache.lookup(a)[0] == "warm"
+
+    def test_nearest_neighbor_within_radius(self):
+        cache = SolutionCache(1 << 20, resolution=1e-3,
+                              neighbor_radius=50.0)
+        cache.put(with_beta(0.950), {"r": 0.01})
+        # 10 buckets away: inside the radius -> warm.
+        outcome, entry = cache.lookup(with_beta(0.960))
+        assert outcome == "warm" and entry.payload["r"] == 0.01
+        # 80 buckets away: outside -> miss.
+        assert cache.lookup(with_beta(0.87))[0] == "miss"
+
+    def test_neighbors_never_cross_structure_or_kind(self):
+        cache = SolutionCache(1 << 20, resolution=1e-3)
+        cache.put(with_beta(0.950), {"r": 0.01})
+        other_grid = with_beta(0.950, dataclasses.replace(
+            BASE, grid=GridSpecConfig(n_points=41)))
+        assert cache.lookup(other_grid)[0] == "miss"
+        assert cache.lookup(with_beta(0.950), kind="anchor")[0] == "miss"
+
+    def test_lru_eviction_respects_byte_budget(self):
+        blob = lambda: {"mu": np.zeros(1000)}           # ~8 KB each
+        nb = payload_nbytes(blob())
+        cache = SolutionCache(3 * nb + 64, resolution=1e-3)
+        betas = [0.90, 0.91, 0.92, 0.93]
+        for b in betas:
+            cache.put(with_beta(b), blob())
+        assert cache.nbytes <= cache.byte_budget
+        assert len(cache) == 3 and cache.evictions == 1
+        # The least-recently-used entry (0.90) was the one evicted.
+        assert cache.lookup(with_beta(0.93))[0] == "hit"
+        # A lookup refreshes recency: touch 0.91, insert another, and the
+        # untouched 0.92 goes instead.
+        assert cache.lookup(with_beta(0.91))[0] == "hit"
+        cache.put(with_beta(0.94), blob())
+        assert cache.lookup(with_beta(0.91))[0] == "hit"
+        outcome, entry = cache.lookup(with_beta(0.92))
+        assert not (outcome == "hit")
+
+    def test_oversized_payload_not_stored(self):
+        cache = SolutionCache(1000, resolution=1e-3)
+        assert cache.put(with_beta(0.95), {"mu": np.zeros(1000)}) is None
+        assert len(cache) == 0 and cache.evictions == 1
+
+    def test_zero_budget_disables_storage(self):
+        cache = SolutionCache(0)
+        cache.put(with_beta(0.95), {"r": 0.01})
+        assert cache.lookup(with_beta(0.95))[0] == "miss"
+
+    def test_payload_nbytes_counts_array_leaves(self):
+        nb = payload_nbytes({"a": np.zeros((10, 10)), "b": 1.0})
+        assert nb >= 800
+
+
+# ---------------------------------------------------------------------------
+# warm pool
+# ---------------------------------------------------------------------------
+
+
+class TestWarmPool:
+    def test_warm_pool_compiles_and_reports(self, tmp_path):
+        from aiyagari_tpu.diagnostics.ledger import RunLedger, read_ledger
+        from aiyagari_tpu.serve.warmup import warm_pool
+
+        led = RunLedger(tmp_path / "warm.jsonl")
+        report = warm_pool(("distribution",), na=32, ledger=led)
+        assert report["compiled"] >= 4
+        # The sized hot programs rode along at the requested grid size.
+        assert "egm/sweep@na32" in report["programs"]
+        for rec in report["programs"].values():
+            assert rec["compile_seconds"] > 0
+        events = [e for e in read_ledger(tmp_path / "warm.jsonl")
+                  if e["kind"] == "warmup"]
+        assert len(events) >= report["compiled"]
+
+    def test_warmup_cli_json(self, tmp_path, capsys):
+        import json
+
+        from aiyagari_tpu.serve.warmup import warmup_main
+
+        rc = warmup_main(["--families", "distribution", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["compiled"] >= 4
+
+    def test_bad_na_rejected(self):
+        from aiyagari_tpu.serve.warmup import warm_pool
+
+        with pytest.raises(ValueError, match="na"):
+            warm_pool(("distribution",), na=2)
+
+
+# ---------------------------------------------------------------------------
+# the service
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def service_ledger(tmp_path_factory):
+    return tmp_path_factory.mktemp("serve") / "ledger.jsonl"
+
+
+class TestServiceSteady:
+    def test_hit_replays_and_warm_polishes_not_stale(self, tmp_path):
+        """The end-to-end cache contract: an exact repeat replays
+        bitwise; a bucket-colliding SECOND calibration gets a polished
+        result for ITS OWN parameters (within the solve's own noise
+        cone of a direct cold solve), never the first's stale answer."""
+        from aiyagari_tpu import dispatch
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "led.jsonl"
+        a = with_beta(0.9435)
+        b = with_beta(0.94354)      # same quantization bucket as a
+        with SolveService(svc_config(max_batch=1), ledger=led) as svc:
+            ra = svc.solve(a, timeout=300)
+            ra2 = svc.solve(a, timeout=60)
+            rb = svc.solve(b, timeout=300)
+        assert ra.status == "converged" and ra.cache == "cold"
+        assert ra2.cache == "hit" and ra2.r == ra.r
+        assert rb.cache == "warm" and rb.status == "converged"
+        assert abs(rb.gap) < EQ.tol
+        # Not the stale bucket answer: b's own direct solve agrees with
+        # the polished response inside the market-clearing noise cone
+        # (both roots satisfy |gap| < tol on the same supply curve).
+        direct = dispatch.solve(b, method="egm", aggregation="distribution",
+                                equilibrium=EQ, on_nonconvergence="raise")
+        assert abs(rb.r - direct.r) < 1e-3
+        events = read_ledger(led)
+        kinds = {e["kind"] for e in events}
+        assert {"serve_request", "cache_hit", "route_decision",
+                "span", "verdict"} <= kinds
+        serve_evs = [e for e in events if e["kind"] == "serve_request"]
+        assert [e["cache"] for e in serve_evs] == ["cold", "hit", "warm"]
+        for e in serve_evs:
+            assert e["status"] == "converged"
+
+    def test_poisoned_request_leaves_batchmates_bitwise_unchanged(self):
+        """A NaN calibration inside a coalesced batch quarantines its own
+        lane (verdict 'nan') while the healthy batchmates' results are
+        BITWISE what the same lockstep sweep produces without the service
+        in the loop (PR 10's quarantine contract, served)."""
+        from aiyagari_tpu import dispatch
+
+        good1, good2 = with_beta(0.942), with_beta(0.948)
+        # The poison must survive model building AND propagate to the
+        # excess demand (the diagnostics/faults.py lesson — a NaN
+        # PREFERENCE is silently masked by the EGM constraint region's
+        # NaN-false comparisons): a NaN borrowing limit NaNs the asset
+        # grid, hence the lane's supply and gap.
+        poisoned = dataclasses.replace(BASE, borrowing_limit=float("nan"))
+        configs = [good1, poisoned, good2]
+        with SolveService(svc_config(cache_bytes=0, max_batch=3,
+                                     max_wait_s=2.0)) as svc:
+            futs = [svc.submit(SolveRequest(c)) for c in configs]
+            resps = [f.result(300) for f in futs]
+        assert [r.batch for r in resps] == [3, 3, 3]
+        assert resps[1].status == "nan" and not resps[1].converged
+        assert resps[0].status == "converged"
+        assert resps[2].status == "converged"
+        ref = dispatch.sweep(configs[0], configs=configs, method="egm",
+                             equilibrium=EQ, quarantine=True)
+        assert resps[0].r == float(ref.r[0])
+        assert resps[2].r == float(ref.r[2])
+        assert bool(ref.quarantined[1])
+
+    def test_coalesce_event_and_gauges(self, tmp_path):
+        from aiyagari_tpu.diagnostics import metrics
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "led.jsonl"
+        cfgs = [with_beta(b) for b in (0.938, 0.942, 0.946)]
+        with SolveService(svc_config(max_batch=3,
+                                     max_wait_s=2.0), ledger=led) as svc:
+            futs = [svc.submit(SolveRequest(c)) for c in cfgs]
+            [f.result(300) for f in futs]
+            assert svc.queue_depth == 0
+        events = read_ledger(led)
+        co = [e for e in events if e["kind"] == "coalesce"]
+        assert any(e["batch"] == 3 for e in co)
+        assert metrics.gauge("aiyagari_serve_queue_depth").value == 0
+        assert metrics.gauge("aiyagari_serve_batch_size").value == 3
+        txt = metrics.render_prometheus()
+        for name in ("aiyagari_serve_queue_depth",
+                     "aiyagari_serve_batch_size",
+                     "aiyagari_serve_cache_hit_rate",
+                     "aiyagari_serve_requests_total",
+                     "aiyagari_serve_latency_seconds"):
+            assert name in txt, name
+
+    def test_exact_hit_skips_the_coalescing_deadline(self):
+        """Replayed hits must not pay max_wait_s: the worker serves them
+        before assembling a batch."""
+        with SolveService(svc_config(max_batch=4, max_wait_s=0.5)) as svc:
+            first = svc.solve(with_beta(0.9480), timeout=300)
+            assert first.cache == "cold"
+            t0 = time.perf_counter()
+            again = svc.solve(with_beta(0.9480), timeout=60)
+            wall = time.perf_counter() - t0
+        assert again.cache == "hit"
+        assert wall < 0.4, wall
+
+
+class TestServiceTransitions:
+    def test_anchor_reuse_replay_and_coalesced_batch(self, tmp_path):
+        """One economy through ONE service end-to-end: the first shock
+        solves cold (anchor + Jacobian computed and cached), the second
+        reuses them (cache 'warm' — the ~10x-less-work path), an exact
+        repeat replays ('hit'), and two further shocks submitted together
+        coalesce into ONE lockstep sweep_transitions that also rides the
+        cached anchor (exactly one sweep span on the ledger)."""
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "led.jsonl"
+        trans = TransitionConfig(T=24, max_iter=15, tol=1e-6)
+        s1 = MITShock(param="tfp", size=0.01, rho=0.9)
+        s2 = MITShock(param="tfp", size=0.005, rho=0.9)
+        with SolveService(svc_config(max_batch=2, max_wait_s=2.0,
+                                     transition=trans),
+                          ledger=led) as svc:
+            t0 = time.perf_counter()
+            r1 = svc.solve(BASE, kind="transition", shock=s1, timeout=600)
+            w1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            r2 = svc.solve(BASE, kind="transition", shock=s2, timeout=600)
+            w2 = time.perf_counter() - t0
+            r3 = svc.solve(BASE, kind="transition", shock=s1, timeout=60)
+            futs = [svc.submit(SolveRequest(BASE, kind="transition",
+                                            shock=MITShock(param="tfp",
+                                                           size=sz,
+                                                           rho=0.9)))
+                    for sz in (0.004, 0.007)]
+            batch = [f.result(600) for f in futs]
+        assert r1.status == "converged" and r1.cache == "cold"
+        assert r2.status == "converged" and r2.cache == "warm"
+        assert r3.cache == "hit"
+        np.testing.assert_array_equal(r3.r_path, r1.r_path)
+        assert r1.r_path.shape == (trans.T,)
+        # The anchor skip is the measured point of the cache: the warm
+        # request does far less work than the cold one (anchor + Jacobian
+        # amortized). Generous 0.6x bound — the measured ratio is ~0.05.
+        assert w2 < 0.6 * w1, (w1, w2)
+        assert all(r.status == "converged" and r.batch == 2
+                   for r in batch)
+        assert all(r.cache == "warm" for r in batch)   # anchor reused
+        # The pair ran as ONE lockstep sweep: exactly one sweep span.
+        spans = [e for e in read_ledger(led) if e["kind"] == "span"]
+        assert sum(e.get("name") == "mit_transition_sweep"
+                   for e in spans) == 1
+
+
+class TestValidation:
+    def test_transition_request_needs_shock(self):
+        with pytest.raises(ValueError, match="shock"):
+            SolveRequest(BASE, kind="transition")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            SolveRequest(BASE, kind="bogus")
+
+    def test_serve_config_validated(self):
+        with pytest.raises(ValueError, match="max_batch"):
+            ServeConfig(max_batch=0)
+        with pytest.raises(ValueError, match="method"):
+            ServeConfig(method="bogus")
+        with pytest.raises(ValueError, match="max_wait"):
+            ServeConfig(max_wait_s=-1.0)
+
+    def test_submit_before_start_rejected(self):
+        svc = SolveService(svc_config())
+        with pytest.raises(RuntimeError, match="start"):
+            svc.submit(SolveRequest(BASE))
+
+    def test_warm_start_knob_validated_at_dispatch(self):
+        from aiyagari_tpu import dispatch
+        from aiyagari_tpu.config import KrusellSmithConfig
+
+        with pytest.raises(ValueError, match="warm_start"):
+            dispatch.solve(KrusellSmithConfig(), warm_start=np.zeros(3))
+        with pytest.raises(ValueError, match="warm_start"):
+            dispatch.solve(BASE, backend="numpy", warm_start=np.zeros(3))
