@@ -127,6 +127,25 @@ SPECS = {
         # catastrophe band is a count_min at 10x, not a wall check.
         Check("value", "count_min", band=_WALL_BAND),
     ),
+    "serve_amortized": (
+        # The amortization layer (ISSUE 16). value IS the cold-solve
+        # fraction — lower is better, so the direction is a max_abs held
+        # at the acceptance ceiling (floor 0.5), not a count_min; the
+        # per-predictor source tables and the ledger trail must not lose
+        # keys; degraded guesses must NEVER change an answer (floor 0 =
+        # hard zero); and the two warm-vs-cold latency ratios hold at the
+        # acceptance bands (the hard gates run every ci battery in
+        # tests/test_bench_ci.py at these same thresholds).
+        Check("warm_sources", "keys_min"),
+        Check("steady_by_source", "keys_min"),
+        Check("transition_by_source", "keys_min"),
+        Check("ledger_events", "keys_min"),
+        Check("value", "max_abs", band=1.0, floor=0.5),
+        Check("wrong_answer_degradations", "max_abs", band=1.0,
+              floor=0.0),
+        Check("surrogate_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
+        Check("anchor_warm_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
+    ),
 }
 
 

@@ -928,8 +928,8 @@ def solve_transition(
     consumption policy is the terminal condition); transition.method picks
     the Newton (sequence-space Jacobian) or damped update. `solver` /
     `equilibrium` tune the anchoring stationary solve; extra kwargs (`ss`,
-    `jacobian`, `keep_policies`, `on_iteration`) pass through to
-    transition/mit.solve_transition.
+    `jacobian`, `anchor_warm_start`, `keep_policies`, `on_iteration`) pass
+    through to transition/mit.solve_transition.
     """
     backend = _transition_backend(backend)
     from aiyagari_tpu.config import precision_scope

@@ -154,13 +154,16 @@ def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
     transpose 39.4 ms vs 6 x 1.2 ms solo; the scatter reference scales
     exactly linearly and wins the batched wall), so batched "auto" pins
     the scatter form on CPU hosts. Accelerators keep the standard
-    resolution — no chip measurement of the batched context exists yet
-    (the pallas_inverse lesson), and TPU scatter is the documented
-    pathology the scatter-free routes exist to avoid. Like f32_sim, this
-    is a recorded decision: the ledger explains why a sweep's
-    distribution steps scatter on the host. Solo-context tuning probes
-    are deliberately NOT consulted for batched programs — a measured
-    solo winner is exactly the number the vmapped context invalidates.
+    resolution when no measurement exists — TPU scatter is the
+    documented pathology the scatter-free routes exist to avoid. Like
+    f32_sim, this is a recorded decision: the ledger explains why a
+    sweep's distribution steps scatter on the host. Solo-context tuning
+    probes are deliberately NOT consulted for batched programs — a
+    measured solo winner is exactly the number the vmapped context
+    invalidates. With tuning active the batched context consults its OWN
+    measured entries instead (the autotuner's "pushforward_batched" knob
+    races the candidates under vmap, ISSUE 16), so the vmapped choice is
+    a measurement, not a heuristic, wherever a probe has run.
 
     `na`/`dtype` are optional resolution context (grid-bucket keying of
     the tuning cache); plan-build call sites pass them, the dispatch
@@ -177,8 +180,37 @@ def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
     if batched:
         import jax
 
-        from aiyagari_tpu.tuning.autotuner import _record_decision
+        from aiyagari_tpu.tuning.autotuner import (
+            _lookup,
+            _record_decision,
+            load_cache,
+            tuning_active,
+        )
 
+        if tuning_active():
+            # The batched context has its OWN probe (ISSUE 16,
+            # autotuner "pushforward_batched": the solo walls are exactly
+            # the numbers vmap invalidates) — a measured vmapped-race
+            # winner beats both heuristics below. The decision is still
+            # recorded under the "pushforward" knob: one knob name per
+            # resolution site, so a run's route_decision trail stays one
+            # event per site regardless of which context resolved it.
+            from aiyagari_tpu.diagnostics import metrics
+
+            entry = _lookup(load_cache(), "pushforward_batched", na, dtype)
+            if entry is not None:
+                metrics.counter("aiyagari_tuning_cache_hits_total",
+                                knob="pushforward_batched").inc()
+                _record_decision(
+                    "pushforward", entry["choice"], "measured",
+                    {"walls_us": entry.get("walls_us", {}),
+                     "probe_na": entry.get("na"),
+                     "measured_utc": entry.get("utc"),
+                     "context": "batched"},
+                    na=na, dtype=dtype)
+                return entry["choice"]
+            metrics.counter("aiyagari_tuning_cache_misses_total",
+                            knob="pushforward_batched").inc()
         if jax.default_backend() == "cpu":
             _record_decision(
                 "pushforward", "scatter", "default",
@@ -188,11 +220,11 @@ def resolve_backend(backend: Optional[str], *, na: Optional[int] = None,
                                "ISSUE 15 measurement)"},
                 na=na, dtype=dtype)
             return "scatter"
-        # Accelerators: the shipped scatter-free default, WITHOUT
-        # consulting the tuning cache — its probes are solo-context, and
-        # a measured solo winner is exactly the number the vmapped
-        # context invalidates (docstring contract; a batched probe suite
-        # is the ROADMAP follow-up).
+        # Accelerators with no batched measurement: the shipped
+        # scatter-free default — solo-context probe entries are never
+        # consulted here (a measured solo winner is exactly the number
+        # the vmapped context invalidates; the batched probe above is
+        # the sanctioned measurement path).
         _record_decision(
             "pushforward", "transpose", "default",
             {"constraint": "batched context: solo tuning probes not "

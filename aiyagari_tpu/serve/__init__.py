@@ -19,12 +19,22 @@ Three load-bearing pieces, each usable standalone:
     ladder as the server-side retry policy, and reports through the
     existing ledger/metrics surface. CLI: `python -m aiyagari_tpu serve`.
 
-`serve.load` is the synthetic open-loop load driver `bench.py --metric
-serve` measures requests/sec with.
+`serve.load` is the synthetic load driver `bench.py --metric serve`
+measures requests/sec with (open-loop, closed-loop, and the offered-rps
+ramp that finds the SLO knee).
+
+Amortized solving (ISSUE 16) escalates warm-start predictors per request:
+exact hit → multi-neighbor blend (`serve.cache.blend_*`) → the
+ledger-trained policy-surface surrogate (`serve.surrogate`) → cold solve,
+with every degraded guess re-solved cold (never a wrong answer) and the
+cold-solve fraction exported as `aiyagari_serve_cold_fraction`.
 """
 
 from aiyagari_tpu.serve.cache import (
     SolutionCache,
+    blend_policies,
+    blend_scalar,
+    blend_weights,
     calibration_key,
     calibration_params,
     payload_nbytes,
@@ -35,14 +45,19 @@ from aiyagari_tpu.serve.service import (
     SolveResponse,
     SolveService,
 )
+from aiyagari_tpu.serve.surrogate import PolicySurrogate
 from aiyagari_tpu.serve.warmup import warm_pool
 
 __all__ = [
+    "PolicySurrogate",
     "ServeConfig",
     "SolveRequest",
     "SolveResponse",
     "SolveService",
     "SolutionCache",
+    "blend_policies",
+    "blend_scalar",
+    "blend_weights",
     "calibration_key",
     "calibration_params",
     "payload_nbytes",

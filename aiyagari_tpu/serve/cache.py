@@ -39,11 +39,16 @@ import math
 import threading
 import time
 from collections import OrderedDict
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
 
 __all__ = [
     "CacheEntry",
     "SolutionCache",
+    "blend_policies",
+    "blend_scalar",
+    "blend_weights",
     "calibration_key",
     "calibration_params",
     "payload_nbytes",
@@ -129,6 +134,66 @@ def payload_nbytes(payload) -> int:
         else:
             total += 64
     return total
+
+
+# -- blending (pure helpers; the service owns when to call them) -----------
+
+
+def blend_weights(distances: Sequence[float],
+                  eps: float = 1e-9) -> np.ndarray:
+    """Inverse-distance weights over a neighborhood, normalized to sum to
+    one. A zero-distance neighbor (same exact calibration — possible when
+    a bucket collision and an exact twin coexist) takes all the mass, as
+    it should: the blend degenerates to that entry."""
+    d = np.asarray(list(distances), dtype=np.float64)
+    if d.ndim != 1 or d.size == 0:
+        raise ValueError("distances must be a non-empty 1-D sequence")
+    if np.any(d < 0.0):
+        raise ValueError("distances must be non-negative")
+    w = 1.0 / (d + eps)
+    return w / w.sum()
+
+
+def blend_scalar(values: Sequence[float], weights: np.ndarray) -> float:
+    """Distance-weighted blend of scalars (the warm rate / secant slope)."""
+    v = np.asarray(list(values), dtype=np.float64)
+    if v.shape != np.shape(weights):
+        raise ValueError(
+            f"values/weights mismatch: {v.shape} vs {np.shape(weights)}")
+    return float(np.dot(v, weights))
+
+
+def blend_policies(policies: Sequence[np.ndarray],
+                   grids: Sequence[np.ndarray],
+                   weights: np.ndarray,
+                   target_grid: np.ndarray) -> np.ndarray:
+    """Distance-weighted blend of consumption policies, each interpolated
+    onto the request's own asset grid first. Policies are [n_states, na_i]
+    (or [na_i]); grids are the matching asset grids. Structural keying
+    means in-cache neighbors always share the request's grid — the interp
+    is then the identity — but the helper handles mismatched grids so
+    blending stays correct if the keying ever loosens (pinned in
+    tests/test_serve.py). Linear interpolation with edge clamping (np.interp
+    semantics): consumption policies are monotone and concave-ish in assets,
+    so linear-in-assets blending keeps the warm start feasible."""
+    if len(policies) != len(grids) or len(policies) != len(weights):
+        raise ValueError("policies, grids, and weights must align")
+    tg = np.asarray(target_grid, dtype=np.float64)
+    out = None
+    for pol, grid, w in zip(policies, grids, weights):
+        p = np.asarray(pol, dtype=np.float64)
+        g = np.asarray(grid, dtype=np.float64)
+        if p.ndim == 1:
+            p = p[None, :]
+        if p.shape[-1] != g.shape[-1]:
+            raise ValueError(
+                f"policy/grid length mismatch: {p.shape[-1]} vs {g.shape[-1]}")
+        if p.shape[-1] == tg.shape[-1] and np.array_equal(g, tg):
+            onto = p
+        else:
+            onto = np.stack([np.interp(tg, g, row) for row in p])
+        out = w * onto if out is None else out + w * onto
+    return out
 
 
 @dataclasses.dataclass
@@ -222,6 +287,31 @@ class SolutionCache:
         if best is not None and best_d <= self.neighbor_radius:
             return best
         return None
+
+    def neighborhood(self, config, *, kind: str = "ss",
+                     extra: tuple = ()) -> List[Tuple[CacheEntry, float]]:
+        """ALL same-kind/same-structure entries within `neighbor_radius`
+        of the request, as (entry, distance-in-bucket-units) pairs sorted
+        nearest-first. The multi-neighbor generalization of the single
+        best entry `lookup` returns: the service distance-weights these
+        into one blended warm start (`blend_weights`/`blend_policies`).
+        Does NOT touch LRU order or hit counters — it is a read-only peek;
+        the classifying `lookup` owns the outcome accounting."""
+        key = self.key_for(config, kind=kind, extra=extra)
+        exact = calibration_params(config)
+        kind_k, structural = key[0], key[1]
+        found: List[Tuple[CacheEntry, float]] = []
+        with self._lock:
+            for entry in self._entries.values():
+                if entry.key[0] != kind_k or entry.key[1] != structural \
+                        or entry.key[3] != key[3]:
+                    continue
+                d = math.sqrt(sum((a - b) ** 2 for a, b in
+                                  zip(entry.exact, exact))) / self.resolution
+                if d <= self.neighbor_radius:
+                    found.append((entry, d))
+        found.sort(key=lambda pair: pair[1])
+        return found
 
     # -- store -------------------------------------------------------------
 

@@ -18,7 +18,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["percentiles", "run_load", "synthetic_requests"]
+__all__ = ["percentiles", "run_load", "run_ramp", "synthetic_requests"]
 
 
 def synthetic_requests(base, n: int, *, seed: int = 0,
@@ -95,4 +95,45 @@ def run_load(service, requests: Sequence, *, rps: Optional[float] = None,
         "batch_sizes": sorted({r.batch for r in responses}),
         "max_queue_wait_s": round(max((r.queue_wait_s for r in responses),
                                       default=0.0), 6),
+        "warm_sources": dict(Counter(r.warm_source for r in responses)),
+        "degraded": sum(1 for r in responses if r.degraded),
     }
+
+
+def run_ramp(service, make_requests, *, rates: Sequence[float],
+             n_per_rate: int, slo_s: float,
+             saturation: float = 0.9, timeout: float = 600.0) -> dict:
+    """The offered-rps ramp (ISSUE 16): drive escalating OPEN-loop rates
+    through the service and report the KNEE — the first offered rate whose
+    p99 crosses the latency SLO or whose achieved throughput falls below
+    `saturation` x offered (the server can no longer keep the schedule;
+    past that point the open loop only measures queue growth). Below the
+    knee the loop is effectively closed (the server keeps up); at the knee
+    it transitions open — this IS the open→closed-loop boundary a capacity
+    plan wants.
+
+    `make_requests(n, step)` builds each step's fresh request list (fresh
+    ids; calibration distribution is the caller's choice), so cache state
+    carries across steps exactly as production traffic would see it.
+
+    Returns {"steps": [per-rate run_load rows + offered/slo verdicts],
+    "knee_rps": the last offered rate that met the SLO (None if the first
+    step already missed), "slo_s": slo_s}."""
+    if not rates:
+        raise ValueError("run_ramp needs at least one offered rate")
+    steps = []
+    knee = None
+    for step, rate in enumerate(rates):
+        reqs = make_requests(n_per_rate, step)
+        row = run_load(service, reqs, rps=float(rate), timeout=timeout)
+        p99 = row.get("p99_s")
+        achieved = row.get("rps") or 0.0
+        met = (p99 is not None and p99 <= slo_s
+               and achieved >= saturation * float(rate))
+        row.update(offered_rps=float(rate), slo_met=met)
+        steps.append(row)
+        if met:
+            knee = float(rate)
+        else:
+            break  # past the knee: further rates only grow the queue
+    return {"steps": steps, "knee_rps": knee, "slo_s": slo_s}

@@ -93,6 +93,15 @@ class ServeConfig:
     warm_pool: bool = True             # precompile the kernel zoo at start()
     warm_families: Optional[Tuple[str, ...]] = None
     warm_na: Optional[int] = None      # also precompile sized hot programs
+    blend_neighbors: int = 4           # cached neighbors blended per warm
+                                       # start (1 = PR 15 single-neighbor)
+    surrogate: bool = True             # the ledger-trained predictor of
+                                       # last resort before a cold solve
+    surrogate_min_samples: int = 12
+    surrogate_fit_every: int = 8
+    anchor_warm: bool = True           # warm-start transition anchors from
+                                       # cross-bucket neighbors + blend
+                                       # their fake-news Jacobians
     solver: Optional[SolverConfig] = None
     equilibrium: EquilibriumConfig = EquilibriumConfig()
     transition: TransitionConfig = TransitionConfig()
@@ -107,6 +116,9 @@ class ServeConfig:
         if self.max_wait_s < 0:
             raise ValueError(
                 f"max_wait_s must be >= 0, got {self.max_wait_s}")
+        if self.blend_neighbors < 1:
+            raise ValueError(
+                f"blend_neighbors must be >= 1, got {self.blend_neighbors}")
 
 
 @dataclasses.dataclass
@@ -138,6 +150,12 @@ class SolveResponse:
     status: str                        # the verdict taxonomy (module doc)
     cache: str                         # "hit" | "warm" | "cold"
     converged: bool
+    warm_source: str = "cold"          # which amortization predictor fed
+                                       # the solve: "hit" | "blend" |
+                                       # "neighbor" | "surrogate" |
+                                       # "anchor" | "anchor_warm" | "cold"
+    degraded: bool = False             # a warm guess failed to close and
+                                       # the request re-solved cold
     r: Optional[float] = None
     w: Optional[float] = None
     capital: Optional[float] = None
@@ -199,6 +217,13 @@ class SolveService:
         self.cache = SolutionCache(config.cache_bytes,
                                    resolution=config.resolution,
                                    neighbor_radius=config.neighbor_radius)
+        self.surrogate = None
+        if config.surrogate:
+            from aiyagari_tpu.serve.surrogate import PolicySurrogate
+
+            self.surrogate = PolicySurrogate(
+                min_samples=config.surrogate_min_samples,
+                fit_every=config.surrogate_fit_every)
         self._led = self._as_ledger(ledger)
         self._queue: list = []          # [(SolveRequest, Future)]
         self._cond = threading.Condition()
@@ -206,6 +231,8 @@ class SolveService:
         self._thread: Optional[threading.Thread] = None
         self.warmup_report: Optional[dict] = None
         self.requests_served = 0
+        self.warm_sources: dict = {}    # warm_source -> served count
+        self.degradations = 0
 
     def _as_ledger(self, ledger):
         if ledger is None:
@@ -256,6 +283,8 @@ class SolveService:
         with self._cond:
             self._running = False
             self._cond.notify_all()
+        if self.surrogate is not None:
+            self.surrogate.stop_background()
         if self._thread is not None:
             self._thread.join(timeout)
             if not self._thread.is_alive():
@@ -390,7 +419,9 @@ class SolveService:
                 # state polishes, anything else solves serially.
                 if req.kind == "steady_state" and outcome == "warm":
                     fut.set_result(self._finish(
-                        req, self._steady_polish(req, entry), batch=1))
+                        req, self._steady_polish(req, entry.payload,
+                                                 source="neighbor"),
+                        batch=1))
                 elif req.kind == "steady_state":
                     fut.set_result(self._finish(
                         req, self._steady_serial(req), batch=1))
@@ -400,7 +431,8 @@ class SolveService:
             p = entry.payload
             fut.set_result(self._finish(req, SolveResponse(
                 id=req.id, kind=req.kind, status=p["status"], cache="hit",
-                converged=bool(p["converged"]), r=p.get("r"),
+                converged=bool(p["converged"]), warm_source="hit",
+                r=p.get("r"),
                 w=p.get("w"), capital=p.get("capital"), gap=p.get("gap"),
                 r_path=p.get("r_path"), wall_s=0.0), batch=1))
         return True
@@ -451,19 +483,44 @@ class SolveService:
         resp.latency_s = round(now - req.submitted, 6)
         resp.batch = batch
         self.requests_served += 1
+        source = resp.warm_source
+        self.warm_sources[source] = self.warm_sources.get(source, 0) + 1
         metrics.counter("aiyagari_serve_requests_total", kind=req.kind,
                         status=resp.status, cache=resp.cache).inc()
         metrics.histogram("aiyagari_serve_latency_seconds",
                           kind=req.kind).observe(resp.latency_s)
+        metrics.counter("aiyagari_serve_warm_source_total",
+                        source=source).inc()
+        metrics.histogram("aiyagari_serve_warm_source_latency_seconds",
+                          source=source).observe(resp.latency_s)
+        self._gauge("aiyagari_serve_cold_fraction", self.cold_fraction())
+        event = dict(id=req.id, request_kind=req.kind,
+                     cache=resp.cache, status=resp.status,
+                     converged=resp.converged,
+                     warm_source=source, degraded=resp.degraded,
+                     queue_wait_s=resp.queue_wait_s,
+                     wall_s=round(resp.wall_s, 6),
+                     latency_s=resp.latency_s, batch=batch)
+        if req.kind == "steady_state" and resp.converged \
+                and resp.r is not None:
+            # The surrogate's training record: a persisted ledger can
+            # replay these into PolicySurrogate.ingest_ledger after a
+            # restart (serve/surrogate.py).
+            from aiyagari_tpu.serve.cache import calibration_params
+
+            event["params"] = list(calibration_params(req.config))
+            event["r"] = float(resp.r)
         if self._led is not None:
-            self._led.event("serve_request", id=req.id,
-                            request_kind=req.kind,
-                            cache=resp.cache, status=resp.status,
-                            converged=resp.converged,
-                            queue_wait_s=resp.queue_wait_s,
-                            wall_s=round(resp.wall_s, 6),
-                            latency_s=resp.latency_s, batch=batch)
+            self._led.event("serve_request", **event)
         return resp
+
+    def cold_fraction(self) -> float:
+        """Fraction of served requests whose solve ran with no warm-start
+        predictor at all (warm_source == "cold"; degraded requests count —
+        they paid the cold solve). The number `--metric amortized` drives
+        toward zero."""
+        total = sum(self.warm_sources.values())
+        return self.warm_sources.get("cold", 0) / total if total else 0.0
 
     # -- steady states -----------------------------------------------------
 
@@ -477,12 +534,21 @@ class SolveService:
                 fut.set_result(self._finish(req, SolveResponse(
                     id=req.id, kind=req.kind, status=p["status"],
                     cache="hit", converged=bool(p["converged"]),
+                    warm_source="hit",
                     r=p["r"], w=p["w"], capital=p["capital"],
                     gap=p["gap"], wall_s=0.0), batch=n))
             elif outcome == "warm":
                 warm.append((req, fut, entry))
             else:
-                cold.append((req, fut))
+                # The predictor of last resort: with no cached neighbor in
+                # radius, ask the surrogate for a starting guess — an
+                # unfit surrogate returns None and the request stays cold
+                # (pinned in tests/test_serve.py).
+                guess = self._surrogate_payload(req)
+                if guess is not None:
+                    warm.append((req, fut, ("surrogate", guess)))
+                else:
+                    cold.append((req, fut))
         if len(cold) == 1:
             req, fut = cold[0]
             fut.set_result(self._finish(
@@ -490,8 +556,73 @@ class SolveService:
         elif cold:
             self._steady_sweep(cold, batch_size=n)
         for req, fut, entry in warm:
+            if isinstance(entry, tuple):
+                source, payload = entry
+            else:
+                # Blend EVERY in-radius neighbor, not just the one lookup
+                # returned; fall back to that single entry's payload if
+                # the neighborhood emptied in between (eviction race).
+                source, payload = self._blend_payload(req, fallback=entry)
             fut.set_result(self._finish(
-                req, self._steady_polish(req, entry), batch=n))
+                req, self._steady_polish(req, payload, source=source),
+                batch=n))
+
+    def _surrogate_payload(self, req: SolveRequest):
+        """A polish-shaped payload dict predicted by the surrogate, or
+        None (unfit head / surrogate off / non-finite prediction)."""
+        if self.surrogate is None or req.kind != "steady_state":
+            return None
+        from aiyagari_tpu.serve.cache import (_structural_key,
+                                              calibration_params)
+
+        pred = self.surrogate.predict(_structural_key(req.config),
+                                      calibration_params(req.config))
+        if pred is None:
+            return None
+        policy = pred.policy
+        if policy is not None and self.config.method == "vfi":
+            policy = None  # the basis is fitted on whatever `warm` holds;
+            #                mixed-method payloads are not worth guarding
+        return {"r": pred.r, "slope": pred.slope, "warm": policy}
+
+    def _blend_payload(self, req: SolveRequest, *, fallback,
+                       kind: str = "ss", extra: tuple = ()):
+        """(source, payload): the distance-weighted blend of every cached
+        neighbor in radius — rate, secant slope, and consumption policy
+        (structural keying guarantees in-cache neighbors share the
+        request's grid, so the policy blend is a weighted sum; the
+        mismatched-grid interpolation lives in cache.blend_policies and is
+        exercised directly by its tests). Degenerates to the single
+        `fallback` entry when only one (or zero — the eviction race)
+        neighbor remains."""
+        from aiyagari_tpu.serve.cache import (blend_scalar, blend_weights)
+
+        near = self.cache.neighborhood(req.config, kind=kind, extra=extra)
+        near = near[:self.config.blend_neighbors]
+        if len(near) <= 1:
+            entry = near[0][0] if near else fallback
+            return "neighbor", entry.payload
+        entries = [e for e, _ in near]
+        weights = blend_weights([d for _, d in near])
+        payload = {
+            "r": blend_scalar([float(e.payload["r"]) for e in entries],
+                              weights),
+            "slope": None, "warm": None,
+        }
+        slopes = [(e.payload.get("slope"), w)
+                  for e, w in zip(entries, weights)
+                  if e.payload.get("slope") is not None]
+        if slopes:
+            wsum = sum(w for _, w in slopes)
+            payload["slope"] = sum(s * w for s, w in slopes) / wsum
+        warms = [(np.asarray(e.payload["warm"]), w)
+                 for e, w in zip(entries, weights)
+                 if e.payload.get("warm") is not None]
+        if warms and all(w0.shape == warms[0][0].shape
+                         for w0, _ in warms):
+            wsum = sum(w for _, w in warms)
+            payload["warm"] = sum(p * (w / wsum) for p, w in warms)
+        return "blend", payload
 
     def _solve_kwargs(self) -> dict:
         return dict(method=self.config.method, solver=self.config.solver,
@@ -522,6 +653,23 @@ class SolveService:
             "converged": bool(result.converged), "status": status,
             "slope": slope, "warm": warm_state,
         }, kind="ss")
+        self._observe_surrogate(config, float(result.r), slope, warm_state)
+
+    def _observe_surrogate(self, config, r: float, slope, warm) -> None:
+        """Feed one converged solve into the surrogate's training ring
+        (best-effort — training must never fail a solve)."""
+        if self.surrogate is None:
+            return
+        try:
+            from aiyagari_tpu.serve.cache import (_structural_key,
+                                                  calibration_params)
+
+            self.surrogate.observe(
+                _structural_key(config), calibration_params(config), r,
+                slope=slope,
+                policy=(None if self.config.method == "vfi" else warm))
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
 
     @staticmethod
     def _slope_from_history(result) -> Optional[float]:
@@ -613,22 +761,26 @@ class SolveService:
                     "gap": float(res.gap[i]), "converged": True,
                     "status": status, "slope": None, "warm": warm_state,
                 }, kind="ss")
+                self._observe_surrogate(req.config, float(res.r[i]), None,
+                                        warm_state)
             fut.set_result(self._finish(req, resp, batch=batch_size))
 
-    def _steady_polish(self, req: SolveRequest, entry) -> SolveResponse:
+    def _steady_polish(self, req: SolveRequest, payload: dict, *,
+                       source: str = "neighbor") -> SolveResponse:
         """The warm path: a short secant polish on the market-clearing
-        rate, seeded at the cached neighbor's equilibrium (and its
-        consumption policy as the household warm start) — each evaluation
+        rate, seeded at the predictor's guess (a blended neighborhood, a
+        single cached neighbor, or the surrogate — `source`) and its
+        consumption policy as the household warm start — each evaluation
         is one max_iter=1 dispatch.solve at a pinned rate, so the whole
         polish is a handful of warm-started household+distribution solves
         instead of a cold bisection from the full bracket. Falls back to
-        the cold path when the polish does not close within polish_steps
-        (correctness never depends on the cache)."""
+        the cold path when the polish does not close within polish_steps,
+        counted as a `degradation` (correctness never depends on any
+        predictor — the degraded answer IS the cold solve's answer)."""
         from aiyagari_tpu import dispatch
 
         t0 = time.perf_counter()
         eq0 = self.config.equilibrium
-        payload = entry.payload
         r = float(payload["r"])
         slope = payload.get("slope")
         warm_state = payload.get("warm")
@@ -660,7 +812,9 @@ class SolveService:
                 status = _status_of(res)
                 self._put_steady(req.config, res, status, slope=slope)
                 return SolveResponse(
-                    id=req.id, kind=req.kind, status=status, cache="warm",
+                    id=req.id, kind=req.kind, status=status,
+                    cache=("cold" if source == "surrogate" else "warm"),
+                    warm_source=source,
                     converged=True, r=float(res.r), w=float(res.w),
                     capital=float(res.capital), gap=gap,
                     wall_s=time.perf_counter() - t0, result=res)
@@ -678,13 +832,35 @@ class SolveService:
                 continue
             step = gap / slope
             r = r - step
-        # Polish exhausted: the neighbor was too far (or the slope
-        # estimate bad) — serve the request cold, honestly labeled warm
-        # (the cache outcome) with the full wall.
+        # Polish exhausted: the guess was too far (or the slope estimate
+        # bad) — DEGRADE to the true cold solve. The answer is therefore
+        # bitwise the cold path's answer (pinned in tests/test_serve.py);
+        # the cache label keeps the lookup outcome, warm_source reports
+        # the request ended up paying a cold solve, and the degradation
+        # is a counted ledger event.
+        self._degrade(req, source, "steady polish exhausted")
         resp = self._steady_serial(req)
-        resp.cache = "warm"
+        resp.cache = "cold" if source == "surrogate" else "warm"
+        resp.warm_source = "cold"
+        resp.degraded = True
         resp.wall_s = time.perf_counter() - t0
         return resp
+
+    def _degrade(self, req: SolveRequest, source: str,
+                 reason: str) -> None:
+        """One counted degradation: a warm-start predictor's guess did
+        not close and the request re-solves cold."""
+        self.degradations += 1
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter("aiyagari_serve_degradations_total",
+                            source=source).inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+        if self._led is not None:
+            self._led.event("degradation", id=req.id, stage="serve",
+                            source=source, reason=reason)
 
     # -- transitions -------------------------------------------------------
 
@@ -706,6 +882,7 @@ class SolveService:
                 fut.set_result(self._finish(req, SolveResponse(
                     id=req.id, kind=req.kind, status=p["status"],
                     cache="hit", converged=bool(p["converged"]),
+                    warm_source="hit",
                     r_path=p["r_path"], wall_s=0.0), batch=n))
             else:
                 todo.append((req, fut))
@@ -719,11 +896,27 @@ class SolveService:
         t_cfg = self.config.transition
         anchor_outcome, anchor = self._lookup(
             todo[0][0], kind="anchor", extra=(t_cfg.T,))
-        ss = jacobian = None
+        ss = jacobian = anchor_warm = None
+        warm_source = "cold"
         if anchor_outcome == "hit":
+            # Exact-calibration anchor: reuse the stationary equilibrium
+            # and its fake-news Jacobian outright.
             ss = anchor.payload.get("ss")
             jacobian = anchor.payload.get("jacobian")
-        cache_label = "warm" if ss is not None else "cold"
+            warm_source = "anchor"
+        elif self.config.anchor_warm:
+            # Cross-bucket amortization (the PR 15 follow-up): warm-start
+            # the anchor SOLVE from the nearest cached anchor's household
+            # policy, and hand Newton a distance-weighted blend of the
+            # neighbors' fake-news Jacobians — BKM (2018) near-linearity
+            # is what makes a nearby economy's Jacobian a good Newton
+            # matrix, and Newton's fixed point does not depend on the
+            # matrix used, so a converged path is exactly as correct as a
+            # cold one. Non-convergence degrades to a cold solve below.
+            anchor_warm, jacobian = self._anchor_warm_material(cfg, t_cfg)
+            if anchor_warm is not None or jacobian is not None:
+                warm_source = "anchor_warm"
+        cache_label = "warm" if warm_source != "cold" else "cold"
         t0 = time.perf_counter()
         # equilibrium= is deliberately NOT threaded through: with eq=None
         # the anchor solve applies transition/mit.stationary_anchor's own
@@ -736,6 +929,11 @@ class SolveService:
                       rescue=(True if self.config.rescue else None))
         if ss is not None:
             kwargs.update(ss=ss, jacobian=jacobian)
+        elif warm_source == "anchor_warm":
+            if anchor_warm is not None:
+                kwargs.update(anchor_warm_start=anchor_warm)
+            if jacobian is not None:
+                kwargs.update(jacobian=jacobian)
         try:
             if len(todo) == 1:
                 res = dispatch.solve_transition(
@@ -744,7 +942,8 @@ class SolveService:
                 walls = time.perf_counter() - t0
                 responses = [self._transition_response(
                     todo[0][0], res, res.r_path, _status_of(res),
-                    bool(res.converged), cache_label, walls)]
+                    bool(res.converged), cache_label, walls,
+                    warm_source)]
                 new_ss, new_j = res.ss, res.jacobian
             else:
                 res = dispatch.sweep_transitions(
@@ -757,7 +956,8 @@ class SolveService:
                 responses = [
                     self._transition_response(
                         req, res, np.asarray(res.r_paths[i]), verdicts[i],
-                        bool(res.converged[i]), cache_label, walls)
+                        bool(res.converged[i]), cache_label, walls,
+                        warm_source)
                     for i, (req, _) in enumerate(todo)]
                 new_ss, new_j = res.ss, res.jacobian
         except Exception as e:  # noqa: BLE001 — per-request error responses
@@ -765,13 +965,64 @@ class SolveService:
 
             status = ((e.verdict or "max_iter")
                       if isinstance(e, ConvergenceError) else "error")
-            for req, fut in todo:
-                fut.set_result(self._finish(req, SolveResponse(
-                    id=req.id, kind=req.kind, status=status,
-                    cache=cache_label, converged=False,
-                    error=f"{type(e).__name__}: {e}"[:500],
-                    wall_s=time.perf_counter() - t0), batch=n))
-            return
+            if warm_source == "cold":
+                for req, fut in todo:
+                    fut.set_result(self._finish(req, SolveResponse(
+                        id=req.id, kind=req.kind, status=status,
+                        cache=cache_label, converged=False,
+                        error=f"{type(e).__name__}: {e}"[:500],
+                        wall_s=time.perf_counter() - t0), batch=n))
+                return
+            # A raising warm path (e.g. rescue-ladder exhaustion seeded
+            # with warm material) is still just a bad guess: hand every
+            # request to the degradation loop below, which re-solves cold.
+            walls = time.perf_counter() - t0
+            responses = [SolveResponse(
+                id=req.id, kind=req.kind, status=status,
+                cache=cache_label, converged=False,
+                warm_source=warm_source,
+                error=f"{type(e).__name__}: {e}"[:500], wall_s=walls)
+                for req, _ in todo]
+            new_ss = new_j = None
+        if warm_source != "cold":
+            # The correctness band: a warm-started/interpolated-Jacobian
+            # path that did NOT converge degrades to a full cold solve —
+            # its final answer is the cold path's answer, bitwise (pinned
+            # in tests/test_serve.py). Converged paths need no check:
+            # Newton's fixed point is Jacobian-independent.
+            cold_kwargs = dict(
+                transition=t_cfg,
+                backend=BackendConfig(dtype=self.config.dtype),
+                solver=self.config.solver, ledger=self._led,
+                rescue=(True if self.config.rescue else None))
+            for i, ((req, _), resp) in enumerate(zip(todo, responses)):
+                if resp.converged:
+                    continue
+                self._degrade(req, warm_source,
+                              "transition warm path did not converge")
+                t1 = time.perf_counter()
+                try:
+                    cold = dispatch.solve_transition(
+                        cfg, req.shock, on_nonconvergence="ignore",
+                        **cold_kwargs)
+                except Exception as e:  # noqa: BLE001 — per-request
+                    from aiyagari_tpu.diagnostics.errors import (
+                        ConvergenceError)
+
+                    resp.error = f"{type(e).__name__}: {e}"[:500]
+                    resp.status = ((e.verdict or "max_iter")
+                                   if isinstance(e, ConvergenceError)
+                                   else "error")
+                    resp.degraded = True
+                    resp.wall_s += time.perf_counter() - t1
+                    continue
+                responses[i] = self._transition_response(
+                    req, cold, cold.r_path, _status_of(cold),
+                    bool(cold.converged), cache_label, resp.wall_s +
+                    (time.perf_counter() - t1), "cold")
+                responses[i].degraded = True
+                if cold.ss is not None:
+                    new_ss, new_j = cold.ss, cold.jacobian
         if self.config.cache_bytes > 0 and new_ss is not None:
             self.cache.put(cfg, {"ss": new_ss, "jacobian": new_j},
                            kind="anchor", extra=(t_cfg.T,))
@@ -784,12 +1035,45 @@ class SolveService:
                     extra=self._transition_extra(req.shock))
             fut.set_result(self._finish(req, resp, batch=n))
 
+    def _anchor_warm_material(self, cfg, t_cfg):
+        """(anchor_warm_start, blended_jacobian) from the cached anchors
+        within neighbor_radius of this economy — (None, None) when the
+        neighborhood is empty. The warm start is the NEAREST anchor's
+        household consumption policy (the anchor solve re-runs, warm);
+        the Jacobian is the distance-weighted interpolation over every
+        in-radius anchor that stored one (transition/jacobian.py)."""
+        from aiyagari_tpu.serve.cache import blend_weights
+
+        near = self.cache.neighborhood(cfg, kind="anchor",
+                                       extra=(t_cfg.T,))
+        near = near[:self.config.blend_neighbors]
+        if not near:
+            return None, None
+        warm = None
+        sol = getattr(near[0][0].payload.get("ss"), "solution", None)
+        if sol is not None:
+            pol = getattr(sol, "policy_c", None)
+            if pol is not None:
+                warm = np.asarray(pol)
+        jacobian = None
+        with_j = [(e.payload.get("jacobian"), d) for e, d in near
+                  if e.payload.get("jacobian") is not None]
+        if with_j:
+            from aiyagari_tpu.transition.jacobian import (
+                interpolate_jacobians)
+
+            jacobian = interpolate_jacobians(
+                [j for j, _ in with_j],
+                blend_weights([d for _, d in with_j]))
+        return warm, jacobian
+
     def _transition_response(self, req, res, r_path, status, converged,
-                             cache, wall) -> SolveResponse:
+                             cache, wall,
+                             warm_source: str = "cold") -> SolveResponse:
         return SolveResponse(
             id=req.id, kind=req.kind, status=status, cache=cache,
-            converged=converged, r_path=np.asarray(r_path),
-            wall_s=wall, result=res)
+            converged=converged, warm_source=warm_source,
+            r_path=np.asarray(r_path), wall_s=wall, result=res)
 
     # -- metrics helpers ---------------------------------------------------
 
@@ -809,29 +1093,69 @@ class SolveService:
 # -- the CLI front ---------------------------------------------------------
 
 
-def _http_server(service: SolveService, base: AiyagariConfig, port: int):
+def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
+                 auth_token: Optional[str] = None,
+                 max_body_bytes: int = 1 << 20,
+                 max_inflight: int = 8,
+                 max_queue_depth: int = 64):
     """Minimal stdlib HTTP front: POST /solve (JSON body with optional
     "params" overrides over the base config, optional "shock"), GET
     /metrics (Prometheus text), GET /healthz. No dependencies — the
     container constraint — and the service's own queue provides the
-    backpressure."""
+    backpressure. Hardened (ISSUE 16): POST /solve requires
+    `Authorization: Bearer <auth_token>` when a token is configured
+    (--auth-token / AIYAGARI_SERVE_TOKEN; 401), rejects bodies over
+    `max_body_bytes` (413, body unread), and sheds load with 429 when one
+    client holds `max_inflight` concurrent solves or the admission queue
+    is `max_queue_depth` deep. /healthz and /metrics stay open — they are
+    the scrape surface, and serve no solve."""
+    import hmac
     import json
+    import threading as _threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from aiyagari_tpu.dispatch import _SWEEP_PARAMS, _scenario_config
+
+    inflight: dict = {}
+    inflight_lock = _threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet: the ledger is the record
             pass
 
         def _send(self, code: int, body: str,
-                  ctype: str = "application/json"):
+                  ctype: str = "application/json", headers=()):
             data = body.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+
+        def _reject(self, code: int, error: str, headers=()) -> None:
+            self._count_rejection(code)
+            self._send(code, json.dumps({"error": error}), headers=headers)
+
+        @staticmethod
+        def _count_rejection(code: int) -> None:
+            try:
+                from aiyagari_tpu.diagnostics import metrics
+
+                metrics.counter("aiyagari_serve_http_rejections_total",
+                                code=str(code)).inc()
+            except Exception:  # pragma: no cover - best-effort
+                pass
+
+        def _authorized(self) -> bool:
+            if auth_token is None:
+                return True
+            header = self.headers.get("Authorization", "")
+            if not header.startswith("Bearer "):
+                return False
+            return hmac.compare_digest(header[len("Bearer "):].strip(),
+                                       auth_token)
 
         def do_GET(self):
             if self.path == "/metrics":
@@ -841,6 +1165,7 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int):
                 self._send(200, json.dumps({
                     "ok": True, "queue_depth": service.queue_depth,
                     "requests_served": service.requests_served,
+                    "cold_fraction": round(service.cold_fraction(), 4),
                     "cache": service.cache.stats()}))
             else:
                 self._send(404, json.dumps({"error": "not found"}))
@@ -849,8 +1174,28 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int):
             if self.path != "/solve":
                 self._send(404, json.dumps({"error": "not found"}))
                 return
+            if not self._authorized():
+                self._reject(401, "unauthorized",
+                             headers=(("WWW-Authenticate", "Bearer"),))
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            if length > max_body_bytes:
+                # The body stays unread: the limit is the defense, not a
+                # post-hoc parse failure.
+                self._reject(
+                    413, f"body {length} bytes > limit {max_body_bytes}")
+                return
+            client = self.client_address[0]
+            with inflight_lock:
+                over = (inflight.get(client, 0) >= max_inflight
+                        or service.queue_depth >= max_queue_depth)
+                if not over:
+                    inflight[client] = inflight.get(client, 0) + 1
+            if over:
+                self._reject(429, "too many concurrent requests",
+                             headers=(("Retry-After", "1"),))
+                return
             try:
-                length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 params = body.get("params") or {}
                 unknown = set(params) - set(_SWEEP_PARAMS)
@@ -868,6 +1213,9 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int):
             except Exception as e:  # noqa: BLE001 — HTTP boundary
                 self._send(400, json.dumps(
                     {"error": f"{type(e).__name__}: {e}"[:500]}))
+            finally:
+                with inflight_lock:
+                    inflight[client] = max(0, inflight.get(client, 1) - 1)
 
     return ThreadingHTTPServer(("127.0.0.1", port), Handler)
 
@@ -904,6 +1252,18 @@ def serve_main(argv) -> int:
                          "EquilibriumConfig.max_iter)")
     ap.add_argument("--no-warm", action="store_true",
                     help="skip the warm-pool precompile at startup")
+    ap.add_argument("--no-surrogate", action="store_true",
+                    help="disable the policy-surface surrogate predictor")
+    ap.add_argument("--auth-token", default=None,
+                    help="require 'Authorization: Bearer <token>' on "
+                         "POST /solve (default: $AIYAGARI_SERVE_TOKEN; "
+                         "unset = open)")
+    ap.add_argument("--max-body-kb", type=float, default=1024.0,
+                    help="reject /solve bodies larger than this (413)")
+    ap.add_argument("--max-inflight", type=int, default=8,
+                    help="per-client concurrent /solve cap (429)")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="admission queue depth before shedding load (429)")
     ap.add_argument("--ledger", default=None,
                     help="append the serving flight record to this JSONL "
                          "ledger (render: python -m aiyagari_tpu report)")
@@ -938,9 +1298,14 @@ def serve_main(argv) -> int:
         max_wait_s=args.max_wait,
         cache_bytes=int(args.cache_mb * 1024 * 1024),
         resolution=args.resolution, warm_pool=not args.no_warm,
+        surrogate=not args.no_surrogate,
         warm_na=args.grid, equilibrium=eq)
     service = SolveService(cfg, ledger=args.ledger)
     service.start()
+    if service.surrogate is not None and args.port is not None:
+        # Long-lived server: refit the surrogate on a background cadence
+        # in addition to the inline fit_every cadence.
+        service.surrogate.start_background()
     try:
         if args.load is not None:
             from aiyagari_tpu.serve.load import synthetic_requests, run_load
@@ -955,9 +1320,17 @@ def serve_main(argv) -> int:
                     "wall_seconds": service.warmup_report["wall_seconds"]}
             print(json.dumps(report, indent=2))
             return 0
-        httpd = _http_server(service, base, args.port)
+        import os
+
+        token = args.auth_token or os.environ.get("AIYAGARI_SERVE_TOKEN")
+        httpd = _http_server(
+            service, base, args.port, auth_token=token,
+            max_body_bytes=int(args.max_body_kb * 1024),
+            max_inflight=args.max_inflight,
+            max_queue_depth=args.max_queue)
         print(f"serving on http://127.0.0.1:{args.port}  "
-              f"(POST /solve, GET /metrics, GET /healthz)")
+              f"(POST /solve{' [auth]' if token else ''}, GET /metrics, "
+              f"GET /healthz)")
         try:
             httpd.serve_forever()
         except KeyboardInterrupt:

@@ -1,0 +1,356 @@
+"""Policy-surface surrogate: a cheap ridge regression from the quantized
+calibration vector to (r, secant slope, low-rank consumption policy),
+trained continuously from the serve layer's own solve stream.
+
+The amortization ladder (ISSUE 16 / ROADMAP "Amortized solving") escalates
+warm-start predictors by how far a request sits from the cache's samples:
+
+  exact hit  →  blended neighbors  →  THIS SURROGATE  →  cold solve
+
+The cache's contents are samples of a smooth map calibration → solution
+(BKM 2018's near-linearity result in PAPERS.md is exactly why a low-order
+polynomial fits it well over a serving session's calibration range). When
+no cached neighbor is within `neighbor_radius`, the service asks the
+surrogate for a predicted rate + policy and runs the SAME secant polish it
+runs on a cache-warm request — so a "cold" request becomes a few Newton
+steps. Correctness is owned downstream: the polish must converge and the
+result is stored/served like any other solve; a bad prediction degrades to
+a true cold solve (a counted `degradation` event), never a wrong answer.
+
+Structure
+---------
+Observations are keyed by the cache's STRUCTURAL key (grid geometry,
+income states, technology — serve/cache._structural_key): policies only
+share a shape, and the calibration→r map only stays smooth, within one
+structure. Per structure the surrogate keeps a bounded sample ring and
+fits, every `fit_every` observations (and from an optional background
+thread):
+
+  * features: quadratic polynomial of the standardized 7-parameter
+    calibration vector — [1, z_i, z_i z_j (i<=j)] = 36 features,
+  * an r head and a slope head: ridge least squares (36x36 host solve),
+  * a policy head: rank-k SVD basis of the centered stacked policies with
+    ridge-regressed coefficients — predictions are mean + coeffs @ basis.
+
+Training data arrives two ways: in-process (`observe`, called by the
+service whenever a converged steady state is stored) and from a persisted
+run ledger (`ingest_ledger` replays `serve_request` events that carry
+`params`/`r` — the r head survives a server restart; policies are only
+available in-process).
+
+Observability: every fit emits a `surrogate_fit` ledger event (sample
+count, in-sample r residual, policy rank, wall) plus
+`aiyagari_surrogate_fits_total` / `aiyagari_surrogate_samples` series.
+All diagnostics are best-effort and can never fail a solve.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PolicySurrogate", "SurrogatePrediction"]
+
+_N_PARAMS = 7  # serve/cache.PARAM_FIELDS
+_N_FEATURES = 1 + _N_PARAMS + _N_PARAMS * (_N_PARAMS + 1) // 2  # 36
+
+
+def _features(z: np.ndarray) -> np.ndarray:
+    """Quadratic polynomial features of standardized params: [n, 36]."""
+    z = np.atleast_2d(np.asarray(z, dtype=np.float64))
+    n = z.shape[0]
+    cols = [np.ones((n, 1)), z]
+    for i in range(_N_PARAMS):
+        for j in range(i, _N_PARAMS):
+            cols.append((z[:, i] * z[:, j])[:, None])
+    return np.concatenate(cols, axis=1)
+
+
+def _ridge(F: np.ndarray, y: np.ndarray, lam: float) -> np.ndarray:
+    """Ridge solve (F'F + lam I) w = F'y; y may be [n] or [n, k]."""
+    G = F.T @ F + lam * np.eye(F.shape[1])
+    return np.linalg.solve(G, F.T @ y)
+
+
+class SurrogatePrediction:
+    """One prediction: warm-start material, shaped like a cache payload."""
+
+    __slots__ = ("r", "slope", "policy", "samples")
+
+    def __init__(self, r: float, slope: Optional[float],
+                 policy: Optional[np.ndarray], samples: int):
+        self.r = r
+        self.slope = slope
+        self.policy = policy
+        self.samples = samples
+
+
+class _Head:
+    """Fitted state for one structural key."""
+
+    def __init__(self, max_samples: int, policy_rank: int):
+        self.max_samples = max_samples
+        self.policy_rank = policy_rank
+        self.params: list = []      # [7] rows
+        self.rs: list = []          # floats
+        self.slopes: list = []      # float or nan
+        self.policies: list = []    # flat np arrays (or None)
+        self.policy_shape: Optional[Tuple[int, ...]] = None
+        self.n_observed = 0
+        self.n_at_fit = 0
+        # fitted state
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+        self.w_r: Optional[np.ndarray] = None
+        self.w_slope: Optional[np.ndarray] = None
+        self.policy_mean: Optional[np.ndarray] = None
+        self.policy_basis: Optional[np.ndarray] = None
+        self.w_policy: Optional[np.ndarray] = None
+        self.r_rms: float = float("nan")
+
+    def push(self, params, r, slope, policy) -> None:
+        self.params.append(np.asarray(params, dtype=np.float64))
+        self.rs.append(float(r))
+        self.slopes.append(float("nan") if slope is None else float(slope))
+        if policy is not None:
+            pol = np.asarray(policy, dtype=np.float64)
+            if self.policy_shape is None:
+                self.policy_shape = pol.shape
+            if pol.shape != self.policy_shape:
+                pol = None  # shape drifted inside one structure: skip
+            else:
+                pol = pol.reshape(-1)
+        self.policies.append(pol if policy is not None else None)
+        self.n_observed += 1
+        if len(self.params) > self.max_samples:
+            self.params.pop(0)
+            self.rs.pop(0)
+            self.slopes.pop(0)
+            self.policies.pop(0)
+
+
+class PolicySurrogate:
+    """Ridge surrogate over the calibration space, one head per structural
+    key (module docstring). Thread-safe: the service worker observes and
+    predicts while an optional background thread refits."""
+
+    def __init__(self, *, min_samples: int = 12, fit_every: int = 8,
+                 max_samples: int = 512, policy_rank: int = 4,
+                 ridge_lambda: float = 1e-6):
+        if min_samples < 2:
+            raise ValueError(f"min_samples must be >= 2, got {min_samples}")
+        if fit_every < 1:
+            raise ValueError(f"fit_every must be >= 1, got {fit_every}")
+        self.min_samples = int(min_samples)
+        self.fit_every = int(fit_every)
+        self.max_samples = int(max_samples)
+        self.policy_rank = int(policy_rank)
+        self.ridge_lambda = float(ridge_lambda)
+        self._heads: Dict[tuple, _Head] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fits = 0
+        self.predictions = 0
+
+    # -- training stream ---------------------------------------------------
+
+    def observe(self, structural: tuple, params, r: float,
+                slope: Optional[float] = None,
+                policy=None) -> None:
+        """One converged solve: calibration params (PARAM_FIELDS order),
+        the equilibrium rate, an optional secant slope, an optional
+        consumption policy [n_states, na]. Fits inline every `fit_every`
+        observations once `min_samples` have arrived."""
+        with self._lock:
+            head = self._heads.get(structural)
+            if head is None:
+                head = _Head(self.max_samples, self.policy_rank)
+                self._heads[structural] = head
+            head.push(params, r, slope, policy)
+            due = (len(head.params) >= self.min_samples
+                   and head.n_observed - head.n_at_fit >= self.fit_every)
+        if due:
+            self.fit(structural)
+
+    def ingest_ledger(self, path, structural: tuple) -> int:
+        """Replay a persisted run ledger's `serve_request` stream into the
+        head for `structural` (the structure this server runs at — the
+        event does not carry grid geometry). Only converged steady-state
+        events that recorded `params` and `r` train; policies are not in
+        the ledger, so this warms the r/slope heads only. Returns the
+        number of observations ingested."""
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        n = 0
+        for event in read_ledger(path):
+            if event.get("kind") != "serve_request":
+                continue
+            if event.get("request_kind") != "steady_state":
+                continue
+            if not event.get("converged"):
+                continue
+            params, r = event.get("params"), event.get("r")
+            if params is None or r is None or len(params) != _N_PARAMS:
+                continue
+            self.observe(structural, params, float(r),
+                         slope=event.get("slope"))
+            n += 1
+        return n
+
+    # -- fitting -----------------------------------------------------------
+
+    def fit(self, structural: Optional[tuple] = None) -> bool:
+        """Refit one head (or every head when structural is None). Returns
+        True if at least one head (re)fitted."""
+        if structural is None:
+            with self._lock:
+                keys = list(self._heads)
+            return any([self.fit(k) for k in keys])
+        t0 = time.perf_counter()
+        with self._lock:
+            head = self._heads.get(structural)
+            if head is None or len(head.params) < self.min_samples:
+                return False
+            X = np.stack(head.params)
+            y_r = np.asarray(head.rs, dtype=np.float64)
+            y_s = np.asarray(head.slopes, dtype=np.float64)
+            pols = [p for p in head.policies if p is not None]
+            P = np.stack(pols) if len(pols) >= self.min_samples else None
+            pol_mask = np.asarray([p is not None for p in head.policies])
+
+            mean = X.mean(axis=0)
+            std = X.std(axis=0)
+            std = np.where(std < 1e-12, 1.0, std)
+            F = _features((X - mean) / std)
+            lam = self.ridge_lambda
+            head.mean, head.std = mean, std
+            head.w_r = _ridge(F, y_r, lam)
+            head.r_rms = float(np.sqrt(np.mean((F @ head.w_r - y_r) ** 2)))
+            s_mask = np.isfinite(y_s)
+            head.w_slope = (_ridge(F[s_mask], y_s[s_mask], lam)
+                            if s_mask.sum() >= self.min_samples else None)
+            if P is not None:
+                pmean = P.mean(axis=0)
+                Pc = P - pmean
+                rank = max(1, min(self.policy_rank, P.shape[0] - 1))
+                _, _, Vt = np.linalg.svd(Pc, full_matrices=False)
+                basis = Vt[:rank]
+                coeffs = Pc @ basis.T
+                head.policy_mean = pmean
+                head.policy_basis = basis
+                head.w_policy = _ridge(F[pol_mask], coeffs, lam)
+            head.n_at_fit = head.n_observed
+            self.fits += 1
+            samples = len(head.params)
+            r_rms = head.r_rms
+            rank_out = (head.policy_basis.shape[0]
+                        if head.policy_basis is not None else 0)
+        self._emit_fit(samples=samples, r_rms=r_rms, policy_rank=rank_out,
+                       wall_s=time.perf_counter() - t0)
+        return True
+
+    # -- prediction --------------------------------------------------------
+
+    def predict(self, structural: tuple,
+                params) -> Optional[SurrogatePrediction]:
+        """Warm-start material for one request, or None while the head is
+        unfit (below `min_samples` or never fitted) — the caller MUST
+        treat None as a cold solve (pinned in tests/test_serve.py). A
+        non-finite prediction also returns None: the surrogate never
+        hands the polish a poisoned guess."""
+        with self._lock:
+            head = self._heads.get(structural)
+            if head is None or head.w_r is None:
+                return None
+            x = np.asarray(params, dtype=np.float64)
+            f = _features(((x - head.mean) / head.std)[None, :])[0]
+            r = float(f @ head.w_r)
+            if not np.isfinite(r):
+                return None
+            slope = None
+            if head.w_slope is not None:
+                s = float(f @ head.w_slope)
+                slope = s if np.isfinite(s) and s < 0.0 else None
+            policy = None
+            if head.w_policy is not None:
+                flat = head.policy_mean + (f @ head.w_policy) @ \
+                    head.policy_basis
+                if np.all(np.isfinite(flat)):
+                    policy = np.maximum(
+                        flat.reshape(head.policy_shape), 1e-12)
+            self.predictions += 1
+            samples = len(head.params)
+        self._count_prediction()
+        return SurrogatePrediction(r=r, slope=slope, policy=policy,
+                                   samples=samples)
+
+    # -- background cadence ------------------------------------------------
+
+    def start_background(self, interval_s: float = 2.0) -> None:
+        """Refit every head on a daemon-thread cadence — the 'trained
+        continuously' mode for long-lived servers; inline fit_every
+        cadence keeps working either way. Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.fit(None)
+                except Exception:  # pragma: no cover - never kill cadence
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="surrogate-refit")
+        self._thread.start()
+
+    def stop_background(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            heads = {
+                repr(k): {"samples": len(h.params),
+                          "observed": h.n_observed,
+                          "fitted": h.w_r is not None,
+                          "r_rms": None if not np.isfinite(h.r_rms)
+                          else round(h.r_rms, 8)}
+                for k, h in self._heads.items()}
+        return {"heads": len(heads), "fits": self.fits,
+                "predictions": self.predictions, "per_head": heads}
+
+    # -- observability (must never fail a solve) ---------------------------
+
+    def _emit_fit(self, **fields) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+            from aiyagari_tpu.diagnostics.ledger import active_ledger
+
+            metrics.counter("aiyagari_surrogate_fits_total").inc()
+            metrics.gauge("aiyagari_surrogate_samples").set(
+                fields.get("samples", 0))
+            led = active_ledger()
+            if led is not None:
+                led.event("surrogate_fit", **{
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in fields.items()})
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+
+    def _count_prediction(self) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter("aiyagari_surrogate_predictions_total").inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass

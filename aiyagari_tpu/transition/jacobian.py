@@ -45,7 +45,47 @@ from aiyagari_tpu.sim.distribution import expectation_step, young_lottery
 from aiyagari_tpu.transition.path import backward_policies
 from aiyagari_tpu.utils.firm import capital_demand_slope
 
-__all__ = ["fake_news_jacobian", "newton_jacobian"]
+__all__ = ["fake_news_jacobian", "interpolate_jacobians",
+           "newton_jacobian"]
+
+
+def interpolate_jacobians(jacobians, weights) -> np.ndarray:
+    """Distance-weighted interpolation of fake-news (or Newton) Jacobians
+    from nearby anchor economies — the serve layer's transition
+    amortization (ISSUE 16). The license is the near-linearity BKM (2018)
+    document: J varies smoothly in the calibration, so a convex blend of
+    neighboring anchors' Jacobians is an accurate Newton matrix for an
+    economy between them. Correctness never rests on the accuracy —
+    Newton's FIXED POINT is independent of the matrix used (the residual,
+    not the matrix, defines convergence), so a converged path under an
+    interpolated J equals the cold path's answer; a bad blend merely fails
+    to converge, and the caller degrades to a cold solve.
+
+    `jacobians` is a non-empty sequence of same-shaped [T, T] host
+    matrices; `weights` a matching sequence of non-negative weights
+    (normalized here). Returns host np.float64 [T, T]."""
+    mats = [np.asarray(j, np.float64) for j in jacobians]
+    if not mats:
+        raise ValueError("interpolate_jacobians needs >= 1 jacobian")
+    shape = mats[0].shape
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError(f"jacobians must be square [T, T], got {shape}")
+    for m in mats[1:]:
+        if m.shape != shape:
+            raise ValueError(
+                f"jacobian shape mismatch: {m.shape} vs {shape}")
+    w = np.asarray(list(weights), np.float64)
+    if w.shape != (len(mats),):
+        raise ValueError(
+            f"weights must align with jacobians: {w.shape} vs {len(mats)}")
+    if np.any(w < 0.0) or not np.isfinite(w).all() or w.sum() <= 0.0:
+        raise ValueError("weights must be non-negative, finite, and "
+                         "not all zero")
+    w = w / w.sum()
+    out = np.zeros(shape, np.float64)
+    for m, wi in zip(mats, w):
+        out += wi * m
+    return out
 
 
 def fake_news_jacobian(C_ss, k_ss, mu_ss, a_grid, s, P, *, r_ss, w_ss,

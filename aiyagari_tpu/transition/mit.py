@@ -239,13 +239,21 @@ def _as_model(model: Union[AiyagariModel, AiyagariConfig], dtype):
 
 def stationary_anchor(model: AiyagariModel, *,
                       solver: Optional[SolverConfig] = None,
-                      eq: Optional[EquilibriumConfig] = None):
+                      eq: Optional[EquilibriumConfig] = None,
+                      warm_start=None):
     """The stationary equilibrium both ends of the path are anchored at:
     an EGM solve (the backward sweep needs the consumption policy as its
     terminal condition) closed with the deterministic Young histogram (the
     forward push needs mu_ss as its initial condition). Tighter-than-default
     tolerances: anchor error is a floor on how flat the flat-path identity
-    can be."""
+    can be.
+
+    `warm_start` seeds the FIRST household solve with a consumption policy
+    from a nearby economy (the serve layer's anchor amortization, ISSUE
+    16) — a pure iteration-count accelerant: the bisection still certifies
+    the same tolerance from the same bracket, so the anchor is exactly as
+    converged as a cold one (equilibrium/bisection.py threads warm_start=
+    since PR 15)."""
     from aiyagari_tpu.equilibrium.bisection import (
         solve_equilibrium_distribution,
     )
@@ -257,7 +265,8 @@ def stationary_anchor(model: AiyagariModel, *,
             "backward sweep iterates the EGM operator from the terminal "
             f"consumption policy); got solver.method={solver.method!r}")
     eq = eq or EquilibriumConfig(max_iter=48, tol=1e-8)
-    return solve_equilibrium_distribution(model, solver=solver, eq=eq)
+    return solve_equilibrium_distribution(model, solver=solver, eq=eq,
+                                          warm_start=warm_start)
 
 
 def _pushforward_of(solver: Optional[SolverConfig]) -> str:
@@ -400,6 +409,7 @@ def solve_transition(
     eq: Optional[EquilibriumConfig] = None,
     ss=None,
     jacobian: Optional[np.ndarray] = None,
+    anchor_warm_start=None,
     keep_policies: bool = True,
     on_iteration: Optional[Callable] = None,
     dtype=jnp.float64,
@@ -409,7 +419,10 @@ def solve_transition(
 
     `ss` (a distribution-closure EquilibriumResult) and `jacobian` (the
     Newton J_D) can be passed in to amortize the anchors across calls —
-    solve_transitions_sweep does exactly that. The per-round max excess
+    solve_transitions_sweep does exactly that. `anchor_warm_start` (a
+    consumption policy from a NEARBY economy) instead warm-starts the
+    anchor solve itself when ss is None — the serve layer's cross-bucket
+    amortization (stationary_anchor); ignored when ss is provided. The per-round max excess
     demand lands in max_excess_history (and flows through on_iteration),
     the acceptance telemetry ISSUE 2 names.
 
@@ -433,7 +446,8 @@ def solve_transition(
     pushforward = _pushforward_of(solver)
     egm_kernel = _egm_kernel_of(solver)
     if ss is None:
-        ss = stationary_anchor(model, solver=solver, eq=eq)
+        ss = stationary_anchor(model, solver=solver, eq=eq,
+                               warm_start=anchor_warm_start)
     _check_anchor(ss)
     tech = model.config.technology
     r_ss = float(ss.r)
@@ -590,6 +604,7 @@ def solve_transitions_sweep(
     eq: Optional[EquilibriumConfig] = None,
     ss=None,
     jacobian: Optional[np.ndarray] = None,
+    anchor_warm_start=None,
     mesh=None,
     on_iteration: Optional[Callable] = None,
     dtype=jnp.float64,
@@ -639,7 +654,8 @@ def solve_transitions_sweep(
     pushforward = _pushforward_of(solver)
     egm_kernel = _egm_kernel_of(solver)
     if ss is None:
-        ss = stationary_anchor(model, solver=solver, eq=eq)
+        ss = stationary_anchor(model, solver=solver, eq=eq,
+                               warm_start=anchor_warm_start)
     _check_anchor(ss)
     tech = model.config.technology
     r_ss = float(ss.r)

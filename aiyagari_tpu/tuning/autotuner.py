@@ -440,6 +440,38 @@ def _probe_pushforward(na: int, dtype) -> Dict[str, Callable]:
     return {rt: make(rt) for rt in candidates}
 
 
+def _probe_pushforward_batched(na: int, dtype) -> Dict[str, Callable]:
+    """The VMAPPED-context push-forward race (ISSUE 16): the same
+    monotone-lottery workload as `_probe_pushforward`, but vmapped over a
+    sweep's worth of lanes — the program shape the lockstep GE sweep and
+    parallel-bracket rounds actually run. Solo walls do NOT transfer (the
+    ISSUE 15 measurement: vmapped transpose gathers ~5.5x/lane slower on
+    hosts while scatter scales linearly), which is exactly why this is a
+    separate knob with its own measured entries."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.ops.pushforward import pushforward_step
+
+    nz, lanes = 7, 6   # the serve/ci sweep width the 5.5x split was seen at
+    idx = jnp.broadcast_to(jnp.clip(jnp.arange(na, dtype=jnp.int32) - 1,
+                                    0, na - 2)[None, None, :],
+                           (lanes, nz, na))
+    w_lo = jnp.full((lanes, nz, na), 0.5, dtype)
+    mu = jnp.full((lanes, nz, na), 1.0 / (nz * na), dtype)
+    P = jnp.full((nz, nz), 1.0 / nz, dtype)
+    candidates = ["scatter", "transpose", "banded"]
+    if _platform() == "tpu":
+        candidates.append("pallas")   # same exclusion logic as the solo probe
+
+    def make(rt):
+        step = jax.jit(jax.vmap(
+            lambda m, i, w: pushforward_step(m, i, w, P, backend=rt)))
+        return lambda: step(mu, idx, w_lo)
+
+    return {rt: make(rt) for rt in candidates}
+
+
 def _probe_egm_kernel(na: int, dtype) -> Dict[str, Callable]:
     from aiyagari_tpu.models.aiyagari import aiyagari_preset
     from aiyagari_tpu.ops.egm import egm_step
@@ -490,6 +522,12 @@ KNOBS: Dict[str, KnobSpec] = {
         candidates=lambda: ("scatter", "transpose", "banded") + (
             ("pallas",) if _platform() == "tpu" else ()),
         build_probe=_probe_pushforward),
+    "pushforward_batched": KnobSpec(
+        name="pushforward_batched",
+        default=lambda: "scatter" if _platform() == "cpu" else "transpose",
+        candidates=lambda: ("scatter", "transpose", "banded") + (
+            ("pallas",) if _platform() == "tpu" else ()),
+        build_probe=_probe_pushforward_batched),
     "egm_kernel": KnobSpec(
         name="egm_kernel",
         default=lambda: "xla",

@@ -21,7 +21,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
               "precision", "pushforward", "egm_fused", "telemetry",
               "resilience", "mesh2d", "attribution", "observatory",
-              "serve", "analysis")
+              "serve", "amortized", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -49,14 +49,14 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-12]
+    tr = records[-13]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-11]
+    ac = records[-12]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -70,7 +70,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-10]
+    pr = records[-11]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -94,7 +94,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-9]
+    pw = records[-10]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -122,7 +122,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The host WALL is advisory only: off-TPU the fused route runs the
     # Pallas interpreter — a correctness vehicle — so no speedup is gated
     # here; the speedup claim is TPU-side (docs/USAGE.md).
-    ef = records[-8]
+    ef = records[-9]
     assert ef["metric"].startswith("egm_fused_sweep")
     assert set(ef["routes"]) == {"xla", "pallas_fused"}
     for name, route in ef["routes"].items():
@@ -148,7 +148,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-7]
+    tm = records[-8]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -165,7 +165,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # sweep quarantined EXACTLY its one poisoned lane with every other
     # lane parity-equal to the clean sweep, and the quarantine machinery
     # costs <= 1.1x a clean sweep (host-side masks only).
-    rs = records[-6]
+    rs = records[-7]
     assert rs["metric"] == "resilience_fault_battery"
     assert rs["value"] == 1.0, rs
     assert rs["recovered"] == rs["points"]
@@ -196,7 +196,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # measure partitioning overhead at equal total work (the frozen
     # BENCH_r12_mesh2d.json documents the measured ordering); the
     # chips-scale claim rides the priced-bytes column.
-    m2 = records[-5]
+    m2 = records[-6]
     assert m2["metric"] == "mesh2d_sweep"
     assert m2["devices"] >= 8, m2
     assert set(m2["topologies"]) == {"unsharded", "scenarios8", "grid8",
@@ -238,7 +238,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # stops fusing and materializes its broadcasts lands at 10-100x), a
     # measured probe with per-candidate walls for every contested knob,
     # and the frozen BENCH_r11_attribution.json artifact.
-    at = records[-4]
+    at = records[-5]
     assert at["metric"] == "route_attribution"
     assert at["value"] >= 10, at
     assert not at["flagged"], at
@@ -277,7 +277,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # two-host shard pair merged back into one run-id-joined, ordered
     # stream with its torn tail tolerated; and the watch table rendered a
     # row per scenario.
-    ob = records[-3]
+    ob = records[-4]
     assert ob["metric"] == "pod_observatory"
     assert ob["devices"] >= 8, ob
     assert set(ob["skew"]["axes"]) == {"scenarios", "grid"}
@@ -324,7 +324,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # acceptance bar; gated at the satellite's >= serial with the 2x
     # claim frozen in BENCH_r14_serve.json). Every request leaves a
     # ledger trail and the serve gauges export.
-    sv = records[-2]
+    sv = records[-3]
     assert sv["metric"] == "serve_load"
     reg = sv["regimes"]
     assert reg["warm"]["p50_s"] <= 0.5 * reg["cold"]["p50_s"], sv
@@ -361,6 +361,55 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert frozen_sv["metric"] == "serve_load"
     assert frozen_sv["warm_vs_cold_p50"] <= 0.5
     assert frozen_sv["coalesced_vs_serial"] >= 2.0
+    # The serve layer's latency-SLO gate (ISSUE 16 satellite): the
+    # offered-rps ramp found a knee — the service met the SLO at least
+    # at its lowest offered rate on exact-hit traffic.
+    assert sv["slo_gate"]["met"] is True, sv
+    assert sv["ramp"]["knee_rps"] is not None, sv
+    assert sv["ramp"]["steps"][0]["slo_met"] is True, sv
+    # The amortized record carries the ISSUE 16 acceptance telemetry: the
+    # predictor ladder (hit -> blend -> surrogate -> anchor/anchor_warm)
+    # drives the mixed-workload cold-solve fraction under 0.5; the
+    # surrogate-warmed and anchor-warmed requests cost <= 0.6x their cold
+    # baselines at p50; and the deliberately-poisoned guesses degraded to
+    # cold solves whose answers matched a fresh cold service BITWISE
+    # (zero wrong-answer degradations — the correctness band).
+    am = records[-2]
+    assert am["metric"] == "serve_amortized"
+    assert am["cold_fraction"] < 0.5, am
+    assert am["value"] == am["cold_fraction"], am
+    ws = am["warm_sources"]
+    assert sum(ws.values()) == am["requests"], am
+    assert ws.get("hit", 0) >= 3, am
+    assert ws.get("blend", 0) + ws.get("neighbor", 0) >= 3, am
+    assert ws.get("surrogate", 0) >= 1, am
+    assert ws.get("anchor_warm", 0) >= 1, am
+    assert am["surrogate_vs_cold_p50"] is not None, am
+    assert am["surrogate_vs_cold_p50"] <= 0.6, am
+    assert am["anchor_warm_vs_cold_p50"] is not None, am
+    assert am["anchor_warm_vs_cold_p50"] <= 0.6, am
+    # Both forced poisonings actually exercised the degrade-to-cold band,
+    # and no degraded answer differed from the cold answer.
+    assert am["forced_degradations"]["steady"] is True, am
+    assert am["forced_degradations"]["transition"] is True, am
+    assert am["degradations"] >= 2, am
+    assert am["wrong_answer_degradations"] == 0, am
+    # The surrogate actually trained from the serve stream (fit events on
+    # the ledger) and the new scrape series exported.
+    assert am["surrogate"]["heads"] >= 1, am
+    ev_am = am["ledger_events"]
+    assert ev_am["surrogate_fit"] > 0, am
+    assert ev_am["degradation"] >= 2, am
+    assert ev_am["serve_request"] == am["requests"], am
+    assert all(am["prometheus_gauges"].values()), am
+    # The frozen artifact the ci battery owns (ISSUE 16 acceptance).
+    with open(os.path.join(bench_dir, "BENCH_r15_amortized.json")) as f:
+        frozen_am = json.load(f)
+    assert frozen_am["metric"] == "serve_amortized"
+    assert frozen_am["cold_fraction"] < 0.5
+    assert frozen_am["wrong_answer_degradations"] == 0
+    assert frozen_am["surrogate_vs_cold_p50"] <= 0.6
+    assert frozen_am["anchor_warm_vs_cold_p50"] <= 0.6
     # The analysis record carries the ISSUE 9 acceptance gate: the static
     # analyzer ran over the kernel zoo + source tree and found NOTHING —
     # a scatter regression, a precision leak, a host sync in a loop, a

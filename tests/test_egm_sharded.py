@@ -223,6 +223,9 @@ class TestShardedLaborEGMSolver:
     (knot, consumption) pairs (VERDICT round 3 #1 — the generalization of
     the exogenous-only round-3 capability)."""
 
+    @pytest.mark.slow  # ~26 s: the labor ring composition stays tier-1 via
+    # test_no_full_grid_crosses_devices (same sharded solve, one size down)
+    # and TestShardedEGMSolver's exogenous trajectory pin.
     def test_trajectory_matches_unsharded(self):
         # Bounded-sweep trajectory equality at 8,192 points: per-sweep
         # agreement pins the sharded composition (ring value interp +

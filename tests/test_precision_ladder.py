@@ -262,6 +262,9 @@ class TestLadderParity:
         # The discrete policy is exactly stable under the polish.
         assert int(jnp.max(jnp.abs(mixed.policy_idx - plain.policy_idx))) <= 1
 
+    @pytest.mark.slow  # ~230 s: two full grid-4096 sharded solves on the
+    # 8-virtual-device CPU mesh; the ladder's sharded wiring stays tier-1
+    # via test_egm_sharded_hot_stage_stays_f32 + the dispatch parities.
     def test_sharded_parity(self):
         from aiyagari_tpu.parallel.mesh import make_mesh
         from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded

@@ -315,6 +315,9 @@ class TestShardedSentinel:
                   grid_power=2.0)
         return m, w, C0, kw
 
+    @pytest.mark.slow  # ~30 s: three grid-8192 sharded solves; the sentinel
+    # verdict/off-identity contracts stay tier-1 on the single-device paths
+    # (TestNanVerdictPolicy, TestQuarantine) at a fraction of the wall.
     def test_sharded_nan_fault_verdict_and_off_identity(self):
         from aiyagari_tpu.parallel.mesh import make_mesh
         from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded

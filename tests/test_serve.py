@@ -1,7 +1,12 @@
 """Persistent solve service (ISSUE 15): the quantized solution cache's
 contracts (bucket collisions polish, LRU byte budget, warm-vs-cold noise
 cone), the warm pool, deadline coalescing with quarantine isolation, and
-the serving flight record.
+the serving flight record. Amortized solving (ISSUE 16) adds the predictor
+ladder's correctness band: multi-neighbor blending (mismatched grids, the
+eviction race), the surrogate's unfit-means-cold contract, the
+bad-guess-degrades-to-cold bitwise pins for both the steady and the
+transition path, the HTTP front's 401/413/429 hardening, and the load
+driver's SLO-knee ramp.
 
 Service tests run at a tiny calibration (grid 40, tol 2e-4 — the serve
 bench's measured always-converges point) so the whole file stays
@@ -9,7 +14,12 @@ tier-1-sized; every solve is CPU f64 under the suite's virtual-device
 conftest."""
 
 import dataclasses
+import threading
 import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -22,10 +32,13 @@ from aiyagari_tpu.config import (
     TransitionConfig,
 )
 from aiyagari_tpu.serve import (
+    PolicySurrogate,
     ServeConfig,
     SolveRequest,
     SolveService,
     SolutionCache,
+    blend_policies,
+    blend_weights,
     calibration_key,
     calibration_params,
     payload_nbytes,
@@ -390,3 +403,275 @@ class TestValidation:
             dispatch.solve(KrusellSmithConfig(), warm_start=np.zeros(3))
         with pytest.raises(ValueError, match="warm_start"):
             dispatch.solve(BASE, backend="numpy", warm_start=np.zeros(3))
+
+
+# ---------------------------------------------------------------------------
+# amortized solving (ISSUE 16): blend, surrogate, degrade-to-cold
+# ---------------------------------------------------------------------------
+
+
+class TestBlendPredictors:
+    def test_blend_policies_interpolates_mismatched_grids(self):
+        """Structural keying means in-cache neighbors always share the
+        request's grid, but the helper's contract covers the general case:
+        each policy is interpolated onto the TARGET grid before weighting.
+        Linear policies make np.interp exact, so the blend is checkable in
+        closed form."""
+        ga = np.linspace(0.0, 10.0, 11)
+        gb = np.linspace(0.0, 10.0, 21)
+        target = np.linspace(0.5, 9.5, 7)
+        pa = np.stack([2.0 * ga, 2.0 * ga + 1.0])       # [n_states, na_a]
+        pb = np.stack([3.0 * gb + 1.0, 3.0 * gb])       # [n_states, na_b]
+        w = blend_weights([1.0, 3.0])
+        out = blend_policies([pa, pb], [ga, gb], w, target)
+        assert out.shape == (2, target.size)
+        np.testing.assert_allclose(
+            out[0], w[0] * (2.0 * target) + w[1] * (3.0 * target + 1.0),
+            rtol=1e-12)
+        np.testing.assert_allclose(
+            out[1], w[0] * (2.0 * target + 1.0) + w[1] * (3.0 * target),
+            rtol=1e-12)
+
+    def test_blend_policies_same_grid_is_weighted_sum(self):
+        g = np.linspace(0.0, 5.0, 8)
+        pa, pb = np.ones((3, 8)), 3.0 * np.ones((3, 8))
+        w = blend_weights([1.0, 1.0])
+        out = blend_policies([pa, pb], [g, g], w, g)
+        np.testing.assert_allclose(out, 2.0 * np.ones((3, 8)))
+
+    def test_blend_weights_zero_distance_takes_all_mass(self):
+        w = blend_weights([0.0, 5.0, 9.0])
+        assert w[0] > 0.999 and abs(float(w.sum()) - 1.0) < 1e-12
+
+    def test_neighbor_evicted_between_lookup_and_blend(self):
+        """The eviction race: the neighborhood empties between the
+        classifying lookup and the blend (a future multi-worker cache) —
+        the blend must fall back to the entry the lookup already holds,
+        not crash or silently go cold."""
+        svc = SolveService(svc_config(max_batch=1, surrogate=False))
+        payload = {"r": 0.012, "slope": -2.0, "warm": None, "w": 1.0,
+                   "capital": 3.0, "gap": 0.0, "converged": True,
+                   "status": "converged"}
+        entry = svc.cache.put(with_beta(0.9500), payload)
+        req = SolveRequest(with_beta(0.9507))
+        outcome, looked = svc.cache.lookup(req.config)
+        assert outcome == "warm" and looked is entry
+        svc.cache._entries.clear()
+        source, blended = svc._blend_payload(req, fallback=looked)
+        assert source == "neighbor" and blended is payload
+
+
+class TestSurrogate:
+    def test_predict_is_none_until_first_fit(self):
+        sur = PolicySurrogate(min_samples=4, fit_every=1)
+        key = ("s",)
+        rng = np.random.default_rng(0)
+        assert sur.predict(key, np.zeros(7)) is None
+        for i in range(3):
+            sur.observe(key, rng.normal(size=7), 0.01 + 1e-3 * i)
+            assert sur.predict(key, np.zeros(7)) is None
+        sur.observe(key, rng.normal(size=7), 0.014)
+        pred = sur.predict(key, np.zeros(7))
+        assert pred is not None and np.isfinite(pred.r)
+        assert sur.fits == 1 and sur.predictions == 1
+
+    def test_unfit_surrogate_serves_cold_not_warm(self):
+        """The service consults the surrogate on every cache miss, but an
+        unfit head predicts None and the request MUST report cold — the
+        ladder never manufactures a warm label out of nothing."""
+        with SolveService(svc_config(max_batch=1)) as svc:
+            assert svc.surrogate is not None
+            resp = svc.solve(with_beta(0.9445), timeout=300)
+        assert resp.status == "converged"
+        assert resp.cache == "cold" and resp.warm_source == "cold"
+        assert not resp.degraded
+        assert svc.surrogate.predictions == 0
+        assert svc.warm_sources == {"cold": 1}
+        assert svc.cold_fraction() == 1.0
+
+
+class TestDegradeToCold:
+    """The correctness band of every predictor: a guess that cannot close
+    re-solves cold, and the served answer is BITWISE the cold path's
+    answer — amortization buys latency, never a different result."""
+
+    def test_bad_steady_guess_degrades_bitwise_to_cold(self, tmp_path):
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "led.jsonl"
+        a, b = with_beta(0.9510), with_beta(0.9515)
+        with SolveService(svc_config(max_batch=1), ledger=led) as svc:
+            first = svc.solve(a, timeout=300)
+            assert first.status == "converged" and first.cache == "cold"
+            # Poison the cached neighbor: a rate far from any equilibrium
+            # with no slope and no policy, and a single polish evaluation
+            # — the warm guess cannot close.
+            entry = svc.cache._entries[svc.cache.key_for(a)]
+            entry.payload = dict(entry.payload, r=0.04, slope=None,
+                                 warm=None)
+            svc.config = dataclasses.replace(svc.config, polish_steps=1)
+            resp = svc.solve(b, timeout=300)
+        assert resp.degraded and resp.warm_source == "cold"
+        assert resp.cache == "warm"       # the lookup outcome is kept
+        assert resp.status == "converged"
+        assert svc.degradations == 1
+        with SolveService(svc_config(max_batch=1, cache_bytes=0,
+                                     surrogate=False)) as verify:
+            ref = verify.solve(b, timeout=300)
+        assert float(resp.r) == float(ref.r)
+        assert float(resp.capital) == float(ref.capital)
+        deg = [e for e in read_ledger(led) if e["kind"] == "degradation"]
+        assert len(deg) == 1 and deg[0]["source"] == "neighbor"
+
+    def test_bad_anchor_jacobian_degrades_bitwise_to_cold(self, tmp_path):
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        led = tmp_path / "led.jsonl"
+        trans = TransitionConfig(T=24, max_iter=20, tol=1e-6)
+        s1 = MITShock(param="tfp", size=0.008, rho=0.9)
+        s2 = MITShock(param="tfp", size=0.005, rho=0.9)
+        with SolveService(svc_config(max_batch=1, transition=trans),
+                          ledger=led) as svc:
+            r1 = svc.solve(BASE, kind="transition", shock=s1, timeout=600)
+            assert r1.status == "converged" and r1.cache == "cold"
+            # Poison the cached anchor's fake-news Jacobian (wrong sign
+            # AND wrong scale): Newton gets an unusable matrix and must
+            # exhaust its iterations.
+            akey = svc.cache.key_for(BASE, kind="anchor", extra=(trans.T,))
+            aentry = svc.cache._entries[akey]
+            bad = -0.05 * np.asarray(aentry.payload["jacobian"])
+            aentry.payload = dict(aentry.payload, jacobian=bad)
+            r2 = svc.solve(BASE, kind="transition", shock=s2, timeout=600)
+            # The degrading cold re-solve repaired the anchor in place.
+            repaired = np.asarray(
+                svc.cache._entries[akey].payload["jacobian"])
+        assert r2.degraded and r2.warm_source == "cold"
+        assert r2.status == "converged" and r2.converged
+        assert r2.cache == "warm"
+        assert svc.degradations == 1
+        assert not np.array_equal(repaired, bad)
+        with SolveService(svc_config(max_batch=1, cache_bytes=0,
+                                     surrogate=False,
+                                     transition=trans)) as verify:
+            ref = verify.solve(BASE, kind="transition", shock=s2,
+                               timeout=600)
+        np.testing.assert_array_equal(r2.r_path, ref.r_path)
+        deg = [e for e in read_ledger(led) if e["kind"] == "degradation"]
+        assert len(deg) == 1 and deg[0]["source"] == "anchor"
+
+
+# ---------------------------------------------------------------------------
+# the hardened HTTP front and the SLO-knee ramp (ISSUE 16 satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestHttpHardening:
+    @staticmethod
+    def _serve(svc, **kw):
+        from aiyagari_tpu.serve.service import _http_server
+
+        httpd = _http_server(svc, BASE, 0, **kw)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, httpd.server_address[1]
+
+    @staticmethod
+    def _request(port, *, method="GET", path="/healthz", body=None,
+                 token=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body, method=method)
+        if token is not None:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def test_auth_required_and_scrape_surface_open(self):
+        import json
+
+        with SolveService(svc_config(max_batch=1)) as svc:
+            first = svc.solve(BASE, timeout=300)
+            assert first.status == "converged"
+            httpd, port = self._serve(svc, auth_token="sekrit")
+            try:
+                code, _, headers = self._request(
+                    port, method="POST", path="/solve", body=b"{}")
+                assert code == 401
+                assert headers.get("WWW-Authenticate") == "Bearer"
+                assert self._request(port, method="POST", path="/solve",
+                                     body=b"{}", token="wrong")[0] == 401
+                # /metrics and /healthz are the scrape surface: open.
+                assert self._request(port, path="/metrics")[0] == 200
+                code, body, _ = self._request(port, path="/healthz")
+                assert code == 200 and json.loads(body)["ok"] is True
+                code, body, _ = self._request(
+                    port, method="POST", path="/solve", body=b"{}",
+                    token="sekrit")
+                assert code == 200
+                served = json.loads(body)
+                assert served["cache"] == "hit"
+                assert served["r"] == first.r
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+
+    def test_body_limit_and_load_shedding(self):
+        # Never started: the 413/429 rejections must fire before any
+        # solve is admitted.
+        svc = SolveService(svc_config(max_batch=1))
+        httpd, port = self._serve(svc, max_body_bytes=256)
+        try:
+            assert self._request(port, method="POST", path="/solve",
+                                 body=b"x" * 1024)[0] == 413
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        httpd, port = self._serve(svc, max_queue_depth=0)
+        try:
+            code, _, headers = self._request(port, method="POST",
+                                             path="/solve", body=b"{}")
+            assert code == 429
+            assert headers.get("Retry-After") == "1"
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+
+class TestRunRamp:
+    def test_knee_is_last_offered_rate_meeting_slo(self):
+        from aiyagari_tpu.serve.load import run_ramp
+
+        lat = [0.005, 0.5]
+
+        class Stub:
+            step = 0
+
+            def submit(self, req):
+                fut = Future()
+                fut.set_result(SimpleNamespace(
+                    latency_s=lat[Stub.step], status="converged",
+                    cache="hit", batch=1, queue_wait_s=0.0,
+                    warm_source="hit", degraded=False))
+                return fut
+
+        def make_requests(n, step):
+            Stub.step = step
+            return [object()] * n
+
+        report = run_ramp(Stub(), make_requests,
+                          rates=(50.0, 100.0, 200.0), n_per_rate=4,
+                          slo_s=0.05)
+        # Step 0 meets the SLO; step 1's p99 blows it; step 2 never runs
+        # (past the knee the open loop only measures queue growth).
+        assert report["knee_rps"] == 50.0
+        assert [s["slo_met"] for s in report["steps"]] == [True, False]
+        assert report["steps"][0]["warm_sources"] == {"hit": 4}
+        assert report["slo_s"] == 0.05
+
+    def test_empty_rates_rejected(self):
+        from aiyagari_tpu.serve.load import run_ramp
+
+        with pytest.raises(ValueError, match="rate"):
+            run_ramp(None, lambda n, s: [], rates=(), n_per_rate=1,
+                     slo_s=1.0)
