@@ -29,6 +29,12 @@ the source DISCIPLINE that keeps them auditable and fast:
     only in the sanctioned resolver functions and tuning/ — anywhere
     else it re-hardcodes a route choice behind the autotuner's back and
     escapes the route_decision ledger trail.
+  * ift-differentiation-discipline (AIYA205) — jax.grad / vjp / jvp /
+    jacfwd / jacrev / hessian aimed directly at an unrolled while_loop
+    solver (solve_aiyagari_egm, stationary_distribution,
+    solve_transition, ...) is flagged everywhere except ops/implicit.py:
+    the IFT wrappers (ISSUE 17) are the one sanctioned way to
+    differentiate through a converged solve.
 
 Suppression: a `# noqa: AIYA###` comment on the flagged line (multiple
 ids comma-separated) marks a deliberate exception; suppressed findings
@@ -76,6 +82,21 @@ _ROUTE_RESOLVER_FUNCS = {
     "ops/interp.py": {"bucket_index", "searchsorted_method"},
 }
 _ROUTE_EXEMPT_DIRS = ("tuning/",)
+
+# AIYA205 scope: the while_loop fixed-point entry points that reverse-mode
+# AD must never touch directly, and the autodiff operators that would do
+# so. ops/implicit.py is the sanctioned door (its custom_vjp rules ARE the
+# gradients of these solves); everything else differentiates the *_implicit
+# wrappers.
+_IFT_EXEMPT = ("ops/implicit.py",)
+_UNROLLED_SOLVER_ENTRYPOINTS = frozenset({
+    "solve_aiyagari_egm", "solve_aiyagari_egm_labor", "solve_aiyagari_vfi",
+    "stationary_distribution", "solve_equilibrium",
+    "solve_equilibrium_distribution", "solve_transition",
+})
+_AUTODIFF_OPERATORS = frozenset({
+    "grad", "value_and_grad", "vjp", "jvp", "jacfwd", "jacrev", "hessian",
+})
 
 # The route names a resolution binds (ops/pushforward.BACKENDS,
 # ops/egm.EGM_KERNELS, the searchsorted methods) — kept literal here so
@@ -134,6 +155,7 @@ class _Linter(ast.NodeVisitor):
         # (when this IS one of the resolver modules) and the tuning layer.
         self.route_exempt = any(f"/{d}" in f"/{rel_norm}"
                                 for d in _ROUTE_EXEMPT_DIRS)
+        self.ift_exempt = any(rel_norm.endswith(e) for e in _IFT_EXEMPT)
         self._route_allowed_funcs = set()
         for suffix, funcs in _ROUTE_RESOLVER_FUNCS.items():
             if rel_norm.endswith(suffix):
@@ -341,6 +363,36 @@ class _Linter(ast.NodeVisitor):
                     "remote TPU transport); use the batched "
                     "jax.device_get pattern (_cached_grid_bounds / "
                     "_fetch_scalars)")
+        # AIYA205: reverse/forward-mode AD aimed straight at an unrolled
+        # while_loop solver. Only the direct-reference form is detectable
+        # statically (jax.grad(solve_aiyagari_egm) / grad(solve_transition,
+        # ...)); a lambda wrapper calling the solver inside still fails at
+        # trace time — the lint catches the honest spelling, the runtime
+        # catches the rest.
+        if not self.ift_exempt:
+            op = None
+            if isinstance(func, ast.Name) and func.id in _AUTODIFF_OPERATORS:
+                op = func.id
+            elif isinstance(func, ast.Attribute):
+                ch = _attr_chain(func)
+                if ch and ch.split(".")[-1] in _AUTODIFF_OPERATORS:
+                    op = ch
+            if op is not None and node.args:
+                tgt = node.args[0]
+                name = None
+                if isinstance(tgt, ast.Name):
+                    name = tgt.id
+                elif isinstance(tgt, ast.Attribute):
+                    name = tgt.attr
+                if name in _UNROLLED_SOLVER_ENTRYPOINTS:
+                    self._emit(
+                        "ift-differentiation-discipline", node,
+                        f"`{op}({name}, ...)` differentiates an unrolled "
+                        "while_loop fixed point; use the implicit wrapper "
+                        f"({name}_implicit / steady_state_map / "
+                        "transition_r_path_implicit — "
+                        "ops/implicit.fixed_point_vjp is the one "
+                        "sanctioned door)")
         chain = _attr_chain(func) if isinstance(func, ast.Attribute) else None
         if chain and chain.split(".")[-2:] == ["debug", "print"]:
             if self._debug_guard_depth == 0:

@@ -281,6 +281,63 @@ def _build_transition_round():
                 _f((_T + 1,)), _f((_T,)))
 
 
+def _build_egm_vjp():
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_implicit
+
+    def fn(C, a_grid, s, P, r, w, amin, sigma, beta):
+        def obj(b):
+            sol = solve_aiyagari_egm_implicit(
+                C, a_grid, s, P, r, w, amin, sigma=sigma, beta=b,
+                tol=1e-6, max_iter=50, adjoint_tol=1e-8, adjoint_max_iter=50)
+            return jnp.sum(sol.policy_c)
+
+        return jax.grad(obj)(beta)
+
+    return fn, _egm_args(_f)
+
+
+def _build_distribution_adjoint():
+    import jax
+
+    from aiyagari_tpu.sim.distribution import (
+        aggregate_capital,
+        stationary_distribution_implicit,
+    )
+
+    def fn(policy_k, a_grid, P):
+        def obj(pol):
+            d = stationary_distribution_implicit(
+                pol, a_grid, P, tol=1e-8, max_iter=200,
+                adjoint_tol=1e-8, adjoint_max_iter=50)
+            return aggregate_capital(d.mu, a_grid)
+
+        return jax.grad(obj)(policy_k)
+
+    return fn, (_f((_NZ, _NA)), _f((_NA,)), _f((_NZ, _NZ)))
+
+
+def _build_ge_ift():
+    import jax
+
+    from aiyagari_tpu.calibrate.economy import steady_state_map
+
+    def fn(beta, sigma, rho, sigma_e, a_grid):
+        def obj(b, sg, rh, se):
+            st = steady_state_map(
+                b, sg, rh, se, a_grid, n_states=_NZ, alpha=0.36,
+                delta=0.08, amin=0.0, bisect_iters=8, hh_tol=1e-6,
+                hh_max_iter=50, dist_tol=1e-8, dist_max_iter=200,
+                adjoint_tol=1e-8, adjoint_max_iter=50)
+            return st["r"]
+
+        return jax.grad(obj, argnums=(0, 1, 2, 3))(beta, sigma, rho, sigma_e)
+
+    return fn, (_f(), _f(), _f(), _f(), _f((_NA,)))
+
+
 def _build_ks_step():
     from aiyagari_tpu.sim.ks_distribution import distribution_capital_path
 
@@ -417,6 +474,29 @@ def _build_registry() -> List[ProgramSpec]:
             name="ks/distribution_step", family="ks",
             build_off=_build_ks_step,
             scatter_free=True, stage_dtype="float64"),
+        # The differentiable solve stack (ISSUE 17): the reverse-mode
+        # artifacts users actually compile when they jax.grad through the
+        # implicit wrappers. Each trace contains BOTH the stop_gradient'd
+        # primal while_loop (already audited via its forward entry above)
+        # AND the Neumann adjoint loop of ops/implicit.py — AIYA107 must
+        # certify the adjoint cond's NaN-exit (`delta > tol` is False for
+        # NaN), and the dead/stable-carry rules its (lambda, delta, k)
+        # carry. NOT declared scatter_free: the cotangent of the gather-
+        # based interpolation/pushforward is a scatter-add by
+        # construction — the adjoint pays it once per backward solve, off
+        # the forward hot path.
+        ProgramSpec(
+            name="egm/sweep_vjp", family="egm",
+            build_off=_build_egm_vjp,
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="distribution/adjoint", family="distribution",
+            build_off=_build_distribution_adjoint,
+            stage_dtype="float64"),
+        ProgramSpec(
+            name="equilibrium/ge_ift", family="equilibrium",
+            build_off=_build_ge_ift,
+            stage_dtype="float64"),
     ]
 
 

@@ -199,6 +199,27 @@ RULES: Tuple[Rule, ...] = (
             "(tuning/autotuner.py), turning an audited decision back "
             "into an unexplained constant."),
     ),
+    Rule(
+        id="AIYA205",
+        name="ift-differentiation-discipline",
+        level="source",
+        description=(
+            "No jax.grad / value_and_grad / vjp / jvp / jacfwd / jacrev / "
+            "hessian applied DIRECTLY to an unwrapped solver fixed point "
+            "(solve_aiyagari_egm*, solve_aiyagari_vfi, "
+            "stationary_distribution, solve_equilibrium*, "
+            "solve_transition): their lax.while_loop primals are not "
+            "reverse-differentiable — a trace-time error at best, a "
+            "silently wrong unrolled gradient at worst. Differentiate "
+            "through the implicit wrappers instead "
+            "(solve_aiyagari_egm_implicit, "
+            "stationary_distribution_implicit, "
+            "calibrate/economy.steady_state_map, "
+            "transition/implicit.transition_r_path_implicit — all built "
+            "on ops/implicit.fixed_point_vjp / two_point_root_vjp): the "
+            "IFT adjoint at the converged point is the one sanctioned "
+            "door (ISSUE 17)."),
+    ),
 )
 
 _BY_NAME = {r.name: r for r in RULES}

@@ -1,0 +1,44 @@
+"""Gradient-based calibration of Aiyagari economies (ISSUE 17).
+
+Built on the IFT adjoints of ops/implicit.py: `economy.steady_state_map`
+is a fully differentiable, vmappable θ → steady state map (differentiable
+Rouwenhorst discretization → device bisection for the primal GE rate →
+scalar IFT through market clearing → wrapped household/distribution
+solves), `moments` computes the calibration targets (wealth Gini, K/Y,
+MPC, top-10% share) from the differentiable μ/policy, `loss` maps raw
+parameters through constraint-keeping transforms into a weighted moment
+distance, and `optimize` fits by Adam (+ BFGS polish) with per-lane
+quarantine. The product entry point is dispatch.calibrate; the HTTP front
+serves it as POST /calibrate (serve/service.py).
+"""
+
+from aiyagari_tpu.calibrate.economy import (
+    income_process_implicit,
+    steady_state_map,
+)
+from aiyagari_tpu.calibrate.loss import (
+    CALIBRATED_PARAMS,
+    constrain,
+    moment_loss,
+    pack,
+    unconstrain,
+    unpack,
+)
+from aiyagari_tpu.calibrate.moments import MOMENTS, model_moments, moments_of
+from aiyagari_tpu.calibrate.optimize import FitResult, fit
+
+__all__ = [
+    "CALIBRATED_PARAMS",
+    "FitResult",
+    "MOMENTS",
+    "constrain",
+    "fit",
+    "income_process_implicit",
+    "model_moments",
+    "moment_loss",
+    "moments_of",
+    "pack",
+    "steady_state_map",
+    "unconstrain",
+    "unpack",
+]

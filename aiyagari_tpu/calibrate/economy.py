@@ -1,0 +1,190 @@
+"""Differentiable θ → steady-state map: the forward model of calibration.
+
+The chain, every stage on device and reverse-AD-transparent:
+
+  (β, σ, ρ, σ_e)
+    → Rouwenhorst discretization        (traceable port of utils/markov.py)
+    → primal GE rate by device bisection (lax.fori_loop, warm-started,
+      all inputs stop_gradient — the nondifferentiable primal)
+    → scalar IFT through market clearing (ops/implicit.two_point_root_vjp)
+    → wrapped household + distribution solves at the differentiable rate
+      (solvers/egm.solve_aiyagari_egm_implicit,
+       sim/distribution.stationary_distribution_implicit)
+    → (r, w, μ, policies, K) with exact gradients to all four parameters.
+
+Frozen by design: the ASSET GRID and the income-state COUNT. A θ-dependent
+grid would make array shapes (and the grid's s_min-dependent bounds) move
+under the optimizer; calibration therefore fits the economy ON the base
+model's grid, which is the same contract a sweep over _SWEEP_PARAMS
+scenarios already has (dispatch._scenario_config changes parameters, never
+shapes). The income discretization is pinned to method="rouwenhorst"
+because its stationary distribution has a CLOSED FORM independent of ρ
+when p = q = (1+ρ)/2 — the binomial(n−1, 1/2) weights — so ∂π/∂ρ = 0
+analytically and the whole s-normalization stays differentiable without
+differentiating an eigenvector solve (tauchen's normal-CDF bin masses
+would be differentiable too, but its stationary π needs the lstsq solve
+utils/markov.py runs on host).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from math import comb
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from aiyagari_tpu.ops.implicit import two_point_root_vjp
+from aiyagari_tpu.sim.distribution import (
+    aggregate_capital,
+    stationary_distribution,
+    stationary_distribution_implicit,
+)
+from aiyagari_tpu.solvers.egm import (
+    initial_consumption_guess,
+    solve_aiyagari_egm,
+    solve_aiyagari_egm_implicit,
+)
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+
+__all__ = ["income_process_implicit", "steady_state_map"]
+
+
+def income_process_implicit(rho, sigma_e, n_states: int):
+    """Differentiable Rouwenhorst discretization of log-AR(1) income:
+    (ρ, σ_e) → (s [n], P [n,n], π [n], labor_raw), matching
+    utils/markov.rouwenhorst + normalized_labor (the numpy reference) at
+    float precision while staying traceable.
+
+    The recursive overlay builds P_n from P_{n-1} with four shifted adds
+    (unrolled python loop — n_states is a static shape); π is the
+    closed-form binomial(n−1, 1/2), exact for p = q and independent of ρ
+    (see module docstring). s is normalized so E_π[s] = 1, with the
+    pre-normalization aggregate labor_raw carried for the demand curve —
+    the same split as utils/markov.normalized_labor.
+    """
+    p = (1.0 + rho) / 2.0
+    P = jnp.stack([jnp.stack([p, 1.0 - p]), jnp.stack([1.0 - p, p])])
+    for m in range(3, n_states + 1):
+        Pn = jnp.zeros((m, m), P.dtype)
+        Pn = Pn.at[:-1, :-1].add(p * P)
+        Pn = Pn.at[:-1, 1:].add((1.0 - p) * P)
+        Pn = Pn.at[1:, :-1].add((1.0 - p) * P)
+        Pn = Pn.at[1:, 1:].add(p * P)
+        Pn = Pn.at[1:-1, :].multiply(0.5)
+        P = Pn
+    psi = sigma_e * jnp.sqrt(n_states - 1.0)
+    l_grid = psi * jnp.linspace(-1.0, 1.0, n_states)
+    pi = jnp.asarray([comb(n_states - 1, k) for k in range(n_states)],
+                     l_grid.dtype) / (2.0 ** (n_states - 1))
+    s_raw = jnp.exp(l_grid)
+    labor_raw = jnp.dot(s_raw, pi)
+    return s_raw / labor_raw, P, pi, labor_raw
+
+
+@partial(jax.jit, static_argnames=(
+    "n_states", "alpha", "delta", "amin", "bisect_iters", "hh_tol",
+    "hh_max_iter", "dist_tol", "dist_max_iter", "adjoint_tol",
+    "adjoint_max_iter", "r_low"))
+def steady_state_map(beta, sigma, rho, sigma_e, a_grid, *, n_states: int,
+                     alpha: float, delta: float, amin: float,
+                     r_low: float = -0.02, bisect_iters: int = 45,
+                     hh_tol: float = 1e-12, hh_max_iter: int = 6000,
+                     dist_tol: float = 1e-13, dist_max_iter: int = 40_000,
+                     adjoint_tol: float = 1e-13,
+                     adjoint_max_iter: int = 5000) -> dict:
+    """The differentiable steady state at θ = (β, σ, ρ, σ_e) on a FROZEN
+    asset grid. Returns {"r", "w", "K", "mu", "policy_c", "policy_k", "s",
+    "P", "labor_raw", "gap"} — all carrying exact gradients to θ via the
+    IFT (module docstring has the chain). Fully vmappable over θ lanes:
+    the primal bisection is a fixed-trip fori_loop whose household and
+    distribution solves warm-start from the previous midpoint.
+
+    `gap` is the residual market-clearing excess at the returned rate —
+    the fit's convergence evidence, ~(bracket width) · (supply slope)
+    after bisect_iters halvings of the [r_low, 1/β−1] bracket.
+    """
+    sg = lax.stop_gradient
+    dt = jnp.asarray(a_grid).dtype
+    s, P, _, labor_raw = income_process_implicit(rho, sigma_e, n_states)
+    # The discretization's linspace/binomial constants are strongly-typed
+    # f64 under x64 — pin the whole economy to the GRID's dtype so the f32
+    # rung of the calibration ladder stays f32 end to end.
+    s = s.astype(dt)
+    P = P.astype(dt)
+    labor_raw = labor_raw.astype(dt)
+
+    # --- primal: device bisection on the frozen-θ economy -------------
+    s0, P0 = sg(s), sg(P)
+    beta0, sigma0, labor0 = sg(beta), sg(sigma), sg(labor_raw)
+    lo0 = jnp.asarray(r_low, dt)
+    hi0 = 1.0 / beta0 - 1.0 - jnp.asarray(1e-6, dt)
+    mid0 = 0.5 * (lo0 + hi0)
+    C_init = initial_consumption_guess(a_grid, s0, mid0,
+                                       wage_from_r(mid0, alpha, delta))
+    mu_init = jnp.full(C_init.shape, 1.0 / C_init.size, dt)
+
+    def household(r, C_ws, mu_ws):
+        w = wage_from_r(r, alpha, delta)
+        sol = solve_aiyagari_egm(C_ws, a_grid, s0, P0, r, w, amin,
+                                 sigma=sigma0, beta=beta0, tol=hh_tol,
+                                 max_iter=hh_max_iter, egm_kernel="xla")
+        d = stationary_distribution(sol.policy_k, a_grid, P0, tol=dist_tol,
+                                    max_iter=dist_max_iter, mu_init=mu_ws)
+        gap = (aggregate_capital(d.mu, a_grid)
+               - capital_demand(r, labor0, alpha, delta))
+        return gap, sol.policy_c, d.mu
+
+    def body(carry, _):
+        lo, hi, C_ws, mu_ws = carry
+        mid = 0.5 * (lo + hi)
+        gap, C_ws, mu_ws = household(mid, C_ws, mu_ws)
+        lo = jnp.where(gap > 0.0, lo, mid)
+        hi = jnp.where(gap > 0.0, mid, hi)
+        return (lo, hi, C_ws, mu_ws), None
+
+    # scan, not fori_loop: fori's lowered counter is a weak-typed scalar
+    # carry (the AIYA106 silent-recompile hazard); the bisection carry
+    # here is fully typed and the trip count static.
+    (lo, hi, C_ws, mu_ws), _ = lax.scan(
+        body, (lo0, hi0, C_init, mu_init), None, length=bisect_iters)
+    r_star = 0.5 * (lo + hi)
+    C_ws, mu_ws = sg(C_ws), sg(mu_ws)
+
+    # --- scalar IFT through market clearing ---------------------------
+    # Every array the gap function needs rides IN the params pytree: a
+    # custom_vjp backward rule must not close over tracers, and this whole
+    # map runs under jit + vmap (dispatch.calibrate). The warm starts and
+    # the grid enter stop_gradient'd — they seed primal solves only.
+    theta = {"beta": beta, "sigma": sigma, "s": s, "P": P,
+             "labor_raw": labor_raw, "a_grid": a_grid,
+             "C_ws": C_ws, "mu_ws": mu_ws}
+
+    def solves_at(r, p):
+        w = wage_from_r(r, alpha, delta)
+        sol = solve_aiyagari_egm_implicit(
+            p["C_ws"], p["a_grid"], p["s"], p["P"], r, w, amin,
+            sigma=p["sigma"], beta=p["beta"], tol=hh_tol,
+            max_iter=hh_max_iter, adjoint_tol=adjoint_tol,
+            adjoint_max_iter=adjoint_max_iter)
+        d = stationary_distribution_implicit(
+            sol.policy_k, p["a_grid"], p["P"], tol=dist_tol,
+            max_iter=dist_max_iter, mu_init=p["mu_ws"],
+            adjoint_tol=adjoint_tol, adjoint_max_iter=adjoint_max_iter)
+        return sol, d
+
+    def gap_fn(r, p):
+        sol, d = solves_at(r, p)
+        return (aggregate_capital(d.mu, p["a_grid"])
+                - capital_demand(r, p["labor_raw"], alpha, delta))
+
+    r = two_point_root_vjp(gap_fn, r_star, theta)
+
+    # --- differentiable steady state at the differentiable rate -------
+    sol, d = solves_at(r, theta)
+    K = aggregate_capital(d.mu, a_grid)
+    gap = K - capital_demand(r, labor_raw, alpha, delta)
+    return {"r": r, "w": wage_from_r(r, alpha, delta), "K": K, "mu": d.mu,
+            "policy_c": sol.policy_c, "policy_k": sol.policy_k, "s": s,
+            "P": P, "labor_raw": labor_raw, "gap": gap}

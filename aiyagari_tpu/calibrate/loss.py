@@ -1,0 +1,88 @@
+"""Parameter transforms + the weighted moment-distance objective.
+
+The optimizer walks an UNCONSTRAINED vector z; the economic parameters are
+recovered through smooth bijections that keep every iterate feasible by
+construction — no clipping, no barrier terms, no infeasible NaN solves
+from an overshooting Adam step:
+
+    β   = sigmoid(z)          ∈ (0, 1)
+    σ   = softplus(z)         > 0
+    ρ   = tanh(z)             ∈ (−1, 1)
+    σ_e = softplus(z)         > 0
+
+The objective is a weighted relative moment distance
+
+    L(z) = Σ_m  w_m · ((m(θ(z)) − target_m) / scale_m)²,
+
+scale_m = max(|target_m|, 0.01) so a near-zero target (an MPC of 0.02)
+doesn't blow its term to 1e4× the others, and w_m defaults to 1.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CALIBRATED_PARAMS", "constrain", "moment_loss", "pack",
+           "unconstrain", "unpack"]
+
+# The differentiable parameter set, in canonical z-vector order. This is
+# deliberately the IFT-reachable subset of dispatch._SWEEP_PARAMS: grid
+# and labor-choice knobs change array shapes (frozen under calibration —
+# calibrate/economy.py module docstring), psi/eta belong to the
+# endogenous-labor model the differentiable chain doesn't wrap yet.
+CALIBRATED_PARAMS = ("beta", "sigma", "rho", "sigma_e")
+
+_MIN_SCALE = 0.01
+
+
+def _softplus_inv(y):
+    # log(expm1(y)), computed as y + log1p(-exp(-y)) for overflow safety.
+    return y + jnp.log1p(-jnp.exp(-y))
+
+
+_TO_PARAM = {
+    "beta": jax.nn.sigmoid,
+    "sigma": jax.nn.softplus,
+    "rho": jnp.tanh,
+    "sigma_e": jax.nn.softplus,
+}
+_TO_Z = {
+    "beta": lambda y: jnp.log(y) - jnp.log1p(-y),
+    "sigma": _softplus_inv,
+    "rho": jnp.arctanh,
+    "sigma_e": _softplus_inv,
+}
+
+
+def constrain(name: str, z):
+    """Unconstrained z → feasible parameter value."""
+    return _TO_PARAM[name](z)
+
+
+def unconstrain(name: str, value):
+    """Feasible parameter value → unconstrained z (the transform inverse)."""
+    return _TO_Z[name](jnp.asarray(value))
+
+
+def pack(theta: dict, names=CALIBRATED_PARAMS):
+    """{name: feasible value} → unconstrained z vector [len(names)]."""
+    return jnp.stack([unconstrain(n, theta[n]) for n in names])
+
+
+def unpack(z, names=CALIBRATED_PARAMS) -> dict:
+    """Unconstrained z vector → {name: feasible value}."""
+    return {n: constrain(n, z[i]) for i, n in enumerate(names)}
+
+
+def moment_loss(moments: dict, targets: dict, weights=None):
+    """Weighted relative moment distance (module docstring). `targets`
+    selects which moments enter — keys absent from it cost nothing."""
+    weights = weights or {}
+    total = jnp.asarray(0.0)
+    for name in sorted(targets):
+        t = jnp.asarray(targets[name])
+        scale = jnp.maximum(jnp.abs(t), _MIN_SCALE)
+        w = jnp.asarray(weights.get(name, 1.0))
+        total = total + w * ((moments[name] - t) / scale) ** 2
+    return total

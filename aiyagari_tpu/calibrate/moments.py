@@ -1,0 +1,85 @@
+"""Calibration target moments from the differentiable steady state.
+
+All four moments are smooth (a.e.) functions of (μ, policy, r) computed
+with the shared jnp statistics kernels (utils/stats.py — the Lorenz/Gini
+machinery is already differentiable: the sort permutation is constant
+because the asset grid is sorted and FROZEN under calibration), so
+jax.grad flows from a moment distance all the way to (β, σ, ρ, σ_e)
+through the IFT adjoints.
+
+  gini        — wealth Gini over the asset marginal μ_a.
+  k_y         — capital-to-output ratio at z = 1: Y = K^α · L_raw^(1−α)
+                with L_raw the pre-normalization aggregate labor (the same
+                labor that scales the demand curve, utils/firm.py).
+  mpc         — μ-weighted average marginal propensity to consume out of
+                cash-on-hand: forward differences of the consumption
+                policy along assets, Δc / ((1+r) Δa) — the (1+r) puts the
+                increment in cash-on-hand units so a one-unit windfall
+                maps to ∂c/∂coh.
+  top10_share — share of wealth held by the top asset decile (Lorenz
+                interpolation, utils/stats.weighted_quantile_shares).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from aiyagari_tpu.utils.stats import weighted_gini, weighted_quantile_shares
+
+__all__ = ["MOMENTS", "model_moments", "moments_of"]
+
+# The serveable target schema (USAGE.md "Gradient-based calibration").
+MOMENTS = ("gini", "k_y", "mpc", "top10_share")
+
+
+def moments_of(state: dict, a_grid, *, alpha: float) -> dict:
+    """The four target moments from a steady_state_map state dict (or any
+    dict with differentiable "mu", "policy_c", "r", "K", "labor_raw")."""
+    mu = state["mu"]
+    mu_a = jnp.sum(mu, axis=0)
+    K = state["K"]
+    Y = K ** alpha * state["labor_raw"] ** (1.0 - alpha)
+
+    C = state["policy_c"]
+    da = a_grid[1:] - a_grid[:-1]
+    mpc_cells = (C[:, 1:] - C[:, :-1]) / ((1.0 + state["r"]) * da[None, :])
+    # Forward differences live on the left knot; the last column reuses the
+    # final segment's slope so the weights still sum to 1.
+    mpc_grid = jnp.concatenate([mpc_cells, mpc_cells[:, -1:]], axis=1)
+    mpc = jnp.sum(mu * mpc_grid)
+
+    shares = weighted_quantile_shares(a_grid, mu_a, n_quantiles=10)
+    return {
+        "gini": weighted_gini(a_grid, mu_a),
+        "k_y": K / Y,
+        "mpc": mpc,
+        "top10_share": shares[-1] / 100.0,
+    }
+
+
+def model_moments(config, **kwargs) -> dict:
+    """Host convenience: the moments of a config's economy at its own
+    parameters — the natural way to build a self-consistent target set
+    (tests, the planted-recovery bench, USAGE examples). Runs the same
+    differentiable chain as the fit, so a calibration against these
+    targets starts at zero loss by construction.
+
+    kwargs forward to calibrate.economy.steady_state_map.
+    """
+    import numpy as np
+
+    from aiyagari_tpu.calibrate.economy import steady_state_map
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    model = AiyagariModel.from_config(config)
+    tech = config.technology
+    state = steady_state_map(
+        jnp.asarray(config.preferences.beta),
+        jnp.asarray(config.preferences.sigma),
+        jnp.asarray(config.income.rho),
+        jnp.asarray(config.income.sigma_e),
+        model.a_grid,
+        n_states=config.income.n_states, alpha=tech.alpha,
+        delta=tech.delta, amin=model.amin, **kwargs)
+    moms = moments_of(state, model.a_grid, alpha=tech.alpha)
+    return {k: float(np.asarray(v)) for k, v in moms.items()}

@@ -1,0 +1,171 @@
+"""On-device moment matching: multi-lane Adam + BFGS polish with per-lane
+quarantine, on the precision ladder.
+
+The fit runs L independent lanes (different starting points of the SAME
+objective) as one vmapped program — lanes are the batching unit
+dispatch.calibrate shards over the scenario mesh axis. Three design rules,
+all inherited from the solver stack's failure discipline:
+
+  quarantine, not NaN-poisoning — a lane whose loss or gradient goes
+      non-finite (a divergent inner solve, an adjoint past its spectral
+      radius) is masked OUT of every subsequent moment/parameter update:
+      its Adam moments stop ingesting, its z freezes at the last finite
+      iterate, and the vmapped reduction over lanes never sees its NaN.
+      The lane stays visible in the result (alive=False) — failure is
+      data, not an exception (cf. serve quarantine, AIYA107 NaN-exit).
+
+  precision ladder — the Adam phase runs its early steps in f32 (each
+      gradient is a full IFT adjoint chain: ~2× the primal solve cost, so
+      halving the bytes matters at scale) and switches to f64 for the
+      late steps + the BFGS polish, mirroring ops/precision.py's
+      hot-then-polish staging of the primal solves.
+
+  trust the polish, not the trajectory — Adam gets the iterate into the
+      basin; the quadratic tail is finished by jax.scipy BFGS, and a
+      polish result is accepted PER LANE only when finite and strictly
+      better than the Adam iterate it started from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.optimize  # noqa: F401  (lazy submodule: explicit import)
+import numpy as np
+
+__all__ = ["FitResult", "fit"]
+
+
+@dataclasses.dataclass(frozen=True)
+class FitResult:
+    """Host-side fit summary. Arrays are per-lane [L]; `z` is [L, d]."""
+
+    z: np.ndarray
+    loss: np.ndarray
+    grad_norm: np.ndarray
+    alive: np.ndarray           # never quarantined
+    converged: np.ndarray       # alive AND inside loss/grad tolerance
+    steps: int                  # Adam steps actually taken
+    grad_evals: int
+    status: str                 # "converged" | "max_iter"
+    best_lane: int
+
+    @property
+    def best_z(self) -> np.ndarray:
+        return self.z[self.best_lane]
+
+
+def _value_and_grad_batch(fn):
+    return jax.jit(jax.vmap(jax.value_and_grad(fn)))
+
+
+def fit(loss_for, z0, *, steps: int = 40, lr: float = 0.1,
+        loss_tol: float = 1e-9, gtol: float = 1e-5,
+        stage_dtypes=("float32", "float64"), stage_split: float = 0.4,
+        polish: bool = True, polish_maxiter: int = 40,
+        on_step=None) -> FitResult:
+    """Fit z (lanes × params, [L, d]) against `loss_for`.
+
+    `loss_for(dtype_str)` returns the differentiable per-lane objective
+    z[d] → scalar at that dtype — the factory shape lets the ladder
+    rebuild the traced program per stage instead of casting inside one.
+    `on_step(step, loss [L], alive [L])` fires on the host after every
+    Adam step (numpy arrays) — dispatch.calibrate hangs the per-step
+    ledger events and gauges on it.
+    """
+    z = jnp.asarray(z0, jnp.float64)
+    if z.ndim != 2:
+        raise ValueError(f"z0 must be [lanes, params], got shape {z.shape}")
+    lanes = z.shape[0]
+    alive = jnp.ones((lanes,), bool)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    grad_evals = 0
+    taken = 0
+    loss = jnp.full((lanes,), jnp.inf)
+    gnorm = jnp.full((lanes,), jnp.inf)
+
+    stages = []
+    if len(stage_dtypes) > 1:
+        hot = int(round(steps * stage_split))
+        stages.append((stage_dtypes[0], hot))
+        stages.append((stage_dtypes[-1], steps - hot))
+    else:
+        stages.append((stage_dtypes[0], steps))
+
+    for dtype_str, n_steps in stages:
+        if n_steps <= 0:
+            continue
+        vg = _value_and_grad_batch(loss_for(dtype_str))
+        dt = jnp.dtype(dtype_str)
+        zs = z.astype(dt)
+        m = jnp.zeros_like(zs)
+        v = jnp.zeros_like(zs)
+        for t in range(1, n_steps + 1):
+            loss_s, g = vg(zs)
+            grad_evals += lanes
+            taken += 1
+            finite = jnp.isfinite(loss_s) & jnp.all(jnp.isfinite(g), axis=1)
+            alive = alive & finite
+            loss = jnp.where(alive, loss_s.astype(jnp.float64), loss)
+            gnorm = jnp.where(
+                alive,
+                jnp.linalg.norm(g.astype(jnp.float64), axis=1), gnorm)
+            # Convergence is judged at THIS iterate, before the update: a
+            # lane already inside tolerance freezes here, so the returned
+            # z is the iterate its reported loss/grad_norm belong to (not
+            # one Adam step past it).
+            done = ~alive | (loss <= loss_tol) | (gnorm <= gtol)
+            upd_mask = (alive & ~done)[:, None]
+            g = jnp.where(upd_mask, g, 0.0)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            mh = m / (1.0 - b1 ** t)
+            vh = v / (1.0 - b2 ** t)
+            zs = jnp.where(upd_mask,
+                           zs - lr * mh / (jnp.sqrt(vh) + eps), zs)
+            if on_step is not None:
+                on_step(taken, np.asarray(loss), np.asarray(alive))
+            if bool(jnp.all(done)):
+                z = zs.astype(jnp.float64)
+                break
+        z = zs.astype(jnp.float64)
+        if bool(jnp.all(~alive | (loss <= loss_tol) | (gnorm <= gtol))):
+            break
+
+    if polish and bool(jnp.any(alive & (loss > loss_tol))):
+        fn64 = loss_for("float64")
+
+        def _polish_one(z1):
+            res = jax.scipy.optimize.minimize(
+                fn64, z1, method="BFGS",
+                options={"maxiter": polish_maxiter, "gtol": 1e-12})
+            return res.x, res.fun
+
+        xs, fs = jax.jit(jax.vmap(_polish_one))(z)
+        grad_evals += lanes * polish_maxiter
+        better = alive & jnp.isfinite(fs) & (fs < loss) \
+            & jnp.all(jnp.isfinite(xs), axis=1)
+        z = jnp.where(better[:, None], xs, z)
+        loss = jnp.where(better, fs, loss)
+        # One last true-gradient read at the accepted iterates.
+        loss_s, g = _value_and_grad_batch(fn64)(z)
+        grad_evals += lanes
+        refreshed = alive & jnp.isfinite(loss_s) \
+            & jnp.all(jnp.isfinite(g), axis=1)
+        loss = jnp.where(refreshed, loss_s, loss)
+        gnorm = jnp.where(refreshed, jnp.linalg.norm(g, axis=1), gnorm)
+
+    converged = alive & ((loss <= loss_tol) | (gnorm <= gtol))
+    loss_np = np.asarray(loss)
+    best = int(np.argmin(np.where(np.asarray(converged), loss_np, np.inf)))
+    if not bool(np.asarray(converged).any()):
+        best = int(np.argmin(np.where(np.asarray(alive), loss_np, np.inf)))
+    return FitResult(
+        z=np.asarray(z), loss=loss_np, grad_norm=np.asarray(gnorm),
+        alive=np.asarray(alive), converged=np.asarray(converged),
+        steps=taken, grad_evals=grad_evals,
+        status="converged" if bool(np.asarray(converged).any())
+        else "max_iter",
+        best_lane=best)

@@ -146,6 +146,20 @@ SPECS = {
         Check("surrogate_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
         Check("anchor_warm_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
     ),
+    "calibration_recovery": (
+        # The differentiable solve stack (ISSUE 17). value IS the planted-
+        # parameter recovery error — the acceptance ceiling is 1e-3 and
+        # the measured landing is ~1e-11, so the floor holds the hard
+        # bound with ~8 orders of headroom; the adjoint-vs-finite-
+        # difference parity holds at its own measured-plus-margin floor;
+        # the fit must stay "converged" and keep all four parameters.
+        Check("converged", "bool"),
+        Check("params", "keys_min"),
+        Check("value", "max_abs", band=1.0, floor=1e-3),
+        Check("grad_fd_max_rel_err", "max_abs", band=1.0, floor=1e-4),
+        Check("wall_per_gradient_seconds", "wall", band=_WALL_BAND,
+              match=("grid", "n_states", "lanes")),
+    ),
 }
 
 

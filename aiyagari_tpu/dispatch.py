@@ -34,7 +34,8 @@ from aiyagari_tpu.config import (
     TransitionConfig,
 )
 
-__all__ = ["solve", "sweep", "solve_transition", "sweep_transitions"]
+__all__ = ["CalibrationResult", "calibrate", "solve", "sweep",
+           "solve_transition", "sweep_transitions"]
 
 
 def _as_ledger(ledger, *configs, entry: str):
@@ -1124,3 +1125,228 @@ def sweep_transitions(
             led.event("quarantine", context="MIT-shock transition sweep",
                       scenario=int(i), verdict=result.verdicts[int(i)])
     return result
+
+
+@dataclasses.dataclass
+class CalibrationResult:
+    """dispatch.calibrate's host-side summary.
+
+    `theta` is populated ONLY when status == "converged" — a stalled fit
+    returns the evidence (per-lane losses, alive mask, the full FitResult)
+    but never a parameter vector it cannot certify, the same refusal
+    discipline serve's /calibrate endpoint inherits verbatim.
+    """
+
+    status: str                      # "converged" | "max_iter"
+    params: tuple                    # calibrated parameter names, z order
+    theta: Optional[dict]            # fitted values (floats), converged only
+    moments: Optional[dict]          # model moments at theta, converged only
+    loss: float                      # best-lane final loss
+    lanes: int
+    steps: int                       # Adam steps taken
+    grad_evals: int
+    fit: object                      # calibrate.optimize.FitResult
+    targets: dict
+
+
+def calibrate(
+    base: AiyagariConfig,
+    targets: dict,
+    params: Sequence[str] = ("beta", "sigma", "rho", "sigma_e"),
+    *,
+    backend: Union[str, BackendConfig] = "jax",
+    lanes: int = 2,
+    steps: int = 40,
+    lr: float = 0.1,
+    weights: Optional[dict] = None,
+    loss_tol: float = 1e-9,
+    gtol: float = 1e-5,
+    stage_dtypes=("float32", "float64"),
+    stage_split: float = 0.4,
+    polish: bool = True,
+    jitter: float = 0.05,
+    seed: int = 0,
+    mesh=None,
+    ledger=None,
+    on_step=None,
+    ss_kwargs: Optional[dict] = None,
+) -> CalibrationResult:
+    """Fit an economy's deep parameters to target moments by gradient.
+
+    The forward model is the fully differentiable steady-state chain
+    (calibrate/economy.steady_state_map: Rouwenhorst -> EGM fixed point ->
+    stationary distribution -> GE rate, every stage an IFT-wrapped adjoint
+    from ops/implicit.py); the objective is the weighted relative moment
+    distance (calibrate/loss.moment_loss) over `targets`, a dict keyed by
+    calibrate.moments.MOMENTS names ("gini", "k_y", "mpc", "top10_share").
+    Optimization is multi-lane Adam + BFGS polish on the f32->f64
+    precision ladder with per-lane quarantine (calibrate/optimize.fit);
+    lane 0 starts at `base`'s own parameters, lanes 1..L-1 at jittered
+    copies, and the L lanes run as ONE vmapped device program over the
+    scenario axis — `mesh` (a MeshConfig) shards that axis exactly as
+    sweep() does, recorded by the same mesh_topology event.
+
+    Calibration requires income.method == "rouwenhorst": the
+    differentiable discretization's closed-form stationary weights exist
+    only for that scheme (calibrate/economy.py module docstring). The
+    asset grid and state count are frozen at `base`'s shapes.
+
+    Every Adam step lands a `calibration_step` ledger event (step, best
+    loss, live lanes); the final verdict + aiyagari_calibration_* metrics
+    record the fit outcome. `on_step(step, loss[L], alive[L])` is the
+    caller's per-step hook (serve streams gauges through it).
+    """
+    import numpy as np
+
+    from aiyagari_tpu.calibrate.economy import steady_state_map
+    from aiyagari_tpu.calibrate.loss import (
+        CALIBRATED_PARAMS,
+        moment_loss,
+        pack,
+        unpack,
+    )
+    from aiyagari_tpu.calibrate.moments import MOMENTS, moments_of
+    from aiyagari_tpu.calibrate.optimize import fit as run_fit
+    from aiyagari_tpu.diagnostics import metrics
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    if isinstance(backend, str):
+        backend = BackendConfig(backend=backend)
+    if backend.backend != "jax":
+        raise ValueError("calibrate() requires backend='jax'")
+    params = tuple(params)
+    unknown = set(params) - set(CALIBRATED_PARAMS)
+    if unknown:
+        raise ValueError(
+            f"unknown calibration parameter(s) {sorted(unknown)}; "
+            f"supported: {sorted(CALIBRATED_PARAMS)}")
+    if not params:
+        raise ValueError("calibrate() needs at least one parameter to fit")
+    bad = set(targets) - set(MOMENTS)
+    if bad:
+        raise ValueError(
+            f"unknown target moment(s) {sorted(bad)}; supported: "
+            f"{sorted(MOMENTS)}")
+    if not targets:
+        raise ValueError(
+            f"calibrate() needs target moments: a dict over {sorted(MOMENTS)}")
+    if base.income.method != "rouwenhorst":
+        raise ValueError(
+            "calibrate() requires income.method='rouwenhorst' (the "
+            "differentiable discretization with closed-form stationary "
+            f"weights); got {base.income.method!r}. Replace the income "
+            "config: dataclasses.replace(cfg, income=dataclasses.replace("
+            "cfg.income, method='rouwenhorst')).")
+    if base.endogenous_labor:
+        raise ValueError(
+            "calibrate() does not support endogenous_labor models yet "
+            "(the differentiable chain wraps the exogenous-labor EGM)")
+    if lanes < 1:
+        raise ValueError(f"lanes must be >= 1, got {lanes}")
+
+    model = AiyagariModel.from_config(base, dtype=jnp.float64)
+    tech = base.technology
+    n_states = base.income.n_states
+    amin = float(model.amin)
+    base_theta = {
+        "beta": base.preferences.beta,
+        "sigma": base.preferences.sigma,
+        "rho": base.income.rho,
+        "sigma_e": base.income.sigma_e,
+    }
+    ss_kwargs = dict(ss_kwargs or {})
+
+    # f32 stage: inner tolerances below f32 resolution would run every
+    # household/distribution solve to max_iter — relax them to the hot
+    # stage's own precision unless the caller pinned values.
+    _F32_TOLS = {"hh_tol": 1e-6, "dist_tol": 1e-7, "adjoint_tol": 1e-6}
+
+    def loss_for(dtype_str: str):
+        dt = jnp.dtype(dtype_str)
+        ag = model.a_grid.astype(dt)
+        tgt = {k: jnp.asarray(float(v), dt) for k, v in targets.items()}
+        kw = dict(ss_kwargs)
+        if dt == jnp.float32:
+            for k, v in _F32_TOLS.items():
+                kw.setdefault(k, v)
+
+        def objective(z):
+            th = {k: jnp.asarray(v, dt) for k, v in base_theta.items()}
+            th.update(unpack(z.astype(dt), params))
+            state = steady_state_map(
+                th["beta"], th["sigma"], th["rho"], th["sigma_e"], ag,
+                n_states=n_states, alpha=tech.alpha, delta=tech.delta,
+                amin=amin, **kw)
+            return moment_loss(moments_of(state, ag, alpha=tech.alpha),
+                               tgt, weights)
+
+        return objective
+
+    z_base = np.asarray(pack({k: base_theta[k] for k in params}, params),
+                        np.float64)
+    rng = np.random.RandomState(seed)
+    z0 = np.tile(z_base, (lanes, 1))
+    if lanes > 1:
+        z0[1:] += jitter * rng.standard_normal((lanes - 1, z_base.size))
+
+    led = _as_ledger(ledger, base, entry="calibrate")
+    mesh_cfg = mesh
+    mesh = _sweep_mesh(backend, mesh, led, entry="calibrate")
+    with _observe(led, "aiyagari_calibrate", lanes=lanes,
+                  params=list(params), moments=sorted(targets)):
+        _probe_skew(mesh, mesh_cfg, led)
+        z0_dev = jnp.asarray(z0)
+        if mesh is not None and lanes % int(mesh.shape["scenarios"]) == 0:
+            import jax as _jax
+
+            from aiyagari_tpu.parallel.mesh import named_sharding
+
+            z0_dev = _jax.device_put(
+                z0_dev, named_sharding(mesh, "scenarios", None))
+
+        def _on_step(step, loss_np, alive_np):
+            live = loss_np[alive_np] if alive_np.any() else loss_np
+            best = float(np.min(live)) if live.size else float("nan")
+            if led is not None:
+                led.event("calibration_step", step=int(step), loss=best,
+                          alive=int(alive_np.sum()), lanes=int(lanes))
+            metrics.gauge("aiyagari_calibration_last_loss").set(best)
+            metrics.gauge("aiyagari_calibration_steps").set(int(step))
+            if on_step is not None:
+                on_step(step, loss_np, alive_np)
+
+        result = run_fit(
+            loss_for, z0_dev, steps=steps, lr=lr, loss_tol=loss_tol,
+            gtol=gtol, stage_dtypes=stage_dtypes, stage_split=stage_split,
+            polish=polish, on_step=_on_step)
+
+        theta = None
+        moments = None
+        if result.status == "converged":
+            theta = {k: float(np.asarray(v))
+                     for k, v in unpack(jnp.asarray(result.best_z),
+                                        params).items()}
+            full = dict(base_theta)
+            full.update(theta)
+            state = steady_state_map(
+                jnp.asarray(full["beta"]), jnp.asarray(full["sigma"]),
+                jnp.asarray(full["rho"]), jnp.asarray(full["sigma_e"]),
+                model.a_grid, n_states=n_states, alpha=tech.alpha,
+                delta=tech.delta, amin=amin, **ss_kwargs)
+            moments = {k: float(np.asarray(v)) for k, v in
+                       moments_of(state, model.a_grid,
+                                  alpha=tech.alpha).items()}
+        best_loss = float(result.loss[result.best_lane])
+        metrics.counter("aiyagari_calibration_fits_total",
+                        status=result.status).inc()
+        metrics.gauge("aiyagari_calibration_last_loss").set(best_loss)
+        metrics.gauge("aiyagari_calibration_steps").set(int(result.steps))
+        if led is not None:
+            led.verdict("calibration",
+                        converged=result.status == "converged",
+                        iterations=int(result.steps), distance=best_loss,
+                        tol=loss_tol)
+    return CalibrationResult(
+        status=result.status, params=params, theta=theta, moments=moments,
+        loss=best_loss, lanes=lanes, steps=int(result.steps),
+        grad_evals=int(result.grad_evals), fit=result, targets=dict(targets))

@@ -1,0 +1,164 @@
+"""Implicit-function-theorem adjoints for converged fixed points (ISSUE 17).
+
+Every hot loop in the solver stack — the EGM sweep, the stationary
+push-forward, the GE bisection, the transition Newton path — is a
+`lax.while_loop` fixed point, and reverse-mode AD cannot flow through a
+while_loop. This module provides the one sanctioned way to differentiate
+*through* a converged solve: wrap the converged iterate in a
+`jax.custom_vjp` whose backward pass solves the ADJOINT system at the
+fixed point instead of unrolling the iteration (DESIGN.md §8 has the
+memory argument; the fake-news adjoint in transition/jacobian.py is the
+in-repo exemplar of the same idea specialized to the transition operator).
+
+Math. Let x* solve x = T(x, θ) with ∂T/∂x a contraction at x*. The IFT
+gives dx*/dθ = (I - ∂T/∂x)^{-1} ∂T/∂θ, so for a downstream scalar L the
+cotangent v = ∂L/∂x* pulls back through
+
+    λ = v + (∂T/∂x)ᵀ λ          (the adjoint fixed point, solved here by
+                                 Neumann iteration — each step is ONE
+                                 vjp of the step function, same cost
+                                 profile as a forward sweep)
+    ∂L/∂θ = (∂T/∂θ)ᵀ λ .
+
+`fixed_point_vjp` implements exactly that for pytree-valued fixed points;
+`two_point_root_vjp` is the scalar specialization for root conditions
+g(x*, θ) = 0 (the GE interest-rate closure), where the adjoint system is
+a single division instead of a Neumann loop.
+
+Primal bit-identity contract: the forward pass returns `x_star` UNCHANGED
+(an identity function with a custom backward rule), so wrapping a solve
+can never perturb the primal answer — gated bitwise by
+tests/test_differentiable.py.
+
+Lint rule AIYA205 (analysis/rules.py) flags `jax.grad`/`jax.jvp` applied
+to an unwrapped solver fixed point anywhere outside this module: the
+gradient of an unrolled while_loop is a trace-time error at best and a
+silent wrong answer at worst, so this module is the only door.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fixed_point_vjp", "neumann_adjoint", "two_point_root_vjp"]
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_sub(a, b):
+    return jax.tree_util.tree_map(jnp.subtract, a, b)
+
+
+def _tree_max_abs(t):
+    leaves = [jnp.max(jnp.abs(leaf)) for leaf in jax.tree_util.tree_leaves(t)]
+    return functools.reduce(jnp.maximum, leaves)
+
+
+def neumann_adjoint(vjp_x, v, *, tol, max_iter):
+    """Solve λ = v + (∂T/∂x)ᵀ λ by Neumann iteration, where `vjp_x` applies
+    (∂T/∂x)ᵀ to a cotangent pytree (the output of `jax.vjp` at the fixed
+    point). Returns (λ, iterations, final sup-norm delta).
+
+    The loop exits when the update falls below `tol` OR the iteration cap
+    is hit OR the residual goes NaN — the condition `delta > tol` is False
+    for NaN (the AIYA107 NaN-exit discipline), so a divergent adjoint
+    (spectral radius ≥ 1) terminates and surfaces as a NaN gradient for the
+    quarantine mask downstream, instead of spinning the cap.
+    """
+    delta0 = jnp.full_like(_tree_max_abs(v), jnp.inf)
+
+    def cond(carry):
+        _, delta, k = carry
+        return (delta > tol) & (k < max_iter)
+
+    def body(carry):
+        lam, _, k = carry
+        nxt = _tree_add(v, vjp_x(lam)[0])
+        delta = _tree_max_abs(_tree_sub(nxt, lam))
+        return nxt, delta, k + 1
+
+    lam, delta, iters = lax.while_loop(
+        cond, body, (v, delta0, jnp.asarray(0, jnp.int32)))
+    return lam, iters, delta
+
+
+def fixed_point_vjp(step_fn, x_star, params, *, tol=1e-13, max_iter=2000):
+    """Differentiable view of a converged fixed point x* = step_fn(x*, θ).
+
+    Forward: returns `x_star` unchanged (bit-identical primal). Backward:
+    one Neumann adjoint solve against the converged iterate (see module
+    docstring), then a single vjp of step_fn in the θ slot.
+
+    `step_fn(x, params)` must be ONE differentiable sweep of the solver —
+    the same operator the solver iterates, with any non-differentiable
+    route (Pallas kernels, host callbacks) pinned to its XLA form. `x_star`
+    and `params` are pytrees of floating arrays; anything non-differentiable
+    (grids of ints, static config) belongs closed over in `step_fn`, not in
+    `params`. The caller is responsible for having solved the primal under
+    `lax.stop_gradient` so no gradient path tries to enter the solver's own
+    while_loop.
+
+    The cotangent returned for the `x_star` argument slot is zero: by the
+    IFT the converged iterate is a *function of θ*, not an independent
+    input, so all sensitivity is routed to θ.
+    """
+
+    @jax.custom_vjp
+    def _fp(x, p):
+        return x
+
+    def _fwd(x, p):
+        return x, (x, p)
+
+    def _bwd(res, v):
+        x, p = res
+        _, vjp_x = jax.vjp(lambda xx: step_fn(xx, p), x)
+        lam, _, _ = neumann_adjoint(vjp_x, v, tol=tol, max_iter=max_iter)
+        _, vjp_p = jax.vjp(lambda pp: step_fn(x, pp), p)
+        bar_p = vjp_p(lam)[0]
+        bar_x = jax.tree_util.tree_map(jnp.zeros_like, x)
+        return bar_x, bar_p
+
+    _fp.defvjp(_fwd, _bwd)
+    return _fp(lax.stop_gradient(x_star), params)
+
+
+def two_point_root_vjp(gap_fn, x_star, params):
+    """Scalar IFT through a root condition g(x*, θ) = 0 (the GE closure:
+    x* is the market-clearing interest rate, g the excess capital supply).
+
+    Forward: returns the converged scalar root unchanged. Backward: for a
+    downstream cotangent v, dx*/dθ = -(∂g/∂x)^{-1} ∂g/∂θ gives
+
+        ∂L/∂θ = (∂g/∂θ)ᵀ · (-v / ∂g/∂x),
+
+    computed with ONE vjp of `gap_fn` (which may itself contain
+    fixed_point_vjp-wrapped inner solves — their custom rules fire inside
+    this pullback). A zero ∂g/∂x (market clearing locally insensitive to
+    the rate — a degenerate economy) yields ±inf/NaN that the calibration
+    quarantine masks out rather than poisoning the reduction.
+    """
+
+    @jax.custom_vjp
+    def _root(x, p):
+        return x
+
+    def _fwd(x, p):
+        return x, (x, p)
+
+    def _bwd(res, v):
+        x, p = res
+        _, pull = jax.vjp(gap_fn, x, p)
+        g_x, _ = pull(jnp.ones_like(v))
+        scale = -v / g_x
+        _, bar_p = pull(scale)
+        return jnp.zeros_like(x), bar_p
+
+    _root.defvjp(_fwd, _bwd)
+    return _root(lax.stop_gradient(x_star), params)

@@ -315,6 +315,82 @@ class SolveService:
         return self.submit(
             SolveRequest(config, kind=kind, shock=shock)).result(timeout)
 
+    def calibrate(self, config: AiyagariConfig, targets: dict, *,
+                  params=("beta", "sigma", "rho", "sigma_e"),
+                  lanes: int = 2, steps: int = 20, lr: float = 0.08,
+                  weights: Optional[dict] = None, seed: int = 0,
+                  jitter: float = 0.02, polish: bool = True,
+                  stage_dtypes=("float64",),
+                  ss_kwargs: Optional[dict] = None,
+                  timeout: float = 600.0) -> dict:
+        """POST /calibrate's engine: fit the economy's deep parameters to
+        `targets` by gradient (dispatch.calibrate — IFT adjoints end to
+        end) and, when the fit CONVERGES, solve the fitted economy through
+        the normal serve path so the solution cache stores it and the
+        surrogate trains on it (the fit becomes warm-start material for
+        its own neighborhood).
+
+        Runs synchronously on the caller's thread — a calibration is a
+        long-lived optimization, not a coalescible solve, so it must not
+        occupy the single worker the /solve queue drains through. The
+        income discretization is REPLACED with "rouwenhorst" (recorded in
+        the response): the differentiable chain exists only for that
+        scheme (calibrate/economy.py).
+
+        The response never carries a parameter vector the fit cannot
+        certify: a stalled fit returns status "max_iter" with the loss
+        evidence and NO "theta"/"moments" keys.
+        """
+        import uuid as _uuid
+
+        from aiyagari_tpu import dispatch
+        from aiyagari_tpu.diagnostics import metrics
+
+        t0 = time.perf_counter()
+        rid = _uuid.uuid4().hex[:8]
+        if config.income.method != "rouwenhorst":
+            config = dataclasses.replace(
+                config, income=dataclasses.replace(
+                    config.income, method="rouwenhorst"))
+        # Step 0 is unconditional: even a fit that dies on its first
+        # gradient leaves a calibration trail in the flight record.
+        if self._led is not None:
+            self._led.event("calibration_step", step=0, id=rid,
+                            loss=None, alive=int(lanes), lanes=int(lanes))
+        res = dispatch.calibrate(
+            config, targets, params, lanes=lanes, steps=steps, lr=lr,
+            weights=weights, seed=seed, jitter=jitter, polish=polish,
+            stage_dtypes=stage_dtypes, ss_kwargs=ss_kwargs,
+            ledger=self._led)
+        out = {
+            "id": rid, "kind": "calibration", "status": res.status,
+            "converged": res.status == "converged",
+            "params": list(res.params),
+            "targets": {k: float(v) for k, v in res.targets.items()},
+            "loss": res.loss, "steps": res.steps, "lanes": res.lanes,
+            "grad_evals": res.grad_evals,
+            "income_method": "rouwenhorst",
+        }
+        if res.status == "converged":
+            out["theta"] = res.theta
+            out["moments"] = res.moments
+            from aiyagari_tpu.dispatch import _scenario_config
+
+            fitted = _scenario_config(config, res.theta)
+            try:
+                resp = self.solve(fitted, timeout=timeout)
+                out["fit_solve"] = {"status": resp.status,
+                                    "cache": resp.cache,
+                                    "r": resp.r}
+            except Exception as e:  # noqa: BLE001 — the fit already
+                # succeeded; a cache-priming solve failure must not void it
+                out["fit_solve"] = {"status": "error",
+                                    "error": f"{type(e).__name__}: {e}"[:200]}
+        out["wall_s"] = round(time.perf_counter() - t0, 6)
+        metrics.counter("aiyagari_serve_requests_total", kind="calibration",
+                        status=res.status, cache="cold").inc()
+        return out
+
     @property
     def queue_depth(self) -> int:
         with self._cond:
@@ -1099,10 +1175,12 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                  max_inflight: int = 8,
                  max_queue_depth: int = 64):
     """Minimal stdlib HTTP front: POST /solve (JSON body with optional
-    "params" overrides over the base config, optional "shock"), GET
-    /metrics (Prometheus text), GET /healthz. No dependencies — the
+    "params" overrides over the base config, optional "shock"), POST
+    /calibrate (same "params" overrides plus required "targets"; see
+    SolveService.calibrate and USAGE.md "Gradient-based calibration"),
+    GET /metrics (Prometheus text), GET /healthz. No dependencies — the
     container constraint — and the service's own queue provides the
-    backpressure. Hardened (ISSUE 16): POST /solve requires
+    backpressure. Hardened (ISSUE 16): every POST requires
     `Authorization: Bearer <auth_token>` when a token is configured
     (--auth-token / AIYAGARI_SERVE_TOKEN; 401), rejects bodies over
     `max_body_bytes` (413, body unread), and sheds load with 429 when one
@@ -1171,7 +1249,7 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                 self._send(404, json.dumps({"error": "not found"}))
 
         def do_POST(self):
-            if self.path != "/solve":
+            if self.path not in ("/solve", "/calibrate"):
                 self._send(404, json.dumps({"error": "not found"}))
                 return
             if not self._authorized():
@@ -1202,6 +1280,30 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                 if unknown:
                     raise ValueError(f"unknown params {sorted(unknown)}")
                 cfg = _scenario_config(base, params)
+                if self.path == "/calibrate":
+                    targets = body.get("targets")
+                    if not isinstance(targets, dict) or not targets:
+                        raise ValueError(
+                            'calibrate needs "targets": {moment: value} '
+                            "(moments: gini, k_y, mpc, top10_share)")
+                    fit_kw = dict(body.get("fit") or {})
+                    allowed = {"lanes", "steps", "lr", "seed", "jitter",
+                               "polish"}
+                    bad = set(fit_kw) - allowed
+                    if bad:
+                        raise ValueError(
+                            f"unknown fit option(s) {sorted(bad)}; "
+                            f"supported: {sorted(allowed)}")
+                    out = service.calibrate(
+                        cfg, targets,
+                        params=tuple(body.get("calibrate")
+                                     or ("beta", "sigma", "rho", "sigma_e")),
+                        weights=body.get("weights"),
+                        ss_kwargs=body.get("ss"),
+                        timeout=float(body.get("timeout", 600)),
+                        **fit_kw)
+                    self._send(200, json.dumps(out))
+                    return
                 shock = None
                 kind = body.get("kind", "steady_state")
                 if body.get("shock"):

@@ -227,12 +227,19 @@ class PolicySurrogate:
             std = np.where(std < 1e-12, 1.0, std)
             F = _features((X - mean) / std)
             lam = self.ridge_lambda
-            head.mean, head.std = mean, std
-            head.w_r = _ridge(F, y_r, lam)
-            head.r_rms = float(np.sqrt(np.mean((F @ head.w_r - y_r) ** 2)))
+            # Fit EVERY component into locals first, then stamp the head
+            # in one block: mean/std and the weights they standardize for
+            # must move together. A calibration-driven shift in the
+            # parameter range changes mean/std sharply — a head half
+            # updated (ridge raising midway, or a policy head left over
+            # from a round that no longer receives policies, e.g. ledger
+            # replay) would apply OLD weights to NEW standardization.
+            w_r = _ridge(F, y_r, lam)
+            r_rms = float(np.sqrt(np.mean((F @ w_r - y_r) ** 2)))
             s_mask = np.isfinite(y_s)
-            head.w_slope = (_ridge(F[s_mask], y_s[s_mask], lam)
-                            if s_mask.sum() >= self.min_samples else None)
+            w_slope = (_ridge(F[s_mask], y_s[s_mask], lam)
+                       if s_mask.sum() >= self.min_samples else None)
+            pmean = basis = w_policy = None
             if P is not None:
                 pmean = P.mean(axis=0)
                 Pc = P - pmean
@@ -240,9 +247,13 @@ class PolicySurrogate:
                 _, _, Vt = np.linalg.svd(Pc, full_matrices=False)
                 basis = Vt[:rank]
                 coeffs = Pc @ basis.T
-                head.policy_mean = pmean
-                head.policy_basis = basis
-                head.w_policy = _ridge(F[pol_mask], coeffs, lam)
+                w_policy = _ridge(F[pol_mask], coeffs, lam)
+            head.mean, head.std = mean, std
+            head.w_r, head.r_rms = w_r, r_rms
+            head.w_slope = w_slope
+            head.policy_mean = pmean
+            head.policy_basis = basis
+            head.w_policy = w_policy
             head.n_at_fit = head.n_observed
             self.fits += 1
             samples = len(head.params)

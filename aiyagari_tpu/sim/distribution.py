@@ -41,6 +41,7 @@ from aiyagari_tpu.diagnostics.telemetry import (
     telemetry_set_trips,
 )
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_simplex
+from aiyagari_tpu.ops.implicit import fixed_point_vjp
 from aiyagari_tpu.ops.interp import bucket_index
 from aiyagari_tpu.ops.precision import matmul_precision_of, plan_stages
 from aiyagari_tpu.ops.pushforward import (
@@ -56,6 +57,7 @@ __all__ = [
     "distribution_step",
     "expectation_step",
     "stationary_distribution",
+    "stationary_distribution_implicit",
     "aggregate_capital",
 ]
 
@@ -344,3 +346,63 @@ def aggregate_capital(mu, a_grid):
     """E[a] under mu — the capital-supply aggregate, replacing the reference's
     time average mean(sim_k) (Aiyagari_VFI.m:129)."""
     return jnp.sum(mu * a_grid[None, :])
+
+
+def stationary_distribution_implicit(policy_k, a_grid, P, *, tol=1e-12,
+                                     max_iter=10_000, mu_init=None,
+                                     pushforward: str = "auto",
+                                     adjoint_tol: float = 1e-13,
+                                     adjoint_max_iter: int = 5000,
+                                     ) -> DistributionSolution:
+    """Differentiable view of the stationary distribution (ISSUE 17): run
+    stationary_distribution with every input under lax.stop_gradient (the
+    primal — bit-identical to the unwrapped solve), then wrap the converged
+    mu in ops/implicit.fixed_point_vjp.
+
+    The fixed-point operator wrapped here is the NORMALIZED push-forward
+    T(mu) = L mu / sum(L mu) — exactly what the solver iterates (it
+    renormalizes every sweep). The normalization is load-bearing for the
+    adjoint, not cosmetic: the raw linear operator L is a stochastic map
+    with eigenvalue 1 at mu*, so the Neumann series for (I - Lᵀ)⁻¹
+    diverges; the normalized step's Jacobian at the fixed point is
+    A = (I - mu* 1ᵀ) L, which annihilates the unit eigenvector
+    (A mu* = 0) and leaves the subdominant spectrum — the same mixing rate
+    that makes the primal iteration converge drives the adjoint.
+
+    The vjp of distribution_step IS expectation_step: the lottery
+    push-forward and the P-mixing are one linear operator L, and
+    <f, L mu> == <Lᵀ f, mu> with expectation_step as Lᵀ (its docstring
+    pins the pairing). jax.vjp recovers that adjoint mechanically from
+    the differentiable backend below — the identity is asserted, not
+    trusted, by tests/test_differentiable.py.
+
+    Route pin: the adjoint's step runs backend="transpose" — scatter-free
+    AND carrying full AD rules — regardless of the primal `pushforward`
+    route (which may resolve to Pallas, ruleless). Gradients flow to
+    policy_k through the lottery weights w_lo (piecewise-linear in the
+    policy: a.e.-differentiable) and to P through the mixing matmul;
+    `idx` is integer and correctly carries none.
+    """
+    sg = jax.lax.stop_gradient
+    prim = stationary_distribution(
+        sg(policy_k), sg(a_grid), sg(P), tol=tol, max_iter=max_iter,
+        mu_init=None if mu_init is None else sg(mu_init),
+        pushforward=pushforward)
+    # a_grid rides in params so the adjoint's closure captures no arrays
+    # (a custom_vjp rule must not close over tracers — this wrapper runs
+    # inside jit/vmap in calibrate/economy.py), but its gradient is CUT:
+    # grid-knot sensitivities through the lottery's bucket assignment are
+    # measure-zero ill-defined, and the grid is frozen under calibration.
+    params = (policy_k, a_grid, P)
+
+    def step(mu, p):
+        pol, ag, Pm = p
+        ag = jax.lax.stop_gradient(ag)
+        idx, w_lo = young_lottery(pol, ag)
+        mu_new = distribution_step(mu, idx, w_lo, Pm, backend="transpose",
+                                   precision=jax.lax.Precision.HIGHEST)
+        return mu_new / jnp.sum(mu_new)
+
+    mu_d = fixed_point_vjp(step, prim.mu, params, tol=adjoint_tol,
+                           max_iter=adjoint_max_iter)
+    return dataclasses.replace(prim, mu=mu_d)

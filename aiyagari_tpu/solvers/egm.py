@@ -26,6 +26,7 @@ from aiyagari_tpu.diagnostics.telemetry import (
 )
 from aiyagari_tpu.ops.accel import accel_init, accel_step, project_floor
 from aiyagari_tpu.ops.egm import constrained_consumption_labor, egm_step, egm_step_labor
+from aiyagari_tpu.ops.implicit import fixed_point_vjp
 from aiyagari_tpu.ops.interp import prolong_power_grid
 from aiyagari_tpu.ops.precision import hot_only, plan_stages
 from aiyagari_tpu.solvers._stopping import effective_tolerance
@@ -49,6 +50,7 @@ __all__ = [
     "ladder_warm_start",
     "ladder_warm_start_labor",
     "solve_aiyagari_egm",
+    "solve_aiyagari_egm_implicit",
     "solve_aiyagari_egm_safe",
     "solve_aiyagari_egm_labor",
     "solve_aiyagari_egm_labor_safe",
@@ -349,6 +351,52 @@ def solve_aiyagari_egm_safe(C_init, a_grid, s, P, r, w, amin, *, sigma: float,
                                  ladder=ladder, telemetry=telemetry,
                                  sentinel=sentinel, faults=faults)
     return sol
+
+
+def solve_aiyagari_egm_implicit(C_init, a_grid, s, P, r, w, amin, *, sigma,
+                                beta, tol: float, max_iter: int,
+                                grid_power: float = 0.0,
+                                adjoint_tol: float = 1e-13,
+                                adjoint_max_iter: int = 2000) -> EGMSolution:
+    """Differentiable view of the converged EGM policy (ISSUE 17): solve the
+    household problem exactly as solve_aiyagari_egm would — every input
+    under lax.stop_gradient, so no gradient path attempts to enter the
+    solver's while_loop — then wrap the converged (policy_c, policy_k) PAIR
+    in ops/implicit.fixed_point_vjp with one differentiable egm_step as the
+    fixed-point operator. Gradients w.r.t. (a_grid, s, P, r, w, amin, sigma,
+    beta) flow through the IFT adjoint; the primal policies are
+    bit-identical to the unwrapped solve (identity forward).
+
+    The pair is wrapped jointly because policy_k is the budget-identity
+    by-product of the same sweep: the step ignores its policy_k input, so
+    the adjoint Jacobian is block-triangular and the Neumann solve
+    converges at the contraction rate of the consumption update alone.
+
+    Route pins: egm_kernel="xla" / matmul_precision="highest" — the Pallas
+    routes carry no AD rules (same pin as transition/jacobian.py), and the
+    adjoint should not inherit a relaxed hot-stage contraction. Telemetry,
+    sentinel, accel and ladder knobs are deliberately absent here: they
+    shape the PRIMAL iteration path, which the IFT adjoint never sees —
+    callers needing them should run the plain solve for diagnostics and
+    this wrapper for gradients.
+    """
+    sg = jax.lax.stop_gradient
+    prim = solve_aiyagari_egm(
+        sg(C_init), sg(a_grid), sg(s), sg(P), sg(r), sg(w), sg(amin),
+        sigma=sg(sigma), beta=sg(beta), tol=tol, max_iter=max_iter,
+        grid_power=grid_power, egm_kernel="xla")
+    params = (a_grid, s, P, r, w, amin, sigma, beta)
+
+    def step(x, p):
+        C, _ = x
+        ag, s_, P_, r_, w_, am_, sig_, bet_ = p
+        return egm_step(C, ag, s_, P_, r_, w_, am_, sigma=sig_, beta=bet_,
+                        grid_power=grid_power, with_escape=False,
+                        egm_kernel="xla", matmul_precision="highest")
+
+    C_d, k_d = fixed_point_vjp(step, (prim.policy_c, prim.policy_k), params,
+                               tol=adjoint_tol, max_iter=adjoint_max_iter)
+    return dataclasses.replace(prim, policy_c=C_d, policy_k=k_d)
 
 
 @partial(jax.jit, static_argnames=("tol", "max_iter", "relative_tol", "progress_every", "grid_power", "noise_floor_ulp", "accel", "ladder", "telemetry", "sentinel", "faults"))

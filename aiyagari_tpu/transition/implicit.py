@@ -1,0 +1,104 @@
+"""Differentiable transition path via the IFT on the Newton map (ISSUE 17).
+
+The MIT-shock solver (transition/mit.py) finds the T-period rate path by a
+HOST Newton loop — r ← r − J⁻¹D(r) with J the fake-news sequence-space
+Jacobian — so nothing can `jax.grad` through it. This module wraps the
+*converged* path in ops/implicit.fixed_point_vjp using the Newton update
+itself as the fixed-point operator:
+
+    Φ(r, θ) = r − J⁻¹ D(r, θ),      Φ(r*, θ) = r*  ⟺  D(r*, θ) = 0,
+
+with J the solver's frozen Newton matrix (a nondifferentiable CONSTANT —
+by the IFT the J factors cancel exactly in dr*/dθ, so an approximate J
+changes only the adjoint's convergence rate, never its limit; at the
+solution ∂Φ/∂r = I − J⁻¹∂D/∂r ≈ 0, so the Neumann adjoint converges in a
+handful of iterations). D is re-expressed differentiably from the fused
+path programs (transition/path.py): one backward EGM scan + one forward
+push — both `lax.scan`s, transparent to reverse AD — with the stationary
+anchors (terminal policy, initial distribution, grids) held fixed, exactly
+as the solver holds them.
+
+θ here is the SHOCK SIZE — the impulse-response sensitivity d r_path /
+d size, the derivative sequence-space estimation consumes (ABRS 2021).
+The stationary anchors do not move with the shock size (an MIT shock is
+unanticipated and transitory: both endpoints are the SAME stationary
+equilibrium for every size), so freezing them is exact, not an
+approximation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from aiyagari_tpu.ops.implicit import fixed_point_vjp
+from aiyagari_tpu.transition.path import backward_policies, forward_capital
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+
+__all__ = ["transition_r_path_implicit"]
+
+
+def transition_r_path_implicit(size, *, primal, model, shock,
+                               adjoint_tol: float = 1e-13,
+                               adjoint_max_iter: int = 100):
+    """Differentiable [T] interest-rate path as a function of the MIT shock
+    size, anchored at a converged primal solve.
+
+    `primal` is the TransitionResult of transition/mit.solve_transition for
+    `shock` (method="newton", so primal.jacobian is populated); `size` is
+    the traced shock size — pass `size=shock.size` and differentiate with
+    jax.grad/jax.vjp. The primal path is returned BIT-IDENTICAL (identity
+    forward); the backward pass runs the Neumann adjoint of the Newton map
+    above. Gradient accuracy inherits the primal's market-clearing
+    residual: solve with a tight trans.tol when comparing against finite
+    differences (tests/test_differentiable.py).
+    """
+    if primal.jacobian is None:
+        raise ValueError(
+            "transition_r_path_implicit needs the Newton Jacobian on the "
+            "primal TransitionResult (solve with TransitionConfig"
+            "(method='newton'))")
+    sg = jax.lax.stop_gradient
+    T = int(primal.T)
+    ss = primal.ss
+    prefs = model.preferences
+    tech = model.config.technology
+    alpha, delta = float(tech.alpha), float(tech.delta)
+    labor_raw = float(model.labor_raw)
+    r_ss = float(primal.r_ss)
+
+    J = sg(jnp.asarray(primal.jacobian, jnp.float64))
+    C_term = sg(jnp.asarray(ss.solution.policy_c, jnp.float64))
+    mu0 = jnp.asarray(ss.mu, jnp.float64)
+    mu0 = sg(mu0 / jnp.sum(mu0))
+    a_grid = sg(jnp.asarray(model.a_grid, jnp.float64))
+    s = sg(jnp.asarray(model.s, jnp.float64))
+    P = sg(jnp.asarray(model.P, jnp.float64))
+
+    decay = shock.rho ** jnp.arange(T, dtype=jnp.float64)
+    key = {"tfp": "z", "borrowing_limit": "amin"}.get(shock.param,
+                                                      shock.param)
+
+    def newton_map(r_path, p):
+        bump = p["size"] * decay
+        z_path = jnp.ones(T) + (bump if key == "z" else 0.0)
+        beta_path = jnp.full(T, prefs.beta) + (bump if key == "beta" else 0.0)
+        sigma_path = jnp.full(T, prefs.sigma) + (bump if key == "sigma"
+                                                 else 0.0)
+        amin_path = jnp.full(T, model.amin) + (bump if key == "amin" else 0.0)
+        w_path = wage_from_r(r_path, alpha, delta, z_path)
+        r_ext = jnp.concatenate([r_path, jnp.array([r_ss])])
+        sig_ext = jnp.concatenate([sigma_path, jnp.array([prefs.sigma])])
+        _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
+                                    beta_path, sig_ext, amin_path,
+                                    matmul_precision="highest",
+                                    egm_kernel="xla")
+        K_ts, _, _ = forward_capital(mu0, k_ts, a_grid, P,
+                                     pushforward="transpose")
+        D = K_ts[:T] - capital_demand(r_path, labor_raw, alpha, delta,
+                                      z_path)
+        return r_path - jnp.linalg.solve(J, D)
+
+    r_star = jnp.asarray(primal.r_path, jnp.float64)
+    return fixed_point_vjp(newton_map, r_star, {"size": size},
+                           tol=adjoint_tol, max_iter=adjoint_max_iter)

@@ -3018,6 +3018,144 @@ def bench_amortized(quick: bool, grid_size: int = 40) -> dict:
     return record
 
 
+def bench_calibration(quick: bool, grid_size: int = 16) -> dict:
+    """Gradient-based calibration (ISSUE 17): planted-parameter recovery
+    through the FULL differentiable solve stack — Rouwenhorst -> EGM fixed
+    point -> stationary distribution -> GE rate, every stage an IFT
+    adjoint (ops/implicit.py) — driven by dispatch.calibrate.
+
+    Three claims, one record:
+
+      grad_fd_max_rel_err  — jax.grad of the moment-distance objective vs
+                             central finite differences, per z coordinate
+                             at the (offset) starting point: the adjoint
+                             chain's correctness evidence, in the ~1e-7
+                             band the IFT parity tests pin;
+      recovery_max_abs_err — a 2-lane Adam + BFGS fit started a few
+                             percent off the planted (beta, sigma, rho,
+                             sigma_e) must land within 1e-3 of ALL FOUR
+                             (the ISSUE 17 acceptance; measured ~1e-11);
+      wall_per_gradient    — one warm vmapped value_and_grad of the full
+                             chain (both lanes), the cost unit the fit's
+                             budget multiplies.
+
+    EVERY run (the ci preset included) freezes BENCH_r16_calibration.json;
+    tests/test_bench_ci.py gates the parity and recovery bands."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from aiyagari_tpu.calibrate.economy import steady_state_map
+    from aiyagari_tpu.calibrate.loss import moment_loss, pack, unpack
+    from aiyagari_tpu.calibrate.moments import model_moments, moments_of
+    from aiyagari_tpu.config import (
+        AiyagariConfig,
+        GridSpecConfig,
+        HouseholdPreferences,
+        IncomeProcess,
+    )
+    from aiyagari_tpu.dispatch import calibrate
+    from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+    t_start = time.perf_counter()
+    planted = {"beta": 0.95, "sigma": 4.5, "rho": 0.70, "sigma_e": 0.70}
+    start = {"beta": 0.955, "sigma": 4.8, "rho": 0.72, "sigma_e": 0.73}
+    names = ("beta", "sigma", "rho", "sigma_e")
+    n_states = 3
+    grid = GridSpecConfig(n_points=grid_size)
+    truth = AiyagariConfig(
+        preferences=HouseholdPreferences(beta=planted["beta"],
+                                         sigma=planted["sigma"]),
+        income=IncomeProcess(rho=planted["rho"], sigma_e=planted["sigma_e"],
+                             n_states=n_states, method="rouwenhorst"),
+        grid=grid)
+    base = AiyagariConfig(
+        preferences=HouseholdPreferences(beta=start["beta"],
+                                         sigma=start["sigma"]),
+        income=IncomeProcess(rho=start["rho"], sigma_e=start["sigma_e"],
+                             n_states=n_states, method="rouwenhorst"),
+        grid=grid)
+    ss_kwargs = dict(bisect_iters=45, hh_tol=1e-12, hh_max_iter=4000,
+                     dist_tol=1e-13, dist_max_iter=20_000)
+    targets = model_moments(truth, **ss_kwargs)
+
+    # --- gradient parity at the starting point ------------------------
+    model = AiyagariModel.from_config(base)
+    tech = base.technology
+
+    def objective(z):
+        th = unpack(z, names)
+        state = steady_state_map(
+            th["beta"], th["sigma"], th["rho"], th["sigma_e"],
+            model.a_grid, n_states=n_states, alpha=tech.alpha,
+            delta=tech.delta, amin=float(model.amin), **ss_kwargs)
+        return moment_loss(moments_of(state, model.a_grid,
+                                      alpha=tech.alpha), targets)
+
+    z0 = jnp.asarray(pack(start, names))
+    grad = np.asarray(jax.grad(objective)(z0))
+    h = 1e-5
+    fd = np.zeros_like(grad)
+    for i in range(z0.size):
+        e = jnp.zeros_like(z0).at[i].set(h)
+        fd[i] = float((objective(z0 + e) - objective(z0 - e)) / (2 * h))
+    denom = np.maximum(np.abs(fd), 1e-12)
+    grad_fd_max_rel_err = float(np.max(np.abs(grad - fd) / denom))
+
+    # --- warm gradient wall (the fit's cost unit, both lanes) ---------
+    vg = jax.jit(jax.vmap(jax.value_and_grad(objective)))
+    z2 = jnp.stack([z0, z0 + 0.01])
+    jax.block_until_ready(vg(z2))          # compile (shared with the fit)
+    t0 = time.perf_counter()
+    reps = 2 if quick else 4
+    for _ in range(reps):
+        jax.block_until_ready(vg(z2))
+    wall_per_gradient = (time.perf_counter() - t0) / reps
+
+    # --- planted recovery ---------------------------------------------
+    t0 = time.perf_counter()
+    res = calibrate(base, targets, names, lanes=2, steps=6, lr=0.05,
+                    seed=0, jitter=0.01, stage_dtypes=("float64",),
+                    ss_kwargs=ss_kwargs)
+    fit_wall = time.perf_counter() - t0
+    recovery = {k: float("nan") for k in names}
+    if res.theta is not None:
+        recovery = {k: abs(res.theta[k] - planted[k]) for k in names}
+    recovery_max_abs_err = float(max(recovery.values()))
+
+    record = {
+        "metric": "calibration_recovery",
+        "value": recovery_max_abs_err,
+        "unit": "max |theta_fit - theta_planted| (lower is better)",
+        "grid": grid_size,
+        "n_states": n_states,
+        "params": list(names),
+        "status": res.status,
+        "converged": res.status == "converged",
+        "loss": res.loss,
+        "steps": int(res.steps),
+        "grad_evals": int(res.grad_evals),
+        "lanes": res.lanes,
+        "recovery_abs_err": {k: (round(v, 12) if np.isfinite(v) else None)
+                             for k, v in recovery.items()},
+        "recovery_max_abs_err": recovery_max_abs_err,
+        "grad_fd_max_rel_err": grad_fd_max_rel_err,
+        "fd_step": h,
+        "wall_per_gradient_seconds": round(wall_per_gradient, 4),
+        "fit_wall_seconds": round(fit_wall, 3),
+        "targets": {k: round(float(v), 10) for k, v in targets.items()},
+        "wall_seconds": round(time.perf_counter() - t_start, 3),
+        "platform": jax.default_backend(),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r16_calibration.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+    return record
+
+
 def _run_in_child(timeout_s: float) -> int | None:
     """Re-exec this benchmark in a child process with a hard timeout and relay
     its JSON line. Returns the exit code, or None if the child timed out or
@@ -3109,7 +3247,7 @@ def main() -> int:
                              "pushforward", "egm_fused", "telemetry",
                              "resilience", "mesh2d", "attribution",
                              "observatory", "serve", "amortized",
-                             "analysis"],
+                             "calibration", "analysis"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -3277,6 +3415,8 @@ def main() -> int:
         "serve": lambda: bench_serve(args.quick, min(args.grid, 40)),
         "amortized": lambda: bench_amortized(args.quick,
                                              min(args.grid, 40)),
+        "calibration": lambda: bench_calibration(args.quick,
+                                                 min(args.grid, 16)),
         "analysis": lambda: bench_analysis(),
     }
     # 'all' runs the full claimed surface in this one device session (vfi
@@ -3294,14 +3434,14 @@ def main() -> int:
         names = (("vfi", "scale", "ge", "sweep", "transition", "accel",
                   "precision", "pushforward", "egm_fused", "telemetry",
                   "resilience", "mesh2d", "attribution", "observatory",
-                  "serve", "amortized", "analysis")
+                  "serve", "amortized", "calibration", "analysis")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
                  "transition", "accel", "precision", "pushforward",
                  "egm_fused", "telemetry", "resilience", "mesh2d",
                  "attribution", "observatory", "serve", "amortized",
-                 "ks_fine", "scale_vfi")
+                 "calibration", "ks_fine", "scale_vfi")
     else:
         names = (args.metric,)
     led = None

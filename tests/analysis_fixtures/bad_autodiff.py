@@ -1,0 +1,36 @@
+"""Adversarial source file for AIYA205 (tests/test_static_analysis.py).
+
+Every call below aims an autodiff operator straight at an unrolled
+while_loop solver fixed point — the exact mistake the IFT wrappers
+(ops/implicit.py, ISSUE 17) exist to prevent — and each must trip exactly
+ift-differentiation-discipline (no cross-fire from the other source
+rules: nothing here imports jax.sharding, fetches a host scalar, or
+debug-prints). The file is only ever READ by the lint, never imported.
+"""
+
+import jax
+from jax import grad, value_and_grad  # noqa: F401  (fixture imports)
+
+from aiyagari_tpu.sim.distribution import stationary_distribution  # noqa: F401
+from aiyagari_tpu.solvers.egm import solve_aiyagari_egm  # noqa: F401
+from aiyagari_tpu.transition.mit import solve_transition  # noqa: F401
+
+
+def bad_attribute_form(args):
+    # AIYA205: jax.grad of the raw EGM sweep's while_loop.
+    return jax.grad(solve_aiyagari_egm)(*args)
+
+
+def bad_bare_name_form(args):
+    # AIYA205: bare `grad` from `from jax import grad`.
+    return grad(stationary_distribution)(*args)
+
+
+def bad_vag_form(args):
+    # AIYA205: value_and_grad with extra kwargs still names the solver.
+    return jax.value_and_grad(solve_transition, argnums=1)(*args)
+
+
+def sanctioned_wrapper_form(solver_implicit, args):
+    # NOT flagged: the implicit wrappers are the sanctioned door.
+    return jax.grad(solver_implicit)(*args)

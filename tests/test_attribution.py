@@ -127,7 +127,9 @@ class TestObservability:
             rep = run_attribution(families=("distribution",))
         events = [e for e in read_ledger(led.path)
                   if e["kind"] == "attribution"]
-        assert len(events) == len(rep.records) == 4
+        # 4 push-forward routes + the ISSUE 17 distribution/adjoint
+        # backward-pass program.
+        assert len(events) == len(rep.records) == 5
         for ev in events:
             assert ev["compiled"]["bytes_accessed"] > 0
             assert ev["flagged"] is False

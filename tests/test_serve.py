@@ -475,6 +475,31 @@ class TestSurrogate:
         assert pred is not None and np.isfinite(pred.r)
         assert sur.fits == 1 and sur.predictions == 1
 
+    def test_refit_without_policies_drops_stale_policy_head(self):
+        """A head refitted from a policy-free stream (ledger replay, or a
+        calibration that shifted the parameter range) must NOT keep its
+        old policy basis: mean/std and every weight move atomically, and
+        a component not refitted this round is dropped rather than
+        applied to the new standardization."""
+        sur = PolicySurrogate(min_samples=4, fit_every=1, policy_rank=2,
+                              max_samples=8)
+        key = ("s",)
+        rng = np.random.default_rng(1)
+        pol = lambda: rng.normal(size=(2, 5))  # noqa: E731
+        for i in range(4):
+            sur.observe(key, rng.normal(size=7), 0.01 + 1e-3 * i,
+                        policy=pol())
+        pred = sur.predict(key, np.zeros(7))
+        assert pred is not None and pred.policy is not None
+        # A calibration-driven range shift: new observations far from the
+        # old cloud, none carrying policies; the rolling window evicts the
+        # policy-bearing samples entirely.
+        for i in range(8):
+            sur.observe(key, 50.0 + rng.normal(size=7), 0.02 + 1e-3 * i)
+        pred = sur.predict(key, np.full(7, 50.0))
+        assert pred is not None and np.isfinite(pred.r)
+        assert pred.policy is None
+
     def test_unfit_surrogate_serves_cold_not_warm(self):
         """The service consults the surrogate on every cache miss, but an
         unfit head predicts None and the request MUST report cold — the
@@ -636,6 +661,140 @@ class TestHttpHardening:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+class TestServeCalibrate:
+    """POST /calibrate (ISSUE 17): gradient calibration behind the same
+    hardened HTTP front, feeding converged fits back through the normal
+    serve path. Runs at the standardized calibration shape (grid 16,
+    3 income states, the ci bench's steady-state knobs) so the vmapped
+    gradient program compiles once across the suite."""
+
+    BASE = AiyagariConfig(
+        grid=GridSpecConfig(n_points=16),
+        income=dataclasses.replace(
+            AiyagariConfig().income, rho=0.75, sigma_e=0.75, n_states=3,
+            method="rouwenhorst"))
+    SS = dict(bisect_iters=45, hh_tol=1e-12, hh_max_iter=4000,
+              dist_tol=1e-13, dist_max_iter=20_000)
+
+    @staticmethod
+    def _serve(svc, base, **kw):
+        from aiyagari_tpu.serve.service import _http_server
+
+        httpd = _http_server(svc, base, 0, **kw)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, httpd.server_address[1]
+
+    @staticmethod
+    def _post(port, path, payload, *, token=None, timeout=600):
+        import json
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(), method="POST")
+        if token is not None:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def test_calibrate_auth_and_validation(self):
+        svc = SolveService(svc_config(max_batch=1))
+        httpd, port = self._serve(svc, self.BASE, auth_token="sekrit")
+        try:
+            # The same Bearer gate as /solve.
+            assert self._post(port, "/calibrate", {})[0] == 401
+            code, body = self._post(port, "/calibrate", {}, token="sekrit")
+            assert code == 400 and "targets" in body["error"]
+            code, body = self._post(
+                port, "/calibrate",
+                {"targets": {"gini": 0.38}, "fit": {"bogus": 1}},
+                token="sekrit")
+            assert code == 400 and "bogus" in body["error"]
+            code, body = self._post(
+                port, "/calibrate", {"targets": {"not_a_moment": 1.0}},
+                token="sekrit")
+            assert code == 400 and "moment" in body["error"]
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_calibrate_end_to_end_feeds_serve_path(self, tmp_path):
+        from aiyagari_tpu.calibrate.moments import model_moments
+        from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+        targets = model_moments(self.BASE, **self.SS)
+        led = tmp_path / "cal.jsonl"
+        with SolveService(svc_config(max_batch=1), ledger=led) as svc:
+            httpd, port = self._serve(svc, self.BASE, auth_token="sekrit")
+            try:
+                code, out = self._post(
+                    port, "/calibrate",
+                    {"targets": targets, "ss": self.SS,
+                     "fit": {"lanes": 2, "steps": 2, "jitter": 1e-4,
+                             "polish": False}},
+                    token="sekrit")
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+            assert code == 200
+            # The fit starts lane 0 AT the parameters that generated the
+            # targets, so it converges on its first objective read...
+            assert out["status"] == "converged" and out["converged"]
+            assert out["income_method"] == "rouwenhorst"
+            prefs, inc = self.BASE.preferences, self.BASE.income
+            assert abs(out["theta"]["beta"] - prefs.beta) < 1e-6
+            assert abs(out["theta"]["sigma"] - prefs.sigma) < 1e-6
+            assert abs(out["theta"]["rho"] - inc.rho) < 1e-6
+            assert abs(out["theta"]["sigma_e"] - inc.sigma_e) < 1e-6
+            for k, v in targets.items():
+                assert abs(out["moments"][k] - v) <= 1e-6 * max(abs(v), 1.0)
+            # ...and the fitted economy went through the NORMAL serve
+            # path: solved, cached, counted. On a 16-point grid the
+            # supply curve is a step function of r, so the GE solver's
+            # strict K-gap tolerance may report max_iter — the contract
+            # here is the ROUTE (solve + cache entry), not GE tightness.
+            fs = out["fit_solve"]
+            assert fs["status"] in ("converged", "max_iter")
+            assert fs["cache"] in ("cold", "warm", "hit")
+            assert np.isfinite(fs["r"])
+            assert out["wall_s"] > 0
+        # The flight record: the unconditional step-0 marker plus one
+        # calibration_step per Adam step, all before the fit verdict.
+        steps = [e for e in read_ledger(led)
+                 if e["kind"] == "calibration_step"]
+        assert [e["step"] for e in steps][:2] == [0, 1]
+        assert steps[0]["lanes"] == 2
+        # The scrape surface gained the calibration series.
+        text = svc.metrics_text()
+        assert "aiyagari_calibration_last_loss" in text
+        assert 'kind="calibration"' in text
+
+    def test_calibrate_stalled_fit_withholds_theta(self, tmp_path):
+        # Targets no Aiyagari economy on this grid attains: one gradient
+        # step cannot reach them, and a fit that cannot certify its
+        # parameters must not serve them.
+        led = tmp_path / "stall.jsonl"
+        with SolveService(svc_config(max_batch=1), ledger=led) as svc:
+            httpd, port = self._serve(svc, self.BASE, auth_token="sekrit")
+            try:
+                code, out = self._post(
+                    port, "/calibrate",
+                    {"targets": {"gini": 0.95, "k_y": 20.0},
+                     "ss": self.SS,
+                     "fit": {"lanes": 2, "steps": 1, "polish": False}},
+                    token="sekrit")
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+            assert code == 200
+            assert out["status"] == "max_iter" and not out["converged"]
+            assert "theta" not in out and "moments" not in out
+            assert "fit_solve" not in out
+            assert out["loss"] > 0
 
 
 class TestRunRamp:

@@ -276,6 +276,29 @@ class TestLintFixtures:
             findings = lint_file(root / rel, rel)
             assert not [f for f in findings if f.rule.id == "AIYA204"], rel
 
+    def test_bad_autodiff_trips_exactly_ift_discipline(self):
+        """ISSUE 17 satellite: jax.grad / bare grad / value_and_grad aimed
+        straight at an unrolled solver fixed point trip exactly AIYA205 —
+        and the sanctioned `jax.grad(<implicit wrapper>)` form does not."""
+        findings = lint_file(FIXTURES / "bad_autodiff.py", "bad_autodiff.py",
+                             hot=False, mesh_exempt=False)
+        assert [f.rule.id for f in findings] == ["AIYA205"] * 3
+        assert all(f.rule.name == "ift-differentiation-discipline"
+                   for f in findings)
+        named = "".join(f.message for f in findings)
+        for solver in ("solve_aiyagari_egm", "stationary_distribution",
+                       "solve_transition"):
+            assert solver in named
+
+    def test_ift_discipline_exempts_implicit_module(self):
+        """ops/implicit.py IS the door: the custom_vjp rules inside may
+        reference whatever autodiff machinery they need."""
+        import aiyagari_tpu
+
+        root = Path(aiyagari_tpu.__file__).resolve().parent
+        findings = lint_file(root / "ops/implicit.py", "ops/implicit.py")
+        assert not [f for f in findings if f.rule.id == "AIYA205"]
+
     def test_mesh_shim_catches_parent_module_import_forms(self, tmp_path):
         """`from jax import sharding` / `from jax.experimental import
         shard_map` bind the forbidden module under a local name — the
