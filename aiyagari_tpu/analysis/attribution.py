@@ -131,6 +131,13 @@ def _model_prices() -> Dict[str, Tuple[Optional[Callable], Optional[float]]]:
             lambda: distribution_sweep_cost(_NZ, _NA, 8, route="transpose"),
             DEFAULT_FLAG_RATIO),
         "equilibrium/ge_round_batched": (None, None),
+        # Fused one-program GE (equilibrium/fused.py): the whole outer loop
+        # in one trace — rounds-per-solve is data-dependent, so a per-call
+        # price would have to guess the iteration count. roofline.ge_fused
+        # _cost prices one ROUND for the bench; joined here, never flagged.
+        "equilibrium/ge_fused": (None, None),
+        "equilibrium/ge_fused_sentinel": (None, None),
+        "equilibrium/ge_fused_batched": (None, None),
         "transition/round": (None, None),
         "ks/distribution_step": (None, None),
     }

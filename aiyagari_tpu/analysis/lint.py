@@ -65,7 +65,12 @@ _PARALLEL_DIR = "parallel/"
 # Hot-module scope of AIYA202: the directories whose code runs per sweep
 # or per solve. numpy_backend.py is the HOST reference implementation
 # (plain numpy end to end) — float() there is arithmetic, not a sync.
-_HOT_DIRS = ("solvers/", "ops/", "sim/", "transition/")
+# equilibrium/ joined the scope with the fused device loop (ISSUE 18):
+# its outer rounds are now in-program, so a host scalar pull there is a
+# per-round sync exactly like one in a solver sweep; the host-loop
+# reference paths carry documented per-line noqa where they fetch their
+# bracket scalars by design.
+_HOT_DIRS = ("solvers/", "ops/", "sim/", "transition/", "equilibrium/")
 _HOT_EXEMPT = ("solvers/numpy_backend.py",)
 
 _NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z]{4}\d{3}(?:\s*,\s*[A-Z]{4}\d{3})*)")
@@ -93,6 +98,10 @@ _UNROLLED_SOLVER_ENTRYPOINTS = frozenset({
     "solve_aiyagari_egm", "solve_aiyagari_egm_labor", "solve_aiyagari_vfi",
     "stationary_distribution", "solve_equilibrium",
     "solve_equilibrium_distribution", "solve_transition",
+    # The fused one-program loops (ISSUE 18): the whole GE while_loop in
+    # one trace — differentiating them unrolls EVERY outer round.
+    "solve_equilibrium_fused", "solve_equilibrium_fused_batched",
+    "fused_ge_program", "fused_ge_batched_program",
 })
 _AUTODIFF_OPERATORS = frozenset({
     "grad", "value_and_grad", "vjp", "jvp", "jacfwd", "jacrev", "hessian",

@@ -267,6 +267,40 @@ def _build_ge_round():
     return fn, (np.array([0.02, 0.03]),)
 
 
+def _build_ge_fused(telemetry=None, sentinel=None, batched=False):
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+    from aiyagari_tpu.equilibrium.fused import (
+        fused_ge_batched_operands,
+        fused_ge_batched_program,
+        fused_ge_operands,
+        fused_ge_program,
+    )
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+    model = aiyagari_preset(grid_size=_NA, dtype=jnp.float64)
+    # Push-forward pinned to the scatter-free transpose form (same
+    # rationale as _build_ge_round). donate=False: the audit executes the
+    # paired telemetry on/off traces of ONE builder output repeatedly, and
+    # donated operands would be deleted after the first call.
+    solver = SolverConfig(method="egm", tol=1e-6, max_iter=50,
+                          pushforward="transpose", telemetry=telemetry,
+                          sentinel=sentinel)
+    eq = EquilibriumConfig(max_iter=8, tol=1e-6,
+                           batch=2 if batched else 1)
+    if batched:
+        fn = fused_ge_batched_program(model, solver=solver, eq=eq,
+                                      dist_tol=1e-8, dist_max_iter=200,
+                                      donate=False)
+        args = fused_ge_batched_operands(model, eq, solver=solver)
+    else:
+        fn = fused_ge_program(model, solver=solver, eq=eq, dist_tol=1e-8,
+                              dist_max_iter=200, donate=False)
+        args = fused_ge_operands(model, eq, solver=solver)
+    return fn, args
+
+
 def _build_transition_round():
     from aiyagari_tpu.transition.path import transition_path_aggregates
 
@@ -465,6 +499,30 @@ def _build_registry() -> List[ProgramSpec]:
         ProgramSpec(
             name="equilibrium/ge_round_batched", family="equilibrium",
             build_off=_build_ge_round,
+            scatter_free=True, stage_dtype="float64"),
+        # The one-program equilibrium (ISSUE 18 tentpole): the WHOLE GE
+        # closure — household fixed point, stationary distribution, market
+        # clearing, bracket update — inside one lax.while_loop. AIYA107
+        # certifies the outer cond NaN-exits (the gap carry starts +inf,
+        # so |NaN| >= tol is concretely False); AIYA101 that the bracket/
+        # history carries stay scatter-free (one-hot selects, not .at[]);
+        # AIYA104 that the telemetry ring is compiled out of the OFF
+        # trace. The sentinel variant audits the verdict-ANDed cond, like
+        # egm/sweep_sentinel. The batched entry wraps the vmapped
+        # candidate round + quarantine mask in the same loop.
+        ProgramSpec(
+            name="equilibrium/ge_fused", family="equilibrium",
+            build_off=partial(_build_ge_fused),
+            build_on=lambda: _build_ge_fused(telemetry=tele()),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="equilibrium/ge_fused_sentinel", family="equilibrium",
+            build_off=lambda: _build_ge_fused(sentinel=_sentinel_cfg())),
+        ProgramSpec(
+            name="equilibrium/ge_fused_batched", family="equilibrium",
+            build_off=lambda: _build_ge_fused(batched=True),
+            build_on=lambda: _build_ge_fused(telemetry=tele(),
+                                             batched=True),
             scatter_free=True, stage_dtype="float64"),
         ProgramSpec(
             name="transition/round", family="transition",

@@ -438,6 +438,28 @@ class SolverConfig:
                                       # deterministic fault injection
                                       # (FaultPlan docstring) — CI/test
                                       # harness only, never production
+    ge_loop: str = "host"             # GE outer-loop placement
+                                      # (equilibrium/fused.py): "host" runs
+                                      # the reference Python bisection loop
+                                      # (one compiled program per round,
+                                      # host scalars between rounds — the
+                                      # parity baseline), "device" fuses
+                                      # the WHOLE equilibrium (household
+                                      # fixed point + stationary
+                                      # distribution + market clearing +
+                                      # bracket update) into one XLA
+                                      # program with the outer loop in a
+                                      # lax.while_loop carry, "auto" picks
+                                      # "device" where the fused program is
+                                      # supported (distribution
+                                      # aggregation, jax backend, no mesh)
+                                      # and falls back to "host" elsewhere
+
+    def __post_init__(self):
+        if self.ge_loop not in ("host", "device", "auto"):
+            raise ValueError(
+                f"SolverConfig.ge_loop must be 'host', 'device' or 'auto', "
+                f"got {self.ge_loop!r}")
 
 
 @dataclasses.dataclass(frozen=True)

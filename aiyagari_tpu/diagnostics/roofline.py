@@ -43,6 +43,7 @@ __all__ = [
     "vfi_slab_cost",
     "egm_sweep_cost",
     "egm_fused_sweep_cost",
+    "ge_fused_round_cost",
     "panel_step_cost",
     "utilization",
 ]
@@ -293,6 +294,32 @@ def distribution_sweep_cost(N: int, na: int, itemsize: int = 8,
     else:
         raise ValueError(f"unknown pushforward route {route!r}")
     return KernelCost(mxu, vpu, bytes_)
+
+
+def ge_fused_round_cost(N: int, na: int, itemsize: int = 8, *,
+                        policy_sweeps: int = 1, dist_sweeps: int = 1,
+                        route: str = "transpose",
+                        batch: int = 1) -> KernelCost:
+    """One OUTER round of the fused one-program GE loop
+    (equilibrium/fused.py): `policy_sweeps` EGM sweeps at the round's
+    prices, `dist_sweeps` push-forward sweeps to the stationary
+    distribution, and the market-clearing tail — aggregation reductions
+    over the [N, na] distribution/policy pair plus the O(1) price update
+    and bracket arithmetic, counted as ~4 ops and 3 streamed arrays per
+    cell. `batch` scales every term for the vmapped candidate round
+    (fused_ge_batched_program), where B candidate rates run the same
+    round in lockstep.
+
+    Rounds-per-solve is data-dependent (the bisection/candidate loop exits
+    on a traced predicate), so this prices one ROUND; the bench multiplies
+    by the measured round count — attribution joins the fused programs
+    unpriced for exactly that reason (attribution._model_prices)."""
+    per_lane = (policy_sweeps * egm_sweep_cost(N, na, itemsize)
+                + dist_sweeps * distribution_sweep_cost(N, na, itemsize,
+                                                        route=route)
+                + KernelCost(0.0, 4.0 * N * na,
+                             itemsize * 3.0 * N * na))
+    return max(batch, 1) * per_lane
 
 
 def mesh2d_collective_cost(S: int, N: int, na: int, *, scenarios: int,

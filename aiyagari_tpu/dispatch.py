@@ -19,6 +19,7 @@ import itertools
 from typing import Optional, Sequence, Union
 
 import jax.numpy as jnp
+import numpy as np
 
 from aiyagari_tpu.diagnostics.errors import enforce_convergence
 
@@ -139,6 +140,13 @@ def _with_ladder(solver: Optional[SolverConfig], method: str,
     return solver
 
 
+# Route-resolution memo: (pf_in, ek_in, na, dtype, egm, batched,
+# tuning-active, tuning-cache stamp) -> (pf, ek, captured decisions).
+# Process-lifetime by design — entries are invalidated by the stamp
+# moving, and the key space is tiny (route knobs x geometries).
+_route_memo: dict = {}
+
+
 def _resolve_routes(solver: Optional[SolverConfig], *,
                     na: Optional[int] = None, dtype=None,
                     egm: bool = True,
@@ -181,22 +189,48 @@ def _resolve_routes(solver: Optional[SolverConfig], *,
     the SolverConfig here: the deep resolver applies the identical
     context-aware default, and threading a batched-only route into a
     config that may also drive serial re-solves (quarantine rescue)
-    would pin the wrong route there."""
-    from aiyagari_tpu.ops.egm import resolve_egm_kernel
-    from aiyagari_tpu.ops.interp import searchsorted_method
-    from aiyagari_tpu.ops.pushforward import resolve_backend
-    from aiyagari_tpu.tuning.autotuner import tuning_active
+    would pin the wrong route there.
+
+    Resolutions are MEMOIZED per (route-relevant config fingerprint,
+    tuning-cache stamp): repeated serve requests hitting the same
+    geometry stop re-reading the tuning cache and re-walking the
+    resolver chain on every call (ISSUE 18 satellite). A memo hit
+    REPLAYS the captured decisions through the autotuner's recorder, so
+    each activation scope still carries exactly one route_decision event
+    per knob; a probe run that rewrites the cache moves the stamp and
+    invalidates the memo."""
+    from aiyagari_tpu.tuning.autotuner import (
+        capture_decisions,
+        replay_decisions,
+        tuning_active,
+        tuning_cache_stamp,
+    )
 
     pf_in = solver.pushforward if solver is not None else "auto"
     ek_in = solver.egm_kernel if solver is not None else "auto"
-    pf = resolve_backend(pf_in, na=na, dtype=dtype, batched=batched)
-    ek = resolve_egm_kernel(ek_in, na=na, dtype=dtype) if egm else ek_in
-    # The searchsorted split has no SolverConfig knob but every
-    # push-forward plan build exercises it (_segment_bounds): resolving
-    # it here records the run's decision even when jit caching skips the
-    # trace-time resolver.
-    searchsorted_method(na)
-    if (solver is not None and tuning_active() and not batched
+    active = tuning_active()
+    key = (pf_in, ek_in, na, None if dtype is None else str(np.dtype(dtype)),
+           egm, batched, active, tuning_cache_stamp() if active else None)
+    hit = _route_memo.get(key)
+    if hit is not None:
+        pf, ek, decisions = hit
+        replay_decisions(decisions)
+    else:
+        from aiyagari_tpu.ops.egm import resolve_egm_kernel
+        from aiyagari_tpu.ops.interp import searchsorted_method
+        from aiyagari_tpu.ops.pushforward import resolve_backend
+
+        with capture_decisions() as decisions:
+            pf = resolve_backend(pf_in, na=na, dtype=dtype, batched=batched)
+            ek = (resolve_egm_kernel(ek_in, na=na, dtype=dtype)
+                  if egm else ek_in)
+            # The searchsorted split has no SolverConfig knob but every
+            # push-forward plan build exercises it (_segment_bounds):
+            # resolving it here records the run's decision even when jit
+            # caching skips the trace-time resolver.
+            searchsorted_method(na)
+        _route_memo[key] = (pf, ek, tuple(decisions))
+    if (solver is not None and active and not batched
             and (pf, ek) != (pf_in, ek_in)):
         solver = dataclasses.replace(solver, pushforward=pf, egm_kernel=ek)
     return solver
@@ -515,6 +549,17 @@ def solve(
 
                         require_x64(solver.ladder)
                     m = AiyagariModel.from_config(model, dtype=_dtype_of(backend))
+                    # One-program equilibrium (equilibrium/fused.py): the
+                    # ge_loop knob decides whether the GE outer loop runs
+                    # as the host reference loop or fused on device inside
+                    # one lax.while_loop program. "auto" falls back to
+                    # host wherever the fused program does not exist;
+                    # explicit "device" on an unsupported combo is loud.
+                    from aiyagari_tpu.equilibrium.fused import resolve_ge_loop
+
+                    ge_loop = resolve_ge_loop(
+                        solver, aggregation=aggregation,
+                        endogenous_labor=model.endogenous_labor, mesh=mesh)
                     if equilibrium.batch >= 2:
                         # Opt-in batched GE (equilibrium/batched.py): B candidate
                         # rates per device round through one vmapped excess-demand
@@ -527,17 +572,34 @@ def solve(
                                 "EquilibriumConfig.batch >= 2 cannot be combined "
                                 "with a grid-axis device mesh; drop 'grid' from "
                                 "BackendConfig.mesh_axes or use the serial path")
-                        from aiyagari_tpu.equilibrium.batched import (
-                            solve_equilibrium_batched,
-                        )
+                        if ge_loop == "device":
+                            from aiyagari_tpu.equilibrium.fused import (
+                                solve_equilibrium_fused_batched,
+                            )
 
-                        result = solve_equilibrium_batched(
-                            m, solver=solver, eq=equilibrium, sim=sim,
-                            aggregation=aggregation)
+                            result = solve_equilibrium_fused_batched(
+                                m, solver=solver, eq=equilibrium)
+                        else:
+                            from aiyagari_tpu.equilibrium.batched import (
+                                solve_equilibrium_batched,
+                            )
+
+                            result = solve_equilibrium_batched(
+                                m, solver=solver, eq=equilibrium, sim=sim,
+                                aggregation=aggregation)
                     elif aggregation == "distribution":
-                        result = solve_equilibrium_distribution(
-                            m, solver=solver, eq=equilibrium, mesh=mesh,
-                            warm_start=warm_start)
+                        if ge_loop == "device":
+                            from aiyagari_tpu.equilibrium.fused import (
+                                solve_equilibrium_fused,
+                            )
+
+                            result = solve_equilibrium_fused(
+                                m, solver=solver, eq=equilibrium,
+                                warm_start=warm_start)
+                        else:
+                            result = solve_equilibrium_distribution(
+                                m, solver=solver, eq=equilibrium, mesh=mesh,
+                                warm_start=warm_start)
                     else:
                         result = solve_equilibrium(
                             m, solver=solver, sim=sim, eq=equilibrium,

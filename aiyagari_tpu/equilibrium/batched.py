@@ -279,8 +279,14 @@ def _ge_round_program(method: str, labor: bool, aggregation: str,
             # the batch width so the vmapped call is well-formed — the
             # operand is unread there and XLA drops it.
             mu = jnp.broadcast_to(mu, (B,) + mu.shape[1:])
-        return batched(warm, mu, r_new, keys, a_grid, s, P, labor_grid,
-                       sigma, beta, psi, eta, amin, labor_raw)
+        out = batched(warm, mu, r_new, keys, a_grid, s, P, labor_grid,
+                      sigma, beta, psi, eta, amin, labor_raw)
+        # One stacked [3, B] host record per round: the driver loop fetches
+        # gap/supply/demand as a single device_get instead of three scalar
+        # streams (ISSUE 18 satellite — the per-round host sync is the
+        # batched loop's only remaining host cost).
+        out["record"] = jnp.stack((out["gap"], out["supply"], out["demand"]))
+        return out
 
     return jax.jit(round_fn)
 
@@ -318,7 +324,7 @@ def excess_demand_batch(model: AiyagariModel, r_batch, *,
     """
     if aggregation not in ("distribution", "simulation"):
         raise ValueError(f"unknown aggregation {aggregation!r}")
-    B = int(np.shape(r_batch)[0])
+    B = np.shape(r_batch)[0]
     knobs = _model_knobs(model, solver, dist_tol, dist_max_iter, sim)
     cold = warm is None
     if not cold and r_warm is None:
@@ -410,6 +416,7 @@ def solve_equilibrium_batched(
     verdict = ""
     best = 0
     r_cand = np.array([0.5 * (lo + hi)])
+    r_list = r_cand.tolist()
     rounds = 0
     for rnd in range(eq.max_iter):
         it_t0 = time.perf_counter()
@@ -420,30 +427,36 @@ def solve_equilibrium_batched(
                                False, rnd == 0)
         out = fn(r_dev, r_prev if r_prev is not None else r_dev,
                  warm_prev, mu_prev, keys, *ops)
-        gaps, supplies, demands, sol_iters = jax.device_get(
-            (out["gap"], out["supply"], out["demand"],
-             out["solver_iterations"]))
-        gaps = np.asarray(gaps, np.float64)
+        # ONE host sync per round: the stacked [3, B] record + the solver
+        # iteration counts come back in a single device_get, and the bulk
+        # .tolist() conversions replace the old per-element float() loops
+        # (ISSUE 18 satellite).
+        record, sol_iters = jax.device_get(
+            (out["record"], out["solver_iterations"]))
+        record = np.asarray(record, np.float64)
+        gaps = record[0]
+        gaps_l, ks_l, kd_l = (row.tolist() for row in record)
+        r_list = np.asarray(r_cand, np.float64).tolist()
         rounds = rnd + 1
-        r_hist.extend(float(r) for r in r_cand)
-        ks_hist.extend(float(x) for x in supplies)
-        kd_hist.extend(float(x) for x in demands)
+        r_hist.extend(r_list)
+        ks_hist.extend(ks_l)
+        kd_hist.extend(kd_l)
         finite = np.where(np.isfinite(gaps), np.abs(gaps), np.inf)
         best = int(np.argmin(finite))
         rec = {
             "round": rnd,
-            "r_candidates": [float(r) for r in r_cand],
-            "gaps": [float(g) for g in gaps],
+            "r_candidates": r_list,
+            "gaps": gaps_l,
             "bracket": (lo, hi),
-            "best_r": float(r_cand[best]),
-            "best_gap": float(gaps[best]),
+            "best_r": r_list[best],
+            "best_gap": gaps_l[best],
             "solver_iterations_max": int(np.max(sol_iters)),
             "seconds": time.perf_counter() - it_t0,
         }
         records.append(rec)
         if on_iteration is not None:
             on_iteration(rec)
-        if np.isfinite(gaps[best]) and abs(gaps[best]) < eq.tol:
+        if np.isfinite(gaps_l[best]) and abs(gaps_l[best]) < eq.tol:
             converged = True
             break
         # Host-side failure sentinel on the per-round best-gap trajectory
@@ -463,10 +476,10 @@ def solve_equilibrium_batched(
         neg = gaps < 0.0
         if neg.any():
             i_star = int(np.max(np.nonzero(neg)[0]))
-            new_lo = float(r_cand[i_star])
-            new_hi = float(r_cand[i_star + 1]) if i_star + 1 < B else hi
+            new_lo = r_list[i_star]
+            new_hi = r_list[i_star + 1] if i_star + 1 < B else hi
         else:
-            new_lo, new_hi = lo, float(r_cand[0])
+            new_lo, new_hi = lo, r_list[0]
         lo, hi = new_lo, new_hi
         r_prev, warm_prev = r_dev, out["warm"]
         if "mu" in out:
@@ -476,13 +489,13 @@ def solve_equilibrium_batched(
     sol_best = take(out["sol"])
     series_best = take(out["series"]) if "series" in out else None
     mu_best = out["mu"][best] if "mu" in out else None
-    r_star = float(r_cand[best])
+    r_star = r_list[best]
     from aiyagari_tpu.diagnostics.telemetry import host_telemetry
 
     return EquilibriumResult(
         r=r_star,
         w=float(wage_from_r(r_star, tech.alpha, tech.delta)),
-        capital=float(supplies[best]),
+        capital=ks_l[best],
         solution=sol_best,
         series=series_best,
         r_history=r_hist,

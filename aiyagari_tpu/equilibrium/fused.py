@@ -1,0 +1,749 @@
+"""One-program equilibrium: the WHOLE GE closure as a single XLA program.
+
+The host bisection (equilibrium/bisection.py) and the host parallel-bracket
+loop (equilibrium/batched.py) pay one jit dispatch plus one host sync per
+outer round: every midpoint fetches `float(...)` scalars, decides the
+bracket on host, and re-enters the device. Once the per-sweep kernels are
+fast, that dispatch/sync overhead IS the GE wall — the same
+dispatch-overhead ceiling the serve layer hammers hardest.
+
+This module moves the outer loop into the program: household fixed point
+(EGM or VFI) + Young stationary distribution + market clearing + bracket
+update all live inside ONE `lax.while_loop` carry, so an entire equilibrium
+is one device program launch. Two shapes:
+
+  * solve_equilibrium_fused — serial bisection in the carry. Each loop
+    round solves the household at the bracket midpoint (warm-started from
+    the carry), pushes the distribution forward from the carried mu, and
+    shrinks [lo, hi] with `jnp.where` on the gap sign — the exact update
+    `_bisect` performs on host (`if supply > demand: r_high = r_mid`).
+    calibrate/economy.steady_state_map proved this composition; here it
+    becomes the production path with histories, telemetry, sentinel, and
+    the bisection's adaptive stopping rule (a while_loop on |gap| >= tol,
+    not a fixed trip count).
+
+  * solve_equilibrium_fused_batched — the parallel-bracket round of
+    equilibrium/batched.py, fused: candidate placement, the vmapped
+    excess-demand evaluation, nearest-candidate warm selection, the
+    per-round quarantine mask, and the sign-change bracket shrink all run
+    inside the while_loop. The host sees one program for the whole solve
+    instead of one per round.
+
+Contracts threaded through the fusion (ISSUE 18):
+
+  * precision ladder / Anderson-SQUAREM accel — passed to the inner
+    solves unchanged; their stage switches and mixing carries live inside
+    the inner while_loops exactly as on the host paths.
+  * telemetry rings — the OUTER loop carries its own SolveTelemetry ring
+    recording the per-round market-clearing |gap| (the device twin of the
+    host loop's host_telemetry), beside the inner solves' own rings.
+  * sentinel verdicts — an outer SentinelState watches the gap trajectory
+    and early-exits the while_loop on nan/stall/explosion via
+    sentinel_cond, the device twin of the host loop's host_verdict check.
+  * quarantine masks — the batched round's non-finite lanes are masked out
+    of best-candidate selection and reported per round; an ALL-lane-nan
+    round exits the loop (the host loop would burn its remaining rounds —
+    the fused loop's nan-exit is required by AIYA107 and strictly better).
+
+Buffer donation: the [N, na] warm policy/value state and the [N, na]
+(or [B, N, na]) distribution iterate dominate the program's argument
+bytes, and the caller never reuses them after the solve — `donate=True`
+(the solve_* default) marks them `donate_argnums` so XLA reuses their
+buffers for outputs/temps instead of holding both generations live.
+A caller-owned warm start (the serve cache's arrays) is defensively
+copied before donation so the cache entry survives.
+
+Host-vs-device placement is the SolverConfig.ge_loop knob, routed by
+dispatch.solve(); the host loops stay bit-identical as the parity
+reference (tests/test_fused_ge.py pins tolerance parity).
+
+Known (documented) deviations from the host reference, all below the
+bisection's sign-decision noise floor:
+  * EGM runs grid_power=0.0 (exact inversion): the windowed fast path's
+    escape contract needs a HOST retry (solve_aiyagari_egm_safe), which a
+    fused program cannot perform mid-loop — the batched closure's pin.
+  * The distribution warm start enters through mu_init (renormalized)
+    where the host's first round passes None (exact uniform); identical
+    to ~1 ulp after the first round's contraction.
+  * No multiscale grid sequencing (solve_aiyagari_egm_multiscale is a
+    host-staged chain); large cold grids should keep ge_loop="host".
+"""
+
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+from aiyagari_tpu.diagnostics.sentinel import (
+    sentinel_cond,
+    sentinel_init,
+    sentinel_update,
+    verdict_name,
+)
+from aiyagari_tpu.diagnostics.telemetry import (
+    host_telemetry,
+    telemetry_init,
+    telemetry_record,
+)
+from aiyagari_tpu.equilibrium.bisection import EquilibriumResult
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.utils.firm import capital_demand, wage_from_r
+
+__all__ = [
+    "resolve_ge_loop",
+    "fused_knobs",
+    "fused_ge_program",
+    "fused_ge_operands",
+    "solve_equilibrium_fused",
+    "fused_ge_batched_program",
+    "fused_ge_batched_operands",
+    "solve_equilibrium_fused_batched",
+    "fused_batched_round",
+]
+
+# warm/mu positions in the fused program signatures — the donated slots.
+_DONATE_SERIAL = (3, 4)     # (lo, hi, r_init, WARM, MU, ...model operands)
+_DONATE_BATCHED = (2, 3)    # (lo, hi, WARM, MU, ...model operands)
+
+
+def resolve_ge_loop(solver: SolverConfig, *, aggregation: str,
+                    endogenous_labor: bool, mesh=None) -> str:
+    """Resolve SolverConfig.ge_loop to a concrete placement.
+
+    "auto" picks "device" exactly where the fused program exists —
+    distribution aggregation, exogenous labor, no device mesh — and falls
+    back to "host" elsewhere. An EXPLICIT "device" on an unsupported combo
+    is loud (the batched closure's require-style contract), never a silent
+    host fallback.
+    """
+    loop = solver.ge_loop
+    if loop == "host":
+        return "host"
+    supported = (aggregation == "distribution" and not endogenous_labor
+                 and mesh is None)
+    if loop == "auto":
+        return "device" if supported else "host"
+    if not supported:
+        why = ("simulation aggregation needs per-round PRNG panel runs"
+               if aggregation != "distribution" else
+               "the endogenous-labor families are host-loop only"
+               if endogenous_labor else
+               "mesh-sharded solves keep the host loop (per-shard restore)")
+        raise ValueError(
+            f"SolverConfig(ge_loop='device') is unsupported here: {why}; "
+            "use ge_loop='auto' to fall back to the host loop")
+    return "device"
+
+
+def fused_knobs(model: AiyagariModel, solver: SolverConfig,
+                eq: EquilibriumConfig, dist_tol: float, dist_max_iter: int):
+    """The static-knob tuple the fused program builders destructure — one
+    builder so the positional contract cannot drift (the batched _knobs
+    idiom)."""
+    tech = model.config.technology
+    return (
+        solver.tol, solver.max_iter, solver.howard_steps,
+        solver.relative_tol, tech.alpha, tech.delta,
+        float(dist_tol), int(dist_max_iter),
+        float(eq.tol), int(eq.max_iter), int(eq.batch),
+        solver.accel, solver.ladder, solver.pushforward,
+        solver.telemetry, solver.sentinel, solver.faults, solver.egm_kernel,
+    )
+
+
+def _routes(method: str, egm_kernel: str, pushforward: str, batched: bool):
+    """Resolve the push-forward and EGM-kernel routes once per cached
+    program build (the traced program carries concrete routes), with the
+    batched closure's pallas_inverse rejection: the fused solves pin
+    grid_power=0 (no host escape retry mid-program)."""
+    from aiyagari_tpu.ops.pushforward import resolve_backend
+
+    pushforward = resolve_backend(pushforward, batched=batched)
+    if method == "egm":
+        from aiyagari_tpu.ops.egm import resolve_egm_kernel
+
+        if resolve_egm_kernel(egm_kernel) == "pallas_inverse":
+            raise ValueError(
+                "egm_kernel='pallas_inverse' is not supported by the fused "
+                "GE loop: its in-program solves run grid_power=0 (no host "
+                "escape retry mid-loop), which the windowed inversion route "
+                "requires; use 'auto', 'xla', or 'pallas_fused'")
+    return pushforward
+
+
+def _household_closure(method: str, knobs: tuple, *, batched: bool):
+    """(hh, round_eval) closures over the static knobs.
+
+    hh(r, warm, a_grid, s, P, sigma, beta, amin) -> (sol, warm_out) is the
+    household fixed point alone (the pre-loop warm pass); round_eval adds
+    the stationary distribution and market clearing — one outer round.
+    """
+    (tol, max_iter, howard_steps, relative_tol, alpha, delta,
+     dist_tol, dist_max_iter, _eq_tol, _eq_max_iter, _eq_batch,
+     accel, ladder, pushforward, telemetry, sentinel, faults,
+     egm_kernel) = knobs
+    pushforward = _routes(method, egm_kernel, pushforward, batched)
+
+    def hh(r, warm, a_grid, s, P, sigma, beta, amin):
+        w = wage_from_r(r, alpha, delta)
+        if method == "vfi":
+            from aiyagari_tpu.solvers.vfi import solve_aiyagari_vfi
+
+            sol = solve_aiyagari_vfi(
+                warm, a_grid, s, P, r, w, sigma=sigma, beta=beta,
+                tol=tol, max_iter=max_iter, howard_steps=howard_steps,
+                relative_tol=relative_tol, ladder=ladder,
+                telemetry=telemetry, sentinel=sentinel, faults=faults)
+            return sol, sol.v
+        from aiyagari_tpu.solvers.egm import solve_aiyagari_egm
+
+        # grid_power=0.0: the generic exact inversion (module docstring).
+        sol = solve_aiyagari_egm(
+            warm, a_grid, s, P, r, w, amin, sigma=sigma, beta=beta,
+            tol=tol, max_iter=max_iter, relative_tol=relative_tol,
+            grid_power=0.0, egm_kernel=egm_kernel, accel=accel,
+            ladder=ladder, telemetry=telemetry, sentinel=sentinel,
+            faults=faults)
+        return sol, sol.policy_c
+
+    def round_eval(r, warm, mu, a_grid, s, P, sigma, beta, amin, labor_raw):
+        from aiyagari_tpu.sim.distribution import (
+            aggregate_capital,
+            stationary_distribution,
+        )
+
+        sol, warm_out = hh(r, warm, a_grid, s, P, sigma, beta, amin)
+        dist = stationary_distribution(
+            sol.policy_k, a_grid, P, tol=dist_tol, max_iter=dist_max_iter,
+            mu_init=mu, accel=accel, ladder=ladder, pushforward=pushforward,
+            telemetry=telemetry, sentinel=sentinel, faults=faults)
+        supply = aggregate_capital(dist.mu, a_grid)
+        demand = capital_demand(r, labor_raw, alpha, delta)
+        return sol, warm_out, dist, supply, demand
+
+    return hh, round_eval
+
+
+@lru_cache(maxsize=None)
+def _fused_serial(method: str, knobs: tuple, donate: bool):
+    """Build + jit the serial fused bisection (module docstring). Cache key
+    = everything that changes the traced program plus the donation split —
+    the donated and undonated twins are distinct executables."""
+    (_tol, _mi, _hs, _rt, alpha, delta, _dtol, _dmi,
+     eq_tol, eq_max_iter, _eq_batch, _accel, _ladder, _pf,
+     telemetry_cfg, sentinel_cfg, _faults, _ek) = knobs
+    hh, round_eval = _household_closure(method, knobs, batched=False)
+
+    def program(lo0, hi0, r0, warm0, mu0, a_grid, s, P, sigma, beta, amin,
+                labor_raw):
+        dt = a_grid.dtype
+        iota = jnp.arange(eq_max_iter, dtype=jnp.int32)
+
+        # Pre-loop warm pass at r_init (the host loop's :63-129 analogue) —
+        # also materializes the solution pytree the while carry threads.
+        sol0, warm1 = hh(jnp.asarray(r0, dt), warm0, a_grid, s, P, sigma,
+                         beta, amin)
+
+        carry = {
+            "lo": jnp.asarray(lo0, dt),
+            "hi": jnp.asarray(hi0, dt),
+            "r": jnp.asarray(r0, dt),
+            # +inf, not 0/nan: round one must run (|inf| >= tol) and a
+            # nan-poisoned gap must FAIL the cond (|nan| >= tol is False)
+            # — the AIYA107 nan-early-exit contract.
+            "gap": jnp.asarray(jnp.inf, dt),
+            "supply": jnp.asarray(jnp.nan, dt),
+            "demand": jnp.asarray(jnp.nan, dt),
+            "warm": warm1,
+            "mu": mu0,
+            "sol": sol0,
+            "dist_tele": telemetry_init(telemetry_cfg),
+            "it": jnp.asarray(0, jnp.int32),
+            "r_hist": jnp.full((eq_max_iter,), jnp.nan, dt),
+            "ks_hist": jnp.full((eq_max_iter,), jnp.nan, dt),
+            "kd_hist": jnp.full((eq_max_iter,), jnp.nan, dt),
+            "si_hist": jnp.zeros((eq_max_iter,), jnp.int32),
+            "di_hist": jnp.zeros((eq_max_iter,), jnp.int32),
+            "tele": telemetry_init(telemetry_cfg),
+            "sent": sentinel_init(sentinel_cfg),
+        }
+
+        def cond(c):
+            base = (jnp.abs(c["gap"]) >= eq_tol) & (c["it"] < eq_max_iter)
+            return sentinel_cond(c["sent"], base)
+
+        def body(c):
+            mid = 0.5 * (c["lo"] + c["hi"])
+            sol, warm, dist, supply, demand = round_eval(
+                mid, c["warm"], c["mu"], a_grid, s, P, sigma, beta, amin,
+                labor_raw)
+            gap = supply - demand
+            # History writes as one-hot selects, not .at[] scatters — the
+            # fused program stays scatter-free for the AIYA101 audit.
+            sel = iota == c["it"]
+            tele = telemetry_record(c["tele"], jnp.abs(gap))
+            sent = sentinel_update(c["sent"], jnp.abs(gap),
+                                   config=sentinel_cfg)
+            return {
+                # Host-parity bracket: `if supply > demand: r_high = mid`.
+                "lo": jnp.where(gap > 0.0, c["lo"], mid),
+                "hi": jnp.where(gap > 0.0, mid, c["hi"]),
+                "r": mid,
+                "gap": gap,
+                "supply": supply,
+                "demand": demand,
+                "warm": warm,
+                "mu": dist.mu,
+                "sol": sol,
+                "dist_tele": dist.telemetry,
+                "it": c["it"] + 1,
+                "r_hist": jnp.where(sel, mid, c["r_hist"]),
+                "ks_hist": jnp.where(sel, supply, c["ks_hist"]),
+                "kd_hist": jnp.where(sel, demand, c["kd_hist"]),
+                "si_hist": jnp.where(sel, sol.iterations.astype(jnp.int32),
+                                     c["si_hist"]),
+                "di_hist": jnp.where(sel, dist.iterations.astype(jnp.int32),
+                                     c["di_hist"]),
+                "tele": tele,
+                "sent": sent,
+            }
+
+        out = lax.while_loop(cond, body, carry)
+        out["w"] = wage_from_r(out["r"], alpha, delta)
+        return out
+
+    donate_argnums = _DONATE_SERIAL if donate else ()
+    return jax.jit(program, donate_argnums=donate_argnums)
+
+
+def fused_ge_program(model: AiyagariModel, *,
+                     solver: SolverConfig = SolverConfig(),
+                     eq: EquilibriumConfig = EquilibriumConfig(),
+                     dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+                     donate: bool = False):
+    """The compiled serial fused-GE entry for `model`'s static geometry.
+    Call with fused_ge_operands(...); donate=True hands the warm/mu
+    argument buffers to XLA (the caller must not reuse them)."""
+    if model.config.endogenous_labor:
+        raise ValueError("the fused GE loop supports exogenous labor only; "
+                         "use ge_loop='host' (resolve_ge_loop routes this)")
+    knobs = fused_knobs(model, solver, eq, dist_tol, dist_max_iter)
+    return _fused_serial(solver.method, knobs, bool(donate))
+
+
+def fused_ge_operands(model: AiyagariModel, eq: EquilibriumConfig, *,
+                      solver: SolverConfig = SolverConfig(),
+                      warm_start=None):
+    """Operand tuple for fused_ge_program: (lo, hi, r_init, warm, mu,
+    a_grid, s, P, sigma, beta, amin, labor_raw). The warm state follows
+    the host loop's seeding — warm_start when given (COPIED, so a donated
+    call cannot delete the caller's cache entry), else the VFI zero value
+    / EGM cash-on-hand guess; mu starts uniform."""
+    prefs = model.preferences
+    dt = model.dtype
+    lo = jnp.asarray(eq.r_low, dt)
+    hi = jnp.asarray(eq.r_high if eq.r_high is not None
+                     else 1.0 / prefs.beta - 1.0, dt)
+    r0 = jnp.asarray(eq.r_init, dt)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+    if warm_start is not None:
+        warm = jnp.array(warm_start, dtype=dt, copy=True)
+    elif solver.method == "vfi":
+        warm = jnp.zeros((N, na), dt)
+    else:
+        from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+        warm = initial_consumption_guess(
+            model.a_grid, model.s, r0,
+            wage_from_r(r0, model.config.technology.alpha,
+                        model.config.technology.delta))
+    mu = jnp.full((N, na), 1.0 / (N * na), dt)
+    sc = lambda x: jnp.asarray(x, dt)
+    return (lo, hi, r0, warm, mu, model.a_grid, model.s, model.P,
+            sc(prefs.sigma), sc(prefs.beta), sc(model.amin),
+            sc(model.labor_raw))
+
+
+def _result_from_fused(out: dict, *, eq: EquilibriumConfig, t0: float,
+                       rounds_are_batches: bool = False) -> EquilibriumResult:
+    """ONE device_get of the fused program's scalar/history outputs, then
+    the host-shaped EquilibriumResult the dispatch/serve layers consume."""
+    small = {k: out[k] for k in
+             ("r", "w", "gap", "supply", "demand", "it", "quar",
+              "r_hist", "ks_hist", "kd_hist", "si_hist", "di_hist")
+             if k in out}
+    if out.get("sent") is not None:
+        small["verdict_code"] = out["sent"].verdict
+    host = jax.device_get(small)
+    # Everything below is host numpy from the ONE device_get above — the
+    # scalar casts are free, not per-element device fetches.
+    it = int(host["it"])  # noqa: AIYA202 — host numpy post-device_get
+    gap = float(host["gap"])  # noqa: AIYA202 — host numpy post-device_get
+    converged = bool(np.isfinite(gap) and abs(gap) < eq.tol)
+    verdict = ""
+    code = int(host["verdict_code"]) if "verdict_code" in host else 0  # noqa: AIYA202 — host numpy post-device_get
+    if code != 0:
+        verdict = verdict_name(code)
+    r_hist = np.asarray(host["r_hist"], np.float64)
+    ks_hist = np.asarray(host["ks_hist"], np.float64)
+    kd_hist = np.asarray(host["kd_hist"], np.float64)
+    si_hist = np.asarray(host["si_hist"])
+    di_hist = np.asarray(host["di_hist"])
+    if rounds_are_batches:
+        # [rounds, B] rows -> flat per-candidate histories (the batched
+        # host loop's convention), one record per ROUND.
+        quar = np.asarray(host.get("quar", np.zeros_like(ks_hist, bool)))
+        si_list = np.asarray(si_hist, np.int64).tolist()
+        records = []
+        for i in range(it):
+            gaps_i = ks_hist[i] - kd_hist[i]
+            finite = np.where(np.isfinite(gaps_i), np.abs(gaps_i), np.inf)
+            b = int(np.argmin(finite))
+            row_r = r_hist[i].tolist()
+            row_g = gaps_i.tolist()
+            records.append({
+                "round": i,
+                "r_candidates": row_r,
+                "gaps": row_g,
+                "best_r": row_r[b],
+                "best_gap": row_g[b],
+                "gap": row_g[b],
+                "quarantined": quar[i].tolist(),
+                "solver_iterations_max": si_list[i],
+            })
+        r_list = r_hist[:it].reshape(-1).tolist()
+        ks_list = ks_hist[:it].reshape(-1).tolist()
+        kd_list = kd_hist[:it].reshape(-1).tolist()
+        outer_resid = [abs(r["best_gap"]) for r in records]
+    else:
+        r_list = r_hist[:it].tolist()
+        ks_list = ks_hist[:it].tolist()
+        kd_list = kd_hist[:it].tolist()
+        si_list = np.asarray(si_hist, np.int64).tolist()
+        di_list = np.asarray(di_hist, np.int64).tolist()
+        records = [{
+            "iteration": i,
+            "r": r_list[i],
+            "k_supply": ks_list[i],
+            "k_demand": kd_list[i],
+            "gap": ks_list[i] - kd_list[i],
+            "solver_iterations": si_list[i],
+            "distribution_iterations": di_list[i],
+        } for i in range(it)]
+        outer_resid = [abs(s - d) for s, d in zip(ks_list, kd_list)]
+    telemetry = (out["tele"] if out.get("tele") is not None
+                 else host_telemetry(outer_resid))
+    return EquilibriumResult(
+        r=float(host["r"]),  # noqa: AIYA202 — host numpy post-device_get
+        w=float(host["w"]),  # noqa: AIYA202 — host numpy post-device_get
+        capital=float(host["supply"]),  # noqa: AIYA202 — host numpy post-device_get
+        solution=out["sol"],
+        series=None,
+        r_history=r_list,
+        k_supply=ks_list,
+        k_demand=kd_list,
+        iterations=it,
+        converged=converged,
+        solve_seconds=time.perf_counter() - t0,
+        per_iteration=records,
+        mu=out["mu"],
+        telemetry=telemetry,
+        dist_telemetry=out.get("dist_tele"),
+        verdict=verdict,
+    )
+
+
+def solve_equilibrium_fused(
+    model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+    eq: EquilibriumConfig = EquilibriumConfig(),
+    dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+    warm_start=None, donate: bool = True,
+) -> EquilibriumResult:
+    """solve_equilibrium_distribution's fixed point, ONE device program:
+    the r-bisection runs inside the compiled while_loop (module docstring).
+    Same bracket semantics, same |gap| < eq.tol stopping rule; the host
+    sees exactly one dispatch and one device_get per equilibrium."""
+    t0 = time.perf_counter()
+    fn = fused_ge_program(model, solver=solver, eq=eq, dist_tol=dist_tol,
+                          dist_max_iter=dist_max_iter, donate=donate)
+    ops = fused_ge_operands(model, eq, solver=solver, warm_start=warm_start)
+    out = fn(*ops)
+    return _result_from_fused(out, eq=eq, t0=t0)
+
+
+# ---------------------------------------------------------------------------
+# Batched candidate rounds inside the same program
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _fused_batched(method: str, knobs: tuple, donate: bool):
+    """Build + jit the fused parallel-bracket loop: B-candidate vmapped
+    rounds, nearest-candidate warm selection, quarantine masking, and the
+    sign-change bracket shrink, all inside one lax.while_loop."""
+    (_tol, _mi, _hs, _rt, alpha, delta, _dtol, _dmi,
+     eq_tol, eq_max_iter, eq_batch, _accel, _ladder, _pf,
+     telemetry_cfg, sentinel_cfg, _faults, _ek) = knobs
+    B = int(eq_batch)
+    _hh, round_eval = _household_closure(method, knobs, batched=True)
+
+    def program(lo0, hi0, warm0, mu0, a_grid, s, P, sigma, beta, amin,
+                labor_raw):
+        dt = a_grid.dtype
+        offsets = jnp.asarray(np.arange(1, B + 1) / (B + 1.0), dt)
+        iota = jnp.arange(eq_max_iter, dtype=jnp.int32)
+        lanes = jnp.arange(B, dtype=jnp.int32)
+
+        batched_eval = jax.vmap(
+            lambda warm, mu, r: round_eval(r, warm, mu, a_grid, s, P,
+                                           sigma, beta, amin, labor_raw),
+            in_axes=(0, 0, 0))
+
+        def shrink(lo, hi, r_cand, gaps):
+            # Host-parity sign-change shrink (solve_equilibrium_batched):
+            # gap increases in r, the root sits above the LAST negative
+            # candidate; nan gaps compare False and act non-negative,
+            # exactly as on host.
+            neg = gaps < 0.0
+            any_neg = jnp.any(neg)
+            i_star = jnp.max(jnp.where(neg, lanes, -1))
+            lo_neg = jnp.take(r_cand, jnp.clip(i_star, 0, B - 1))
+            hi_neg = jnp.where(i_star + 1 < B,
+                               jnp.take(r_cand, jnp.clip(i_star + 1, 0,
+                                                         B - 1)),
+                               hi)
+            new_lo = jnp.where(any_neg, lo_neg, lo)
+            new_hi = jnp.where(any_neg, hi_neg, r_cand[0])
+            return new_lo, new_hi
+
+        def eval_round(r_cand, warm, mu, it, c):
+            sol, warm_out, dist, supply, demand = batched_eval(warm, mu,
+                                                               r_cand)
+            gaps = supply - demand
+            # Quarantine mask: non-finite lanes are excluded from the best
+            # pick (and reported); the other lanes' values are untouched —
+            # vmapped lanes are independent, so a poisoned candidate costs
+            # its own lane only (the sweep's lockstep contract).
+            quar = ~jnp.isfinite(gaps)
+            finite = jnp.where(quar, jnp.inf, jnp.abs(gaps))
+            best = jnp.argmin(finite).astype(jnp.int32)
+            best_gap = jnp.take(gaps, best)
+            sel = (iota == it)[:, None]
+            return {
+                "lo": c["lo"], "hi": c["hi"],   # shrunk by the caller
+                "r_prev": r_cand,
+                "best": best,
+                "best_r": jnp.take(r_cand, best),
+                "best_gap": best_gap,
+                "best_supply": jnp.take(supply, best),
+                "warm": warm_out,
+                "mu": dist.mu,
+                "sol": sol,
+                "dist_tele": dist.telemetry,
+                "it": it + 1,
+                "r_hist": jnp.where(sel, r_cand[None, :], c["r_hist"]),
+                "ks_hist": jnp.where(sel, supply[None, :], c["ks_hist"]),
+                "kd_hist": jnp.where(sel, demand[None, :], c["kd_hist"]),
+                "si_hist": jnp.where(
+                    iota == it,
+                    jnp.max(sol.iterations).astype(jnp.int32), c["si_hist"]),
+                "di_hist": jnp.where(
+                    iota == it,
+                    jnp.max(dist.iterations).astype(jnp.int32), c["di_hist"]),
+                "quar": jnp.where(sel, quar[None, :], c["quar"]),
+                "tele": telemetry_record(c["tele"], jnp.abs(best_gap)),
+                "sent": sentinel_update(c["sent"], jnp.abs(best_gap),
+                                        config=sentinel_cfg),
+            }, gaps
+
+        shell = {
+            "lo": jnp.asarray(lo0, dt),
+            "hi": jnp.asarray(hi0, dt),
+            "r_hist": jnp.full((eq_max_iter, B), jnp.nan, dt),
+            "ks_hist": jnp.full((eq_max_iter, B), jnp.nan, dt),
+            "kd_hist": jnp.full((eq_max_iter, B), jnp.nan, dt),
+            "si_hist": jnp.zeros((eq_max_iter,), jnp.int32),
+            "di_hist": jnp.zeros((eq_max_iter,), jnp.int32),
+            "quar": jnp.zeros((eq_max_iter, B), bool),
+            "tele": telemetry_init(telemetry_cfg),
+            "sent": sentinel_init(sentinel_cfg),
+        }
+        # Round 0 runs OUTSIDE the while_loop (the host loop's cold round /
+        # the serial path's pre-loop pass): warm0/mu0 seed the candidates
+        # directly, and the round's outputs give the carry its solution
+        # pytree structure.
+        r0 = shell["lo"] + (shell["hi"] - shell["lo"]) * offsets
+        carry, gaps0 = eval_round(r0, warm0, mu0,
+                                  jnp.asarray(0, jnp.int32), shell)
+        lo1, hi1 = shrink(carry["lo"], carry["hi"], r0, gaps0)
+        carry["lo"], carry["hi"] = lo1, hi1
+
+        def cond(c):
+            # |nan| >= tol is False: an all-lane-nan round (best_gap nan)
+            # exits the loop — the AIYA107 nan-exit contract (module
+            # docstring names the host deviation).
+            base = (jnp.abs(c["best_gap"]) >= eq_tol) & (c["it"] < eq_max_iter)
+            return sentinel_cond(c["sent"], base)
+
+        def body(c):
+            r_cand = c["lo"] + (c["hi"] - c["lo"]) * offsets
+            # Nearest-candidate warm selection (the bracket nests, so the
+            # previous round's survivors are the closest warm states).
+            j = jnp.argmin(jnp.abs(r_cand[:, None] - c["r_prev"][None, :]),
+                           axis=1)
+            warm = jnp.take(c["warm"], j, axis=0)
+            mu = jnp.take(c["mu"], j, axis=0)
+            nxt, gaps = eval_round(r_cand, warm, mu, c["it"], c)
+            lo, hi = shrink(c["lo"], c["hi"], r_cand, gaps)
+            nxt["lo"], nxt["hi"] = lo, hi
+            return nxt
+
+        out = lax.while_loop(cond, body, carry)
+        out["r"] = out["best_r"]
+        out["w"] = wage_from_r(out["best_r"], alpha, delta)
+        out["gap"] = out["best_gap"]
+        out["supply"] = out["best_supply"]
+        out["demand"] = out["best_supply"] - out["best_gap"]
+        return out
+
+    donate_argnums = _DONATE_BATCHED if donate else ()
+    return jax.jit(program, donate_argnums=donate_argnums)
+
+
+def fused_ge_batched_program(model: AiyagariModel, *,
+                             solver: SolverConfig = SolverConfig(),
+                             eq: EquilibriumConfig = EquilibriumConfig(batch=8),
+                             dist_tol: float = 1e-10,
+                             dist_max_iter: int = 10_000,
+                             donate: bool = False):
+    """The compiled fused parallel-bracket entry (eq.batch candidates per
+    in-program round). Call with fused_ge_batched_operands(...)."""
+    if model.config.endogenous_labor:
+        raise ValueError("the fused GE loop supports exogenous labor only; "
+                         "use ge_loop='host' (resolve_ge_loop routes this)")
+    if eq.batch < 2:
+        raise ValueError(
+            f"fused_ge_batched_program needs eq.batch >= 2, got {eq.batch}")
+    knobs = fused_knobs(model, solver, eq, dist_tol, dist_max_iter)
+    return _fused_batched(solver.method, knobs, bool(donate))
+
+
+def fused_ge_batched_operands(model: AiyagariModel, eq: EquilibriumConfig, *,
+                              solver: SolverConfig = SolverConfig()):
+    """Operand tuple for fused_ge_batched_program: (lo, hi, warm, mu,
+    a_grid, s, P, sigma, beta, amin, labor_raw) with [B]-leading warm/mu.
+    Cold-start seeding matches the host batched round 0: VFI zeros / EGM
+    cash-on-hand guesses at each candidate's own prices, uniform mu."""
+    prefs = model.preferences
+    tech = model.config.technology
+    dt = model.dtype
+    B = int(eq.batch)
+    lo = float(eq.r_low)
+    hi = float(eq.r_high if eq.r_high is not None
+               else 1.0 / prefs.beta - 1.0)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+    r0 = jnp.asarray(lo + (hi - lo) * np.arange(1, B + 1) / (B + 1.0), dt)
+    if solver.method == "vfi":
+        warm = jnp.zeros((B, N, na), dt)
+    else:
+        from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+        w0 = wage_from_r(r0, tech.alpha, tech.delta)
+        warm = jax.vmap(initial_consumption_guess,
+                        in_axes=(None, None, 0, 0))(model.a_grid, model.s,
+                                                    r0, w0)
+    mu = jnp.full((B, N, na), 1.0 / (N * na), dt)
+    sc = lambda x: jnp.asarray(x, dt)
+    return (jnp.asarray(lo, dt), jnp.asarray(hi, dt), warm, mu,
+            model.a_grid, model.s, model.P, sc(prefs.sigma), sc(prefs.beta),
+            sc(model.amin), sc(model.labor_raw))
+
+
+def solve_equilibrium_fused_batched(
+    model: AiyagariModel, *, solver: SolverConfig = SolverConfig(),
+    eq: EquilibriumConfig = EquilibriumConfig(batch=8),
+    dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+    donate: bool = True,
+) -> EquilibriumResult:
+    """solve_equilibrium_batched's fixed point, ONE device program (module
+    docstring): the parallel-bracket rounds run inside the compiled
+    while_loop. Histories carry every evaluated candidate; `iterations`
+    counts rounds, as on the host path."""
+    t0 = time.perf_counter()
+    fn = fused_ge_batched_program(model, solver=solver, eq=eq,
+                                  dist_tol=dist_tol,
+                                  dist_max_iter=dist_max_iter, donate=donate)
+    ops = fused_ge_batched_operands(model, eq, solver=solver)
+    out = fn(*ops)
+    best = int(jax.device_get(out["best"]))
+    take = lambda x: jax.tree_util.tree_map(lambda l: l[best], x)
+    out = dict(out)
+    out["sol"] = take(out["sol"])
+    out["mu"] = out["mu"][best]
+    if out.get("dist_tele") is not None:
+        out["dist_tele"] = take(out["dist_tele"])
+    return _result_from_fused(out, eq=eq, t0=t0, rounds_are_batches=True)
+
+
+@lru_cache(maxsize=None)
+def _fused_round(method: str, knobs: tuple):
+    """One quarantine-masked candidate round, standalone: the exact vmapped
+    evaluation + masking the fused batched loop runs per round, exposed so
+    tests can pin quarantined-lane bitwise independence (a poisoned
+    candidate must not perturb its neighbors' bits)."""
+    _hh, round_eval = _household_closure(method, knobs, batched=True)
+
+    def program(r_cand, warm, mu, a_grid, s, P, sigma, beta, amin,
+                labor_raw):
+        sol, warm_out, dist, supply, demand = jax.vmap(
+            lambda w_, m_, r_: round_eval(r_, w_, m_, a_grid, s, P, sigma,
+                                          beta, amin, labor_raw),
+            in_axes=(0, 0, 0))(warm, mu, r_cand)
+        gaps = supply - demand
+        quar = ~jnp.isfinite(gaps)
+        return {"gap": gaps, "quarantined": quar, "supply": supply,
+                "demand": demand, "warm": warm_out, "mu": dist.mu,
+                "sol": sol}
+
+    return jax.jit(program)
+
+
+def fused_batched_round(model: AiyagariModel, r_cand, *,
+                        solver: SolverConfig = SolverConfig(),
+                        eq: EquilibriumConfig = EquilibriumConfig(batch=8),
+                        dist_tol: float = 1e-10, dist_max_iter: int = 10_000,
+                        warm=None, mu=None):
+    """Evaluate one fused candidate round at `r_cand` ([B]) with the
+    quarantine mask. warm/mu default to the cold-start seeding of
+    fused_ge_batched_operands evaluated at r_cand's own prices."""
+    knobs = fused_knobs(model, solver, eq, dist_tol, dist_max_iter)
+    fn = _fused_round(solver.method, knobs)
+    dt = model.dtype
+    prefs = model.preferences
+    tech = model.config.technology
+    r_cand = jnp.asarray(r_cand, dt)
+    B = int(r_cand.shape[0])
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+    if warm is None:
+        if solver.method == "vfi":
+            warm = jnp.zeros((B, N, na), dt)
+        else:
+            from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+            w0 = wage_from_r(r_cand, tech.alpha, tech.delta)
+            warm = jax.vmap(initial_consumption_guess,
+                            in_axes=(None, None, 0, 0))(model.a_grid,
+                                                        model.s, r_cand, w0)
+    if mu is None:
+        mu = jnp.full((B, N, na), 1.0 / (N * na), dt)
+    sc = lambda x: jnp.asarray(x, dt)
+    return fn(r_cand, warm, mu, model.a_grid, model.s, model.P,
+              sc(prefs.sigma), sc(prefs.beta), sc(model.amin),
+              sc(model.labor_raw))

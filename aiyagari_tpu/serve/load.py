@@ -18,7 +18,140 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["percentiles", "run_load", "run_ramp", "synthetic_requests"]
+__all__ = ["HttpServiceClient", "percentiles", "run_load", "run_ramp",
+           "synthetic_requests"]
+
+
+class HttpServiceClient:
+    """A `.submit(SolveRequest) -> Future` adapter over the stdlib HTTP
+    front (service.py `_http_server`), so `run_load`/`run_ramp` can drive
+    the FULL network path with the same driver they use in-process.
+
+    Connections are persistent (ISSUE 18 satellite): each driver thread
+    holds ONE keep-alive `http.client.HTTPConnection` — the server speaks
+    HTTP/1.1 with Content-Length on every response, so the socket is
+    reusable — and the measured SLO knee is solve throughput, not TCP
+    setup/teardown per request. A connection that goes stale (server
+    restart, idle timeout) is dropped and re-dialed once before the error
+    propagates.
+
+    The request's calibration travels as the `params` override the HTTP
+    front applies over ITS base config (dispatch._SWEEP_PARAMS), extracted
+    by diffing the request's config against `base` — so the client
+    composes with `synthetic_requests(base, ...)` unchanged. Responses
+    come back as SolveResponse objects; `latency_s` is CLIENT-observed
+    (submit -> parsed response, network included), which is the number the
+    knee is defined on."""
+
+    def __init__(self, base, port: int, *, host: str = "127.0.0.1",
+                 auth_token: Optional[str] = None, timeout: float = 600.0,
+                 workers: int = 8):
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._base = base
+        self._host = host
+        self._port = port
+        self._token = auth_token
+        self._timeout = timeout
+        self._tls = threading.local()
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="serve-load")
+
+    def _params_of(self, config) -> dict:
+        """The sweep-param overrides that rebuild `config` from the base
+        (loud on a config the HTTP front cannot express)."""
+        import dataclasses
+
+        from aiyagari_tpu.dispatch import _SWEEP_PARAMS, _scenario_config
+
+        out = {}
+        for name, (section, field) in _SWEEP_PARAMS.items():
+            holder = config if section is None else getattr(config, section)
+            base_holder = (self._base if section is None
+                           else getattr(self._base, section))
+            v, v0 = getattr(holder, field), getattr(base_holder, field)
+            if v != v0:
+                out[name] = v
+        if _scenario_config(self._base, out) != config:
+            raise ValueError(
+                "request config differs from the client base outside the "
+                f"sweepable params {sorted(_SWEEP_PARAMS)}; the HTTP front "
+                "only applies params overrides over its base economy")
+        return out
+
+    def _connection(self):
+        import http.client
+
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=self._timeout)
+            self._tls.conn = conn
+        return conn
+
+    def _post(self, path: str, body: str) -> dict:
+        import http.client
+        import json
+
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request("POST", path, body, headers)
+                resp = conn.getresponse()
+                data = resp.read()      # drain: keeps the socket reusable
+                return json.loads(data)
+            except (http.client.HTTPException, OSError):
+                # Stale keep-alive socket: drop it and re-dial ONCE.
+                conn.close()
+                self._tls.conn = None
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")   # pragma: no cover
+
+    def _roundtrip(self, req):
+        import json
+
+        from aiyagari_tpu.serve.service import SolveResponse
+
+        body = {"params": self._params_of(req.config),
+                "timeout": self._timeout}
+        if req.kind == "transition":
+            body["shock"] = {
+                "param": req.shock.param, "size": req.shock.size,
+                "rho": req.shock.rho}
+        t0 = time.perf_counter()
+        out = self._post("/solve", json.dumps(body))
+        wall = time.perf_counter() - t0
+        if "error" in out and "status" not in out:
+            raise RuntimeError(f"HTTP solve failed: {out['error']}")
+        resp = SolveResponse(
+            id=out.get("id", req.id), kind=out.get("kind", req.kind),
+            status=out["status"], cache=out.get("cache", "cold"),
+            converged=bool(out.get("converged")),
+            warm_source=out.get("warm_source", "cold"),
+            degraded=bool(out.get("degraded")),
+            r=out.get("r"), w=out.get("w"), capital=out.get("capital"),
+            gap=out.get("gap"),
+            queue_wait_s=out.get("queue_wait_s", 0.0),
+            wall_s=out.get("wall_s", 0.0), batch=out.get("batch", 1))
+        resp.latency_s = round(wall, 6)
+        return resp
+
+    def submit(self, request):
+        return self._pool.submit(self._roundtrip, request)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "HttpServiceClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def synthetic_requests(base, n: int, *, seed: int = 0,

@@ -102,6 +102,13 @@ class ServeConfig:
     anchor_warm: bool = True           # warm-start transition anchors from
                                        # cross-bucket neighbors + blend
                                        # their fake-news Jacobians
+    pipeline: bool = True              # two-stage worker: a stager thread
+                                       # admits/coalesces/pre-stages batch
+                                       # k+1 while the executor drives the
+                                       # device on batch k (False = the
+                                       # PR 15 single-thread worker, the
+                                       # serial A/B the bench measures
+                                       # against)
     solver: Optional[SolverConfig] = None
     equilibrium: EquilibriumConfig = EquilibriumConfig()
     transition: TransitionConfig = TransitionConfig()
@@ -229,6 +236,12 @@ class SolveService:
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Pipelined-worker state (config.pipeline): the depth-1 staged
+        # slot between the stager (admission/coalescing/route pre-resolve)
+        # and the executor (device work), both waiting on self._cond.
+        self._staged: list = []         # [batch]; bounded to depth 1
+        self._stager: Optional[threading.Thread] = None
+        self._stage_done = False
         self.warmup_report: Optional[dict] = None
         self.requests_served = 0
         self.warm_sources: dict = {}    # warm_source -> served count
@@ -257,6 +270,16 @@ class SolveService:
                 self._running = True
                 self._cond.notify_all()
             if self._thread.is_alive():
+                if (self.config.pipeline
+                        and (self._stager is None
+                             or not self._stager.is_alive())):
+                    # The stager drained and exited during the stop — the
+                    # executor alone would starve; respawn the front half.
+                    self._stage_done = False
+                    self._stager = threading.Thread(
+                        target=self._stage_loop,
+                        name="aiyagari-serve-stager", daemon=True)
+                    self._stager.start()
                 return self
             # The worker exited between the checks — fall through and
             # spawn a fresh one.
@@ -270,8 +293,18 @@ class SolveService:
                        else "float32"),
                 ledger=self._led)
         self._running = True
-        self._thread = threading.Thread(target=self._worker,
-                                        name="aiyagari-serve", daemon=True)
+        self._stage_done = False
+        if self.config.pipeline:
+            self._stager = threading.Thread(
+                target=self._stage_loop, name="aiyagari-serve-stager",
+                daemon=True)
+            self._stager.start()
+            self._thread = threading.Thread(
+                target=self._exec_loop, name="aiyagari-serve",
+                daemon=True)
+        else:
+            self._thread = threading.Thread(
+                target=self._worker, name="aiyagari-serve", daemon=True)
         self._thread.start()
         return self
 
@@ -279,14 +312,22 @@ class SolveService:
         """Drain the queue, then stop the worker. If the worker is still
         mid-solve after `timeout`, the handle is KEPT (a later start()
         resurrects it; a later stop() re-joins) — clearing it would let
-        start() spawn a second worker racing the still-draining first."""
+        start() spawn a second worker racing the still-draining first.
+        The pipelined worker drains front-to-back: the stager stages every
+        remaining admission, signals done, and the executor exits once the
+        staged slot empties."""
         with self._cond:
             self._running = False
             self._cond.notify_all()
         if self.surrogate is not None:
             self.surrogate.stop_background()
+        deadline = time.perf_counter() + timeout
+        if self._stager is not None:
+            self._stager.join(max(0.0, deadline - time.perf_counter()))
+            if not self._stager.is_alive():
+                self._stager = None
         if self._thread is not None:
-            self._thread.join(timeout)
+            self._thread.join(max(0.0, deadline - time.perf_counter()))
             if not self._thread.is_alive():
                 self._thread = None
 
@@ -403,64 +444,151 @@ class SolveService:
 
     # -- worker ------------------------------------------------------------
 
+    def _admit_batch(self):
+        """The admission half of a worker turn: pop the oldest request,
+        serve it on the spot if it is an exact cache hit (or resolve it if
+        the fast path raised), else coalesce a compatible batch to the
+        max_wait_s deadline. Returns the assembled batch, or None when the
+        turn consumed itself (hit/error/shutdown-with-empty-queue)."""
+        with self._cond:
+            while not self._queue and self._running:
+                self._cond.wait(0.1)
+            if not self._queue:
+                if not self._running:
+                    return None
+                return ()               # spurious wake — take another turn
+            first = self._queue.pop(0)
+            self._gauge_queue_depth()
+        try:
+            served = self._try_hit(first)
+        except Exception as e:  # noqa: BLE001 — the worker must survive
+            # A failing fast path (e.g. a ledger write hitting ENOSPC)
+            # must resolve the popped request and keep the worker
+            # alive — an unhandled raise here would kill the single
+            # worker with _running still True and hang every later
+            # submit() silently.
+            req, fut = first
+            if not fut.done():
+                fut.set_result(self._finish(req, SolveResponse(
+                    id=req.id, kind=req.kind, status="error",
+                    cache="cold", converged=False,
+                    error=f"{type(e).__name__}: {e}"[:500]), batch=1))
+            served = True
+        if served:
+            return ()
+        batch = [first]
+        # Deadline coalescing: hold the batch open for compatible
+        # requests until max_wait_s from the FIRST pop, or max_batch.
+        key = _compat_key(first[0], self.config)
+        deadline = time.perf_counter() + self.config.max_wait_s
+        while (len(batch) < self.config.max_batch
+               and self.config.max_batch > 1):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0.0:
+                break
+            with self._cond:
+                idx = next(
+                    (i for i, (req, _) in enumerate(self._queue)
+                     if _compat_key(req, self.config) == key), None)
+                if idx is not None:
+                    batch.append(self._queue.pop(idx))
+                    self._gauge_queue_depth()
+                    continue
+                self._cond.wait(min(remaining, 0.005))
+        return batch
+
+    def _run_batch(self, batch) -> None:
+        """Execute one assembled batch, resolving every future even when
+        the solve path raises (the worker must survive)."""
+        try:
+            self._serve_batch(batch)
+        except Exception as e:  # noqa: BLE001 — the worker must survive
+            for req, fut in batch:
+                if not fut.done():
+                    fut.set_result(self._finish(
+                        req, SolveResponse(
+                            id=req.id, kind=req.kind, status="error",
+                            cache="cold", converged=False,
+                            error=f"{type(e).__name__}: {e}"[:500]),
+                        batch=len(batch)))
+
     def _worker(self) -> None:
+        """The single-thread worker (config.pipeline=False): admission,
+        coalescing, and device execution all serialized on one thread —
+        the device idles through every coalescing deadline and every
+        Python batch-assembly pass."""
+        while True:
+            batch = self._admit_batch()
+            if batch is None:
+                return
+            if batch:
+                self._run_batch(batch)
+
+    def _stage_loop(self) -> None:
+        """Stage 1 of the pipelined worker (config.pipeline=True): admit +
+        coalesce + pre-stage batch k+1 WHILE the executor drives the
+        device on batch k, then hand it through the depth-1 staged slot.
+        Exact cache hits are still served here immediately (host-only
+        work), so the cheapest requests never wait behind a device batch.
+        Steady-state serve throughput is then bounded by device time, not
+        by Python batch assembly (ISSUE 18 tentpole)."""
+        while True:
+            batch = self._admit_batch()
+            if batch is None:
+                with self._cond:
+                    # No admissions left and the service is stopping: the
+                    # executor drains the staged slot, then exits.
+                    self._stage_done = True
+                    self._cond.notify_all()
+                return
+            if not batch:
+                continue
+            self._prestage(batch)
+            with self._cond:
+                # Depth-1 handoff: block while the previous staged batch
+                # is still waiting — deeper staging would only add queue
+                # latency ahead of an already-busy device.
+                while self._staged:
+                    self._cond.wait(0.1)
+                self._staged.append(batch)
+                self._cond.notify_all()
+
+    def _exec_loop(self) -> None:
+        """Stage 2 of the pipelined worker: pull assembled batches from
+        the staged slot and run the device work. Frees the slot BEFORE
+        executing, so the stager assembles batch k+1 during batch k's
+        solve."""
         while True:
             with self._cond:
-                while not self._queue and self._running:
+                while not self._staged and not self._stage_done:
                     self._cond.wait(0.1)
-                if not self._queue:
-                    if not self._running:
-                        return
-                    continue
-                first = self._queue.pop(0)
-                self._gauge_queue_depth()
-            try:
-                served = self._try_hit(first)
-            except Exception as e:  # noqa: BLE001 — the worker must survive
-                # A failing fast path (e.g. a ledger write hitting ENOSPC)
-                # must resolve the popped request and keep the worker
-                # alive — an unhandled raise here would kill the single
-                # worker with _running still True and hang every later
-                # submit() silently.
-                req, fut = first
-                if not fut.done():
-                    fut.set_result(self._finish(req, SolveResponse(
-                        id=req.id, kind=req.kind, status="error",
-                        cache="cold", converged=False,
-                        error=f"{type(e).__name__}: {e}"[:500]), batch=1))
-                served = True
-            if served:
-                continue
-            batch = [first]
-            # Deadline coalescing: hold the batch open for compatible
-            # requests until max_wait_s from the FIRST pop, or max_batch.
-            key = _compat_key(first[0], self.config)
-            deadline = time.perf_counter() + self.config.max_wait_s
-            while (len(batch) < self.config.max_batch
-                   and self.config.max_batch > 1):
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0.0:
-                    break
-                with self._cond:
-                    idx = next(
-                        (i for i, (req, _) in enumerate(self._queue)
-                         if _compat_key(req, self.config) == key), None)
-                    if idx is not None:
-                        batch.append(self._queue.pop(idx))
-                        self._gauge_queue_depth()
-                        continue
-                    self._cond.wait(min(remaining, 0.005))
-            try:
-                self._serve_batch(batch)
-            except Exception as e:  # noqa: BLE001 — the worker must survive
-                for req, fut in batch:
-                    if not fut.done():
-                        fut.set_result(self._finish(
-                            req, SolveResponse(
-                                id=req.id, kind=req.kind, status="error",
-                                cache="cold", converged=False,
-                                error=f"{type(e).__name__}: {e}"[:500]),
-                            batch=len(batch)))
+                if not self._staged:
+                    return              # drained and the stager signed off
+                batch = self._staged.pop(0)
+                self._cond.notify_all()
+            self._run_batch(batch)
+
+    def _prestage(self, batch) -> None:
+        """Host-side pre-work on the stager thread: prime the dispatch
+        route memo for the batch's geometry (both the solo and the
+        vmapped context), so the executor's own _resolve_routes calls
+        become memo hits that just replay the recorded decisions
+        (dispatch.py). Best-effort — dispatch re-resolves identically if
+        any of this fails."""
+        try:
+            from aiyagari_tpu import dispatch
+
+            req = batch[0][0]
+            backend = BackendConfig(dtype=self.config.dtype)
+            dt = dispatch._dtype_of(backend)
+            na = req.config.grid.n_points
+            egm = not req.config.endogenous_labor
+            for batched in (False, True):
+                dispatch._resolve_routes(self.config.solver, na=na,
+                                         dtype=dt, egm=egm,
+                                         batched=batched)
+        except Exception:  # noqa: BLE001 — pre-staging is an optimization
+            pass
 
     def _try_hit(self, item) -> bool:
         """Serve an exact cache hit IMMEDIATELY, before any coalescing
@@ -486,13 +614,17 @@ class SolveService:
         with activate(self._led):
             outcome, entry = self._lookup(req, kind=key_kind, extra=extra)
             if outcome != "hit":
-                # Defensive only: evictions happen in cache.put, which
-                # only THIS worker thread calls, so nothing can evict
-                # between the peek and the lookup today — this branch
-                # exists for a future multi-worker service (where its
-                # transition leg would double-count one lookup; accepted
-                # as unreachable-now). Serve on the spot — a warm steady
-                # state polishes, anything else solves serially.
+                # The peek raced an eviction. In the pipelined worker this
+                # CAN happen (the executor's cache.put evicts while the
+                # stager peeks): the request must NOT solve here — device
+                # work belongs to the executor alone — so it falls through
+                # into the coalesced batch, whose _serve_steady lookup
+                # handles the warm/miss outcome (one double-counted lookup
+                # on this rare race, accepted). The single-thread worker
+                # serves it on the spot as before — a warm steady state
+                # polishes, anything else solves serially.
+                if self.config.pipeline:
+                    return False
                 if req.kind == "steady_state" and outcome == "warm":
                     fut.set_result(self._finish(
                         req, self._steady_polish(req, entry.payload,
@@ -1198,6 +1330,13 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
     inflight_lock = _threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 => persistent connections: a load driver (or any real
+        # client) reuses one TCP connection across requests instead of
+        # paying connect/teardown per solve (ISSUE 18 satellite — the SLO
+        # knee should measure solve throughput, not TCP setup). Safe only
+        # because _send always sets Content-Length.
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *args):  # quiet: the ledger is the record
             pass
 
@@ -1356,6 +1495,9 @@ def serve_main(argv) -> int:
                     help="skip the warm-pool precompile at startup")
     ap.add_argument("--no-surrogate", action="store_true",
                     help="disable the policy-surface surrogate predictor")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="single-thread worker (disable the stager/"
+                         "executor pipeline)")
     ap.add_argument("--auth-token", default=None,
                     help="require 'Authorization: Bearer <token>' on "
                          "POST /solve (default: $AIYAGARI_SERVE_TOKEN; "
@@ -1401,6 +1543,7 @@ def serve_main(argv) -> int:
         cache_bytes=int(args.cache_mb * 1024 * 1024),
         resolution=args.resolution, warm_pool=not args.no_warm,
         surrogate=not args.no_surrogate,
+        pipeline=not args.no_pipeline,
         warm_na=args.grid, equilibrium=eq)
     service = SolveService(cfg, ledger=args.ledger)
     service.start()

@@ -60,17 +60,20 @@ __all__ = [
     "KNOBS",
     "KnobSpec",
     "autotune",
+    "capture_decisions",
     "configure",
     "explain",
     "grid_bucket",
     "load_cache",
     "platform_fingerprint",
     "probe_knob",
+    "replay_decisions",
     "resolve_route",
     "save_cache",
     "tune_main",
     "tuning_active",
     "tuning_cache_path",
+    "tuning_cache_stamp",
 ]
 
 _CACHE_VERSION = 1
@@ -317,6 +320,53 @@ def _prior_choice(knob: str, na: Optional[int], dtype,
 
 # -- decision recording -----------------------------------------------------
 
+# Stack of armed capture buffers (capture_decisions): every decision that
+# flows through _record_decision is ALSO appended to the innermost buffer,
+# whether or not a ledger was active to receive the event. The dispatch
+# boundary memoizes route resolutions per (config fingerprint, cache stamp)
+# and replays the captured decisions on memo hits, so the exactly-one
+# route_decision-per-activation contract survives the caching.
+_decision_capture: list = []
+
+
+@contextlib.contextmanager
+def capture_decisions():
+    """Collect every _record_decision call in this scope as replayable
+    (knob, choice, source, evidence, na, dtype) tuples — armed by
+    dispatch._resolve_routes around a memo MISS so later hits can replay
+    the identical decisions without re-running the resolvers."""
+    buf: list = []
+    _decision_capture.append(buf)
+    try:
+        yield buf
+    finally:
+        _decision_capture.pop()
+
+
+def replay_decisions(decisions) -> None:
+    """Re-emit previously captured decisions into the CURRENT activation
+    scope (dispatch memo hits). Goes through _record_decision, so the
+    per-activation dedup set still guarantees one event per knob."""
+    for knob, choice, source, evidence, na, dtype in decisions:
+        _record_decision(knob, choice, source, evidence, na=na, dtype=dtype)
+
+
+def tuning_cache_stamp():
+    """Identity of the tuning-cache state route resolutions depend on:
+    (path, mtime_ns, size) of the cache document, (path, None) when the
+    file is absent, or None when persistence is disabled. A probe run
+    rewrites the cache atomically (save_cache's os.replace), moving the
+    stamp — so memoized route resolutions invalidate exactly when the
+    measured decisions could change, and never sooner."""
+    p = tuning_cache_path()
+    if p is None:
+        return None
+    try:
+        st = p.stat()
+    except OSError:
+        return (str(p), None)
+    return (str(p), st.st_mtime_ns, st.st_size)
+
 
 def _record_decision(knob: str, choice: str, source: str, evidence: dict,
                      *, na: Optional[int], dtype) -> None:
@@ -325,9 +375,14 @@ def _record_decision(knob: str, choice: str, source: str, evidence: dict,
     dispatch.solve/sweep run carries exactly one decision per knob (the
     dedup set is cleared on ledger.activate entry). No active ledger ->
     no event, no counter — resolution stays free for library users who
-    opted into neither observability nor tuning."""
+    opted into neither observability nor tuning. An armed capture buffer
+    (capture_decisions) records the decision regardless, so memoized
+    resolutions can replay it into later activation scopes."""
     from aiyagari_tpu.diagnostics import ledger, metrics
 
+    if _decision_capture:
+        _decision_capture[-1].append((knob, choice, source, evidence, na,
+                                      dtype))
     led = ledger.active_ledger()
     if led is None:
         return

@@ -762,6 +762,166 @@ def bench_ge_batched(quick: bool, grid_size: int = 400, batch: int = 8) -> dict:
     }
 
 
+def bench_ge_fused(quick: bool, grid_size: int = 100, batch: int = 8) -> dict:
+    """One-program equilibrium (ISSUE 18 tentpole, equilibrium/fused.py):
+    the SAME bisection root solved with (a) the host outer loop — one
+    dispatch + fetch per candidate rate (equilibrium/bisection.py) — and
+    (b) the fused device loop — the whole bracket search inside one
+    compiled lax.while_loop, ONE dispatch and ONE device_get per
+    equilibrium. Three gated claims, one frozen record
+    (BENCH_r17_ge_fused.json, gated by tests/test_bench_ci.py):
+
+      wall_ratio_device_over_host <= 0.8 — the fused loop must beat the
+        host loop by erasing per-iteration dispatch/fetch latency (warm
+        walls, interleaved min-of-reps: the ratio discipline of
+        bench_precision's timed_pair);
+      r_agreement <= 1e-10 — both loops run the same bracket arithmetic,
+        so the equilibrium rate must match to round-off, not just to tol;
+      donation — the donate_argnums build's XLA peak-memory proxy
+        (argument + output + temp - alias bytes, memory_analysis()) must
+        sit STRICTLY below the undonated build's, and the donated warm
+        buffer must come back is_deleted() (the aliasing actually
+        happened; a silently-ignored donation shows up as equality).
+
+    The batched leg times the vmapped candidate round inside the same
+    program (solve_equilibrium_fused_batched) for the round-count story;
+    it shares the record but is not ratio-gated (B lanes of household
+    work per round trade wall for rounds by design)."""
+    import jax
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import EquilibriumConfig, SolverConfig
+    from aiyagari_tpu.equilibrium.bisection import solve_equilibrium_distribution
+    from aiyagari_tpu.equilibrium.fused import (
+        fused_ge_operands,
+        fused_ge_program,
+        solve_equilibrium_fused,
+        solve_equilibrium_fused_batched,
+    )
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+
+    if quick:
+        grid_size = min(grid_size, 100)
+    platform = jax.default_backend()
+    dtype = jnp.float32 if platform == "tpu" else jnp.float64
+    model = aiyagari_preset(grid_size=grid_size, dtype=dtype)
+    sv = SolverConfig(method="egm")
+    eq_tol = 1e-3
+    eq = EquilibriumConfig(max_iter=30, tol=eq_tol)
+    bat_eq = EquilibriumConfig(batch=batch, max_iter=10, tol=eq_tol)
+
+    def run_host():
+        return solve_equilibrium_distribution(model, solver=sv, eq=eq)
+
+    def run_device():
+        return solve_equilibrium_fused(model, solver=sv, eq=eq)
+
+    def run_batched():
+        return solve_equilibrium_fused_batched(model, solver=sv, eq=bat_eq)
+
+    # Warm EVERY path before timing: compiles, route caches, and the host
+    # loop's per-iteration program cache. Both loops fetch their scalars
+    # internally (one device_get for the fused paths) — self-fencing.
+    host, dev, bat = run_host(), run_device(), run_batched()
+    reps = 2 if quick else 4
+    best = [np.inf, np.inf, np.inf]
+    for _ in range(reps):
+        # Interleaved min-of-reps (bench_precision's timed_pair rationale):
+        # a RATIO gate needs both sides sampled under the same host drift.
+        for i, fn in enumerate((run_host, run_device, run_batched)):
+            t0 = time.perf_counter()
+            fn()
+            best[i] = min(best[i], time.perf_counter() - t0)
+    t_host, t_dev, t_bat = best
+
+    # Donation accounting: XLA's own memory analysis of the two builds of
+    # the IDENTICAL program. The proxy counts every buffer class the run
+    # must hold minus what aliasing reuses — the number donate_argnums
+    # exists to shrink.
+    def memory_of(donate: bool) -> dict:
+        fn = fused_ge_program(model, solver=sv, eq=eq, donate=donate)
+        mem = fn.lower(*fused_ge_operands(model, eq, solver=sv)).compile(
+        ).memory_analysis()
+        arg, out_b, tmp, alias = (
+            int(mem.argument_size_in_bytes), int(mem.output_size_in_bytes),
+            int(mem.temp_size_in_bytes), int(mem.alias_size_in_bytes))
+        return {"argument_bytes": arg, "output_bytes": out_b,
+                "temp_bytes": tmp, "alias_bytes": alias,
+                "peak_proxy_bytes": arg + out_b + tmp - alias}
+
+    mem_donated, mem_undonated = memory_of(True), memory_of(False)
+    ops = fused_ge_operands(model, eq, solver=sv)
+    warm_buf = ops[3]
+    jax.block_until_ready(
+        fused_ge_program(model, solver=sv, eq=eq, donate=True)(*ops)["sol"])
+    donated_input_deleted = bool(warm_buf.is_deleted())
+
+    # Roofline price of the measured device solve: one fused round at the
+    # run's MEAN inner-iteration counts (diagnostics/roofline.py), times
+    # the round count — the bench multiplies because rounds-per-solve is
+    # data-dependent (ge_fused_round_cost docstring).
+    from aiyagari_tpu.diagnostics.roofline import (
+        dtype_itemsize,
+        ge_fused_round_cost,
+    )
+
+    N, na = int(model.P.shape[0]), int(model.a_grid.shape[0])
+    mean_si = float(np.mean([r["solver_iterations"]
+                             for r in dev.per_iteration]) or 1.0)
+    mean_di = float(np.mean([r["distribution_iterations"]
+                             for r in dev.per_iteration]) or 1.0)
+    cost = int(dev.iterations) * ge_fused_round_cost(
+        N, na, dtype_itemsize(dtype), policy_sweeps=max(mean_si, 1.0),
+        dist_sweeps=max(mean_di, 1.0))
+
+    record = {
+        "metric": f"aiyagari_ge_fused_grid{grid_size}",
+        "value": round(t_dev, 4),
+        "unit": "seconds",
+        "vs_baseline": round(t_host / t_dev, 2),
+        "wall_ratio_device_over_host": round(t_dev / t_host, 4),
+        "baseline_seconds": round(t_host, 4),
+        "baseline_source": "host outer loop, same economy/tol (in-process)",
+        "batched_seconds": round(t_bat, 4),
+        "batch": batch,
+        "host_iterations": int(host.iterations),
+        "device_rounds": int(dev.iterations),
+        "batched_rounds": int(bat.iterations),
+        # Sequential device programs the host must schedule: the host loop
+        # launches (household solve + distribution) per iteration and
+        # fetches between them; each fused path is ONE program + ONE get.
+        "device_programs_host_loop": int(host.iterations) * 2,
+        "device_programs_fused": 1,
+        "r_host": round(float(host.r), 12),
+        "r_device": round(float(dev.r), 12),
+        "r_batched": round(float(bat.r), 12),
+        "r_agreement": abs(float(host.r) - float(dev.r)),
+        "r_agreement_batched": round(abs(float(host.r) - float(bat.r)), 10),
+        "host_converged": bool(host.converged),
+        "device_converged": bool(dev.converged),
+        "batched_converged": bool(bat.converged),
+        "memory_donated": mem_donated,
+        "memory_undonated": mem_undonated,
+        "donation_saves_bytes": (mem_undonated["peak_proxy_bytes"]
+                                 - mem_donated["peak_proxy_bytes"]),
+        "donated_input_deleted": donated_input_deleted,
+        "modeled_solve": {"mxu_flops": cost.mxu_flops,
+                          "vpu_ops": cost.vpu_ops,
+                          "hbm_bytes": cost.hbm_bytes,
+                          "mean_solver_iterations": round(mean_si, 2),
+                          "mean_distribution_iterations": round(mean_di, 2)},
+        "eq_tol": eq_tol,
+        "platform": platform,
+    }
+    # EVERY run (the ci preset included) freezes the round-17 artifact —
+    # the attribution/serve pattern: the ci battery IS the freeze.
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r17_ge_fused.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+    return record
+
+
 def bench_sweep(quick: bool, grid_size: int = 200) -> dict:
     """Scenario-sweep throughput (dispatch.sweep): S independent economies
     (a beta x sigma grid around the reference calibration) solved to GE as
@@ -3242,7 +3402,7 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--metric",
                     choices=["all", "vfi", "ks", "ks_large", "ks_fine",
-                             "scale", "scale_vfi", "ge", "sweep",
+                             "scale", "scale_vfi", "ge", "ge_fused", "sweep",
                              "transition", "accel", "precision",
                              "pushforward", "egm_fused", "telemetry",
                              "resilience", "mesh2d", "attribution",
@@ -3393,6 +3553,8 @@ def main() -> int:
         "scale_vfi": lambda: bench_scale(args.grid_scale, args.quick, "vfi",
                                          args.noise_floor_ulp, False),
         "ge": lambda: bench_ge_batched(args.quick),
+        "ge_fused": lambda: bench_ge_fused(args.quick,
+                                           min(args.grid, 100)),
         "sweep": lambda: bench_sweep(args.quick),
         "transition": lambda: bench_transition(args.quick),
         "accel": lambda: bench_accel(args.quick),
@@ -3431,14 +3593,15 @@ def main() -> int:
         # "analysis" last: it audits the same programs the battery just
         # exercised, and a perf metric dying mid-battery should not also
         # cost the static gate its record.
-        names = (("vfi", "scale", "ge", "sweep", "transition", "accel",
-                  "precision", "pushforward", "egm_fused", "telemetry",
-                  "resilience", "mesh2d", "attribution", "observatory",
-                  "serve", "amortized", "calibration", "analysis")
+        names = (("vfi", "scale", "ge", "ge_fused", "sweep", "transition",
+                  "accel", "precision", "pushforward", "egm_fused",
+                  "telemetry", "resilience", "mesh2d", "attribution",
+                  "observatory", "serve", "amortized", "calibration",
+                  "analysis")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
-        names = ("vfi", "ks", "ks_large", "scale", "ge", "sweep",
-                 "transition", "accel", "precision", "pushforward",
+        names = ("vfi", "ks", "ks_large", "scale", "ge", "ge_fused",
+                 "sweep", "transition", "accel", "precision", "pushforward",
                  "egm_fused", "telemetry", "resilience", "mesh2d",
                  "attribution", "observatory", "serve", "amortized",
                  "calibration", "ks_fine", "scale_vfi")

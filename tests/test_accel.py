@@ -244,6 +244,9 @@ class TestEGMParity:
                                        atol=band)
         assert int(sol.iterations) < int(plain.iterations)
 
+    @pytest.mark.slow  # ~9 s: the multiscale+accel wiring contract; the
+    # plain accel parity bands stay tier-1 here and the multiscale ladder's
+    # own mechanics in test_precision_ladder.
     def test_multiscale_ladder_accepts_accel(self):
         from aiyagari_tpu.solvers.egm import solve_aiyagari_egm_multiscale
 
@@ -261,6 +264,10 @@ class TestEGMParity:
 
 
 class TestShardedParity:
+    @pytest.mark.slow  # ~22 s: the labor variant below pins the same
+    # psum'd-normal-equations/pmax'd-safeguard sharded composition tier-1
+    # (strictly more machinery), and the unsharded accel parity stays in
+    # TestEGMParity.
     def test_sharded_accelerated_trajectory_matches_single_device(self):
         # Iterate-by-iterate equality of the ACCELERATED trajectory: the
         # psum'd normal equations/pmax'd safeguards must reproduce the

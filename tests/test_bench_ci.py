@@ -18,14 +18,20 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
                      "bench.py")
 
 # The ci battery's metric set (bench.py main): one record each, in order.
-CI_METRICS = ("vfi", "scale", "ge", "sweep", "transition", "accel",
-              "precision", "pushforward", "egm_fused", "telemetry",
+CI_METRICS = ("vfi", "scale", "ge", "ge_fused", "sweep", "transition",
+              "accel", "precision", "pushforward", "egm_fused", "telemetry",
               "resilience", "mesh2d", "attribution", "observatory",
               "serve", "amortized", "calibration", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     ledger_path = tmp_path / "bench_ledger.jsonl"
+    # Snapshot the round-14 serve knee BEFORE the battery refreezes the
+    # artifact in place — the keep-alive no-regress gate below needs the
+    # committed value, not this run's own.
+    with open(os.path.join(os.path.dirname(BENCH),
+                           "BENCH_r14_serve.json")) as f:
+        knee_before = json.load(f)["ramp"]["knee_rps"]
     out = subprocess.run(
         [sys.executable, BENCH, "--preset", "ci", "--ledger",
          str(ledger_path)],
@@ -48,6 +54,42 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
         # Tiny grids must never OOM-skip; every record carries a real value.
         assert "skipped" not in rec, f"ci metric skipped: {rec}"
         assert isinstance(rec.get("value"), (int, float)), rec
+    # The ge_fused record carries the ISSUE 18 acceptance telemetry: the
+    # one-program equilibrium. Three gates — the fused device loop beats
+    # the host outer loop (<= 0.8x wall, interleaved minima, so the
+    # ratio is drift-immune), both loops land on the SAME root to
+    # round-off (they run identical bracket arithmetic; 1e-10 is the
+    # acceptance band, the measurement is exact), and buffer donation
+    # demonstrably happened — XLA's peak-memory proxy for the donated
+    # build strictly below the undonated build of the identical program,
+    # with the donated warm buffer deleted after the call.
+    gf = records[-16]
+    assert gf["metric"].startswith("aiyagari_ge_fused")
+    assert gf["host_converged"] and gf["device_converged"], gf
+    assert gf["batched_converged"], gf
+    assert gf["wall_ratio_device_over_host"] <= 0.8, gf
+    assert gf["r_agreement"] <= 1e-10, gf
+    mem_d, mem_u = gf["memory_donated"], gf["memory_undonated"]
+    assert mem_d["alias_bytes"] > 0, gf
+    assert mem_d["peak_proxy_bytes"] < mem_u["peak_proxy_bytes"], gf
+    assert gf["donated_input_deleted"] is True, gf
+    # The structural win: ONE device program per equilibrium vs two
+    # sequential programs (+ fetches) per host iteration; the vmapped
+    # candidate round compresses the round count further.
+    assert gf["device_programs_fused"] == 1
+    assert gf["device_programs_host_loop"] == 2 * gf["host_iterations"]
+    assert gf["batched_rounds"] < gf["device_rounds"], gf
+    assert gf["modeled_solve"]["hbm_bytes"] > 0, gf
+    # The frozen artifact the ci battery owns (ISSUE 18 acceptance).
+    with open(os.path.join(os.path.dirname(BENCH),
+                           "BENCH_r17_ge_fused.json")) as f:
+        frozen_gf = json.load(f)
+    assert frozen_gf["metric"].startswith("aiyagari_ge_fused")
+    assert frozen_gf["wall_ratio_device_over_host"] <= 0.8
+    assert frozen_gf["r_agreement"] <= 1e-10
+    assert (frozen_gf["memory_donated"]["peak_proxy_bytes"]
+            < frozen_gf["memory_undonated"]["peak_proxy_bytes"])
+    assert frozen_gf["donated_input_deleted"] is True
     # The transition record carries the ISSUE 2 acceptance telemetry.
     tr = records[-14]
     assert tr["metric"].startswith("transition_newton")
@@ -373,6 +415,11 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert sv["slo_gate"]["met"] is True, sv
     assert sv["ramp"]["knee_rps"] is not None, sv
     assert sv["ramp"]["steps"][0]["slo_met"] is True, sv
+    # Keep-alive knee no-regress (ISSUE 18 satellite): with the pipelined
+    # worker and persistent HTTP connections in the serve path, the ramp's
+    # SLO knee must not fall below the committed round-14 value (the
+    # pre-battery snapshot — the battery refreezes the artifact in place).
+    assert sv["ramp"]["knee_rps"] >= knee_before, (sv["ramp"], knee_before)
     # The amortized record carries the ISSUE 16 acceptance telemetry: the
     # predictor ladder (hit -> blend -> surrogate -> anchor/anchor_warm)
     # drives the mixed-workload cold-solve fraction under 0.5; the

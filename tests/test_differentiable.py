@@ -159,6 +159,9 @@ def test_expectation_step_is_distribution_step_transpose(model, hh):
     assert abs(float(lhs - rhs)) < 1e-12
 
 
+@pytest.mark.slow  # ~16 s: the composite-moment FD sweep; the per-stage
+# gradient parities stay tier-1 above and the battery's calibration leg
+# re-gates grad-vs-FD (<1e-4) on every run (test_bench_ci).
 def test_steady_state_map_gradient_parity(model):
     from aiyagari_tpu.calibrate.economy import steady_state_map
     from aiyagari_tpu.calibrate.moments import moments_of
@@ -260,6 +263,10 @@ def test_fit_quarantines_nonfinite_lane():
     assert res.loss[0] < 1e-9
 
 
+@pytest.mark.slow  # ~15 s: the 2-lane dispatch.calibrate e2e; quarantine
+# and validation stay tier-1 here, and the battery's calibration leg
+# replants and recovers the full parameter vector on every run
+# (test_bench_ci gates recovery <1e-3).
 def test_dispatch_calibrate_recovers_self_targets():
     from aiyagari_tpu.calibrate.moments import model_moments
 

@@ -1,0 +1,322 @@
+"""One-program equilibrium tests (equilibrium/fused.py, ISSUE 18):
+
+* placement — resolve_ge_loop routes "auto" to the device loop exactly
+  where the fused program exists, and an explicit "device" on an
+  unsupported combination is loud, never a silent host fallback;
+* parity — the fused device bisection lands on the SAME equilibrium rate
+  as the host outer loop (both run identical bracket arithmetic on the
+  same excess-demand curve), for both solver families at two
+  calibrations, and the fused parallel-bracket loop matches the host
+  batched loop; the precision ladder's stage switches survive the fusion;
+* sentinel/nan — a poisoned solve exits the fused while_loop after ONE
+  round (|nan| >= tol is False — the AIYA107 contract) instead of
+  burning eq.max_iter device rounds, with and without a sentinel armed;
+* quarantine — a nan-poisoned candidate lane in the fused batched round
+  is masked and reported while every other lane's outputs stay BITWISE
+  equal to the clean round (vmapped lanes are independent);
+* donation — donate=True actually donates (the warm/mu operand buffers
+  come back deleted), donate=False does not, and a caller-owned warm
+  start survives a donated call (fused_ge_operands copies it — the serve
+  cache's entries must outlive the solve).
+
+Scale notes follow tests/test_batched_ge.py: 60-point/3-state economies,
+eq tol 1e-3 (the inner solves leave ~1e-4 supply noise), EGM for the gap
+criterion, VFI pinned on root location only.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+    IncomeProcess,
+    SentinelConfig,
+    SolverConfig,
+)
+from aiyagari_tpu.equilibrium.batched import solve_equilibrium_batched
+from aiyagari_tpu.equilibrium.bisection import solve_equilibrium_distribution
+from aiyagari_tpu.equilibrium.fused import (
+    fused_batched_round,
+    fused_ge_batched_operands,
+    fused_ge_batched_program,
+    fused_ge_operands,
+    fused_ge_program,
+    resolve_ge_loop,
+    solve_equilibrium_fused,
+    solve_equilibrium_fused_batched,
+)
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+
+CFG = AiyagariConfig(income=IncomeProcess(n_states=3),
+                     grid=GridSpecConfig(n_points=60))
+EQ_TOL = 1e-3
+SERIAL_EQ = EquilibriumConfig(max_iter=25, tol=EQ_TOL)
+BATCH_EQ = EquilibriumConfig(batch=8, max_iter=8, tol=EQ_TOL)
+# Shared solver configs: the fused builders cache compiled programs on the
+# static-knob tuple, so reusing these across tests (and calibrations —
+# sigma/beta enter as traced operands) keeps the module to a handful of
+# trace/compile passes.
+SV_EGM = SolverConfig(method="egm")
+SV_VFI = SolverConfig(method="vfi")
+
+
+@pytest.fixture(scope="module")
+def model():
+    return AiyagariModel.from_config(CFG, jnp.float64)
+
+
+def _model_at(beta):
+    prefs = dataclasses.replace(CFG.preferences, beta=beta)
+    return AiyagariModel.from_config(
+        dataclasses.replace(CFG, preferences=prefs), jnp.float64)
+
+
+class TestResolveGeLoop:
+    def test_auto_routes_device_where_supported(self):
+        sv = SolverConfig(ge_loop="auto")
+        assert resolve_ge_loop(sv, aggregation="distribution",
+                               endogenous_labor=False) == "device"
+        # Every unsupported leg falls back silently under "auto".
+        assert resolve_ge_loop(sv, aggregation="simulation",
+                               endogenous_labor=False) == "host"
+        assert resolve_ge_loop(sv, aggregation="distribution",
+                               endogenous_labor=True) == "host"
+        assert resolve_ge_loop(sv, aggregation="distribution",
+                               endogenous_labor=False,
+                               mesh=object()) == "host"
+
+    def test_host_is_always_host(self):
+        sv = SolverConfig(ge_loop="host")
+        assert resolve_ge_loop(sv, aggregation="distribution",
+                               endogenous_labor=False) == "host"
+
+    def test_explicit_device_on_unsupported_combo_is_loud(self):
+        sv = SolverConfig(ge_loop="device")
+        with pytest.raises(ValueError, match="PRNG panel"):
+            resolve_ge_loop(sv, aggregation="simulation",
+                            endogenous_labor=False)
+        with pytest.raises(ValueError, match="endogenous-labor"):
+            resolve_ge_loop(sv, aggregation="distribution",
+                            endogenous_labor=True)
+
+    def test_config_validates_the_knob(self):
+        with pytest.raises(ValueError, match="ge_loop"):
+            SolverConfig(ge_loop="gpu")
+
+
+class TestSerialParity:
+    @pytest.mark.parametrize("beta", [0.94, 0.96])
+    def test_egm_same_root_same_rounds(self, beta):
+        m = _model_at(beta)
+        ser = solve_equilibrium_distribution(m, solver=SV_EGM, eq=SERIAL_EQ)
+        dev = solve_equilibrium_fused(m, solver=SV_EGM, eq=SERIAL_EQ)
+        assert ser.converged and dev.converged
+        # Identical bracket arithmetic: every fused round's midpoint is the
+        # host round's midpoint, so the root matches to round-off (the
+        # ISSUE 18 acceptance band; measured exactly equal), not just tol.
+        assert abs(dev.r - ser.r) <= 1e-10
+        assert dev.iterations == ser.iterations
+        assert abs(dev.capital - ser.capital) < 1e-6
+        # Histories line up round for round.
+        np.testing.assert_allclose(dev.r_history, ser.r_history,
+                                   rtol=0, atol=1e-12)
+
+    @pytest.mark.parametrize("beta", [0.94, 0.96])
+    def test_vfi_same_root(self, beta):
+        # Discrete VFI cannot fire |gap| < tol at this grid (its excess
+        # demand steps by whole grid cells) — both loops burn max_iter and
+        # must localize the same jump point (test_batched_ge's pin).
+        m = _model_at(beta)
+        ser = solve_equilibrium_distribution(m, solver=SV_VFI, eq=SERIAL_EQ)
+        dev = solve_equilibrium_fused(m, solver=SV_VFI, eq=SERIAL_EQ)
+        assert abs(dev.r - ser.r) <= 1e-10
+        assert dev.iterations == ser.iterations
+
+    def test_ladder_stage_switch_parity(self, model):
+        # The mixed-precision ladder's stage switches live inside the inner
+        # while_loops; fusing the outer loop around them must not move the
+        # root (the ISSUE 18 "thread existing contracts" pin).
+        from aiyagari_tpu.ops.precision import default_ladder
+
+        sv = SolverConfig(method="egm", ladder=default_ladder())
+        ser = solve_equilibrium_distribution(model, solver=sv, eq=SERIAL_EQ)
+        dev = solve_equilibrium_fused(model, solver=sv, eq=SERIAL_EQ)
+        assert ser.converged and dev.converged
+        # Not the unladdered paths' exact agreement: the fused solves run
+        # grid_power=0 (module-docstring deviation) and under the ladder's
+        # f32 hot stage that inversion difference sits ABOVE the stage's
+        # sign-decision noise floor near the root, so one late bisection
+        # branch may differ (measured: one extra host round, |dr| ~ 1.4e-6
+        # — stage supply noise over the ~4e2 curve slope). Pin the band.
+        assert abs(dev.r - ser.r) <= 1e-4
+        assert abs(dev.iterations - ser.iterations) <= 1
+
+    def test_telemetry_ring_records_outer_gaps(self, model):
+        from aiyagari_tpu.config import TelemetryConfig
+
+        sv = SolverConfig(method="egm", telemetry=TelemetryConfig())
+        dev = solve_equilibrium_fused(model, solver=sv, eq=SERIAL_EQ)
+        assert dev.converged
+        assert dev.telemetry is not None
+        # The outer ring recorded one |gap| per round, ending below tol.
+        count = int(np.asarray(dev.telemetry.count))
+        assert count == dev.iterations
+        resid = np.asarray(dev.telemetry.residuals)[:count]
+        assert abs(resid[-1]) < EQ_TOL
+
+
+class TestBatchedParity:
+    def test_fused_batched_matches_host_batched(self, model):
+        host = solve_equilibrium_batched(model, solver=SV_EGM, eq=BATCH_EQ)
+        dev = solve_equilibrium_fused_batched(model, solver=SV_EGM,
+                                              eq=BATCH_EQ)
+        assert host.converged and dev.converged
+        # Same candidate placement, same sign-change shrink: same root.
+        assert abs(dev.r - host.r) <= 1e-10
+        assert dev.iterations == host.iterations
+        # Histories carry every candidate of every round.
+        assert len(dev.r_history) == dev.iterations * BATCH_EQ.batch
+        rec = dev.per_iteration[-1]
+        assert rec["best_r"] == dev.r
+        assert abs(rec["best_gap"]) < EQ_TOL
+        assert rec["quarantined"] == [False] * BATCH_EQ.batch
+
+    def test_batch_below_two_rejected(self, model):
+        with pytest.raises(ValueError, match="batch >= 2"):
+            fused_ge_batched_program(model,
+                                     eq=EquilibriumConfig(batch=1))
+
+
+class TestNanEarlyExit:
+    """A nan gap fails `|gap| >= tol`, so the fused while_loop exits after
+    the round that produced it — the host loop would burn its remaining
+    rounds re-bisecting on garbage (module docstring names the deviation;
+    AIYA107 requires the exit)."""
+
+    EQ = EquilibriumConfig(max_iter=10, tol=EQ_TOL)
+
+    def _poisoned_out(self, model, solver):
+        # Poison the DEMAND side (labor_raw -> capital_demand -> nan gap):
+        # a supply-side poison (nan sigma/warm) is sanitized by the
+        # distribution's mass guards into a finite zero-supply gap and
+        # keeps bisecting — only a genuinely nan gap exercises the exit.
+        fn = fused_ge_program(model, solver=solver, eq=self.EQ,
+                              dist_tol=1e-8, dist_max_iter=200,
+                              donate=False)
+        ops = list(fused_ge_operands(model, self.EQ, solver=solver))
+        ops[11] = jnp.asarray(jnp.nan, model.dtype)    # labor_raw
+        return fn(*ops)
+
+    def test_plain_loop_exits_after_one_round(self, model):
+        out = self._poisoned_out(model, SV_EGM)
+        assert int(out["it"]) == 1, "nan gap must exit the loop"
+        assert np.isnan(float(out["gap"]))
+
+    def test_sentinel_verdict_on_nan(self, model):
+        from aiyagari_tpu.diagnostics.sentinel import verdict_name
+
+        sv = SolverConfig(method="egm", sentinel=SentinelConfig())
+        out = self._poisoned_out(model, sv)
+        assert int(out["it"]) == 1
+        assert verdict_name(int(out["sent"].verdict)) == "nan"
+
+
+class TestQuarantineBitwise:
+    def test_poisoned_lane_leaves_neighbors_bitwise(self, model):
+        # One candidate round, one nan-poisoned lane: the mask quarantines
+        # exactly that lane, and — vmapped lanes being independent — every
+        # other lane's outputs match the clean round BIT FOR BIT.
+        sv = SolverConfig(method="egm", max_iter=400)
+        kw = dict(solver=sv, eq=EquilibriumConfig(batch=4),
+                  dist_tol=1e-8, dist_max_iter=400)
+        r_clean = np.array([0.005, 0.010, 0.015, 0.020])
+        r_poison = r_clean.copy()
+        r_poison[1] = np.nan
+        clean = fused_batched_round(model, r_clean, **kw)
+        pois = fused_batched_round(model, r_poison, **kw)
+        quar = np.asarray(pois["quarantined"])
+        assert quar.tolist() == [False, True, False, False]
+        assert np.isnan(float(pois["gap"][1]))
+        keep = [0, 2, 3]
+        for key in ("gap", "supply", "demand"):
+            np.testing.assert_array_equal(
+                np.asarray(pois[key])[keep], np.asarray(clean[key])[keep],
+                err_msg=key)
+        np.testing.assert_array_equal(np.asarray(pois["mu"])[keep],
+                                      np.asarray(clean["mu"])[keep])
+        np.testing.assert_array_equal(np.asarray(pois["warm"])[keep],
+                                      np.asarray(clean["warm"])[keep])
+
+
+class TestDonation:
+    def test_donated_operands_are_deleted(self, model):
+        fn = fused_ge_program(model, solver=SV_EGM, eq=SERIAL_EQ,
+                              donate=True)
+        ops = fused_ge_operands(model, SERIAL_EQ, solver=SV_EGM)
+        out = fn(*ops)
+        assert np.isfinite(float(out["r"]))
+        # The donated slots (warm, mu) gave their buffers to XLA.
+        assert ops[3].is_deleted()
+        assert ops[4].is_deleted()
+        # Undonated operands survive.
+        assert not ops[5].is_deleted()       # a_grid
+
+    def test_undonated_operands_survive(self, model):
+        fn = fused_ge_program(model, solver=SV_EGM, eq=SERIAL_EQ,
+                              donate=False)
+        ops = fused_ge_operands(model, SERIAL_EQ, solver=SV_EGM)
+        fn(*ops)
+        assert not ops[3].is_deleted()
+        assert not ops[4].is_deleted()
+
+    def test_caller_warm_start_survives_donation(self, model):
+        # The serve replay path: a cache-owned warm start must outlive the
+        # donated call (fused_ge_operands copies before donation).
+        warm = jnp.ones((model.P.shape[0], model.a_grid.shape[0]),
+                        model.dtype)
+        fn = fused_ge_program(model, solver=SV_EGM, eq=SERIAL_EQ,
+                              donate=True)
+        ops = fused_ge_operands(model, SERIAL_EQ, solver=SV_EGM,
+                                warm_start=warm)
+        fn(*ops)
+        assert ops[3].is_deleted()           # the copy was donated
+        assert not warm.is_deleted()         # the caller's buffer was not
+        assert float(warm[0, 0]) == 1.0
+
+    def test_batched_donation(self, model):
+        fn = fused_ge_batched_program(model, solver=SV_EGM, eq=BATCH_EQ,
+                                      donate=True)
+        ops = fused_ge_batched_operands(model, BATCH_EQ, solver=SV_EGM)
+        fn(*ops)
+        assert ops[2].is_deleted() and ops[3].is_deleted()
+
+
+class TestDispatchRouting:
+    def test_device_loop_matches_host_loop(self):
+        from aiyagari_tpu import solve
+
+        kw = dict(method="egm", aggregation="distribution",
+                  equilibrium=SERIAL_EQ, on_nonconvergence="ignore")
+        host = solve(CFG, solver=SolverConfig(method="egm", ge_loop="host"),
+                     **kw)
+        dev = solve(CFG, solver=SolverConfig(method="egm",
+                                             ge_loop="device"), **kw)
+        assert host.converged and dev.converged
+        assert abs(dev.r - host.r) <= 1e-10
+        assert dev.iterations == host.iterations
+
+    def test_explicit_device_on_simulation_is_loud(self):
+        from aiyagari_tpu import solve
+        from aiyagari_tpu.config import SimConfig
+
+        with pytest.raises(ValueError, match="ge_loop"):
+            solve(CFG, method="egm", aggregation="simulation",
+                  sim=SimConfig(periods=200, n_agents=4, discard=50),
+                  solver=SolverConfig(method="egm", ge_loop="device"),
+                  equilibrium=EquilibriumConfig(max_iter=4, tol=EQ_TOL))

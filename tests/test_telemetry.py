@@ -297,6 +297,9 @@ class _ListLedger:
 
 
 class TestShardedTelemetry:
+    @pytest.mark.slow  # ~19 s: the sharded flat-leaf recorder crossing's
+    # off-path stays tier-1 below, and the recorder-trajectory contract is
+    # pinned unsharded per family above.
     def test_sharded_recorder_matches_unsharded(self):
         from aiyagari_tpu.parallel.mesh import make_mesh
         from aiyagari_tpu.solvers.egm_sharded import solve_aiyagari_egm_sharded
