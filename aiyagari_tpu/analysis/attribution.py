@@ -139,6 +139,13 @@ def _model_prices() -> Dict[str, Tuple[Optional[Callable], Optional[float]]]:
         "equilibrium/ge_fused_sentinel": (None, None),
         "equilibrium/ge_fused_batched": (None, None),
         "transition/round": (None, None),
+        # Fused one-program transitions (transition/fused.py): same story —
+        # the whole MIT-shock Newton/damped round loop lives in one
+        # while_loop, rounds are data-dependent.  roofline.transition_fused
+        # _round_cost prices one ROUND for the bench; joined, never flagged.
+        "transition/fused": (None, None),
+        "transition/fused_sentinel": (None, None),
+        "transition/fused_sweep": (None, None),
         "ks/distribution_step": (None, None),
     }
 

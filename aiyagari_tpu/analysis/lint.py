@@ -102,6 +102,12 @@ _UNROLLED_SOLVER_ENTRYPOINTS = frozenset({
     # one trace — differentiating them unrolls EVERY outer round.
     "solve_equilibrium_fused", "solve_equilibrium_fused_batched",
     "fused_ge_program", "fused_ge_batched_program",
+    # The fused transition round loops (ISSUE 19): same rationale — the
+    # whole Newton/damped round loop lives in one while_loop trace, and
+    # path sensitivities come from the fake-news linearization
+    # (transition/jacobian.py), never from differentiating the loop.
+    "solve_transition_fused", "solve_transitions_sweep_fused",
+    "fused_transition_program", "fused_transition_sweep_program",
 })
 _AUTODIFF_OPERATORS = frozenset({
     "grad", "value_and_grad", "vjp", "jvp", "jacfwd", "jacrev", "hessian",

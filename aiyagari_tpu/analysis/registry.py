@@ -315,6 +315,41 @@ def _build_transition_round():
                 _f((_T + 1,)), _f((_T,)))
 
 
+def _build_transition_fused(telemetry=None, sentinel=None, sweep=False):
+    import jax.numpy as jnp
+
+    from aiyagari_tpu.config import MITShock, SolverConfig, TransitionConfig
+    from aiyagari_tpu.models.aiyagari import aiyagari_preset
+    from aiyagari_tpu.transition.fused import (
+        fused_transition_operands,
+        fused_transition_program,
+        fused_transition_sweep_operands,
+        fused_transition_sweep_program,
+    )
+
+    model = aiyagari_preset(grid_size=_NA, dtype=jnp.float64)
+    # Push-forward pinned to the scatter-free transpose form and
+    # donate=False, for the same reasons as _build_ge_fused: the audit
+    # re-executes one builder output across paired traces, and the
+    # AIYA101 verdict must not depend on the tracing host.
+    solver = SolverConfig(method="egm", tol=1e-6, max_iter=50,
+                          pushforward="transpose", telemetry=telemetry,
+                          sentinel=sentinel)
+    trans = TransitionConfig(T=_T, max_iter=4, tol=1e-6, method="newton")
+    if sweep:
+        shocks = [MITShock(size=-0.01), MITShock(size=-0.02)]
+        fn = fused_transition_sweep_program(model, len(shocks),
+                                            trans=trans, solver=solver,
+                                            donate=False)
+        args = fused_transition_sweep_operands(model, shocks, trans)
+    else:
+        fn = fused_transition_program(model, trans=trans, solver=solver,
+                                      donate=False)
+        args = fused_transition_operands(model, MITShock(size=-0.01),
+                                         trans)
+    return fn, args
+
+
 def _build_egm_vjp():
     import jax
     import jax.numpy as jnp
@@ -527,6 +562,31 @@ def _build_registry() -> List[ProgramSpec]:
         ProgramSpec(
             name="transition/round", family="transition",
             build_off=_build_transition_round,
+            scatter_free=True, stage_dtype="float64"),
+        # The one-program transitions (ISSUE 19 tentpole): the WHOLE
+        # MIT-shock solve — backward dated-EGM scan, forward push,
+        # excess demand, Newton/damped price-path update — inside one
+        # lax.while_loop. AIYA107 certifies the outer cond NaN-exits
+        # (max excess demand starts +inf; |NaN| >= tol is concretely
+        # False); AIYA101 that the convergence-history carry stays
+        # scatter-free (one-hot selects); AIYA104 that the telemetry
+        # ring is compiled out of the OFF trace. The sentinel variant
+        # audits the verdict-ANDed cond; the sweep entry wraps the
+        # vmapped lockstep round + quarantine mask in the same loop.
+        ProgramSpec(
+            name="transition/fused", family="transition",
+            build_off=partial(_build_transition_fused),
+            build_on=lambda: _build_transition_fused(telemetry=tele()),
+            scatter_free=True, stage_dtype="float64"),
+        ProgramSpec(
+            name="transition/fused_sentinel", family="transition",
+            build_off=lambda: _build_transition_fused(
+                sentinel=_sentinel_cfg())),
+        ProgramSpec(
+            name="transition/fused_sweep", family="transition",
+            build_off=lambda: _build_transition_fused(sweep=True),
+            build_on=lambda: _build_transition_fused(telemetry=tele(),
+                                                     sweep=True),
             scatter_free=True, stage_dtype="float64"),
         ProgramSpec(
             name="ks/distribution_step", family="ks",

@@ -564,6 +564,15 @@ class TransitionConfig:
     r <- (1-damping) r + damping * r_implied. tol bounds the max excess
     capital demand along the whole path (units of K, same as the stationary
     closure's |K_s - K_d| criterion).
+
+    loop places the round loop: "host" drives one path-evaluation program
+    per Newton/damped round from host (the parity reference); "device"
+    fuses the whole round loop into one lax.while_loop program
+    (transition/fused.py — one launch and one small fetch per solve) and
+    raises loudly where the fused program cannot express the solve
+    (endogenous labor, mesh-sharded sweeps, per-round callbacks); "auto"
+    picks "device" exactly where it is legal and falls back to "host"
+    elsewhere (the SolverConfig.ge_loop contract).
     """
 
     T: int = 200
@@ -571,6 +580,13 @@ class TransitionConfig:
     tol: float = 1e-6
     damping: float = 0.5
     method: str = "newton"
+    loop: str = "host"                # round-loop placement
+
+    def __post_init__(self):
+        if self.loop not in ("host", "device", "auto"):
+            raise ValueError(
+                f"TransitionConfig.loop must be 'host', 'device' or "
+                f"'auto', got {self.loop!r}")
 
 
 @dataclasses.dataclass(frozen=True)

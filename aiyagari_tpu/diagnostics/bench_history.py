@@ -113,6 +113,24 @@ SPECS = {
     "static_analysis_findings": (
         Check("value", "max_abs", band=1.0, floor=0.0),
     ),
+    "transition_fused": (
+        # One-program transitions (ISSUE 19). The device-over-host wall
+        # ratio is held at the 0.8 acceptance ceiling (the hard gate runs
+        # every ci battery in tests/test_bench_ci.py at the same
+        # threshold); the host/device price paths must keep agreeing to
+        # round-off; the r-path carry donation must keep actually
+        # happening; and the structural launch collapse (ONE program per
+        # solve) is a hard pin. The absolute wall rides the catastrophe
+        # band, sized by the record's geometry + round count.
+        Check("wall_ratio_device_over_host", "max_abs", band=1.0,
+              floor=0.8),
+        Check("r_agreement", "max_abs", band=1.0, floor=1e-10),
+        Check("donated_input_deleted", "bool"),
+        Check("device_converged", "bool"),
+        Check("device_programs_fused", "max_abs", band=1.0, floor=1.0),
+        Check("value", "wall", band=_WALL_BAND,
+              match=("grid", "T", "device_rounds")),
+    ),
     "serve_load": (
         # Structural: the regimes/ledger-trail/gauge surfaces must not
         # shrink, and the two acceptance ratios hold with bands wide

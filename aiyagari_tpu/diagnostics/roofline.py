@@ -44,6 +44,7 @@ __all__ = [
     "egm_sweep_cost",
     "egm_fused_sweep_cost",
     "ge_fused_round_cost",
+    "transition_fused_round_cost",
     "panel_step_cost",
     "utilization",
 ]
@@ -319,6 +320,35 @@ def ge_fused_round_cost(N: int, na: int, itemsize: int = 8, *,
                                                         route=route)
                 + KernelCost(0.0, 4.0 * N * na,
                              itemsize * 3.0 * N * na))
+    return max(batch, 1) * per_lane
+
+
+def transition_fused_round_cost(N: int, na: int, T: int, itemsize: int = 8,
+                                *, route: str = "transpose",
+                                batch: int = 1) -> KernelCost:
+    """One OUTER round of the fused one-program transition loop
+    (transition/fused.py): the backward dated-EGM scan is T single EGM
+    sweeps at the round's price path, the forward push is T distribution
+    push-forward sweeps, and the tail is the Newton step — a [T, T]
+    Jacobian-inverse matmul on the excess-demand vector (2*T*T MACs)
+    plus the O(T) price-path arithmetic (excess demand, sup-norm, damped
+    blend, clip — ~6 ops per period) streaming the [N, na] anchor pair
+    and the [T, T] inverse. `batch` scales every term for the vmapped
+    lockstep sweep (fused_transition_sweep_program), where S scenario
+    lanes run the same round; the hoisted jac_inv is shared, but the
+    model charges it per lane — at T << sqrt(N*na) the overcount is
+    noise against the sweeps.
+
+    Rounds-per-solve is data-dependent (the while_loop exits on the
+    traced sup-norm predicate), so this prices one ROUND; the bench
+    multiplies by the measured round count — attribution joins the fused
+    transition programs unpriced for exactly that reason
+    (attribution._model_prices)."""
+    per_lane = (T * egm_sweep_cost(N, na, itemsize)
+                + T * distribution_sweep_cost(N, na, itemsize, route=route)
+                + KernelCost(2.0 * T * T,
+                             4.0 * N * na + 6.0 * T,
+                             itemsize * (3.0 * N * na + T * T)))
     return max(batch, 1) * per_lane
 
 

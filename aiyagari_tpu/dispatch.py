@@ -1025,11 +1025,30 @@ def solve_transition(
                   T=transition.T):
         solver = _resolve_routes(solver, na=model.grid.n_points,
                                  dtype=_dtype_of(backend))
+        from aiyagari_tpu.transition.fused import resolve_transition_loop
+
+        t_loop = resolve_transition_loop(
+            transition, endogenous_labor=model.endogenous_labor,
+            on_iteration=kwargs.get("on_iteration"))
         with precision_scope(backend.dtype):
-            result = _solve(model, shock, trans=transition, solver=solver,
-                            eq=equilibrium, dtype=_dtype_of(backend),
-                            ladder=_transition_ladder(backend, solver),
-                            **kwargs)
+            if t_loop == "device":
+                from aiyagari_tpu.transition.fused import (
+                    solve_transition_fused,
+                )
+
+                # An explicit on_iteration=None routed here; the fused
+                # signature has no callback slot.
+                kwargs.pop("on_iteration", None)
+                result = solve_transition_fused(
+                    model, shock, trans=transition, solver=solver,
+                    eq=equilibrium, dtype=_dtype_of(backend),
+                    ladder=_transition_ladder(backend, solver), **kwargs)
+            else:
+                result = _solve(model, shock, trans=transition,
+                                solver=solver, eq=equilibrium,
+                                dtype=_dtype_of(backend),
+                                ladder=_transition_ladder(backend, solver),
+                                **kwargs)
     distance = (result.max_excess_history[-1]
                 if result.max_excess_history else float("inf"))
     _ledger_result(led, "MIT-shock transition path", result,
@@ -1132,13 +1151,30 @@ def sweep_transitions(
         _probe_skew(mesh, mesh_cfg, led)
         solver = _resolve_routes(solver, na=model.grid.n_points,
                                  dtype=_dtype_of(backend))
+        from aiyagari_tpu.transition.fused import resolve_transition_loop
+
+        t_loop = resolve_transition_loop(
+            transition, endogenous_labor=model.endogenous_labor,
+            mesh=mesh, on_iteration=kwargs.get("on_iteration"))
         with precision_scope(backend.dtype):
-            result = _sweep(model, shocks_run, trans=transition,
-                            solver=solver, eq=equilibrium, mesh=mesh,
-                            dtype=_dtype_of(backend),
-                            ladder=_transition_ladder(backend, solver),
-                            quarantine=quarantine,
-                            **kwargs)
+            if t_loop == "device":
+                from aiyagari_tpu.transition.fused import (
+                    solve_transitions_sweep_fused,
+                )
+
+                kwargs.pop("on_iteration", None)
+                result = solve_transitions_sweep_fused(
+                    model, shocks_run, trans=transition, solver=solver,
+                    eq=equilibrium, dtype=_dtype_of(backend),
+                    ladder=_transition_ladder(backend, solver),
+                    quarantine=quarantine, **kwargs)
+            else:
+                result = _sweep(model, shocks_run, trans=transition,
+                                solver=solver, eq=equilibrium, mesh=mesh,
+                                dtype=_dtype_of(backend),
+                                ladder=_transition_ladder(backend, solver),
+                                quarantine=quarantine,
+                                **kwargs)
     import numpy as _np
 
     result.shocks = list(shocks)

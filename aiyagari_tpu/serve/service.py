@@ -111,7 +111,10 @@ class ServeConfig:
                                        # against)
     solver: Optional[SolverConfig] = None
     equilibrium: EquilibriumConfig = EquilibriumConfig()
-    transition: TransitionConfig = TransitionConfig()
+    # loop="auto": coalesced transition batches and anchor-warm solves
+    # lower through the fused one-program round loop wherever it is legal
+    # (transition/fused.py via dispatch routing), host elsewhere.
+    transition: TransitionConfig = TransitionConfig(loop="auto")
 
     def __post_init__(self):
         if self.method not in ("vfi", "egm"):

@@ -1,0 +1,911 @@
+"""One-program transitions: the WHOLE MIT-shock solve as one XLA program.
+
+The host round loop (transition/mit.py) pays one program launch plus one
+host sync per Newton round: every round launches the backward dated-EGM
+scan + forward push program, fetches the aggregates, and applies the
+Newton/damped update on host. At the ci calibration that is ~4-5 launches
+and ~4-5 device->host syncs per solve — and coalesced transition batches
+are the single most expensive serve workload (BENCH_r14_serve.json), so
+that dispatch overhead IS the wall the serve knee sits on.
+
+This module moves the round loop into the program: the backward
+`lax.scan` over dated EGM steps, the forward distribution push, the
+per-round max excess demand, and the price-path update all live inside
+ONE `lax.while_loop` carry, so an entire transition is one device
+program launch and one small device_get. Two shapes:
+
+  * solve_transition_fused — the serial Newton/damped-BKM round loop in
+    the carry. Each loop round evaluates the carried candidate path
+    (backward_policies + forward_capital, the exact per-round program the
+    host loop launches), forms the excess demand against the firm FOC,
+    and updates the path: Newton applies the PRECOMPUTED fake-news
+    Jacobian inverse as one [T, T] @ [T] MXU matmul in the carry —
+    `np.linalg.solve` has no in-loop analogue, so the factorization is
+    hoisted to the host once per solve (J is round-invariant: it is the
+    steady-state linearization) and the loop pays a matmul, not a solve.
+    The damped-BKM update is the same `(1-damping) r + damping r_implied`
+    convex combination as the host loop.
+
+  * solve_transitions_sweep_fused — the lockstep scenario round of
+    solve_transitions_sweep, fused: the vmapped backward+forward batch
+    evaluation, the per-lane excess demand, the quarantine mask for
+    non-finite lanes, and the masked Newton/damped update (`jnp.where`
+    selects, converged/quarantined lanes pinned) all run inside the same
+    while_loop. Healthy lanes stay BITWISE identical to a clean fused
+    sweep of the same batch shape (vmapped lanes are independent), the
+    quarantine pin tests/test_fused_transition.py holds.
+
+Contracts threaded through the fusion (ISSUE 19, the PR 18 discipline):
+
+  * AIYA107 nan-exit — the serial cond reads the carried max excess
+    (init +inf: round one must run, and a NaN round concretely fails
+    `max_d >= thr`); the sweep's final-stage cond reads only bool/int
+    carries (NaN lanes are quarantined IN THE BODY before the cond sees
+    them), and its hot-stage cond's live-lane max is NaN-poisoned to
+    False exactly like the serial cond.
+  * AIYA101 scatter-free — per-round history records are one-hot
+    `jnp.where(iota == it, ...)` selects, never `.at[]` scatters.
+  * sentinel / telemetry — the carry threads the in-program residual
+    ring and failure sentinel (telemetry_init/record, sentinel_update/
+    cond) so the audited artifacts match the GE fused programs.
+  * buffer donation — the candidate rate path (and the sweep's [S, T]
+    twin), the terminal-policy anchor, and the initial-distribution
+    anchor are `donate_argnums`; the anchors are CACHED device arrays
+    (_StageAnchors), so the solve wrappers defensively copy them before
+    every donated call (the fused-GE warm-start contract).
+
+Host-vs-device placement is the TransitionConfig.loop knob, routed by
+dispatch.solve_transition / dispatch.sweep_transitions via
+resolve_transition_loop; the host loops stay the parity reference
+(tests/test_fused_transition.py pins serial fused-vs-host r-path parity
+at <= 1e-10 for unladdered Newton).
+
+Known (documented) deviations from the host reference:
+
+  * Ladder stages chain one while_loop program PER stage dtype (the
+    switch threshold `max(tol, switch_ulp * eps(hot) * max|K|)` lives in
+    the hot-stage cond); the Newton/damped update of a HOT round runs in
+    the hot dtype, where the host loop updates in f64. Convergence is
+    still only certified from a final-dtype evaluation against tol, so
+    the certificate matches — the hot-path difference is below the
+    switch threshold by construction (the ladder band the parity test
+    documents).
+  * The host sentinel's stall/explosion verdicts use the trailing-window
+    host_verdict rule; the fused loop carries the in-program sentinel
+    (diagnostics/sentinel.py) instead. The "nan" verdict — the one the
+    rescue ladder keys on — is pinned identical.
+  * An all-lanes-quarantined hot sweep stage stops immediately; the host
+    loop burns one more (pinned, no-op) evaluation in the wider dtype.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import lru_cache
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    MITShock,
+    SolverConfig,
+    TransitionConfig,
+)
+from aiyagari_tpu.diagnostics.sentinel import (
+    VERDICT_NAMES,
+    sentinel_cond,
+    sentinel_init,
+    sentinel_update,
+)
+from aiyagari_tpu.diagnostics.telemetry import telemetry_init, telemetry_record
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.transition.mit import (
+    _R_CEIL,
+    TransitionResult,
+    TransitionSweepResult,
+    _as_model,
+    _check_anchor,
+    _check_trans,
+    _device_paths,
+    _egm_kernel_of,
+    _pushforward_of,
+    _round_telemetry,
+    _stage_dtype_names,
+    _stage_matmul_precision,
+    _StageAnchors,
+    shock_paths,
+    stationary_anchor,
+    transition_jacobian,
+)
+from aiyagari_tpu.transition.path import (
+    backward_policies,
+    forward_capital,
+    transition_path,
+)
+from aiyagari_tpu.utils.firm import capital_demand, r_from_capital, wage_from_r
+
+__all__ = [
+    "resolve_transition_loop",
+    "fused_transition_knobs",
+    "fused_transition_program",
+    "fused_transition_operands",
+    "solve_transition_fused",
+    "fused_transition_sweep_program",
+    "fused_transition_sweep_operands",
+    "solve_transitions_sweep_fused",
+]
+
+# Donated slots in the fused program signatures: the candidate rate path
+# and the two [N, na] anchor operands (terminal policy, initial
+# distribution). The anchors are loop-INVARIANT (every round restarts the
+# backward scan from the stationary policy), so XLA mainly cashes in the
+# rate-path carry alias; donation still deletes all three argument
+# buffers, which is why the solve wrappers copy the cached anchors.
+_DONATE_SERIAL = (0, 1, 2)   # (R0, C_TERM, MU0, ...model/path operands)
+_DONATE_SWEEP = (0, 3, 4)    # (R0, conv0, quar0, C_TERM, MU0, ...)
+
+
+def resolve_transition_loop(trans: TransitionConfig, *,
+                            endogenous_labor: bool, mesh=None,
+                            on_iteration=None) -> str:
+    """Resolve TransitionConfig.loop to a concrete placement.
+
+    "auto" picks "device" exactly where the fused program exists —
+    exogenous labor, no scenario mesh, no per-round host callback — and
+    falls back to "host" elsewhere. An EXPLICIT "device" on an
+    unsupported combo is loud (the resolve_ge_loop contract), never a
+    silent host fallback. egm_kernel='pallas_inverse' is rejected for
+    EVERY transition path by _egm_kernel_of (the windowed route's
+    host-escape-retry cannot ride the dated scan), so it needs no case
+    here.
+    """
+    loop = getattr(trans, "loop", "host")
+    if loop == "host":
+        return "host"
+    supported = (not endogenous_labor and mesh is None
+                 and on_iteration is None)
+    if loop == "auto":
+        return "device" if supported else "host"
+    if not supported:
+        why = ("the endogenous-labor families are host-loop only"
+               if endogenous_labor else
+               "mesh-sharded sweeps keep the host lockstep loop "
+               "(per-shard placement)"
+               if mesh is not None else
+               "per-round on_iteration callbacks need the host loop "
+               "(one program per round)")
+        raise ValueError(
+            f"TransitionConfig(loop='device') is unsupported here: {why}; "
+            "use loop='auto' to fall back to the host loop")
+    return "device"
+
+
+def fused_transition_knobs(model: AiyagariModel, trans: TransitionConfig,
+                           solver: Optional[SolverConfig] = None, *,
+                           matmul_precision: str = "highest",
+                           floor_scale: float = 0.0):
+    """The static-knob tuple the fused program builders destructure (the
+    fused_knobs idiom). floor_scale > 0 marks a HOT ladder stage: the
+    cond's threshold becomes max(tol, floor_scale * max|K_ts|) — the
+    host loop's error-controlled switch criterion — and floor_scale is
+    switch_ulp * eps(stage dtype), a static per-stage constant."""
+    tech = model.config.technology
+    return (
+        int(trans.T), int(trans.max_iter), float(trans.tol),
+        float(trans.damping), str(trans.method),
+        float(tech.alpha), float(tech.delta),
+        _pushforward_of(solver), _egm_kernel_of(solver),
+        str(matmul_precision), float(floor_scale),
+        solver.telemetry if solver is not None else None,
+        solver.sentinel if solver is not None else None,
+    )
+
+
+def _round_closure(knobs: tuple, *, batched: bool):
+    """(eval_paths, update) closures over the static knobs: one round's
+    path evaluation (the exact backward+forward program the host loop
+    launches) and the Newton/damped path update."""
+    (T, _max_iter, _tol, damping, method, alpha, delta, pushforward,
+     egm_kernel, matmul_precision, _floor_scale, _tele, _sent) = knobs
+    from aiyagari_tpu.ops.pushforward import resolve_backend
+
+    pushforward = resolve_backend(pushforward, batched=batched)
+
+    def eval_paths(r_ext, w, beta, sigma_ext, amin, C_term, mu0, a_grid,
+                   s, P):
+        _, k_ts = backward_policies(
+            C_term, a_grid, s, P, r_ext, w, beta, sigma_ext, amin,
+            matmul_precision=matmul_precision, egm_kernel=egm_kernel)
+        return forward_capital(mu0, k_ts, a_grid, P,
+                               pushforward=pushforward)
+
+    def update(r, K_head, D, z, labor_raw, jac_inv):
+        if method == "newton":
+            # The hoisted-factorization Newton step: J^{-1} applied as a
+            # matmul in the carry (module docstring). Serial: [T,T]@[T];
+            # sweep: [S,T]@[T,T]^T — the host loop's solve(J, D.T).T.
+            step = (jac_inv @ D if D.ndim == 1 else D @ jac_inv.T)
+            upd = r - step
+        else:
+            r_implied = r_from_capital(jnp.maximum(K_head, 1e-10),
+                                       labor_raw, alpha, delta, z)
+            upd = (1.0 - damping) * r + damping * r_implied
+        return jnp.clip(upd, -delta + 1e-3, _R_CEIL)
+
+    return eval_paths, update
+
+
+@lru_cache(maxsize=None)
+def _fused_transition(knobs: tuple, donate: bool):
+    """Build + jit the serial fused transition round loop. Cache key =
+    everything that changes the traced program plus the donation split —
+    the donated and undonated twins are distinct executables."""
+    (T, max_iter, tol, _damping, method, alpha, delta, _pf, _ek,
+     _mp, floor_scale, telemetry_cfg, sentinel_cfg) = knobs
+    eval_paths, update = _round_closure(knobs, batched=False)
+
+    def _solve(r0, C_term0, mu0, a_grid, s, P, z, beta, sigma_ext, amin,
+               labor_raw, r_ss, rounds_left, jac_inv):
+        dt = a_grid.dtype
+        iota = jnp.arange(max_iter, dtype=jnp.int32)
+
+        carry = {
+            # "cand" is the path the NEXT round evaluates; "r" the path
+            # the LAST round evaluated — the round-cap consistency rule
+            # (a never-evaluated update must not pair with this round's
+            # aggregates) falls out of returning "r".
+            "cand": r0,
+            "r": r0,
+            # +inf, not 0/nan: round one must run (inf >= thr) and a
+            # nan-poisoned round must FAIL the cond (nan >= thr is
+            # False) — the AIYA107 nan-early-exit contract.
+            "max_d": jnp.asarray(jnp.inf, dt),
+            # zeros, not nan: the hot-stage cond reads max|K| for its
+            # switch floor, and round one must see a finite threshold.
+            "K": jnp.zeros((T + 1,), dt),
+            "A": jnp.zeros((T,), dt),
+            "D": jnp.zeros((T,), dt),
+            "mu": mu0,
+            "it": jnp.asarray(0, jnp.int32),
+            "hist": jnp.full((max_iter,), jnp.nan, dt),
+            "tele": telemetry_init(telemetry_cfg),
+            "sent": sentinel_init(sentinel_cfg),
+        }
+
+        def cond(c):
+            if floor_scale:
+                # Hot ladder stage: the host loop's error-controlled
+                # switch — stop when the residual reaches the hot
+                # dtype's noise floor in units of K.
+                thr = jnp.maximum(jnp.asarray(tol, dt),
+                                  floor_scale * jnp.max(jnp.abs(c["K"])))
+            else:
+                thr = jnp.asarray(tol, dt)
+            base = (c["max_d"] >= thr) & (c["it"] < rounds_left)
+            return sentinel_cond(c["sent"], base)
+
+        def body(c):
+            r = c["cand"]
+            w = wage_from_r(r, alpha, delta, z)
+            r_ext = jnp.concatenate([r, r_ss[None]])
+            K_ts, A_ts, mu_T = eval_paths(r_ext, w, beta, sigma_ext, amin,
+                                          C_term0, mu0, a_grid, s, P)
+            D = K_ts[:T] - capital_demand(r, labor_raw, alpha, delta, z)
+            max_d = jnp.max(jnp.abs(D))
+            cand = update(r, K_ts[:T], D, z, labor_raw, jac_inv)
+            # History writes as one-hot selects, not .at[] scatters —
+            # the fused program stays scatter-free (AIYA101).
+            sel = iota == c["it"]
+            return {
+                "cand": cand,
+                "r": r,
+                "max_d": max_d,
+                "K": K_ts,
+                "A": A_ts,
+                "D": D,
+                "mu": mu_T,
+                "it": c["it"] + 1,
+                "hist": jnp.where(sel, max_d, c["hist"]),
+                "tele": telemetry_record(c["tele"], max_d),
+                "sent": sentinel_update(c["sent"], max_d,
+                                        config=sentinel_cfg),
+            }
+
+        return lax.while_loop(cond, body, carry)
+
+    if method == "newton":
+        def program(r0, C_term0, mu0, a_grid, s, P, z, beta, sigma_ext,
+                    amin, labor_raw, r_ss, rounds_left, jac_inv):
+            return _solve(r0, C_term0, mu0, a_grid, s, P, z, beta,
+                          sigma_ext, amin, labor_raw, r_ss, rounds_left,
+                          jac_inv)
+    else:
+        def program(r0, C_term0, mu0, a_grid, s, P, z, beta, sigma_ext,
+                    amin, labor_raw, r_ss, rounds_left):
+            return _solve(r0, C_term0, mu0, a_grid, s, P, z, beta,
+                          sigma_ext, amin, labor_raw, r_ss, rounds_left,
+                          None)
+
+    donate_argnums = _DONATE_SERIAL if donate else ()
+    return jax.jit(program, donate_argnums=donate_argnums)
+
+
+@lru_cache(maxsize=None)
+def _fused_transition_sweep(knobs: tuple, S: int, quarantine: bool,
+                            donate: bool):
+    """Build + jit the lockstep fused scenario sweep: the vmapped
+    backward+forward batch round INSIDE the while_loop, quarantine lanes
+    masked by select. With quarantine=False the carry threads a "bad"
+    flag instead — any non-finite lane exits the loop and the host
+    wrapper raises the historical all-or-nothing FloatingPointError."""
+    (T, max_iter, tol, _damping, method, alpha, delta, _pf, _ek,
+     _mp, floor_scale, telemetry_cfg, sentinel_cfg) = knobs
+    eval_paths, update = _round_closure(knobs, batched=True)
+    final_stage = not floor_scale
+
+    def _solve(r0, conv0, quar0, C_term0, mu0, a_grid, s, P, z_s, beta_s,
+               sig_ext_s, amin_s, labor_raw, r_ss, rounds_left, jac_inv):
+        dt = a_grid.dtype
+        iota = jnp.arange(max_iter, dtype=jnp.int32)
+
+        def lane(r_ext, w, beta, sig_ext, amin):
+            K_ts, _, _ = eval_paths(r_ext, w, beta, sig_ext, amin,
+                                    C_term0, mu0, a_grid, s, P)
+            return K_ts
+
+        batch_eval = jax.vmap(lane)
+
+        carry = {
+            "cand": r0,
+            "r": r0,
+            "max_d": jnp.full((S,), jnp.inf, dt),
+            "K": jnp.zeros((S, T + 1), dt),
+            "conv": conv0,
+            "quar": quar0,
+            "it": jnp.asarray(0, jnp.int32),
+            "hist": jnp.full((max_iter,), jnp.nan, dt),
+            "tele": telemetry_init(telemetry_cfg),
+            "sent": sentinel_init(sentinel_cfg),
+        }
+        if not quarantine:
+            carry["bad"] = jnp.asarray(False)
+
+        def cond(c):
+            base = (~jnp.all(c["conv"] | c["quar"])
+                    & (c["it"] < rounds_left))
+            if not quarantine:
+                base = base & ~c["bad"]
+            if floor_scale:
+                # Global hot-stage switch over the LIVE lanes (the host
+                # loop's criterion); a NaN live lane poisons live_max and
+                # concretely fails the cond — the AIYA107 contract, and
+                # exactly the host loop's skip-the-switch behavior.
+                live = ~c["quar"]
+                live_max = jnp.max(jnp.where(live, c["max_d"], 0.0))
+                kmax = jnp.max(jnp.where(live[:, None],
+                                         jnp.abs(c["K"]), 0.0))
+                thr = jnp.maximum(jnp.asarray(tol, dt),
+                                  floor_scale * kmax)
+                base = base & (live_max >= thr)
+            return sentinel_cond(c["sent"], base)
+
+        def body(c):
+            r = c["cand"]
+            w_s = wage_from_r(r, alpha, delta, z_s)
+            r_ext_s = jnp.concatenate(
+                [r, jnp.broadcast_to(r_ss, (S, 1)).astype(dt)], axis=1)
+            K_s = batch_eval(r_ext_s, w_s, beta_s, sig_ext_s, amin_s)
+            D = K_s[:, :T] - capital_demand(r, labor_raw, alpha, delta,
+                                            z_s)
+            max_d = jnp.max(jnp.abs(D), axis=1)
+            if quarantine:
+                # Freeze newly-diverged lanes: paths pinned, updates
+                # masked, excluded from the all-converged check.
+                quar = c["quar"] | (~jnp.isfinite(max_d) & ~c["conv"])
+            else:
+                quar = c["quar"]
+            live = ~quar
+            live_max = jnp.max(jnp.where(live, max_d, 0.0))
+            conv = c["conv"]
+            if final_stage:
+                # Only final-dtype evaluations certify convergence.
+                conv = conv | (jnp.isfinite(max_d) & (max_d < tol) & live)
+            cand = update(r, K_s[:, :T], D, z_s, labor_raw, jac_inv)
+            # A quarantined lane's step is NaN; the mask pins its path,
+            # so the NaN never reaches the carried candidate.
+            cand = jnp.where((conv | quar)[:, None], r, cand)
+            sel = iota == c["it"]
+            out = {
+                "cand": cand,
+                "r": r,
+                "max_d": max_d,
+                "K": K_s,
+                "conv": conv,
+                "quar": quar,
+                "it": c["it"] + 1,
+                "hist": jnp.where(sel, live_max, c["hist"]),
+                "tele": telemetry_record(c["tele"], live_max),
+                "sent": sentinel_update(c["sent"], live_max,
+                                        config=sentinel_cfg),
+            }
+            if not quarantine:
+                out["bad"] = c["bad"] | jnp.any(~jnp.isfinite(max_d))
+            return out
+
+        return lax.while_loop(cond, body, carry)
+
+    if method == "newton":
+        def program(r0, conv0, quar0, C_term0, mu0, a_grid, s, P, z_s,
+                    beta_s, sig_ext_s, amin_s, labor_raw, r_ss,
+                    rounds_left, jac_inv):
+            return _solve(r0, conv0, quar0, C_term0, mu0, a_grid, s, P,
+                          z_s, beta_s, sig_ext_s, amin_s, labor_raw,
+                          r_ss, rounds_left, jac_inv)
+    else:
+        def program(r0, conv0, quar0, C_term0, mu0, a_grid, s, P, z_s,
+                    beta_s, sig_ext_s, amin_s, labor_raw, r_ss,
+                    rounds_left):
+            return _solve(r0, conv0, quar0, C_term0, mu0, a_grid, s, P,
+                          z_s, beta_s, sig_ext_s, amin_s, labor_raw,
+                          r_ss, rounds_left, None)
+
+    donate_argnums = _DONATE_SWEEP if donate else ()
+    return jax.jit(program, donate_argnums=donate_argnums)
+
+
+def fused_transition_program(model: AiyagariModel, *,
+                             trans: TransitionConfig = TransitionConfig(),
+                             solver: Optional[SolverConfig] = None,
+                             matmul_precision: str = "highest",
+                             floor_scale: float = 0.0,
+                             donate: bool = False):
+    """The compiled serial fused-transition entry for `model`'s static
+    geometry. Call with fused_transition_operands(...); donate=True hands
+    the rate-path/anchor argument buffers to XLA (the caller must not
+    reuse them)."""
+    if model.config.endogenous_labor:
+        raise ValueError(
+            "the fused transition loop supports exogenous labor only; "
+            "use loop='host' (resolve_transition_loop routes this)")
+    knobs = fused_transition_knobs(model, trans, solver,
+                                   matmul_precision=matmul_precision,
+                                   floor_scale=floor_scale)
+    return _fused_transition(knobs, bool(donate))
+
+
+def fused_transition_sweep_program(model: AiyagariModel, S: int, *,
+                                   trans: TransitionConfig =
+                                   TransitionConfig(),
+                                   solver: Optional[SolverConfig] = None,
+                                   matmul_precision: str = "highest",
+                                   floor_scale: float = 0.0,
+                                   quarantine: bool = True,
+                                   donate: bool = False):
+    """The compiled lockstep fused-sweep entry for S scenarios."""
+    if model.config.endogenous_labor:
+        raise ValueError(
+            "the fused transition sweep supports exogenous labor only; "
+            "use loop='host' (resolve_transition_loop routes this)")
+    knobs = fused_transition_knobs(model, trans, solver,
+                                   matmul_precision=matmul_precision,
+                                   floor_scale=floor_scale)
+    return _fused_transition_sweep(knobs, int(S), bool(quarantine),
+                                   bool(donate))
+
+
+def fused_transition_operands(model: AiyagariModel, shock: MITShock,
+                              trans: TransitionConfig, *,
+                              ss=None, jac_inv=None, r_path=None,
+                              rounds_left: Optional[int] = None,
+                              dtype=None):
+    """Operand tuple for fused_transition_program. With `ss` the anchors
+    are the stationary terminal policy / initial distribution (COPIED, so
+    a donated call cannot delete the cached arrays); without, synthetic
+    anchors seed a trace-only call (the registry audit's use). jac_inv
+    defaults to the identity for trace-only Newton builds."""
+    dt = jnp.dtype(model.dtype if dtype is None else dtype)
+    T = int(trans.T)
+    paths = shock_paths(model, shock, T)
+    N, na = model.P.shape[0], model.a_grid.shape[0]
+    if ss is not None:
+        r_ss = float(ss.r)
+        C_term = jnp.array(ss.solution.policy_c, dtype=dt, copy=True)
+        mu0 = jnp.array(ss.mu, dtype=dt, copy=True)
+    else:
+        r_ss = 0.03
+        from aiyagari_tpu.solvers.egm import initial_consumption_guess
+
+        tech = model.config.technology
+        C_term = jnp.asarray(initial_consumption_guess(
+            model.a_grid, model.s, r_ss,
+            wage_from_r(r_ss, tech.alpha, tech.delta)), dt)
+        mu0 = jnp.full((N, na), 1.0 / (N * na), dt)
+    r0 = (jnp.full((T,), r_ss, dt) if r_path is None
+          else jnp.array(r_path, dtype=dt, copy=True))
+    sig_ext = np.concatenate([paths["sigma"],
+                              [model.preferences.sigma]])
+    sc = lambda x: jnp.asarray(x, dt)
+    ops = (r0, C_term, mu0, jnp.asarray(model.a_grid, dt),
+           jnp.asarray(model.s, dt), jnp.asarray(model.P, dt),
+           sc(paths["z"]), sc(paths["beta"]), sc(sig_ext),
+           sc(paths["amin"]), sc(model.labor_raw), sc(r_ss),
+           jnp.asarray(trans.max_iter if rounds_left is None
+                       else rounds_left, jnp.int32))
+    if trans.method == "newton":
+        ops = ops + (jnp.asarray(np.eye(T) if jac_inv is None else jac_inv,
+                                 dt),)
+    return ops
+
+
+def fused_transition_sweep_operands(model: AiyagariModel,
+                                    shocks: Sequence[MITShock],
+                                    trans: TransitionConfig, *,
+                                    ss=None, jac_inv=None,
+                                    dtype=None):
+    """Operand tuple for fused_transition_sweep_program (trace/bench use;
+    solve_transitions_sweep_fused assembles per-stage operands itself)."""
+    dt = jnp.dtype(model.dtype if dtype is None else dtype)
+    T = int(trans.T)
+    S = len(shocks)
+    serial = fused_transition_operands(model, shocks[0], trans, ss=ss,
+                                       jac_inv=jac_inv, dtype=dtype)
+    all_paths = [shock_paths(model, sh, T) for sh in shocks]
+    stacked = {k: np.stack([p[k] for p in all_paths])
+               for k in ("z", "beta", "sigma", "amin")}
+    sig_ext_s = np.concatenate(
+        [stacked["sigma"], np.full((S, 1), model.preferences.sigma)],
+        axis=1)
+    sc = lambda x: jnp.asarray(x, dt)
+    r0 = jnp.broadcast_to(serial[0], (S, T)).copy()
+    ops = (r0, jnp.zeros((S,), bool), jnp.zeros((S,), bool),
+           serial[1], serial[2], serial[3], serial[4], serial[5],
+           sc(stacked["z"]), sc(stacked["beta"]), sc(sig_ext_s),
+           sc(stacked["amin"]), serial[10], serial[11], serial[12])
+    if trans.method == "newton":
+        ops = ops + (serial[13],)
+    return ops
+
+
+def _stage_floor_scale(ladder, stage: int, n_stages: int,
+                       dt_name: str) -> float:
+    """The per-stage switch-floor constant: switch_ulp * eps(hot dtype)
+    for hot stages, 0.0 (cond threshold = tol) for the final stage."""
+    if ladder is None or stage == n_stages - 1:
+        return 0.0
+    return float(ladder.switch_ulp) * float(jnp.finfo(jnp.dtype(dt_name)).eps)
+
+
+def _newton_inverse(trans: TransitionConfig, jacobian) -> Optional[np.ndarray]:
+    """The hoisted Newton factorization: J^{-1} computed ONCE per solve on
+    host, applied as a matmul in the carry (module docstring)."""
+    if trans.method != "newton":
+        return None
+    return np.linalg.inv(np.asarray(jacobian, np.float64))
+
+
+def solve_transition_fused(
+    model: Union[AiyagariModel, AiyagariConfig],
+    shock: MITShock,
+    *,
+    trans: TransitionConfig = TransitionConfig(),
+    solver: Optional[SolverConfig] = None,
+    eq: Optional[EquilibriumConfig] = None,
+    ss=None,
+    jacobian: Optional[np.ndarray] = None,
+    anchor_warm_start=None,
+    keep_policies: bool = True,
+    dtype=jnp.float64,
+    ladder=None,
+    donate: bool = True,
+) -> TransitionResult:
+    """solve_transition with the round loop fused on-device: ONE program
+    launch and ONE small device_get per ladder stage (one of each for the
+    common unladdered solve), against the host loop's launch+sync per
+    round. Same signature minus on_iteration (resolve_transition_loop
+    gates callbacks to the host loop); same TransitionResult, pinned by
+    tests/test_fused_transition.py."""
+    t0 = time.perf_counter()
+    model = _as_model(model, dtype)
+    _check_trans(trans)
+    T = int(trans.T)
+    # Route validation BEFORE the anchor solve (the _egm_kernel_of raise
+    # inside the knob build).
+    base_knobs = fused_transition_knobs(model, trans, solver)
+    pushforward = base_knobs[7]
+    egm_kernel = base_knobs[8]
+    if ss is None:
+        ss = stationary_anchor(model, solver=solver, eq=eq,
+                               warm_start=anchor_warm_start)
+    _check_anchor(ss)
+    from aiyagari_tpu.sim.distribution import aggregate_capital
+
+    tech = model.config.technology
+    r_ss = float(ss.r)
+    K_ss = float(aggregate_capital(ss.mu, model.a_grid))
+    paths = shock_paths(model, shock, T)
+    if trans.method == "newton" and jacobian is None:
+        jacobian = transition_jacobian(model, ss, T,
+                                       pushforward=pushforward)
+    jac_inv = _newton_inverse(trans, jacobian)
+
+    stage_names = _stage_dtype_names(model, ladder)
+    n_stages = len(stage_names)
+    anchors = _StageAnchors(model, ss)
+    sentinel_cfg = solver.sentinel if solver is not None else None
+    sig_ext = np.concatenate([paths["sigma"], [model.preferences.sigma]])
+
+    rounds = 0
+    hot_rounds = 0
+    switch_excess = 0.0
+    hist: list = []
+    bits_hist: list = []
+    converged = False
+    verdict = ""
+    r_dev = None          # the last evaluated path, carried across stages
+    out = None
+    host = None
+    for stage, dt_name in enumerate(stage_names):
+        final = stage == n_stages - 1
+        rounds_left = trans.max_iter - rounds
+        if rounds_left <= 0:
+            break
+        dt = jnp.dtype(dt_name)
+        floor_scale = _stage_floor_scale(ladder, stage, n_stages, dt_name)
+        knobs = fused_transition_knobs(
+            model, trans, solver,
+            matmul_precision=_stage_matmul_precision(ladder, stage),
+            floor_scale=floor_scale)
+        fn = _fused_transition(knobs, bool(donate))
+        policy_c, mu, a_grid, s_arr, P = anchors.get(dt_name)
+        sc = lambda x: jnp.asarray(x, dt)
+        args = (
+            # Donated slots: a FRESH path buffer and COPIES of the cached
+            # anchors (a donated call must not delete the cache entries).
+            jnp.full((T,), r_ss, dt) if r_dev is None
+            else jnp.array(r_dev, dtype=dt, copy=True),
+            jnp.array(policy_c, dtype=dt, copy=True),
+            jnp.array(mu, dtype=dt, copy=True),
+            a_grid, s_arr, P,
+            sc(paths["z"]), sc(paths["beta"]), sc(sig_ext),
+            sc(paths["amin"]), sc(model.labor_raw), sc(r_ss),
+            jnp.asarray(rounds_left, jnp.int32),
+        )
+        if trans.method == "newton":
+            args = args + (sc(jac_inv),)
+        out = fn(*args)
+        small = {k: out[k] for k in ("r", "max_d", "K", "A", "D", "it",
+                                     "hist")}
+        if out["sent"] is not None:
+            small["verdict_code"] = out["sent"].verdict
+        # ONE device_get per stage program (one per solve unladdered) —
+        # everything below is host numpy on the fetched dict.
+        host = jax.device_get(small)
+        it = int(host["it"])  # noqa: AIYA202 — host numpy post-device_get
+        md = float(host["max_d"])  # noqa: AIYA202 — host numpy post-device_get
+        hist += [float(v) for v in
+                 np.asarray(host["hist"], np.float64)[:it]]
+        bits_hist += [int(jnp.finfo(dt).bits)] * it
+        rounds += it
+        if not final:
+            hot_rounds = rounds
+        r_dev = out["r"]
+        code = 0
+        if "verdict_code" in host:
+            code = int(host["verdict_code"])  # noqa: AIYA202 — host numpy post-device_get
+        if not np.isfinite(md):
+            if sentinel_cfg is not None:
+                verdict = "nan"
+                break
+            raise FloatingPointError(
+                f"transition path diverged at round {rounds - 1} "
+                "(non-finite excess demand); try method='damped' or a "
+                "smaller shock")
+        if code != 0:
+            verdict = VERDICT_NAMES[code]
+            break
+        if final:
+            converged = md < trans.tol
+            break
+        kmax = float(np.max(np.abs(np.asarray(host["K"], np.float64))))
+        if md < max(trans.tol, floor_scale * kmax):
+            # The hot stage exited through its switch floor: re-evaluate
+            # the SAME path at the next dtype (the host loop's continue).
+            switch_excess = md
+            continue
+        break  # round cap burned inside the hot stage
+
+    r_path = np.asarray(host["r"], np.float64)
+    K_ts = np.asarray(host["K"], np.float64)
+    D = np.asarray(host["D"], np.float64)
+    policies = None
+    if keep_policies:
+        # One full evaluation at the final (already-evaluated) path for
+        # the dated policy stacks the round loop never returns — the
+        # host loop's post-loop materialization, unchanged.
+        full = transition_path(ss.solution.policy_c, ss.mu, model.a_grid,
+                               model.s, model.P,
+                               *_device_paths(model, r_path, paths, r_ss),
+                               pushforward=pushforward,
+                               egm_kernel=egm_kernel)
+        policies = {"C_ts": full["C_ts"], "k_ts": full["k_ts"]}
+    return TransitionResult(
+        r_path=r_path,
+        w_path=np.asarray(wage_from_r(r_path, tech.alpha, tech.delta,
+                                      paths["z"])),
+        K_ts=K_ts,
+        A_ts=np.asarray(host["A"], np.float64),
+        excess=D,
+        max_excess_history=hist,
+        rounds=rounds,
+        converged=converged,
+        solve_seconds=time.perf_counter() - t0,
+        method=trans.method,
+        shock=shock,
+        T=T,
+        r_ss=r_ss,
+        K_ss=K_ss,
+        ss=ss,
+        policies=policies,
+        mu_T=out["mu"],
+        jacobian=jacobian,
+        hot_rounds=hot_rounds,
+        switch_excess=switch_excess,
+        telemetry=_round_telemetry(hist, bits_hist),
+        verdict=verdict,
+    )
+
+
+def solve_transitions_sweep_fused(
+    model: Union[AiyagariModel, AiyagariConfig],
+    shocks: Sequence[MITShock],
+    *,
+    trans: TransitionConfig = TransitionConfig(),
+    solver: Optional[SolverConfig] = None,
+    eq: Optional[EquilibriumConfig] = None,
+    ss=None,
+    jacobian: Optional[np.ndarray] = None,
+    anchor_warm_start=None,
+    dtype=jnp.float64,
+    ladder=None,
+    quarantine: bool = True,
+    donate: bool = True,
+) -> TransitionSweepResult:
+    """solve_transitions_sweep with the lockstep round loop fused
+    on-device: the vmapped scenario round runs INSIDE one while_loop per
+    ladder stage, quarantine masks and all. Same signature minus mesh /
+    on_iteration (resolve_transition_loop gates both to the host loop);
+    same TransitionSweepResult."""
+    t0 = time.perf_counter()
+    model = _as_model(model, dtype)
+    _check_trans(trans)
+    shocks = list(shocks)
+    if not shocks:
+        raise ValueError(
+            "solve_transitions_sweep needs at least one shock")
+    T = int(trans.T)
+    S = len(shocks)
+    base_knobs = fused_transition_knobs(model, trans, solver)
+    pushforward = base_knobs[7]
+    if ss is None:
+        ss = stationary_anchor(model, solver=solver, eq=eq,
+                               warm_start=anchor_warm_start)
+    _check_anchor(ss)
+    tech = model.config.technology
+    r_ss = float(ss.r)
+    if trans.method == "newton" and jacobian is None:
+        jacobian = transition_jacobian(model, ss, T,
+                                       pushforward=pushforward)
+    jac_inv = _newton_inverse(trans, jacobian)
+
+    all_paths = [shock_paths(model, sh, T) for sh in shocks]
+    stacked = {k: np.stack([p[k] for p in all_paths])
+               for k in ("z", "beta", "sigma", "amin")}
+    sig_ext_s = np.concatenate(
+        [stacked["sigma"], np.full((S, 1), model.preferences.sigma)],
+        axis=1)
+
+    stage_names = _stage_dtype_names(model, ladder)
+    n_stages = len(stage_names)
+    anchors = _StageAnchors(model, ss)
+
+    rounds = 0
+    hot_rounds = 0
+    switch_excess = 0.0
+    hist: list = []
+    bits_hist: list = []
+    conv = np.zeros(S, bool)
+    quar = np.zeros(S, bool)
+    max_d = np.full(S, np.inf)
+    r_dev = None
+    out = None
+    host = None
+    for stage, dt_name in enumerate(stage_names):
+        final = stage == n_stages - 1
+        rounds_left = trans.max_iter - rounds
+        if rounds_left <= 0:
+            break
+        dt = jnp.dtype(dt_name)
+        floor_scale = _stage_floor_scale(ladder, stage, n_stages, dt_name)
+        knobs = fused_transition_knobs(
+            model, trans, solver,
+            matmul_precision=_stage_matmul_precision(ladder, stage),
+            floor_scale=floor_scale)
+        fn = _fused_transition_sweep(knobs, S, bool(quarantine),
+                                     bool(donate))
+        policy_c, mu, a_grid, s_arr, P = anchors.get(dt_name)
+        sc = lambda x: jnp.asarray(x, dt)
+        args = (
+            jnp.full((S, T), r_ss, dt) if r_dev is None
+            else jnp.array(r_dev, dtype=dt, copy=True),
+            jnp.asarray(conv), jnp.asarray(quar),
+            jnp.array(policy_c, dtype=dt, copy=True),
+            jnp.array(mu, dtype=dt, copy=True),
+            a_grid, s_arr, P,
+            sc(stacked["z"]), sc(stacked["beta"]), sc(sig_ext_s),
+            sc(stacked["amin"]), sc(model.labor_raw), sc(r_ss),
+            jnp.asarray(rounds_left, jnp.int32),
+        )
+        if trans.method == "newton":
+            args = args + (sc(jac_inv),)
+        out = fn(*args)
+        small = {k: out[k] for k in ("r", "max_d", "K", "conv", "quar",
+                                     "it", "hist")}
+        host = jax.device_get(small)
+        it = int(host["it"])  # noqa: AIYA202 — host numpy post-device_get
+        max_d = np.asarray(host["max_d"], np.float64)
+        conv = np.asarray(host["conv"], bool)
+        quar = np.asarray(host["quar"], bool)
+        hist += [float(v) for v in
+                 np.asarray(host["hist"], np.float64)[:it]]
+        bits_hist += [int(jnp.finfo(dt).bits)] * it
+        rounds += it
+        if not final:
+            hot_rounds = rounds
+        r_dev = out["r"]
+        if not quarantine and not np.all(np.isfinite(max_d)):
+            bad = [i for i in range(S) if not np.isfinite(max_d[i])]
+            raise FloatingPointError(
+                f"transition sweep diverged at round {rounds - 1} for "
+                f"scenario(s) {bad}; try method='damped' or smaller "
+                "shocks")
+        if final or (conv | quar).all():
+            break
+        live = ~quar
+        live_max = float(np.max(np.where(live, max_d, 0.0), initial=0.0))
+        kmax = float(np.max(np.abs(np.asarray(host["K"],
+                                              np.float64))[live],
+                            initial=0.0))
+        if live_max < max(trans.tol, floor_scale * kmax):
+            switch_excess = live_max
+            continue
+        break  # round cap burned inside the hot stage
+
+    wall = time.perf_counter() - t0
+    verdicts = ["converged" if c else ("nan" if q else "max_iter")
+                for c, q in zip(conv, quar)]
+    return TransitionSweepResult(
+        r_paths=np.asarray(host["r"], np.float64),
+        K_ts=np.asarray(host["K"], np.float64),
+        max_excess=max_d,
+        converged=conv,
+        rounds=rounds,
+        scenarios=S,
+        solve_seconds=wall,
+        transitions_per_sec=S / wall if wall > 0 else float("inf"),
+        shocks=shocks,
+        method=trans.method,
+        T=T,
+        r_ss=r_ss,
+        ss=ss,
+        jacobian=jacobian,
+        hot_rounds=hot_rounds,
+        switch_excess=switch_excess,
+        telemetry=_round_telemetry(hist, bits_hist),
+        quarantined=quar,
+        verdicts=verdicts,
+    )

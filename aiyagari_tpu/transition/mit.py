@@ -51,11 +51,10 @@ from aiyagari_tpu.sim.distribution import aggregate_capital
 from aiyagari_tpu.transition.jacobian import fake_news_jacobian, newton_jacobian
 from aiyagari_tpu.transition.path import (
     transition_path,
-    transition_path_aggregates,
-    transition_path_batch,
+    transition_path_record,
+    transition_path_record_batch,
 )
 from aiyagari_tpu.utils.firm import (
-    capital_demand,
     r_from_capital,
     wage_from_r,
 )
@@ -456,6 +455,12 @@ def solve_transition(
 
     if trans.method == "newton" and jacobian is None:
         jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
+    # Hoist the Newton factorization out of the loop: J is the (round-
+    # invariant) steady-state linearization, so the per-round update is a
+    # [T, T] @ [T] matmul — the same form the fused device loop applies in
+    # its carry (transition/fused.py), which pins host/device parity.
+    jac_inv = (np.linalg.inv(np.asarray(jacobian, np.float64))
+               if trans.method == "newton" else None)
 
     stage_names = _stage_dtype_names(model, ladder)
     anchors = _StageAnchors(model, ss)
@@ -463,8 +468,18 @@ def solve_transition(
     hot_rounds = 0
     switch_excess = 0.0
 
+    # Loop-invariant f64 operands of the round-record program: the excess
+    # demand is formed ON DEVICE against the f64 candidate path
+    # (transition/path.transition_path_record), so each round fetches one
+    # stacked [3T+1] record instead of K_ts now and A_ts after the loop.
+    z64 = jnp.asarray(paths["z"], jnp.float64)
+    labor64 = jnp.asarray(model.labor_raw, jnp.float64)
+    alpha64 = jnp.asarray(tech.alpha, jnp.float64)
+    delta64 = jnp.asarray(tech.delta, jnp.float64)
+
     r_path = np.full(T, r_ss)
     out = None
+    rec = None
     K_ts = D = None
     hist: list = []
     bits_hist: list = []   # per-round stage dtype width (the ladder record)
@@ -477,15 +492,18 @@ def solve_transition(
         dt_name = stage_names[stage]
         dev = _device_paths(model, r_path, paths, r_ss,
                             dtype=jnp.dtype(dt_name))
-        # Aggregates-only program per round (the update reads K_ts alone);
-        # the policy stacks are materialized once below, at the final path.
-        out = transition_path_aggregates(
+        # Record program per round (the update reads K_ts/D alone); the
+        # policy stacks are materialized once below, at the final path.
+        out = transition_path_record(
             *anchors.get(dt_name), *dev,
+            jnp.asarray(r_path, jnp.float64), z64, labor64, alpha64,
+            delta64,
             matmul_precision=_stage_matmul_precision(ladder, stage),
             pushforward=pushforward, egm_kernel=egm_kernel)
-        K_ts = np.asarray(jax.device_get(out["K_ts"]), np.float64)
-        D = K_ts[:T] - capital_demand(r_path, model.labor_raw, tech.alpha,
-                                      tech.delta, paths["z"])
+        # ONE stacked device_get per round: [K_ts (T+1) | D (T) | A_ts (T)].
+        rec = np.asarray(jax.device_get(out["record"]), np.float64)
+        K_ts = rec[:T + 1]
+        D = rec[T + 1:2 * T + 1]
         rounds = rnd + 1
         if stage < len(stage_names) - 1:
             # Telemetry counts every round EVALUATED hot, whether or not
@@ -541,7 +559,7 @@ def solve_transition(
             # diagnostics.
             break
         if trans.method == "newton":
-            r_path = r_path - np.linalg.solve(jacobian, D)
+            r_path = r_path - jac_inv @ D
         else:
             r_implied = r_from_capital(
                 np.maximum(K_ts[:T], 1e-10), model.labor_raw, tech.alpha,
@@ -565,7 +583,7 @@ def solve_transition(
         w_path=np.asarray(wage_from_r(r_path, tech.alpha, tech.delta,
                                       paths["z"])),
         K_ts=K_ts,
-        A_ts=np.asarray(jax.device_get(out["A_ts"]), np.float64),
+        A_ts=rec[2 * T + 1:],
         excess=D,
         max_excess_history=hist,
         rounds=rounds,
@@ -661,6 +679,10 @@ def solve_transitions_sweep(
     r_ss = float(ss.r)
     if trans.method == "newton" and jacobian is None:
         jacobian = transition_jacobian(model, ss, T, pushforward=pushforward)
+    # Hoisted Newton factorization (single-solve rationale): S right-hand
+    # sides per round become one [S, T] @ [T, T] matmul.
+    jac_inv = (np.linalg.inv(np.asarray(jacobian, np.float64))
+               if trans.method == "newton" else None)
 
     all_paths = [shock_paths(model, sh, T) for sh in shocks]
     stacked = {k: np.stack([p[k] for p in all_paths])
@@ -706,11 +728,20 @@ def solve_transitions_sweep(
                                 place(stacked["amin"], dt))
         return _params[dt_name]
 
+    # Loop-invariant f64 operands of the batched round-record program
+    # (transition/path.transition_path_record_batch): the per-lane excess
+    # demand is formed on device, one stacked [S, 3T+1] fetch per round.
+    z64_s = place(stacked["z"], jnp.float64)
+    labor64 = jnp.asarray(model.labor_raw, jnp.float64)
+    alpha64 = jnp.asarray(tech.alpha, jnp.float64)
+    delta64 = jnp.asarray(tech.delta, jnp.float64)
+
     r_paths = np.full((S, T), r_ss)
     conv = np.zeros(S, bool)
     quar = np.zeros(S, bool)
     max_d = np.full(S, np.inf)
     out = None
+    rec = None
     rounds = 0
     hist: list = []
     bits_hist: list = []
@@ -721,14 +752,16 @@ def solve_transitions_sweep(
         beta_dev, sig_dev, amin_dev = stage_params(dt_name)
         w_s = wage_from_r(r_paths, tech.alpha, tech.delta, stacked["z"])
         r_ext_s = np.concatenate([r_paths, np.full((S, 1), r_ss)], axis=1)
-        out = transition_path_batch(
+        out = transition_path_record_batch(
             *anchors.get(dt_name),
             place(r_ext_s, dt), place(w_s, dt), beta_dev, sig_dev, amin_dev,
+            place(r_paths, jnp.float64), z64_s, labor64, alpha64, delta64,
             matmul_precision=_stage_matmul_precision(ladder, stage),
             pushforward=pushforward, egm_kernel=egm_kernel)
-        K_s = np.asarray(jax.device_get(out["K_ts"]), np.float64)  # [S, T+1]
-        D = K_s[:, :T] - capital_demand(r_paths, model.labor_raw, tech.alpha,
-                                        tech.delta, stacked["z"])
+        # ONE stacked device_get per round: [S, K_ts (T+1) | D (T) | A_ts].
+        rec = np.asarray(jax.device_get(out["record"]), np.float64)
+        K_s = rec[:, :T + 1]
+        D = rec[:, T + 1:2 * T + 1]
         rounds = rnd + 1
         final_stage = stage == len(stage_names) - 1
         if not final_stage:
@@ -790,7 +823,7 @@ def solve_transitions_sweep(
             # solve (converged scenarios are pinned either way).
             break
         if trans.method == "newton":
-            step = np.linalg.solve(jacobian, D.T).T            # [S, T]
+            step = D @ jac_inv.T                               # [S, T]
         else:
             r_implied = r_from_capital(
                 np.maximum(K_s[:, :T], 1e-10), model.labor_raw,
@@ -807,7 +840,7 @@ def solve_transitions_sweep(
                 for c, q in zip(conv, quar)]
     return TransitionSweepResult(
         r_paths=r_paths,
-        K_ts=np.asarray(jax.device_get(out["K_ts"]), np.float64),
+        K_ts=rec[:, :T + 1],
         max_excess=max_d,
         converged=conv,
         rounds=rounds,

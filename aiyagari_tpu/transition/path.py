@@ -160,6 +160,71 @@ def transition_path_aggregates(C_term, mu0, a_grid, s, P, r_ext, w_path,
     return {"K_ts": K_ts, "A_ts": A_ts, "mu_T": mu_T}
 
 
+@partial(jax.jit, static_argnames=("matmul_precision", "pushforward",
+                                   "egm_kernel"))
+def transition_path_record(C_term, mu0, a_grid, s, P, r_ext, w_path,
+                           beta_path, sigma_ext, amin_path, r64, z64,
+                           labor_raw, alpha, delta,
+                           matmul_precision: str = "highest",
+                           pushforward: str = "auto",
+                           egm_kernel: str = "xla"):
+    """transition_path_aggregates plus the round's HOST-LOOP fetch record,
+    stacked into ONE [3T+1] float64 array: [K_ts (T+1) | D (T) | A_ts (T)].
+
+    The host round loop used to fetch K_ts, recompute the excess demand on
+    host, and fetch A_ts again after the loop — one device_get per round
+    plus one trailing. This program moves the firm FOC onto the device:
+    r64/z64 are the rate path and TFP path as COMMITTED float64 operands
+    (under the mixed-precision ladder the path evaluation runs in the hot
+    dtype while the excess demand is still formed in f64 against the f64
+    candidate path, exactly what the host recompute did), so the loop
+    fetches one stacked record per round and nothing after. mu_T stays on
+    device (the result carries the array, never fetches it)."""
+    _, k_ts = backward_policies(C_term, a_grid, s, P, r_ext, w_path,
+                                beta_path, sigma_ext, amin_path,
+                                matmul_precision=matmul_precision,
+                                egm_kernel=egm_kernel)
+    K_ts, A_ts, mu_T = forward_capital(mu0, k_ts, a_grid, P,
+                                       pushforward=pushforward)
+    T = amin_path.shape[0]
+    K64 = K_ts.astype(jnp.float64)
+    D = K64[:T] - _capital_demand(r64, labor_raw, alpha, delta, z64)
+    record = jnp.concatenate([K64, D, A_ts.astype(jnp.float64)])
+    return {"record": record, "mu_T": mu_T}
+
+
+def _capital_demand(r, labor, alpha, delta, z):
+    from aiyagari_tpu.utils.firm import capital_demand
+
+    return capital_demand(r, labor, alpha, delta, z)
+
+
+_RECORD_BATCH_CACHE: dict = {}
+
+
+def transition_path_record_batch(C_term, mu0, a_grid, s, P, r_ext_s, w_s,
+                                 beta_s, sigma_s, amin_s, r64_s, z64_s,
+                                 labor_raw, alpha, delta,
+                                 matmul_precision: str = "highest",
+                                 pushforward: str = "auto",
+                                 egm_kernel: str = "xla"):
+    """Scenario-sweep twin of transition_path_record: one [S, 3T+1] f64
+    record per round (the lockstep loop's single stacked device_get)."""
+    key = (matmul_precision, pushforward, egm_kernel)
+    fn = _RECORD_BATCH_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(jax.vmap(
+            lambda *a: transition_path_record(
+                *a, matmul_precision=matmul_precision,
+                pushforward=pushforward, egm_kernel=egm_kernel),
+            in_axes=(None, None, None, None, None, 0, 0, 0, 0, 0, 0, 0,
+                     None, None, None),
+        ))
+        _RECORD_BATCH_CACHE[key] = fn
+    return fn(C_term, mu0, a_grid, s, P, r_ext_s, w_s, beta_s, sigma_s,
+              amin_s, r64_s, z64_s, labor_raw, alpha, delta)
+
+
 # vmapped twin for scenario sweeps: paths carry a leading [S] axis, the
 # model arrays and stationary anchors are shared. jit(vmap(...)) compiles
 # once per (S, T, N, na) and per matmul precision (the ladder's hot rounds

@@ -19,6 +19,7 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The ci battery's metric set (bench.py main): one record each, in order.
 CI_METRICS = ("vfi", "scale", "ge", "ge_fused", "sweep", "transition",
+              "transition_fused",
               "accel", "precision", "pushforward", "egm_fused", "telemetry",
               "resilience", "mesh2d", "attribution", "observatory",
               "serve", "amortized", "calibration", "analysis")
@@ -63,7 +64,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # demonstrably happened — XLA's peak-memory proxy for the donated
     # build strictly below the undonated build of the identical program,
     # with the donated warm buffer deleted after the call.
-    gf = records[-16]
+    gf = records[-17]
     assert gf["metric"].startswith("aiyagari_ge_fused")
     assert gf["host_converged"] and gf["device_converged"], gf
     assert gf["batched_converged"], gf
@@ -91,10 +92,52 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
             < frozen_gf["memory_undonated"]["peak_proxy_bytes"])
     assert frozen_gf["donated_input_deleted"] is True
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-14]
+    tr = records[-15]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
+    # The transition_fused record carries the ISSUE 19 acceptance
+    # telemetry: the one-program MIT-shock solve. Same gate shape as
+    # ge_fused above — the fused device Newton loop beats the host round
+    # loop (<= 0.8x wall, interleaved minima; the win is LAUNCH-count
+    # erasure, ~T*rounds dispatches collapsed to one), both loops land on
+    # the same terminal rate to round-off (identical hoisted
+    # Jacobian-inverse matmul on identical excess-demand curves; 1e-10 is
+    # the acceptance band, the measurement is exact), and path-carry
+    # donation demonstrably happened: XLA aliased real input bytes, the
+    # donated build's peak-memory proxy sits strictly below the undonated
+    # build of the identical program, and the donated r-path carry is
+    # deleted after the call.
+    tf = records[-14]
+    assert tf["metric"].startswith("transition_fused")
+    assert tf["host_converged"] and tf["device_converged"], tf
+    assert tf["wall_ratio_device_over_host"] <= 0.8, tf
+    assert tf["r_agreement"] <= 1e-10, tf
+    tf_d, tf_u = tf["memory_donated"], tf["memory_undonated"]
+    assert tf_d["alias_bytes"] > 0, tf
+    assert tf_d["peak_proxy_bytes"] < tf_u["peak_proxy_bytes"], tf
+    assert tf["donated_input_deleted"] is True, tf
+    # The structural win: ONE device program per transition solve vs one
+    # program (+ fetch) per Newton round on the host loop.
+    assert tf["device_programs_fused"] == 1
+    assert tf["device_programs_host_loop"] == tf["host_rounds"]
+    assert tf["modeled_solve"]["hbm_bytes"] > 0, tf
+    # The coalesced sweep rode the same fused program: every scenario
+    # converged and the fused sweep's terminal rates agree with the host
+    # sweep's to round-off.
+    assert tf["sweep_converged"] == tf["sweep_scenarios"], tf
+    assert tf["sweep_r_agreement"] <= 1e-10, tf
+    assert tf["sweep_transitions_per_sec"] > 0, tf
+    # The frozen artifact the ci battery owns (ISSUE 19 acceptance).
+    with open(os.path.join(os.path.dirname(BENCH),
+                           "BENCH_r18_transition_fused.json")) as f:
+        frozen_tf = json.load(f)
+    assert frozen_tf["metric"].startswith("transition_fused")
+    assert frozen_tf["wall_ratio_device_over_host"] <= 0.8
+    assert frozen_tf["r_agreement"] <= 1e-10
+    assert (frozen_tf["memory_donated"]["peak_proxy_bytes"]
+            < frozen_tf["memory_undonated"]["peak_proxy_bytes"])
+    assert frozen_tf["donated_input_deleted"] is True
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.

@@ -276,6 +276,9 @@ class TestShardedLaborEGMSolver:
         np.testing.assert_allclose(np.asarray(sol.policy_c),
                                    np.asarray(ref.policy_c), atol=1e-10)
 
+    @pytest.mark.slow  # ~20 s: the exogenous variant below pins the same
+    # no-full-grid jaxpr contract on the cheaper program; this one adds
+    # only the stacked-channel labor shapes.
     def test_no_full_grid_crosses_devices(self):
         # The knots-resident assertion for the LABOR program: the ring
         # rotation's collective-permutes carry the stacked [2, N, na/D]

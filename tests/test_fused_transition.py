@@ -1,0 +1,338 @@
+"""One-program transitions (transition/fused.py, ISSUE 19):
+
+* placement — resolve_transition_loop routes "auto" to the device loop
+  exactly where the fused program exists (exogenous labor, no scenario
+  mesh, no per-round callback), and an explicit "device" on an
+  unsupported combination is loud, never a silent host fallback;
+* parity — the fused device Newton lands on the SAME equilibrium price
+  path as the host round loop (both apply the identical hoisted
+  Jacobian-inverse matmul to the identical excess-demand curve), serial
+  and lockstep-sweep, and Newton/damped agree inside the fused loop the
+  way they do on the host;
+* sentinel/nan — a nan excess demand fails `max_d >= tol`, so the fused
+  while_loop exits after the round that produced it (the AIYA107
+  contract), raising FloatingPointError bare and returning the "nan"
+  verdict with a sentinel armed;
+* quarantine — a nan-poisoned scenario lane in the fused sweep is masked
+  and reported while every healthy lane's path stays BITWISE equal to
+  the clean sweep (vmapped lanes are independent; converged lanes
+  freeze);
+* donation — donate=True actually donates (the r-path/anchor operand
+  buffers come back deleted), donate=False does not, and the caller's
+  stationary-anchor arrays survive a donated solve
+  (fused_transition_operands copies them — the serve anchor cache's
+  entries must outlive the solve);
+* dispatch/serve — TransitionConfig.loop threads through
+  solve_transition / sweep_transitions with host parity, and a serve
+  transition request rides the fused path end-to-end under the
+  loop="auto" service default.
+
+Scale notes: 40-point/7-state economy, T=24 — smaller than
+tests/test_transition.py (the algorithmic anchors live there; this file
+pins placement and parity).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import aiyagari_tpu as at
+from aiyagari_tpu.config import SentinelConfig, SolverConfig
+from aiyagari_tpu.models.aiyagari import AiyagariModel
+from aiyagari_tpu.transition.fused import (
+    fused_transition_operands,
+    fused_transition_program,
+    resolve_transition_loop,
+    solve_transition_fused,
+    solve_transitions_sweep_fused,
+)
+from aiyagari_tpu.transition.mit import (
+    solve_transition as host_solve,
+    solve_transitions_sweep as host_sweep,
+    stationary_anchor,
+    transition_jacobian,
+)
+
+GRID = 40
+T = 24
+
+CFG = at.AiyagariConfig(grid=at.GridSpecConfig(n_points=GRID))
+SHOCK = at.MITShock(param="tfp", size=0.01, rho=0.8)
+# The fault-injection poison (diagnostics/faults.py): an untempered nan
+# TFP path whose first round's excess demand is non-finite.
+NAN_SHOCK = at.MITShock(param="tfp", size=float("nan"), rho=0.0)
+TC = at.TransitionConfig(T=T, tol=1e-8, method="newton", max_iter=20)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return AiyagariModel.from_config(CFG, jnp.float64)
+
+
+@pytest.fixture(scope="module")
+def ss(model):
+    return stationary_anchor(model)
+
+
+@pytest.fixture(scope="module")
+def jac(model, ss):
+    return transition_jacobian(model, ss, T)
+
+
+class TestResolveTransitionLoop:
+    def test_auto_routes_device_where_supported(self):
+        tc = at.TransitionConfig(loop="auto")
+        assert resolve_transition_loop(tc, endogenous_labor=False) \
+            == "device"
+        # Every unsupported leg falls back silently under "auto".
+        assert resolve_transition_loop(tc, endogenous_labor=True) == "host"
+        assert resolve_transition_loop(tc, endogenous_labor=False,
+                                       mesh=object()) == "host"
+        assert resolve_transition_loop(
+            tc, endogenous_labor=False,
+            on_iteration=lambda *a: None) == "host"
+
+    def test_host_is_always_host(self):
+        tc = at.TransitionConfig(loop="host")
+        assert resolve_transition_loop(tc, endogenous_labor=False) == "host"
+
+    def test_explicit_device_on_unsupported_combo_is_loud(self):
+        tc = at.TransitionConfig(loop="device")
+        with pytest.raises(ValueError, match="endogenous-labor"):
+            resolve_transition_loop(tc, endogenous_labor=True)
+        with pytest.raises(ValueError, match="mesh-sharded"):
+            resolve_transition_loop(tc, endogenous_labor=False,
+                                    mesh=object())
+        with pytest.raises(ValueError, match="on_iteration"):
+            resolve_transition_loop(tc, endogenous_labor=False,
+                                    on_iteration=lambda *a: None)
+
+    def test_config_validates_the_knob(self):
+        with pytest.raises(ValueError, match="loop"):
+            at.TransitionConfig(loop="gpu")
+
+
+class TestSerialParity:
+    def test_newton_same_path_same_rounds(self, model, ss, jac):
+        host = host_solve(model, SHOCK, trans=TC, ss=ss, jacobian=jac)
+        dev = solve_transition_fused(model, SHOCK, trans=TC, ss=ss,
+                                     jacobian=jac)
+        assert host.converged and dev.converged
+        # Identical update arithmetic (the hoisted inverse is applied by
+        # the same matmul on both sides): the ISSUE 19 acceptance band is
+        # 1e-10; measured ~1e-16.
+        assert np.max(np.abs(dev.r_path - host.r_path)) <= 1e-10
+        assert dev.rounds == host.rounds
+        np.testing.assert_allclose(dev.K_ts, host.K_ts, atol=1e-9)
+        np.testing.assert_allclose(dev.A_ts, host.A_ts, atol=1e-9)
+        # Histories line up round for round.
+        np.testing.assert_allclose(dev.max_excess_history,
+                                   host.max_excess_history,
+                                   rtol=0, atol=1e-12)
+        # The capped-result contract rides along: the returned path pairs
+        # with the excess measured AT it.
+        np.testing.assert_allclose(
+            np.max(np.abs(dev.excess)), dev.max_excess_history[-1],
+            atol=1e-12)
+
+    def test_newton_vs_damped_inside_fused(self, model, ss, jac):
+        rn = solve_transition_fused(model, SHOCK, trans=TC, ss=ss,
+                                    jacobian=jac)
+        rd = solve_transition_fused(
+            model, SHOCK, ss=ss,
+            trans=at.TransitionConfig(T=T, tol=1e-8, method="damped",
+                                      max_iter=300, damping=0.5))
+        assert rn.converged and rd.converged
+        # Same residual root, two iterations: one fixed point.
+        np.testing.assert_allclose(rn.r_path, rd.r_path, atol=1e-8)
+        assert rn.rounds < rd.rounds
+
+    def test_sweep_matches_host_sweep(self, model, ss, jac):
+        shocks = [SHOCK, at.MITShock("tfp", 0.005, 0.9),
+                  at.MITShock("beta", 0.002, 0.7)]
+        host = host_sweep(model, shocks, trans=TC, ss=ss, jacobian=jac)
+        dev = solve_transitions_sweep_fused(model, shocks, trans=TC,
+                                            ss=ss, jacobian=jac)
+        assert bool(np.all(host.converged)) and bool(np.all(dev.converged))
+        assert np.max(np.abs(np.asarray(dev.r_paths)
+                             - np.asarray(host.r_paths))) <= 1e-10
+        assert dev.rounds == host.rounds
+        np.testing.assert_allclose(dev.K_ts, host.K_ts, atol=1e-9)
+        assert dev.verdicts == ["converged"] * len(shocks)
+        assert dev.transitions_per_sec > 0
+
+
+class TestNanEarlyExit:
+    """The fused cond is `max_d >= thr` with max_d seeded +inf: a nan
+    excess demand fails it concretely (AIYA107), so the loop exits after
+    the round that produced it instead of burning max_iter device
+    rounds."""
+
+    def test_raw_program_exits_after_one_round(self, model, ss, jac):
+        fn = fused_transition_program(model, trans=TC, donate=False)
+        jac_inv = np.linalg.inv(np.asarray(jac, np.float64))
+        ops = fused_transition_operands(model, NAN_SHOCK, TC, ss=ss,
+                                        jac_inv=jac_inv)
+        out = fn(*ops)
+        assert int(out["it"]) == 1, "nan excess demand must exit the loop"
+        assert np.isnan(float(out["max_d"]))
+
+    def test_bare_solve_raises(self, model, ss, jac):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            solve_transition_fused(model, NAN_SHOCK, trans=TC, ss=ss,
+                                   jacobian=jac)
+
+    def test_sentinel_verdict_on_nan(self, model, ss, jac):
+        sv = SolverConfig(method="egm", sentinel=SentinelConfig())
+        res = solve_transition_fused(model, NAN_SHOCK, trans=TC, ss=ss,
+                                     jacobian=jac, solver=sv)
+        assert not res.converged
+        assert res.verdict == "nan"
+        assert res.rounds == 1
+
+
+class TestQuarantineBitwise:
+    def test_poisoned_lane_leaves_neighbors_bitwise(self, model, ss, jac):
+        clean = [SHOCK, at.MITShock("tfp", 0.005, 0.9),
+                 at.MITShock("beta", 0.002, 0.7)]
+        poisoned = [clean[0], NAN_SHOCK, clean[2]]
+        ref = solve_transitions_sweep_fused(model, clean, trans=TC,
+                                            ss=ss, jacobian=jac)
+        res = solve_transitions_sweep_fused(model, poisoned, trans=TC,
+                                            ss=ss, jacobian=jac)
+        assert np.asarray(res.quarantined).tolist() == [False, True, False]
+        assert res.verdicts[1] == "nan"
+        assert not bool(np.asarray(res.converged)[1])
+        # Healthy lanes are untouched by the poison: vmapped lanes are
+        # independent and converged lanes freeze, so their paths match
+        # the clean sweep BIT FOR BIT.
+        for i in (0, 2):
+            np.testing.assert_array_equal(np.asarray(res.r_paths)[i],
+                                          np.asarray(ref.r_paths)[i])
+            np.testing.assert_array_equal(np.asarray(res.K_ts)[i],
+                                          np.asarray(ref.K_ts)[i])
+            assert bool(np.asarray(res.converged)[i])
+
+    def test_quarantine_off_raises_with_lane(self, model, ss, jac):
+        with pytest.raises(FloatingPointError, match=r"scenario\(s\) \[1\]"):
+            solve_transitions_sweep_fused(
+                model, [SHOCK, NAN_SHOCK], trans=TC, ss=ss, jacobian=jac,
+                quarantine=False)
+
+
+class TestDonation:
+    def test_donated_operands_are_deleted(self, model, ss, jac):
+        fn = fused_transition_program(model, trans=TC, donate=True)
+        jac_inv = np.linalg.inv(np.asarray(jac, np.float64))
+        ops = fused_transition_operands(model, SHOCK, TC, ss=ss,
+                                        jac_inv=jac_inv)
+        out = fn(*ops)
+        assert np.isfinite(float(out["max_d"]))
+        # The r0 slot seeds the loop carry, so XLA always aliases it and
+        # the buffer comes back deleted. The anchor slots (C_term, mu0)
+        # are loop-invariant — read every round — so the compiler aliases
+        # what it can (at least one here) and leaves the rest alive with
+        # the once-per-compile "not usable" warning.
+        assert ops[0].is_deleted()
+        assert ops[1].is_deleted() or ops[2].is_deleted()
+        # Undonated operands survive.
+        assert not ops[3].is_deleted()       # a_grid
+
+    def test_undonated_operands_survive(self, model, ss, jac):
+        fn = fused_transition_program(model, trans=TC, donate=False)
+        jac_inv = np.linalg.inv(np.asarray(jac, np.float64))
+        ops = fused_transition_operands(model, SHOCK, TC, ss=ss,
+                                        jac_inv=jac_inv)
+        fn(*ops)
+        assert not ops[0].is_deleted()
+        assert not ops[1].is_deleted()
+        assert not ops[2].is_deleted()
+
+    def test_anchor_cache_survives_donated_solve(self, model, ss, jac):
+        # The serve anchor-reuse path: the cached stationary solution must
+        # outlive a donated solve (fused_transition_operands copies the
+        # terminal policy / initial distribution before donation).
+        res = solve_transition_fused(model, SHOCK, trans=TC, ss=ss,
+                                     jacobian=jac, donate=True)
+        assert res.converged
+        assert not ss.solution.policy_c.is_deleted()
+        assert not ss.mu.is_deleted()
+        # And the anchor still evaluates.
+        assert np.isfinite(float(np.sum(np.asarray(ss.mu))))
+
+
+class TestDispatchRouting:
+    def test_device_loop_matches_host_loop(self, ss, jac):
+        host = at.solve_transition(
+            CFG, SHOCK, transition=dataclasses.replace(TC, loop="host"),
+            ss=ss, jacobian=jac)
+        dev = at.solve_transition(
+            CFG, SHOCK, transition=dataclasses.replace(TC, loop="device"),
+            ss=ss, jacobian=jac)
+        assert host.converged and dev.converged
+        assert np.max(np.abs(dev.r_path - host.r_path)) <= 1e-10
+        assert dev.rounds == host.rounds
+
+    def test_sweep_device_loop_matches_host(self, ss, jac):
+        shocks = [SHOCK, at.MITShock("tfp", 0.005, 0.9)]
+        host = at.sweep_transitions(
+            CFG, shocks, transition=dataclasses.replace(TC, loop="host"),
+            ss=ss, jacobian=jac)
+        dev = at.sweep_transitions(
+            CFG, shocks, transition=dataclasses.replace(TC, loop="device"),
+            ss=ss, jacobian=jac)
+        assert np.max(np.abs(np.asarray(dev.r_paths)
+                             - np.asarray(host.r_paths))) <= 1e-10
+        assert dev.rounds == host.rounds
+
+    def test_auto_falls_back_on_mesh_sweep(self, ss, jac):
+        # A scenarios-mesh sweep keeps the host lockstep loop under
+        # "auto" — placement changes, results do not (the host parity is
+        # test_transition's pin; here only the routing must not raise).
+        res = at.sweep_transitions(
+            CFG, [SHOCK, at.MITShock("tfp", 0.005, 0.9),
+                  at.MITShock("beta", 0.002, 0.7),
+                  at.MITShock("sigma", 0.05, 0.6)],
+            transition=dataclasses.replace(TC, loop="auto"),
+            ss=ss, jacobian=jac,
+            backend=at.BackendConfig(mesh_axes=("scenarios",),
+                                     mesh_shape=(4,)))
+        assert bool(np.all(res.converged))
+
+    def test_explicit_device_on_endogenous_labor_is_loud(self):
+        with pytest.raises(ValueError, match="endogenous-labor"):
+            at.solve_transition(
+                at.AiyagariConfig(endogenous_labor=True), SHOCK,
+                transition=dataclasses.replace(TC, loop="device"))
+
+
+class TestServeEndToEnd:
+    def test_transition_request_rides_fused_path(self):
+        from aiyagari_tpu.serve import ServeConfig, SolveRequest, SolveService
+
+        trans = at.TransitionConfig(T=T, max_iter=15, tol=1e-6,
+                                    loop="auto")
+        assert ServeConfig().transition.loop == "auto"   # service default
+        cfg = ServeConfig(method="egm",
+                          equilibrium=at.EquilibriumConfig(max_iter=48,
+                                                           tol=2e-4),
+                          warm_pool=False, rescue=False, max_batch=2,
+                          max_wait_s=2.0, transition=trans)
+        s1 = at.MITShock(param="tfp", size=0.01, rho=0.9)
+        with SolveService(cfg) as svc:
+            r1 = svc.solve(CFG, kind="transition", shock=s1, timeout=600)
+            # Two shocks submitted together coalesce into ONE lockstep
+            # sweep, which also lowers through the fused loop.
+            futs = [svc.submit(SolveRequest(CFG, kind="transition",
+                                            shock=at.MITShock(
+                                                param="tfp", size=sz,
+                                                rho=0.9)))
+                    for sz in (0.004, 0.007)]
+            batch = [f.result(600) for f in futs]
+        assert r1.status == "converged"
+        assert r1.r_path.shape == (T,)
+        assert all(r.status == "converged" and r.batch == 2
+                   for r in batch)

@@ -206,6 +206,9 @@ class TestSwitchMechanics:
         assert abs(float(jnp.sum(mix.mu)) - 1.0) < 1e-12
         assert float(jnp.max(jnp.abs(mix.mu - p64.mu))) < 1e-9
 
+    @pytest.mark.slow  # ~13 s: switch mechanics are pinned by the cheap
+    # egm_pair tests above; the multiscale composition runs in every ci
+    # battery (--metric scale) and the accel wiring's slow sibling.
     def test_multiscale_warm_stages_run_hot(self):
         # The multiscale ladder under "mixed": warm stages are f32 citizens
         # (hot-only), the final stage still polishes — so the final solution

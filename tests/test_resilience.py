@@ -536,6 +536,10 @@ class TestQuarantine:
                                       faults=FaultPlan(poison_scenario=7)),
                   equilibrium=self.EQ)
 
+    @pytest.mark.slow  # ~8 s: the identical quarantine contract (lane
+    # count, rescued verdict, unpoisoned parity) is re-gated by every ci
+    # battery run (resilience record) and the fused-sweep variant is
+    # pinned tier-1 in test_fused_transition.py.
     def test_transition_sweep_quarantine_and_rescue(self):
         from aiyagari_tpu import MITShock, sweep_transitions
 

@@ -292,6 +292,8 @@ class TestGridSharding:
         out_sharding = lowered.output_shardings
         assert collective_ops or not out_sharding.is_fully_replicated
 
+    @pytest.mark.slow  # ~11 s: grid-sharded VFI parity is pinned tier-1 by
+    # test_ks_sharded's discrete path; this adds only the 2k dense-row scale.
     def test_dense_bellman_rows_shard_cleanly(self):
         # The [N, na, na'] Bellman max (Aiyagari_VFI.m:70-83) partitions on
         # the QUERY axis (na) with the choice axis local: sharded and

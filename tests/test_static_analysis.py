@@ -87,7 +87,10 @@ class TestShippedTreeClean:
                               "vfi/step", "distribution/step_transpose",
                               "distribution/stationary",
                               "equilibrium/ge_round_batched",
-                              "transition/round", "ks/distribution_step"):
+                              "transition/round", "transition/fused",
+                              "transition/fused_sentinel",
+                              "transition/fused_sweep",
+                              "ks/distribution_step"):
             assert family_member in audited
 
     def test_mesh_shim_ships_with_zero_suppressions(self):
