@@ -37,6 +37,9 @@ service"):
   python -m aiyagari_tpu serve --port 8799   # HTTP front: POST /solve,
                                              # GET /metrics, GET /healthz
   python -m aiyagari_tpu serve --load 32     # synthetic open-loop load
+  python -m aiyagari_tpu fleet --workers 2   # N workers + routing front
+                                             # (grid-class buckets, shared
+                                             # L2 tier, graceful drain)
 """
 
 from __future__ import annotations
@@ -87,6 +90,13 @@ def main(argv=None) -> int:
         from aiyagari_tpu.serve.service import serve_main
 
         return serve_main(argv[1:])
+    # `fleet` spawns N serve workers as separate processes behind a
+    # grid-class routing front with graceful drain (serve/fleet.py) —
+    # the pod-scale solve fabric.
+    if argv[:1] == ["fleet"]:
+        from aiyagari_tpu.serve.fleet import fleet_main
+
+        return fleet_main(argv[1:])
     ap = argparse.ArgumentParser(prog="aiyagari_tpu", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("model", choices=["aiyagari", "aiyagari-labor", "ks"])

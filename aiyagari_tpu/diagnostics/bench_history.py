@@ -164,6 +164,26 @@ SPECS = {
         Check("surrogate_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
         Check("anchor_warm_vs_cold_p50", "max_abs", band=1.0, floor=0.6),
     ),
+    "fleet": (
+        # The solve fabric (ISSUE 20). All four acceptance gates are
+        # frozen as booleans (the hard gates run every ci battery in
+        # tests/test_bench_ci.py at the same thresholds); poisoned-L2
+        # wrong answers are a hard zero; the AOT restore ratio holds the
+        # 0.5 acceptance ceiling; the 2-worker aggregate holds the 1.6x
+        # floor as a count_min. value is the aggregate hit throughput
+        # (requests/sec — higher is better), so its catastrophe band is
+        # a count_min, not a wall check.
+        Check("gates.aot_restore_le_half_fresh", "bool"),
+        Check("gates.aggregate_ge_1p6x_single", "bool"),
+        Check("gates.l2_cold_fraction_below", "bool"),
+        Check("gates.poisoned_l2_degrades_bitwise", "bool"),
+        Check("poisoned_l2.wrong_answer_degradations", "max_abs",
+              band=1.0, floor=0.0),
+        Check("aot_walls.worst_restore_vs_fresh", "max_abs", band=1.0,
+              floor=0.5),
+        Check("throughput.aggregate_vs_single", "count_min", band=1.6),
+        Check("value", "count_min", band=_WALL_BAND),
+    ),
     "calibration_recovery": (
         # The differentiable solve stack (ISSUE 17). value IS the planted-
         # parameter recovery error — the acceptance ceiling is 1e-3 and

@@ -322,8 +322,12 @@ def report_main(argv) -> int:
                       f"trips={s.get('accel_trips')} "
                       f"fallbacks={s.get('pushforward_fallbacks')}")
             elif k == "degradation":
-                print(f"  degradation: {ev.get('event')} x{ev.get('n', 1)}"
-                      f" ({ev.get('route', '-')})")
+                if ev.get("stage") == "l2_tier":
+                    print(f"  degradation [l2_tier]: {ev.get('reason')} "
+                          f"({ev.get('path', '-')})")
+                else:
+                    print(f"  degradation: {ev.get('event')} "
+                          f"x{ev.get('n', 1)} ({ev.get('route', '-')})")
             elif k == "metric":
                 print(f"  metric {ev.get('metric')}: {ev.get('value')} "
                       f"{ev.get('unit', '')}")
@@ -408,8 +412,32 @@ def report_main(argv) -> int:
                     print(f"  warmup {ev.get('program')}: skipped "
                           f"({ev.get('skipped')})")
                 else:
+                    aot_bit = " [AOT restore]" if ev.get("restored") else ""
                     print(f"  warmup {ev.get('program')}: "
-                          f"{ev.get('compile_seconds')}s")
+                          f"{ev.get('compile_seconds')}s{aot_bit}")
+            elif k == "fleet_worker":
+                print(f"  fleet worker {ev.get('worker')} "
+                      f"(port {ev.get('port', '-')}, "
+                      f"grid {ev.get('grid', '-')}): {ev.get('state')}"
+                      + (f"  warm {ev['warm_seconds']}s"
+                         f" ({ev.get('warm_restored', 0)} AOT-restored)"
+                         if ev.get("warm_seconds") is not None else ""))
+            elif k == "fleet_route":
+                print(f"  fleet route {ev.get('rid')}: -> worker "
+                      f"{ev.get('worker')} (port {ev.get('port')}, "
+                      f"class {ev.get('grid_class')}) {ev.get('path')}")
+            elif k == "fleet_ack":
+                print(f"  fleet ack {ev.get('rid')}: {ev.get('code')}")
+            elif k == "fleet_drain":
+                print(f"  fleet drain worker {ev.get('worker')}: "
+                      f"replayed {ev.get('replayed')} un-acked request(s) "
+                      f"({ev.get('replay_failures', 0)} failed) onto "
+                      f"{ev.get('survivors')} survivor(s)")
+            elif k == "fleet_stop":
+                print(f"  fleet stop: {ev.get('workers')} worker(s) down")
+            elif k == "tier_promote":
+                print(f"  tier promote [{ev.get('promotion', '-')}]: "
+                      f"L2 -> L1 warm material ({ev.get('path', '-')})")
             elif k == "tuning_probe":
                 walls = ev.get("walls_us") or {}
                 detail = "  ".join(f"{r}={w:.1f}us" for r, w in

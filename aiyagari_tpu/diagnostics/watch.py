@@ -20,9 +20,14 @@ state table from the observatory's event kinds, and renders it:
                  (requests, per-status and per-cache-outcome counts, max
                  batch, last queue wait — a serving run is readable with
                  the same CLI, ISSUE 15 satellite)
+  fleet_* / tier_promote / l2_tier degradation -> the fleet block (ISSUE
+                 20): per-worker table (port, grid class, ready state,
+                 request count + rps from that worker's shard, L2
+                 promotions) plus route/ack/drain and tier tallies
 
-A single-process ledger (no shards, no mesh) degrades to the same table
-with one host column — the CLI works identically on a laptop run.
+A single-process ledger (no shards, no mesh, no fleet) degrades to the
+same table with one host column — the CLI works identically on a laptop
+run, and a single-service serve ledger renders without a fleet block.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ def build_state(events) -> dict:
             "meta": {}, "mesh": None, "skew": [], "rows": {},
             "verdicts": [], "events": 0, "hosts": set(),
             "regressions": 0, "last_ts": None, "serve": None,
+            "fleet": None,
         })
         run["events"] += 1
         run["last_ts"] = ev.get("ts", run["last_ts"])
@@ -73,7 +79,48 @@ def build_state(events) -> dict:
             run["regressions"] += 1
         elif kind in ("serve_request", "cache_hit", "coalesce"):
             _fold_serve(run, kind, ev)
+        elif (kind in ("fleet_worker", "fleet_route", "fleet_ack",
+                       "fleet_drain", "fleet_stop", "tier_promote")
+              or (kind == "degradation"
+                  and ev.get("stage") == "l2_tier")):
+            _fold_fleet(run, kind, ev)
     return runs
+
+
+def _fold_fleet(run: dict, kind: str, ev: dict) -> None:
+    """Fold the solve-fabric events (ISSUE 20) into one block: per-worker
+    rows keyed by worker index, route/ack/drain tallies, and the L2
+    tier's promotion/degradation counts. A tier_promote in a single-
+    service run creates the block with tier stats only — the renderer
+    skips the worker table when there are no workers."""
+    fl = run["fleet"]
+    if fl is None:
+        fl = run["fleet"] = {
+            "workers": {}, "routes": 0, "acks": 0, "drains": 0,
+            "replays": 0, "promotions": {}, "l2_degradations": 0,
+        }
+    if kind == "fleet_worker":
+        idx = ev.get("worker")
+        w = fl["workers"].setdefault(
+            idx if idx is not None else "?",
+            {"port": None, "grid": None, "state": "?",
+             "warm_seconds": None, "warm_restored": None})
+        for field in ("port", "grid", "state", "warm_seconds",
+                      "warm_restored"):
+            if ev.get(field) is not None:
+                w[field] = ev[field]
+    elif kind == "fleet_route":
+        fl["routes"] += 1
+    elif kind == "fleet_ack":
+        fl["acks"] += 1
+    elif kind == "fleet_drain":
+        fl["drains"] += 1
+        fl["replays"] += int(ev.get("replayed") or 0)
+    elif kind == "tier_promote":
+        host = int(ev.get("process_index", 0))
+        fl["promotions"][host] = fl["promotions"].get(host, 0) + 1
+    elif kind == "degradation":
+        fl["l2_degradations"] += 1
 
 
 def _fold_serve(run: dict, kind: str, ev: dict) -> None:
@@ -84,7 +131,7 @@ def _fold_serve(run: dict, kind: str, ev: dict) -> None:
         sv = run["serve"] = {
             "requests": 0, "statuses": {}, "cache": {},
             "lookups": {}, "coalesced_batches": 0, "max_batch": 0,
-            "last_queue_wait_s": None,
+            "last_queue_wait_s": None, "by_host": {},
         }
     if kind == "serve_request":
         sv["requests"] += 1
@@ -95,6 +142,17 @@ def _fold_serve(run: dict, kind: str, ev: dict) -> None:
         sv["max_batch"] = max(sv["max_batch"], int(ev.get("batch") or 1))
         if ev.get("queue_wait_s") is not None:
             sv["last_queue_wait_s"] = ev["queue_wait_s"]
+        # Per-shard tallies: in a fleet ledger process_index IS the worker
+        # index, so these become the worker table's requests/rps columns.
+        host = sv["by_host"].setdefault(
+            int(ev.get("process_index", 0)),
+            {"requests": 0, "first_ts": None, "last_ts": None})
+        host["requests"] += 1
+        ts = ev.get("ts")
+        if ts is not None:
+            if host["first_ts"] is None:
+                host["first_ts"] = ts
+            host["last_ts"] = ts
     elif kind == "cache_hit":
         oc = ev.get("outcome") or "?"
         sv["lookups"][oc] = sv["lookups"].get(oc, 0) + 1
@@ -220,6 +278,36 @@ def render_state(runs: dict) -> str:
             if sv["last_queue_wait_s"] is not None:
                 bits.append(f"last wait={sv['last_queue_wait_s']}s")
             lines.append("  " + "  ".join(bits))
+        fl = run.get("fleet")
+        if fl:
+            promos = sum(fl["promotions"].values())
+            lines.append(
+                f"  fleet: {len(fl['workers'])} worker(s)  "
+                f"routes={fl['routes']} acks={fl['acks']} "
+                f"unacked={max(0, fl['routes'] - fl['acks'])} "
+                f"drains={fl['drains']} replays={fl['replays']}  "
+                f"tier promotions={promos} "
+                f"degradations={fl['l2_degradations']}")
+            if fl["workers"]:
+                by_host = (run.get("serve") or {}).get("by_host", {})
+                lines.append("  worker  port   grid  state      requests"
+                             "  rps      l2_promotions  warm_s")
+                for idx in sorted(fl["workers"],
+                                  key=lambda k: (isinstance(k, str), k)):
+                    w = fl["workers"][idx]
+                    h = by_host.get(idx if isinstance(idx, int) else -1,
+                                    {})
+                    n = h.get("requests", 0)
+                    span = ((h.get("last_ts") or 0)
+                            - (h.get("first_ts") or 0))
+                    rps = round(n / span, 2) if n and span > 0 else None
+                    lines.append(
+                        "  " + _fmt(idx, 8) + _fmt(w["port"], 7)
+                        + _fmt(w["grid"], 6) + _fmt(w["state"], 11)
+                        + _fmt(n, 10) + _fmt(rps, 9, "{:.2f}")
+                        + _fmt(fl["promotions"].get(
+                            idx if isinstance(idx, int) else -1, 0), 15)
+                        + _fmt(w["warm_seconds"], 1, "{:.2f}").rstrip())
         for ev in run["verdicts"]:
             status = "converged" if ev.get("converged") else "NOT CONVERGED"
             lines.append(f"  done {ev.get('context')}: {status} after "

@@ -17,7 +17,13 @@ from __future__ import annotations
 import hashlib
 import os
 
-__all__ = ["enable_compilation_cache"]
+__all__ = [
+    "aot_cache_dir",
+    "aot_key",
+    "enable_compilation_cache",
+    "load_serialized",
+    "save_serialized",
+]
 
 
 def _host_cpu_tag() -> str:
@@ -107,3 +113,85 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     except AttributeError:   # older jax without the persistent cache
         return None
     return cache_dir
+
+
+# -- AOT-serialized executables (jax.export) -------------------------------
+#
+# The XLA cache above removes the BACKEND compile on restart; these helpers
+# remove the TRACE as well. serve/warmup.py exports each warm-pool program
+# through jax.export after its first compile and persists the serialized
+# bytes beside the XLA cache; the next start deserializes and compiles the
+# exported StableHLO directly — no solver-code retrace — so a fleet worker
+# (or a crashed one) is serving in seconds (ISSUE 20 tentpole, layer 2).
+# Keys carry jax/jaxlib versions and the backend+CPU-stepping suffix:
+# serialized StableHLO ages with the lowering exactly like XLA artifacts.
+
+
+def aot_cache_dir(cache_dir: str | None = None) -> str | None:
+    """The AOT executable directory, resolved with the SAME order and kill
+    switch as `enable_compilation_cache`: explicit argument,
+    $AIYAGARI_TPU_COMPILE_CACHE (empty string disables), then
+    ~/.cache/aiyagari_tpu/aot-{backend}-{cpu_tag}."""
+    import jax
+
+    env = os.environ.get("AIYAGARI_TPU_COMPILE_CACHE")
+    if env == "":
+        return None
+    if cache_dir is None:
+        cache_dir = env
+    suffix = f"{jax.default_backend()}-{_host_cpu_tag()}"
+    if cache_dir is None:
+        return os.path.join(os.path.expanduser("~"), ".cache",
+                            "aiyagari_tpu", f"aot-{suffix}")
+    return f"{cache_dir.rstrip(os.sep)}-aot-{suffix}"
+
+
+def aot_key(name: str) -> str:
+    """Filename-safe cache key for one exported program: the program name
+    plus the jax/jaxlib versions (serialized artifacts do not survive a
+    lowering upgrade; the platform is already in the directory suffix)."""
+    import jax
+    import jaxlib
+
+    digest = hashlib.sha256(
+        f"{name}|{jax.__version__}|{jaxlib.__version__}".encode()
+    ).hexdigest()[:32]
+    return f"{digest}.jaxexport"
+
+
+def save_serialized(name: str, data: bytes,
+                    cache_dir: str | None = None) -> str | None:
+    """Atomically persist one serialized executable; returns the path
+    written (None when the cache is disabled or the write fails — AOT
+    export is an optimization and must never fail a warm pool)."""
+    base = aot_cache_dir(cache_dir)
+    if base is None:
+        return None
+    path = os.path.join(base, aot_key(name))
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(base, exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load_serialized(name: str,
+                    cache_dir: str | None = None) -> bytes | None:
+    """The serialized executable for `name` under the current
+    jax/jaxlib/platform key, or None (missing, disabled, unreadable)."""
+    base = aot_cache_dir(cache_dir)
+    if base is None:
+        return None
+    try:
+        with open(os.path.join(base, aot_key(name)), "rb") as f:
+            return f.read()
+    except OSError:
+        return None

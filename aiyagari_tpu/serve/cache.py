@@ -208,6 +208,10 @@ class CacheEntry:
     nbytes: int
     stored_at: float
     hits: int = 0
+    promoted: bool = False     # adopted from the L2 tier (serve/tier.py):
+                               # warm-start material only — an exact match
+                               # classifies "warm", never "hit", until a
+                               # LOCAL converged solve re-stores the key
 
 
 class SolutionCache:
@@ -224,7 +228,13 @@ class SolutionCache:
         self.neighbor_radius = float(neighbor_radius)
         self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        # Re-entrant: stats() reads hit_rate() under the lock, and the
+        # thread-safety contract (ISSUE 20 satellite) is that EVERY
+        # lookup/put/evict bookkeeping path — including the service's
+        # fast-path peek and the L2 promotion path (serve/tier.py), which
+        # run on HTTP handler threads concurrent with the worker — holds
+        # this one lock around the LRU and its counters.
+        self._lock = threading.RLock()
         self.hits = 0
         self.warm = 0
         self.misses = 0
@@ -249,25 +259,57 @@ class SolutionCache:
         key = self.key_for(config, kind=kind, extra=extra)
         exact = calibration_params(config)
         with self._lock:
-            entry = self._entries.get(key)
-            if entry is not None:
-                self._entries.move_to_end(key)
-                entry.hits += 1
-                if entry.exact == exact:
-                    self.hits += 1
-                    self._count("hits")
-                    return "hit", entry
-                self.warm += 1
-                self._count("warm")
-                return "warm", entry
-            entry = self._nearest_locked(key, exact)
-            if entry is not None:
-                self.warm += 1
-                self._count("warm")
-                return "warm", entry
+            outcome, entry = self._classify_locked(key, exact)
+            self._count_outcome_locked(outcome)
+            return outcome, entry
+
+    def _classify_locked(self, key: tuple, exact: Tuple[float, ...]):
+        """The classification half of `lookup` (caller holds the lock, and
+        owns the outcome counting): exact hit / bucket-collision warm /
+        nearest-neighbor warm / miss. Split out so the tiered cache
+        (serve/tier.py) can classify L1 and fall through to L2 without
+        double-counting a miss."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+            entry.hits += 1
+            if entry.exact == exact and not entry.promoted:
+                return "hit", entry
+            return "warm", entry
+        entry = self._nearest_locked(key, exact)
+        if entry is not None:
+            return "warm", entry
+        return "miss", None
+
+    def _count_outcome_locked(self, outcome: str) -> None:
+        if outcome == "hit":
+            self.hits += 1
+            self._count("hits")
+        elif outcome == "warm":
+            self.warm += 1
+            self._count("warm")
+        else:
             self.misses += 1
             self._count("misses")
-            return "miss", None
+
+    def peek(self, config, *, kind: str = "ss",
+             extra: tuple = ()) -> Optional[CacheEntry]:
+        """A LOCKED exact-hit peek that mutates nothing: no LRU reorder,
+        no hit counters, no outcome accounting. The service's fast path
+        (`_try_hit`) uses this instead of reading `_entries` bare — HTTP
+        handler threads and the L2 promotion path race on the LRU, and an
+        unlocked OrderedDict read during a concurrent evict/insert is a
+        data race (ISSUE 20 satellite)."""
+        if self.byte_budget <= 0:
+            return None
+        key = self.key_for(config, kind=kind, extra=extra)
+        exact = calibration_params(config)
+        with self._lock:
+            entry = self._entries.get(key)
+            if (entry is not None and entry.exact == exact
+                    and not entry.promoted):
+                return entry
+        return None
 
     def _nearest_locked(self, key: tuple, exact: Tuple[float, ...]):
         """The nearest same-kind/same-structure entry within
@@ -322,11 +364,22 @@ class SolutionCache:
         holds. A payload larger than the whole budget is not stored (it
         would evict everything and then itself — the metric records the
         refusal as an eviction)."""
-        key = self.key_for(config, kind=kind, extra=extra)
+        return self.put_entry(self.key_for(config, kind=kind, extra=extra),
+                              calibration_params(config), payload)
+
+    def put_entry(self, key: tuple, exact: Tuple[float, ...],
+                  payload, *, promoted: bool = False
+                  ) -> Optional[CacheEntry]:
+        """`put` under a precomputed (key, exact) pair — the L2 promotion
+        path (serve/tier.py) adopts another worker's entry verbatim, so
+        the key arrives already quantized and must be inserted under the
+        same lock discipline as a local put. `promoted=True` marks the
+        entry as cross-worker warm material: exact lookups on it classify
+        "warm" (polish, then re-store locally), never "hit"."""
         nbytes = payload_nbytes(payload)
-        entry = CacheEntry(key=key, exact=calibration_params(config),
-                           payload=payload, nbytes=nbytes,
-                           stored_at=time.time())
+        entry = CacheEntry(key=key, exact=tuple(exact), payload=payload,
+                           nbytes=nbytes, stored_at=time.time(),
+                           promoted=promoted)
         with self._lock:
             if self.byte_budget <= 0:
                 return None
@@ -362,8 +415,9 @@ class SolutionCache:
     def hit_rate(self) -> float:
         """Exact-hit fraction of all lookups (the gauge the service
         exports; warm lookups are counted as non-hits — they still solve)."""
-        total = self.hits + self.warm + self.misses
-        return self.hits / total if total else 0.0
+        with self._lock:
+            total = self.hits + self.warm + self.misses
+            return self.hits / total if total else 0.0
 
     def stats(self) -> dict:
         with self._lock:

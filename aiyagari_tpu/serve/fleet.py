@@ -1,0 +1,565 @@
+"""Fleet front (ISSUE 20 tentpole, layer 3): N `SolveService` workers as
+separate processes behind one routing HTTP front — `serve/` grows from a
+single service into a pod-scale solve fabric.
+
+Topology. Each worker is a full `python -m aiyagari_tpu serve --port P`
+process with its own grid-sized warm pool, its own L1 solution cache, and
+its own host-stamped ledger shard (`ledger.p<k>.jsonl`) under ONE run id
+the front draws and passes to every worker (PR 14's multi-host machinery,
+reused verbatim: `merge_ledgers` reads the whole fleet as a single flight
+record). Workers share the L2 solution tier (`serve/tier.py`) and the
+AOT-serialized warm pool (`serve/warmup.py --aot`), so worker B starts
+warm from worker A's compiles and polishes from worker A's solves.
+
+Routing. The front classes each request by GRID-SIZE bucket — a request's
+optional top-level `"grid"` field is matched to the nearest worker grid
+class — because grid size is the structural key: a worker's warm pool,
+its XLA executables, and its cache entries are all sized to its grid, so
+right-sizing the route is what makes the fabric's caches compose.
+Within a class, ready non-draining workers round-robin.
+
+Delivery record. Every routed request writes a `fleet_route` event
+(request id, worker, body) to the front's shard BEFORE the forward, and a
+`fleet_ack` after the worker's response went out. The un-acked difference
+is exactly the set of requests whose answers never reached a client —
+`unacked_from_ledger` computes it, and a graceful drain (POST /drain)
+replays it onto the surviving workers after the drained process exits:
+admission stops, in-flight requests finish, the process is terminated,
+and un-acked work is re-solved so its results exist in the fabric's
+tiers even though the original connection is gone.
+
+Observability: `aiyagari_fleet_workers` / `aiyagari_fleet_rps` gauges,
+`aiyagari_fleet_{requests,replays,drains}_total` counters, aggregated
+worker + L2 state on GET /healthz, `python -m aiyagari_tpu watch` renders
+the per-worker table from the merged shards.
+
+CLI: `python -m aiyagari_tpu fleet --workers N [--grids 40,100 ...]`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from collections import deque
+from http.client import HTTPConnection
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["Fleet", "fleet_main", "unacked_from_ledger"]
+
+
+def _free_port() -> int:
+    """An OS-assigned free TCP port (bind-to-0 probe). Raceable in
+    principle; in practice the worker binds within milliseconds."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def grid_class(grids: Sequence[int], requested: Optional[int]) -> int:
+    """The worker grid class serving a requested grid size: the nearest
+    available class (ties to the smaller — a too-small warm pool recompiles
+    less than a too-big one idles). None = the fleet's first class."""
+    classes = sorted(set(int(g) for g in grids))
+    if not classes:
+        raise ValueError("fleet has no grid classes")
+    if requested is None:
+        return classes[0]
+    return min(classes, key=lambda g: (abs(g - int(requested)), g))
+
+
+def unacked_from_ledger(events, *, run_id: Optional[str] = None,
+                        worker: Optional[int] = None) -> List[dict]:
+    """The routed-but-never-acknowledged requests of a fleet run: every
+    `fleet_route` event (latest attempt per request id wins) without a
+    matching `fleet_ack`. Pure function over ledger event dicts — works on
+    one shard or on `merge_ledgers` output; filter by `run_id`/`worker`
+    when the file holds more than one run or the drain targets one
+    worker's backlog."""
+    routed: dict = {}
+    acked: set = set()
+    for ev in events:
+        if run_id is not None and ev.get("run_id") != run_id:
+            continue
+        kind = ev.get("kind")
+        if kind == "fleet_route":
+            routed[ev.get("rid")] = ev
+        elif kind == "fleet_ack":
+            acked.add(ev.get("rid"))
+    out = [ev for rid, ev in routed.items() if rid not in acked]
+    if worker is not None:
+        out = [ev for ev in out if ev.get("worker") == worker]
+    out.sort(key=lambda ev: ev.get("seq", 0))
+    return out
+
+
+class _Worker:
+    """One spawned serve process and the front's view of it."""
+
+    def __init__(self, index: int, grid: int, port: int,
+                 proc: subprocess.Popen):
+        self.index = index
+        self.grid = grid
+        self.port = port
+        self.proc = proc
+        self.ready = False
+        self.draining = False
+        self.inflight = 0
+        self.served = 0
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class Fleet:
+    """Spawn + front N serve workers (module docstring). Usage:
+
+        fleet = Fleet(workers=2, grids=(40,), ledger="fleet.jsonl",
+                      l2_dir="l2/", aot=True)
+        fleet.start(ready_timeout=600)
+        httpd = fleet.front(port)           # ThreadingHTTPServer
+        ...
+        fleet.stop()
+    """
+
+    def __init__(self, workers: int = 2, *, grids: Sequence[int] = (40,),
+                 ledger=None, l2_dir=None, aot: bool = False,
+                 method: str = "egm", dtype: str = "float64",
+                 max_batch: int = 8, cache_mb: float = 256.0,
+                 warm_families: Optional[str] = None,
+                 platform: Optional[str] = None,
+                 extra_args: Sequence[str] = ()):
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        from aiyagari_tpu.diagnostics.ledger import RunLedger, new_run_id
+
+        self.run_id = new_run_id()
+        self.n = int(workers)
+        self.grids = tuple(int(g) for g in grids) or (40,)
+        self.ledger_path = str(ledger) if ledger else None
+        self.l2_dir = str(l2_dir) if l2_dir else None
+        self.aot = bool(aot)
+        self._spawn_opts = dict(
+            method=method, dtype=dtype, max_batch=max_batch,
+            cache_mb=cache_mb, warm_families=warm_families,
+            platform=platform, extra_args=tuple(extra_args))
+        self.workers: List[_Worker] = []
+        self._led = None
+        if self.ledger_path:
+            # The front takes shard index n (workers hold 0..n-1): one run
+            # id, n+1 host-stamped shards, one merged flight record.
+            self._led = RunLedger(
+                self.ledger_path, run_id=self.run_id,
+                process_index=self.n, process_count=self.n + 1,
+                meta={"entry": "fleet_front", "workers": self.n,
+                      "grids": list(self.grids)})
+        self._lock = threading.Lock()
+        self._rr = 0                      # round-robin cursor
+        self._times: deque = deque(maxlen=512)   # request timestamps (rps)
+        self._health_cache: Tuple[float, dict] = (0.0, {})
+        self.drains = 0
+        self.replays = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self, index: int, grid: int) -> _Worker:
+        port = _free_port()
+        o = self._spawn_opts
+        cmd = [sys.executable, "-m", "aiyagari_tpu", "serve",
+               "--port", str(port), "--grid", str(grid),
+               "--method", o["method"], "--dtype", o["dtype"],
+               "--max-batch", str(o["max_batch"]),
+               "--cache-mb", str(o["cache_mb"])]
+        if self.ledger_path:
+            cmd += ["--ledger", self.ledger_path,
+                    "--run-id", self.run_id,
+                    "--worker-index", str(index),
+                    "--worker-count", str(self.n + 1)]
+        if self.l2_dir:
+            cmd += ["--l2-dir", self.l2_dir]
+        if self.aot:
+            cmd += ["--aot"]
+        if o["warm_families"] is not None:
+            cmd += ["--warm-families", o["warm_families"]]
+        cmd += list(o["extra_args"])
+        env = dict(os.environ)
+        if o["platform"]:
+            env["JAX_PLATFORMS"] = o["platform"]
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL, env=env)
+        return _Worker(index, grid, port, proc)
+
+    def start(self, ready_timeout: float = 600.0) -> "Fleet":
+        """Spawn every worker, then poll /healthz until each reports 200
+        ready (the readiness split: a worker answers 503 "warming" from
+        the moment its socket is up until its warm pool / AOT restore
+        completes) or the deadline passes."""
+        for i in range(self.n):
+            self.workers.append(
+                self._spawn(i, self.grids[i % len(self.grids)]))
+        deadline = time.monotonic() + ready_timeout
+        for w in self.workers:
+            while time.monotonic() < deadline and w.alive():
+                state = self._worker_health(w)
+                if state.get("state") == "ready":
+                    w.ready = True
+                    break
+                time.sleep(0.25)
+            if self._led is not None:
+                self._led.event(
+                    "fleet_worker", worker=w.index, port=w.port,
+                    grid=w.grid, state="ready" if w.ready else "not_ready",
+                    alive=w.alive())
+        self._gauge_workers()
+        return self
+
+    def stop(self) -> None:
+        for w in self.workers:
+            if w.alive():
+                w.proc.terminate()
+        for w in self.workers:
+            try:
+                w.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+                w.proc.wait(timeout=10)
+        if self._led is not None:
+            self._led.event("fleet_stop", drains=self.drains,
+                            replays=self.replays)
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- worker I/O --------------------------------------------------------
+
+    @staticmethod
+    def _worker_health(w: _Worker, timeout: float = 5.0) -> dict:
+        try:
+            conn = HTTPConnection("127.0.0.1", w.port, timeout=timeout)
+            try:
+                conn.request("GET", "/healthz")
+                r = conn.getresponse()
+                body = json.loads(r.read() or b"{}")
+                body.setdefault(
+                    "state", "ready" if r.status == 200 else "warming")
+                return body
+            finally:
+                conn.close()
+        except Exception:  # noqa: BLE001 — down/unreachable = not ready
+            return {"state": "down"}
+
+    def _forward(self, w: _Worker, path: str, body: dict,
+                 timeout: float) -> Tuple[int, bytes]:
+        data = json.dumps(body).encode()
+        conn = HTTPConnection("127.0.0.1", w.port, timeout=timeout)
+        try:
+            conn.request("POST", path, body=data,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+    # -- routing -----------------------------------------------------------
+
+    def _eligible(self, cls: int) -> List[_Worker]:
+        return [w for w in self.workers
+                if w.grid == cls and w.ready and not w.draining
+                and w.alive()]
+
+    def route(self, body: dict, *, path: str = "/solve",
+              timeout: float = 600.0,
+              exclude: Sequence[int] = ()) -> Tuple[int, bytes]:
+        """Class the request by grid bucket, pick the next ready worker
+        round-robin, forward with a route/ack delivery record, and fail
+        over to the class's survivors on transport errors (a worker that
+        died mid-request). Raises RuntimeError when no worker can take
+        the class."""
+        requested = body.pop("grid", None)
+        cls = grid_class(self.grids, requested)
+        rid = uuid.uuid4().hex[:12]
+        last_err: Optional[Exception] = None
+        tried: set = set(exclude)
+        for _ in range(len(self.workers)):
+            with self._lock:
+                cands = [w for w in self._eligible(cls)
+                         if w.index not in tried]
+                if not cands:
+                    break
+                w = cands[self._rr % len(cands)]
+                self._rr += 1
+                w.inflight += 1
+            tried.add(w.index)
+            if self._led is not None:
+                self._led.event("fleet_route", rid=rid, worker=w.index,
+                                port=w.port, grid_class=cls, path=path,
+                                body=json.dumps(body))
+            try:
+                code, payload = self._forward(w, path, body, timeout)
+            except Exception as e:  # noqa: BLE001 — transport failure:
+                last_err = e        # the survivors get the request
+                continue
+            finally:
+                with self._lock:
+                    w.inflight -= 1
+            with self._lock:
+                w.served += 1
+            if self._led is not None:
+                self._led.event("fleet_ack", rid=rid, worker=w.index,
+                                code=code)
+            self._count("requests")
+            with self._lock:
+                self._times.append(time.monotonic())
+            return code, payload
+        raise RuntimeError(
+            f"no worker available for grid class {cls}"
+            + (f" (last transport error: {last_err})" if last_err else ""))
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, index: int, *, inflight_timeout: float = 120.0,
+              replay_timeout: float = 600.0) -> dict:
+        """Gracefully retire worker `index`: stop admission (draining
+        flag), wait for its front-tracked in-flight requests to finish,
+        terminate the process, then replay its un-acked requests from the
+        ledger onto the surviving workers (their answers never reached a
+        client — re-solving parks the results in the fabric's caches)."""
+        w = next((x for x in self.workers if x.index == index), None)
+        if w is None:
+            raise ValueError(f"no worker {index}")
+        with self._lock:
+            w.draining = True
+        deadline = time.monotonic() + inflight_timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if w.inflight <= 0:
+                    break
+            time.sleep(0.05)
+        if w.alive():
+            w.proc.terminate()
+            try:
+                w.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        w.ready = False
+        replayed = failed = 0
+        if self._led is not None:
+            from aiyagari_tpu.diagnostics.ledger import read_ledger
+
+            events = read_ledger(self._led.path)
+            for ev in unacked_from_ledger(events, run_id=self.run_id,
+                                          worker=index):
+                try:
+                    body = json.loads(ev.get("body") or "{}")
+                    code, _ = self.route(
+                        body, path=ev.get("path", "/solve"),
+                        timeout=replay_timeout, exclude=(index,))
+                    replayed += 1
+                    self._count("replays")
+                except Exception:  # noqa: BLE001 — count, keep draining
+                    failed += 1
+            self.replays += replayed
+        self.drains += 1
+        self._count("drains")
+        self._gauge_workers()
+        report = {"worker": index, "replayed": replayed,
+                  "replay_failures": failed,
+                  "survivors": sum(1 for x in self.workers if x.ready)}
+        if self._led is not None:
+            self._led.event("fleet_drain", **report)
+        return report
+
+    # -- aggregated health -------------------------------------------------
+
+    def health(self, max_age_s: float = 1.0) -> dict:
+        """Fleet-wide healthz: per-worker state + aggregated L2/cold
+        numbers, memoized for `max_age_s` so a polling front does not
+        multiply scrape load onto the workers."""
+        with self._lock:
+            ts, cached = self._health_cache
+            if time.monotonic() - ts < max_age_s and cached:
+                return cached
+        rows = []
+        l2_hits = 0
+        ready = 0
+        for w in self.workers:
+            h = self._worker_health(w) if w.alive() else {"state": "down"}
+            state = ("draining" if w.draining else h.get("state", "down"))
+            if state == "ready":
+                ready += 1
+            l2 = (h.get("cache") or {}).get("l2") or {}
+            l2_hits += int(l2.get("hits", 0))
+            rows.append({
+                "worker": w.index, "port": w.port, "grid": w.grid,
+                "state": state, "served": w.served,
+                "requests_served": h.get("requests_served", 0),
+                "cold_fraction": h.get("cold_fraction"),
+                "cache": h.get("cache")})
+        now = time.monotonic()
+        with self._lock:
+            while self._times and now - self._times[0] > 30.0:
+                self._times.popleft()
+            rps = len(self._times) / 30.0
+        out = {"ok": ready > 0, "run_id": self.run_id, "workers": rows,
+               "ready": ready, "rps": round(rps, 3),
+               "l2_hits": l2_hits, "drains": self.drains,
+               "replays": self.replays}
+        self._gauge("aiyagari_fleet_workers", ready)
+        self._gauge("aiyagari_fleet_rps", rps)
+        self._gauge("aiyagari_fleet_l2_hits", l2_hits)
+        with self._lock:
+            self._health_cache = (time.monotonic(), out)
+        return out
+
+    def _gauge_workers(self) -> None:
+        self._gauge("aiyagari_fleet_workers",
+                    sum(1 for w in self.workers if w.ready))
+
+    # -- observability (best-effort) ---------------------------------------
+
+    @staticmethod
+    def _gauge(name: str, value) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.gauge(name).set(float(value))
+        except Exception:  # pragma: no cover
+            pass
+
+    @staticmethod
+    def _count(what: str) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter(f"aiyagari_fleet_{what}_total").inc()
+        except Exception:  # pragma: no cover
+            pass
+
+    # -- the routing HTTP front --------------------------------------------
+
+    def front(self, port: int):
+        """The front's ThreadingHTTPServer: POST /solve (routed), POST
+        /drain {"worker": i}, GET /healthz (aggregate), GET /metrics
+        (front-process registry). Call serve_forever() on the result."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        fleet = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    h = fleet.health()
+                    self._send(200 if h["ok"] else 503,
+                               json.dumps(h).encode())
+                elif self.path == "/metrics":
+                    from aiyagari_tpu.diagnostics import metrics
+
+                    fleet.health()   # refresh the fleet gauges
+                    self._send(200, metrics.render_prometheus().encode(),
+                               "text/plain; version=0.0.4")
+                else:
+                    self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except Exception:  # noqa: BLE001 — HTTP boundary
+                    self._send(400, b'{"error": "bad json"}')
+                    return
+                try:
+                    if self.path == "/drain":
+                        report = fleet.drain(int(body.get("worker", 0)))
+                        self._send(200, json.dumps(report).encode())
+                    elif self.path in ("/solve", "/calibrate"):
+                        code, payload = fleet.route(
+                            body, path=self.path,
+                            timeout=float(body.get("timeout", 600)) + 30.0)
+                        self._send(code, payload)
+                    else:
+                        self._send(404, b'{"error": "not found"}')
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    self._send(503, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"[:500]}
+                    ).encode())
+
+        return ThreadingHTTPServer(("127.0.0.1", port), Handler)
+
+
+def fleet_main(argv) -> int:
+    """`python -m aiyagari_tpu fleet --workers N`: spawn the workers, wait
+    for readiness, and serve the routing front."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="aiyagari_tpu fleet")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--grids", default="40",
+                    help="comma-separated grid classes; workers round-"
+                         "robin over them (one right-sized warm pool per "
+                         "class)")
+    ap.add_argument("--port", type=int, default=8800,
+                    help="the routing front's HTTP port")
+    ap.add_argument("--method", choices=["vfi", "egm"], default="egm")
+    ap.add_argument("--dtype", choices=["float32", "float64", "mixed"],
+                    default="float64")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--cache-mb", type=float, default=256.0)
+    ap.add_argument("--l2-dir", default=None,
+                    help="shared cross-worker L2 solution tier directory")
+    ap.add_argument("--aot", action="store_true",
+                    help="workers restore AOT-serialized warm pools")
+    ap.add_argument("--warm-families", default=None,
+                    help="worker warm-pool families ('' = sized programs "
+                         "only)")
+    ap.add_argument("--ledger", default=None,
+                    help="sharded fleet flight record (one run id; "
+                         "render: python -m aiyagari_tpu report/watch)")
+    ap.add_argument("--ready-timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    grids = tuple(int(g) for g in args.grids.split(",") if g)
+    fleet = Fleet(args.workers, grids=grids, ledger=args.ledger,
+                  l2_dir=args.l2_dir, aot=args.aot, method=args.method,
+                  dtype=args.dtype, max_batch=args.max_batch,
+                  cache_mb=args.cache_mb,
+                  warm_families=args.warm_families)
+    fleet.start(ready_timeout=args.ready_timeout)
+    ready = sum(1 for w in fleet.workers if w.ready)
+    print(f"fleet: {ready}/{fleet.n} worker(s) ready "
+          f"(grids {sorted(set(fleet.grids))}, run {fleet.run_id})")
+    for w in fleet.workers:
+        print(f"  worker {w.index}: grid {w.grid} on 127.0.0.1:{w.port} "
+              f"[{'ready' if w.ready else 'NOT READY'}]")
+    httpd = fleet.front(args.port)
+    print(f"fleet front on http://127.0.0.1:{args.port}  "
+          f"(POST /solve, POST /drain, GET /healthz, GET /metrics)")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        fleet.stop()
+    return 0

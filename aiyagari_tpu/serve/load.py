@@ -43,15 +43,24 @@ class HttpServiceClient:
     (submit -> parsed response, network included), which is the number the
     knee is defined on."""
 
-    def __init__(self, base, port: int, *, host: str = "127.0.0.1",
+    def __init__(self, base, port, *, host: str = "127.0.0.1",
                  auth_token: Optional[str] = None, timeout: float = 600.0,
                  workers: int = 8):
+        import itertools
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
         self._base = base
         self._host = host
-        self._port = port
+        # One port drives a single service; a SEQUENCE of ports fans the
+        # same open-loop schedule round-robin over multiple base URLs —
+        # the multi-worker drive `bench --metric fleet` rides (each driver
+        # thread keeps one keep-alive socket PER port).
+        self._ports = (tuple(int(p) for p in port)
+                       if isinstance(port, (tuple, list)) else (int(port),))
+        if not self._ports:
+            raise ValueError("HttpServiceClient needs at least one port")
+        self._rr = itertools.count()
         self._token = auth_token
         self._timeout = timeout
         self._tls = threading.local()
@@ -80,25 +89,32 @@ class HttpServiceClient:
                 "only applies params overrides over its base economy")
         return out
 
-    def _connection(self):
+    def _connection(self, port: int):
         import http.client
 
-        conn = getattr(self._tls, "conn", None)
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            conns = self._tls.conns = {}
+        conn = conns.get(port)
         if conn is None:
-            conn = http.client.HTTPConnection(self._host, self._port,
+            conn = http.client.HTTPConnection(self._host, port,
                                               timeout=self._timeout)
-            self._tls.conn = conn
+            conns[port] = conn
         return conn
 
     def _post(self, path: str, body: str) -> dict:
         import http.client
         import json
 
+        # Round-robin over the configured base URLs, one pick per request
+        # (retries stay on the picked port — a stale socket is not a down
+        # server).
+        port = self._ports[next(self._rr) % len(self._ports)]
         headers = {"Content-Type": "application/json"}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
         for attempt in (0, 1):
-            conn = self._connection()
+            conn = self._connection(port)
             try:
                 conn.request("POST", path, body, headers)
                 resp = conn.getresponse()
@@ -107,7 +123,7 @@ class HttpServiceClient:
             except (http.client.HTTPException, OSError):
                 # Stale keep-alive socket: drop it and re-dial ONCE.
                 conn.close()
-                self._tls.conn = None
+                self._tls.conns.pop(port, None)
                 if attempt:
                     raise
         raise RuntimeError("unreachable")   # pragma: no cover

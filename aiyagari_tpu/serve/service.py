@@ -85,6 +85,9 @@ class ServeConfig:
     max_batch: int = 8
     max_wait_s: float = 0.05
     cache_bytes: int = 256 * 1024 * 1024
+    l2_dir: Optional[str] = None       # shared cross-worker L2 solution
+                                       # tier (serve/tier.py); None = L1 only
+    l2_bytes: int = 1 << 30            # L2 directory byte budget
     resolution: float = 1e-3           # calibration quantization bucket
     neighbor_radius: float = 50.0      # nearest-neighbor radius, in buckets
     polish_steps: int = 8              # secant evaluations before the
@@ -93,6 +96,9 @@ class ServeConfig:
     warm_pool: bool = True             # precompile the kernel zoo at start()
     warm_families: Optional[Tuple[str, ...]] = None
     warm_na: Optional[int] = None      # also precompile sized hot programs
+    warm_aot: bool = False             # restore AOT-serialized executables
+                                       # instead of retracing, exporting
+                                       # fresh compiles for the next start
     blend_neighbors: int = 4           # cached neighbors blended per warm
                                        # start (1 = PR 15 single-neighbor)
     surrogate: bool = True             # the ledger-trained predictor of
@@ -224,9 +230,20 @@ class SolveService:
         from aiyagari_tpu.serve.cache import SolutionCache
 
         self.config = config
-        self.cache = SolutionCache(config.cache_bytes,
-                                   resolution=config.resolution,
-                                   neighbor_radius=config.neighbor_radius)
+        self._led = self._as_ledger(ledger)
+        if config.l2_dir and config.cache_bytes > 0:
+            from aiyagari_tpu.serve.tier import L2Tier, TieredSolutionCache
+
+            self.cache = TieredSolutionCache(
+                config.cache_bytes, resolution=config.resolution,
+                neighbor_radius=config.neighbor_radius,
+                l2=L2Tier(config.l2_dir, config.l2_bytes,
+                          resolution=config.resolution, ledger=self._led),
+                ledger=self._led)
+        else:
+            self.cache = SolutionCache(
+                config.cache_bytes, resolution=config.resolution,
+                neighbor_radius=config.neighbor_radius)
         self.surrogate = None
         if config.surrogate:
             from aiyagari_tpu.serve.surrogate import PolicySurrogate
@@ -234,7 +251,6 @@ class SolveService:
             self.surrogate = PolicySurrogate(
                 min_samples=config.surrogate_min_samples,
                 fit_every=config.surrogate_fit_every)
-        self._led = self._as_ledger(ledger)
         self._queue: list = []          # [(SolveRequest, Future)]
         self._cond = threading.Condition()
         self._running = False
@@ -246,6 +262,10 @@ class SolveService:
         self._stager: Optional[threading.Thread] = None
         self._stage_done = False
         self.warmup_report: Optional[dict] = None
+        # Readiness (ISSUE 20 satellite): False until start() finishes the
+        # warm pool (or its AOT restore), so /healthz can 503 and a fleet
+        # front / external load balancer never routes to a cold worker.
+        self._ready = False
         self.requests_served = 0
         self.warm_sources: dict = {}    # warm_source -> served count
         self.degradations = 0
@@ -283,6 +303,7 @@ class SolveService:
                         target=self._stage_loop,
                         name="aiyagari-serve-stager", daemon=True)
                     self._stager.start()
+                self._set_ready(True)
                 return self
             # The worker exited between the checks — fall through and
             # spawn a fresh one.
@@ -294,6 +315,7 @@ class SolveService:
                 self.config.warm_families, na=self.config.warm_na,
                 dtype=("float64" if self.config.dtype in ("float64", "mixed")
                        else "float32"),
+                aot=self.config.warm_aot,
                 ledger=self._led)
         self._running = True
         self._stage_done = False
@@ -309,6 +331,7 @@ class SolveService:
             self._thread = threading.Thread(
                 target=self._worker, name="aiyagari-serve", daemon=True)
         self._thread.start()
+        self._set_ready(True)
         return self
 
     def stop(self, timeout: float = 60.0) -> None:
@@ -319,6 +342,7 @@ class SolveService:
         The pipelined worker drains front-to-back: the stager stages every
         remaining admission, signals done, and the executor exits once the
         staged slot empties."""
+        self._set_ready(False)
         with self._cond:
             self._running = False
             self._cond.notify_all()
@@ -333,6 +357,17 @@ class SolveService:
             self._thread.join(max(0.0, deadline - time.perf_counter()))
             if not self._thread.is_alive():
                 self._thread = None
+
+    @property
+    def ready(self) -> bool:
+        """True once start() has finished warming (pool compile or AOT
+        restore) and the worker is accepting work. The HTTP front's
+        /healthz readiness split keys off this."""
+        return self._ready and self._running
+
+    def _set_ready(self, up: bool) -> None:
+        self._ready = bool(up)
+        self._gauge("aiyagari_serve_ready", 1.0 if up else 0.0)
 
     def __enter__(self) -> "SolveService":
         return self.start()
@@ -608,11 +643,10 @@ class SolveService:
         key_kind = "transition" if req.kind == "transition" else "ss"
         extra = (self._transition_extra(req.shock)
                  if req.kind == "transition" else ())
-        from aiyagari_tpu.serve.cache import calibration_params
-
-        entry = self.cache._entries.get(
-            self.cache.key_for(req.config, kind=key_kind, extra=extra))
-        if entry is None or entry.exact != calibration_params(req.config):
+        # A LOCKED no-mutation peek (cache.peek): HTTP handler threads and
+        # the L2 promotion path race on the LRU, so the fast path must not
+        # read _entries bare (ISSUE 20 thread-safety satellite).
+        if self.cache.peek(req.config, kind=key_kind, extra=extra) is None:
             return False
         with activate(self._led):
             outcome, entry = self._lookup(req, kind=key_kind, extra=extra)
@@ -1382,8 +1416,17 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                 self._send(200, service.metrics_text(),
                            "text/plain; version=0.0.4")
             elif self.path == "/healthz":
+                # Readiness split (ISSUE 20): 503 "warming" until the warm
+                # pool (or AOT restore) completes, so a fleet front or an
+                # external load balancer never routes to a cold worker.
+                if not service.ready:
+                    self._send(503, json.dumps({
+                        "ok": False, "state": "warming"}),
+                        headers=(("Retry-After", "1"),))
+                    return
                 self._send(200, json.dumps({
-                    "ok": True, "queue_depth": service.queue_depth,
+                    "ok": True, "state": "ready",
+                    "queue_depth": service.queue_depth,
                     "requests_served": service.requests_served,
                     "cold_fraction": round(service.cold_fraction(), 4),
                     "cache": service.cache.stats()}))
@@ -1436,6 +1479,15 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                         raise ValueError(
                             f"unknown fit option(s) {sorted(bad)}; "
                             f"supported: {sorted(allowed)}")
+                    if not service.ready:
+                        # Rejections and validation answer even while
+                        # warming; ADMISSION does not — a 503 with
+                        # Retry-After sends the fleet front (or any load
+                        # balancer) to a warm worker until the warm pool
+                        # / AOT restore completes.
+                        self._reject(503, "warming",
+                                     headers=(("Retry-After", "1"),))
+                        return
                     out = service.calibrate(
                         cfg, targets,
                         params=tuple(body.get("calibrate")
@@ -1451,6 +1503,10 @@ def _http_server(service: SolveService, base: AiyagariConfig, port: int, *,
                 if body.get("shock"):
                     shock = MITShock(**body["shock"])
                     kind = "transition"
+                if not service.ready:
+                    self._reject(503, "warming",
+                                 headers=(("Retry-After", "1"),))
+                    return
                 resp = service.solve(cfg, kind=kind, shock=shock,
                                      timeout=float(body.get("timeout", 600)))
                 self._send(200, json.dumps(resp.to_json()))
@@ -1484,6 +1540,18 @@ def serve_main(argv) -> int:
                     help="coalescing deadline, seconds")
     ap.add_argument("--cache-mb", type=float, default=256.0,
                     help="solution-cache byte budget (0 disables)")
+    ap.add_argument("--l2-dir", default=None,
+                    help="shared cross-worker L2 solution-tier directory "
+                         "(serve/tier.py); unset = in-process L1 only")
+    ap.add_argument("--l2-mb", type=float, default=1024.0,
+                    help="L2 tier directory byte budget")
+    ap.add_argument("--aot", action="store_true",
+                    help="restore AOT-serialized warm-pool executables "
+                         "(and export fresh compiles for the next start)")
+    ap.add_argument("--warm-families", default=None,
+                    help="comma-separated registry families to warm "
+                         "('' = only the --grid-sized hot programs; "
+                         "default: the whole catalogue)")
     ap.add_argument("--resolution", type=float, default=1e-3,
                     help="calibration quantization bucket width")
     ap.add_argument("--tol", type=float, default=None,
@@ -1514,6 +1582,14 @@ def serve_main(argv) -> int:
     ap.add_argument("--ledger", default=None,
                     help="append the serving flight record to this JSONL "
                          "ledger (render: python -m aiyagari_tpu report)")
+    ap.add_argument("--run-id", default=None,
+                    help="fleet: join this run id (the front passes one id "
+                         "to every worker so merge_ledgers sees ONE run)")
+    ap.add_argument("--worker-index", type=int, default=None,
+                    help="fleet: this worker's index — selects the "
+                         "host-stamped ledger shard ledger.p<k>.jsonl")
+    ap.add_argument("--worker-count", type=int, default=None,
+                    help="fleet: total workers under the shared run id")
     ap.add_argument("--port", type=int, default=None,
                     help="HTTP front port (POST /solve, GET /metrics, "
                          "GET /healthz)")
@@ -1533,6 +1609,12 @@ def serve_main(argv) -> int:
 
     if args.dtype in ("float64", "mixed"):
         jax.config.update("jax_enable_x64", True)
+    # Fleet workers are fresh processes: the persistent XLA compile cache
+    # (io_utils/compile_cache.py) turns their warm-pool compiles into disk
+    # hits populated by earlier runs on this host.
+    from aiyagari_tpu.io_utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     base = AiyagariConfig(grid=GridSpecConfig(n_points=args.grid))
     eq = EquilibriumConfig()
     if args.tol is not None or args.max_iter is not None:
@@ -1544,12 +1626,55 @@ def serve_main(argv) -> int:
         method=args.method, dtype=args.dtype, max_batch=args.max_batch,
         max_wait_s=args.max_wait,
         cache_bytes=int(args.cache_mb * 1024 * 1024),
+        l2_dir=args.l2_dir, l2_bytes=int(args.l2_mb * 1024 * 1024),
         resolution=args.resolution, warm_pool=not args.no_warm,
+        warm_aot=args.aot,
+        warm_families=(None if args.warm_families is None
+                       else tuple(f for f in args.warm_families.split(",")
+                                  if f)),
         surrogate=not args.no_surrogate,
         pipeline=not args.no_pipeline,
         warm_na=args.grid, equilibrium=eq)
-    service = SolveService(cfg, ledger=args.ledger)
-    service.start()
+    ledger = args.ledger
+    if args.ledger and (args.run_id is not None
+                        or args.worker_index is not None):
+        # Fleet worker: join the front's ONE run id, write this worker's
+        # host-stamped shard (ledger.p<k>.jsonl) — merge_ledgers then
+        # reads the whole fleet as a single flight record (PR 14).
+        from aiyagari_tpu.diagnostics.ledger import RunLedger
+
+        ledger = RunLedger(
+            args.ledger, run_id=args.run_id,
+            config=[eq, cfg.transition],
+            process_index=args.worker_index,
+            process_count=args.worker_count,
+            meta={"entry": "serve", "port": args.port})
+    service = SolveService(cfg, ledger=ledger)
+    if args.port is None:
+        service.start()
+    else:
+        # HTTP mode: bring the socket up FIRST and warm in the background,
+        # so /healthz reports 503 "warming" (the readiness split the fleet
+        # front polls) instead of connection-refused during the pool
+        # compile / AOT restore.
+        def _start_and_announce():
+            t0 = time.perf_counter()
+            try:
+                service.start()
+            except Exception as e:  # noqa: BLE001 — surfaced via healthz
+                print(f"serve: start failed: {type(e).__name__}: {e}")
+                return
+            if service._led is not None:
+                rep = service.warmup_report or {}
+                service._led.event(
+                    "fleet_worker", port=args.port,
+                    worker=args.worker_index, state="ready",
+                    warm_seconds=round(time.perf_counter() - t0, 4),
+                    warm_programs=rep.get("compiled", 0),
+                    warm_restored=rep.get("restored", 0))
+
+        threading.Thread(target=_start_and_announce,
+                         name="aiyagari-serve-warm", daemon=True).start()
     if service.surrogate is not None and args.port is not None:
         # Long-lived server: refit the surrogate on a background cadence
         # in addition to the inline fit_every cadence.

@@ -1,0 +1,502 @@
+"""Tiered solution cache (ISSUE 20 tentpole, layer 1): the in-process LRU
+(serve/cache.py) stays L1; this module adds a shared, directory-backed L2
+so a converged solve stored by worker A becomes warm-start material —
+hit-bucket, blend, neighbor, or transition-anchor — on worker B.
+
+The tier's one correctness rule: **L2 never answers a request.** An L2
+find is promoted into L1 and returned as outcome "warm", even when the
+stored exact calibration matches the request's — so every cross-worker
+payload re-enters the PR 16 predictor ladder (secant polish for steady
+states, anchor/Jacobian reuse with the non-convergence degrade for
+transitions) and the bitwise degrade-to-cold band holds across the tier:
+a poisoned, stale, or torn L2 entry can cost wall time, never a wrong
+answer (pinned by tests/test_tier.py and `bench.py --metric fleet`).
+Only after the request's own solve converges is its result re-stored —
+under the request's own key, in both tiers (write-through).
+
+Storage format (mirrors `tuning/autotuner.py`'s cache discipline):
+
+  * One pickle file per quantized bucket key, named by the key's sha256 —
+    two workers solving the same bucket converge on the same file.
+  * Writes are ATOMIC (unique tmp file + os.replace): a concurrent reader
+    never sees a torn document from a well-behaved writer.
+  * Every document is stamped with {format version, jax/jaxlib versions,
+    platform fingerprint, quantization resolution}. A stamp mismatch —
+    another jax lowering, different silicon, a different bucket width —
+    makes the entry STALE: it is skipped loudly (warning + `degradation`
+    ledger event + counter), never deserialized into a warm start.
+  * Torn/corrupt payloads (a killed writer, a disk error) and
+    index-said-present-but-gone files (the eviction race between two
+    workers) degrade the same way: loud, counted, non-fatal — the lookup
+    reports a miss and the request solves cold.
+  * The directory is byte-budgeted: after each write, oldest-mtime files
+    are evicted until the budget holds, tolerating the racing unlink a
+    second worker's eviction pass may win.
+
+Trust model: the L2 directory is a pickle store shared by one fleet's
+workers — the same trust domain as the process list itself. Do not point
+it at a directory writable by untrusted parties.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import math
+import os
+import pickle
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from aiyagari_tpu.serve.cache import (
+    CacheEntry,
+    SolutionCache,
+    payload_nbytes,
+)
+
+__all__ = ["L2Tier", "TieredSolutionCache"]
+
+_STAMP_VERSION = 1
+
+
+@dataclasses.dataclass
+class L2Doc:
+    """One deserialized L2 entry: the (key, exact, payload) triple a
+    promotion adopts into L1, plus the file it came from."""
+
+    key: tuple
+    exact: Tuple[float, ...]
+    payload: object
+    path: Path
+
+
+class L2Tier:
+    """The shared directory tier. Thread-safe within a process; safe
+    across processes by construction (atomic writes, stamped reads,
+    race-tolerant eviction). All failure paths are loud-but-non-fatal:
+    a broken shared cache must never fail a solve."""
+
+    def __init__(self, directory, byte_budget: int = 1 << 30, *,
+                 resolution: float = 1e-3, ledger=None):
+        if resolution <= 0.0:
+            raise ValueError(f"resolution must be > 0, got {resolution}")
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.byte_budget = int(byte_budget)
+        self.resolution = float(resolution)
+        self._ledger = ledger
+        self._lock = threading.RLock()
+        # fname -> (mtime_ns, size, key, exact); key is None for files
+        # that failed to read at that signature (no re-warn until the
+        # file changes).
+        self._index: dict = {}
+        self._warned: set = set()
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+        self.evictions = 0
+        self.degradations = 0
+
+    # -- identity ----------------------------------------------------------
+
+    def stamp(self) -> dict:
+        """The document identity: a mismatch on ANY field makes an entry
+        stale (the autotuner cache's invalidation rule — measurements and
+        payloads age with the lowering and the silicon; resolution is in
+        the stamp because the bucket keys are computed at it)."""
+        import jax
+        import jaxlib
+
+        from aiyagari_tpu.tuning.autotuner import platform_fingerprint
+
+        return {"version": _STAMP_VERSION, "jax": jax.__version__,
+                "jaxlib": jaxlib.__version__,
+                "fingerprint": platform_fingerprint(),
+                "resolution": self.resolution}
+
+    def path_for(self, key: tuple) -> Path:
+        digest = hashlib.sha256(repr(key).encode()).hexdigest()[:40]
+        return self.dir / f"{digest}.pkl"
+
+    # -- degradation (loud, counted, non-fatal) ----------------------------
+
+    def _degrade(self, reason: str, path, error: str = "") -> None:
+        with self._lock:
+            self.degradations += 1
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter("aiyagari_serve_l2_degradations_total",
+                            reason=reason).inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+        try:
+            from aiyagari_tpu.diagnostics import ledger as ledger_mod
+
+            fields = dict(stage="l2_tier", reason=reason, path=str(path),
+                          error=str(error)[:200])
+            if self._ledger is not None:
+                self._ledger.event("degradation", **fields)
+            else:
+                ledger_mod.emit("degradation", **fields)
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+        warn_key = (str(path), reason)
+        if warn_key not in self._warned:
+            self._warned.add(warn_key)
+            warnings.warn(
+                f"L2 solution tier entry {path} degraded ({reason}"
+                f"{': ' + str(error)[:120] if error else ''}); treating it "
+                "as a miss — the request solves cold",
+                RuntimeWarning, stacklevel=3)
+
+    # -- read path ---------------------------------------------------------
+
+    def _read(self, path: Path, *, expected: bool) -> Optional[L2Doc]:
+        """Read + validate one entry file. `expected` marks a file the
+        in-process index believed present: its disappearance is the
+        two-worker eviction race (degradation), while a plain absent
+        bucket file is an ordinary miss."""
+        try:
+            data = path.read_bytes()
+        except FileNotFoundError:
+            if expected:
+                self._degrade("evicted_during_read", path)
+            return None
+        except OSError as e:
+            self._degrade("unreadable", path, str(e))
+            return None
+        try:
+            doc = pickle.loads(data)
+            if (not isinstance(doc, dict) or "payload" not in doc
+                    or "key" not in doc or "exact" not in doc):
+                raise ValueError("document missing key/exact/payload")
+        except Exception as e:  # noqa: BLE001 — any torn pickle shape
+            self._degrade("torn_payload", path, f"{type(e).__name__}: {e}")
+            return None
+        if doc.get("stamp") != self.stamp():
+            self._degrade("stale_stamp", path,
+                          f"stamp {doc.get('stamp')!r}")
+            return None
+        return L2Doc(key=tuple(doc["key"]), exact=tuple(doc["exact"]),
+                     payload=doc["payload"], path=path)
+
+    def _refresh_index(self) -> None:
+        """Bring the in-process (fname -> key, exact) index up to date:
+        unpickle only new/changed files, drop vanished ones. The index is
+        what makes neighbor scans O(entries) host arithmetic instead of
+        O(entries) unpickles per lookup."""
+        with self._lock:
+            seen = set()
+            try:
+                it = os.scandir(self.dir)
+            except OSError:
+                return
+            with it:
+                for de in it:
+                    if not de.name.endswith(".pkl"):
+                        continue
+                    seen.add(de.name)
+                    try:
+                        st = de.stat()
+                    except OSError:
+                        continue
+                    sig = (st.st_mtime_ns, st.st_size)
+                    memo = self._index.get(de.name)
+                    if memo is not None and memo[0] == sig[0] \
+                            and memo[1] == sig[1]:
+                        continue
+                    doc = self._read(Path(de.path), expected=False)
+                    if doc is None:
+                        # Remember the failure at this signature so a
+                        # torn file degrades once, not on every scan.
+                        self._index[de.name] = (*sig, None, None)
+                    else:
+                        self._index[de.name] = (*sig, doc.key, doc.exact)
+            for name in list(self._index):
+                if name not in seen:
+                    del self._index[name]
+
+    def _candidates(self, key: tuple,
+                    exact: Tuple[float, ...]) -> List[Tuple[float, Path]]:
+        """(distance-in-bucket-units, path) for every indexed same-kind /
+        same-structure / same-extra entry, nearest first."""
+        kind, structural, extra = key[0], key[1], key[3]
+        out: List[Tuple[float, Path]] = []
+        with self._lock:
+            for name, (_, _, k2, e2) in self._index.items():
+                if k2 is None or k2[0] != kind or k2[1] != structural \
+                        or k2[3] != extra:
+                    continue
+                d = math.sqrt(sum((a - b) ** 2 for a, b in
+                                  zip(e2, exact))) / self.resolution
+                out.append((d, self.dir / name))
+        out.sort(key=lambda pair: pair[0])
+        return out
+
+    def lookup(self, key: tuple, exact: Tuple[float, ...], *,
+               radius: float) -> Optional[L2Doc]:
+        """The best warm material for this request: the exact bucket file
+        if present and valid, else the nearest in-radius neighbor from the
+        index (falling through candidates whose files a racing eviction
+        already removed — each fall-through is a counted degradation)."""
+        path = self.path_for(key)
+        with self._lock:
+            expected = path.name in self._index \
+                and self._index[path.name][2] is not None
+        doc = self._read(path, expected=expected)
+        if doc is not None:
+            with self._lock:
+                self.hits += 1
+            self._count("hits")
+            return doc
+        self._refresh_index()
+        for d, cand in self._candidates(key, exact):
+            if d > radius:
+                break
+            if cand == path:
+                continue        # already tried (and degraded) above
+            doc = self._read(cand, expected=True)
+            if doc is not None:
+                with self._lock:
+                    self.hits += 1
+                self._count("hits")
+                return doc
+        with self._lock:
+            self.misses += 1
+        self._count("misses")
+        return None
+
+    def neighbors(self, key: tuple, exact: Tuple[float, ...], *,
+                  radius: float, limit: int = 8) -> List[L2Doc]:
+        """Up to `limit` valid in-radius entries, nearest first — the
+        multi-neighbor material a blend promotion pulls into L1."""
+        self._refresh_index()
+        out: List[L2Doc] = []
+        for d, cand in self._candidates(key, exact):
+            if d > radius or len(out) >= limit:
+                break
+            doc = self._read(cand, expected=True)
+            if doc is not None:
+                out.append(doc)
+        return out
+
+    # -- write path --------------------------------------------------------
+
+    def put(self, key: tuple, exact: Tuple[float, ...], payload) -> bool:
+        """Write-through one entry (atomic rename), then evict to budget.
+        Unpicklable payloads (exotic result objects) are skipped with a
+        counted degradation — the local L1 still holds them."""
+        if self.byte_budget <= 0:
+            return False
+        path = self.path_for(key)
+        doc = {"stamp": self.stamp(), "key": tuple(key),
+               "exact": tuple(exact), "payload": payload}
+        tmp = self.dir / (
+            f"{path.name}.{os.getpid()}.{threading.get_ident()}.tmp")
+        try:
+            data = pickle.dumps(doc, protocol=pickle.HIGHEST_PROTOCOL)
+            tmp.write_bytes(data)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001 — a broken shared cache
+            # must never fail the solve that tried to share its result
+            self._degrade("unwritable", path, f"{type(e).__name__}: {e}")
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self.writes += 1
+            try:
+                st = path.stat()
+                self._index[path.name] = (st.st_mtime_ns, st.st_size,
+                                          tuple(key), tuple(exact))
+            except OSError:
+                pass
+        self._count("writes")
+        self._evict()
+        return True
+
+    def _evict(self) -> None:
+        """Delete oldest-mtime entries until the directory fits the byte
+        budget. Two workers may run this concurrently — the unlink
+        tolerates losing the race."""
+        try:
+            with os.scandir(self.dir) as it:
+                files = []
+                for de in it:
+                    if not de.name.endswith(".pkl"):
+                        continue
+                    try:
+                        st = de.stat()
+                    except OSError:
+                        continue
+                    files.append((st.st_mtime_ns, st.st_size, de.path,
+                                  de.name))
+        except OSError:
+            return
+        total = sum(sz for _, sz, _, _ in files)
+        if total <= self.byte_budget:
+            return
+        files.sort()
+        for _, sz, fpath, name in files:
+            if total <= self.byte_budget or len(files) <= 1:
+                break
+            try:
+                os.unlink(fpath)
+            except FileNotFoundError:
+                pass        # the other worker's eviction pass won
+            except OSError:
+                continue
+            total -= sz
+            with self._lock:
+                self.evictions += 1
+                self._index.pop(name, None)
+            self._count("evictions")
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        entries = nbytes = 0
+        try:
+            with os.scandir(self.dir) as it:
+                for de in it:
+                    if de.name.endswith(".pkl"):
+                        entries += 1
+                        try:
+                            nbytes += de.stat().st_size
+                        except OSError:
+                            pass
+        except OSError:
+            pass
+        with self._lock:
+            return {"dir": str(self.dir), "entries": entries,
+                    "bytes": nbytes, "hits": self.hits,
+                    "misses": self.misses, "writes": self.writes,
+                    "evictions": self.evictions,
+                    "degradations": self.degradations}
+
+    @staticmethod
+    def _count(outcome: str) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter(f"aiyagari_serve_l2_{outcome}_total").inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+
+
+class TieredSolutionCache(SolutionCache):
+    """L1 (the in-process LRU) over a shared L2 directory. Drop-in for
+    `SolutionCache` at the service boundary:
+
+      * `lookup` classifies L1 first under the L1 lock; only on an L1
+        miss is the tier consulted (file I/O never blocks HTTP threads'
+        peeks on the LRU). An L2 find is promoted into L1 (`put_entry`,
+        same lock discipline as a local put) and returned as "warm" —
+        NEVER "hit" — so cross-worker payloads always re-enter the
+        predictor ladder's polish/degrade machinery.
+      * `put` writes through: the converged payload lands in L1 and in
+        the shared directory, becoming the other workers' warm material.
+      * `neighborhood` promotes up to 8 in-radius L2 entries first, so
+        multi-neighbor blends and transition-anchor interpolation see the
+        fleet's material, then delegates to the L1 scan.
+    """
+
+    def __init__(self, byte_budget: int = 256 * 1024 * 1024, *,
+                 resolution: float = 1e-3, neighbor_radius: float = 50.0,
+                 l2: L2Tier, ledger=None):
+        super().__init__(byte_budget, resolution=resolution,
+                         neighbor_radius=neighbor_radius)
+        if float(l2.resolution) != float(resolution):
+            raise ValueError(
+                f"L2 tier resolution {l2.resolution} != cache resolution "
+                f"{resolution}: the bucket keys would not line up across "
+                "workers")
+        self.l2 = l2
+        self._tier_ledger = ledger
+
+    def lookup(self, config, *, kind: str = "ss", extra: tuple = ()):
+        key = self.key_for(config, kind=kind, extra=extra)
+        from aiyagari_tpu.serve.cache import calibration_params
+
+        exact = calibration_params(config)
+        with self._lock:
+            outcome, entry = self._classify_locked(key, exact)
+            if outcome != "miss":
+                self._count_outcome_locked(outcome)
+                return outcome, entry
+        doc = self.l2.lookup(key, exact, radius=self.neighbor_radius)
+        if doc is None:
+            with self._lock:
+                self._count_outcome_locked("miss")
+            return "miss", None
+        entry = self._promote(doc)
+        with self._lock:
+            self._count_outcome_locked("warm")
+        return "warm", entry
+
+    def _promote(self, doc: L2Doc) -> CacheEntry:
+        """Adopt one L2 document into L1 under the L1 lock. If L1 refuses
+        it (payload over the whole budget), the material is still handed
+        back as a transient entry — warm material is warm material."""
+        entry = self.put_entry(doc.key, doc.exact, doc.payload,
+                               promoted=True)
+        if entry is None:
+            entry = CacheEntry(key=doc.key, exact=doc.exact,
+                               payload=doc.payload,
+                               nbytes=payload_nbytes(doc.payload),
+                               stored_at=time.time(), promoted=True)
+        self._count_promotion(doc.key[0])
+        return entry
+
+    def _count_promotion(self, kind) -> None:
+        try:
+            from aiyagari_tpu.diagnostics import metrics
+
+            metrics.counter("aiyagari_serve_l2_promotions_total",
+                            kind=str(kind)).inc()
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+        try:
+            from aiyagari_tpu.diagnostics import ledger as ledger_mod
+
+            # Field is named `promotion`, not `kind`: `kind` is the ledger
+            # event type itself and would collide with event()'s positional.
+            fields = dict(promotion=str(kind))
+            if self._tier_ledger is not None:
+                self._tier_ledger.event("tier_promote", **fields)
+            else:
+                ledger_mod.emit("tier_promote", **fields)
+        except Exception:  # pragma: no cover - diagnostics are best-effort
+            pass
+
+    def put(self, config, payload, *, kind: str = "ss",
+            extra: tuple = ()) -> Optional[CacheEntry]:
+        entry = super().put(config, payload, kind=kind, extra=extra)
+        if entry is not None:
+            self.l2.put(entry.key, entry.exact, payload)
+        return entry
+
+    def neighborhood(self, config, *, kind: str = "ss",
+                     extra: tuple = ()) -> List[Tuple[CacheEntry, float]]:
+        key = self.key_for(config, kind=kind, extra=extra)
+        from aiyagari_tpu.serve.cache import calibration_params
+
+        exact = calibration_params(config)
+        for doc in self.l2.neighbors(key, exact,
+                                     radius=self.neighbor_radius):
+            with self._lock:
+                present = doc.key in self._entries
+            if not present:
+                self._promote(doc)
+        return super().neighborhood(config, kind=kind, extra=extra)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["l2"] = self.l2.stats()
+        return out

@@ -85,14 +85,81 @@ def _sized_builders(na: int, dtype_name: str):
     ]
 
 
+def _aot_restore(name: str, aot_dir) -> bool:
+    """Load one AOT-serialized executable WITHOUT touching the program's
+    own builder or the compiler: the artifact is the pickled
+    `jax.experimental.serialize_executable` triple (unloaded executable
+    bytes + in/out trees), so restore is a file read + a backend LOAD —
+    no solver import, no retrace, no XLA compile (exactly what layer 2 of
+    the ISSUE 20 tentpole removes from restart). False = no artifact, or
+    a deserialize/load failure (stale lowering, different topology) —
+    fall back to fresh."""
+    import pickle
+
+    from jax.experimental import serialize_executable as se
+
+    from aiyagari_tpu.io_utils.compile_cache import load_serialized
+
+    data = load_serialized(name, aot_dir)
+    if data is None:
+        return False
+    try:
+        payload, in_tree, out_tree = pickle.loads(data)
+        se.deserialize_and_load(payload, in_tree, out_tree)
+        return True
+    except Exception:  # noqa: BLE001 — a stale artifact must never
+        return False   # fail a warm pool; the fresh path still works
+
+
+def _aot_export(name: str, fn, args, aot_dir) -> str:
+    """Serialize one freshly-compiled program's EXECUTABLE for the next
+    start. Returns the aot status string: "exported", or "unexportable"
+    (programs whose executables capture non-serializable state — host
+    callbacks, exotic closures — recorded, not fatal)."""
+    import pickle
+
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    from aiyagari_tpu.io_utils.compile_cache import save_serialized
+
+    def flat_fn(*a):
+        # Serialize the FLATTENED-output program: result dataclasses
+        # (EGMSolution, ...) are not registered for pytree serialization,
+        # and the warm pool never consumes outputs — flattening is host
+        # metadata only, the compiled executable is the same computation.
+        return jax.tree_util.tree_leaves(fn(*a))
+
+    try:
+        compiled = jax.jit(flat_fn).lower(*args).compile()
+        data = pickle.dumps(se.serialize(compiled),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:  # noqa: BLE001 — export is an optimization (e.g.
+        # host_callback-bearing programs cannot serialize); recorded,
+        # never fatal — the fresh-compile path already ran.
+        return "unexportable"
+    if save_serialized(name, data, aot_dir) is None:
+        return "unexportable"
+    return "exported"
+
+
 def warm_pool(families: Optional[Tuple[str, ...]] = None, *,
               na: Optional[int] = None, dtype: str = "float64",
-              cache_dir: Optional[str] = None, ledger=None) -> dict:
+              cache_dir: Optional[str] = None, aot: bool = False,
+              aot_dir: Optional[str] = None, ledger=None) -> dict:
     """Precompile the registry catalogue (plus, with `na`, the sized hot
     programs) into the persistent compile cache. Returns the warm-up
     report: per-program compile walls, skipped programs (environment-
     dependent builders raise ProgramUnavailable, exactly like the audit),
     and the cache directory used.
+
+    With `aot=True` (ISSUE 20 tentpole, layer 2), each program first tries
+    an AOT RESTORE — deserialize the `jax.export` artifact persisted
+    beside the compile cache and compile it directly, skipping the trace
+    entirely — and on a restore miss compiles fresh and exports the
+    serialized executable for the next start. Per-program `warmup` ledger
+    events carry the restore-vs-compile wall and the aot status
+    ("restored" | "exported" | "unexportable" | "off").
 
     Every compiled program emits a `warmup` ledger event (active ledger
     or the explicit `ledger` argument) and an
@@ -125,8 +192,21 @@ def warm_pool(families: Optional[Tuple[str, ...]] = None, *,
 
     programs: dict = {}
     skipped: list = []
+    restored_count = 0
     for name, build in jobs:
         p0 = time.perf_counter()
+        if aot and _aot_restore(name, aot_dir):
+            wall = time.perf_counter() - p0
+            programs[name] = {"compile_seconds": round(wall, 4),
+                              "restored": True, "aot": "restored"}
+            restored_count += 1
+            metrics.gauge("aiyagari_warmup_compile_seconds",
+                          program=name).set(wall)
+            metrics.counter("aiyagari_warmup_programs_total").inc()
+            metrics.counter("aiyagari_warmup_aot_restored_total").inc()
+            emit("warmup", program=name, compile_seconds=round(wall, 4),
+                 restored=True, aot="restored")
+            continue
         try:
             fn, args = build()
             jax.jit(fn).lower(*args).compile()
@@ -134,16 +214,27 @@ def warm_pool(families: Optional[Tuple[str, ...]] = None, *,
             skipped.append((name, str(e)))
             emit("warmup", program=name, skipped=str(e)[:200])
             continue
+        # compile_seconds is what a cold boot pays (build+trace+compile);
+        # the export is the one-time extra the EXPORTING boot pays for
+        # the next start's restore, timed separately.
         wall = time.perf_counter() - p0
-        programs[name] = {"compile_seconds": round(wall, 4)}
+        e0 = time.perf_counter()
+        aot_status = _aot_export(name, fn, args, aot_dir) if aot else "off"
+        programs[name] = {"compile_seconds": round(wall, 4),
+                          "restored": False, "aot": aot_status,
+                          "export_seconds": (
+                              round(time.perf_counter() - e0, 4)
+                              if aot else None)}
         metrics.gauge("aiyagari_warmup_compile_seconds",
                       program=name).set(wall)
         metrics.counter("aiyagari_warmup_programs_total").inc()
-        emit("warmup", program=name, compile_seconds=round(wall, 4))
+        emit("warmup", program=name, compile_seconds=round(wall, 4),
+             restored=False, aot=aot_status)
     return {
         "programs": programs,
         "skipped": skipped,
         "compiled": len(programs),
+        "restored": restored_count,
         "cache_dir": cache_used,
         "wall_seconds": round(time.perf_counter() - t0, 4),
     }
@@ -159,7 +250,8 @@ def warmup_main(argv) -> int:
     ap = argparse.ArgumentParser(prog="aiyagari_tpu warmup")
     ap.add_argument("--families", default=None,
                     help="comma-separated registry families to warm "
-                         "(default: the whole catalogue)")
+                         "('' = none — only the --na-sized hot programs; "
+                         "default: the whole catalogue)")
     ap.add_argument("--na", type=int, default=None,
                     help="also compile the size-sensitive hot programs "
                          "(EGM sweep, stationary distribution, "
@@ -170,6 +262,12 @@ def warmup_main(argv) -> int:
     ap.add_argument("--cache-dir", default=None,
                     help="compile-cache directory (default: "
                          "io_utils/compile_cache.py resolution order)")
+    ap.add_argument("--aot", action="store_true",
+                    help="restore AOT-serialized executables when present; "
+                         "export fresh compiles for the next start")
+    ap.add_argument("--aot-dir", default=None,
+                    help="AOT executable directory (default: beside the "
+                         "compile cache — io_utils/compile_cache.py)")
     ap.add_argument("--ledger", default=None,
                     help="append warmup events to this JSONL run ledger")
     ap.add_argument("--json", action="store_true",
@@ -185,19 +283,23 @@ def warmup_main(argv) -> int:
         from aiyagari_tpu.diagnostics.ledger import RunLedger
 
         led = RunLedger(args.ledger, meta={"entry": "warmup"})
-    families = (tuple(f for f in args.families.split(",") if f)
-                if args.families else None)
+    families = (None if args.families is None
+                else tuple(f for f in args.families.split(",") if f))
     report = warm_pool(families, na=args.na, dtype=args.dtype,
-                       cache_dir=args.cache_dir, ledger=led)
+                       cache_dir=args.cache_dir, aot=args.aot,
+                       aot_dir=args.aot_dir, ledger=led)
     if args.json:
         print(json.dumps(report, indent=2))
         return 0
-    print(f"warm pool: {report['compiled']} program(s) compiled in "
+    print(f"warm pool: {report['compiled']} program(s) ready "
+          f"({report['restored']} AOT-restored) in "
           f"{report['wall_seconds']}s"
           + (f" -> {report['cache_dir']}" if report["cache_dir"] else ""))
     for name, rec in sorted(report["programs"].items(),
                             key=lambda kv: -kv[1]["compile_seconds"]):
-        print(f"  {name:44s} {rec['compile_seconds']:8.3f}s")
+        tag = {"restored": " [aot]", "exported": " [exported]"}.get(
+            rec.get("aot", "off"), "")
+        print(f"  {name:44s} {rec['compile_seconds']:8.3f}s{tag}")
     for name, reason in report["skipped"]:
         print(f"  {name:44s} skipped: {reason[:60]}")
     return 0

@@ -3502,6 +3502,308 @@ def bench_calibration(quick: bool, grid_size: int = 16) -> dict:
     return record
 
 
+def bench_fleet(quick: bool, grid_size: int = 40) -> dict:
+    """Solve fabric (ISSUE 20): the tiered cache + AOT warm pool + fleet
+    front, measured in four regimes —
+
+      aot_walls    — `python -m aiyagari_tpu warmup --na G --families ''
+                     --aot` twice in subprocesses against EMPTY caches:
+                     run 1 compiles fresh and exports AOT executables,
+                     run 2 restores them (no retrace). Gated: for every
+                     program run 2 restored, restore wall <= 0.5x the
+                     fresh compile wall (host_callback-bearing programs
+                     are legitimately unexportable and recorded as such).
+      throughput   — 1 spawned serve worker vs 2, each primed with the
+                     same calibrations, then driven with exact-hit
+                     traffic over real HTTP. This box is single-core, so
+                     the 2-worker number is AGGREGATE FLEET CAPACITY:
+                     each worker is driven separately (sequentially) and
+                     the per-worker rates are summed — the number a
+                     one-core-per-worker deployment serves. Gated:
+                     aggregate >= 1.6x the single worker. A concurrent
+                     multi-URL round-robin drive through the same
+                     HttpServiceClient is recorded informationally (on
+                     one core it measures GIL interleaving, not
+                     capacity).
+      l2_cold_frac — two fresh services serving the same calibrations,
+                     once sharing an L2 directory a first service
+                     populated, once without: the L2-fed service's cold
+                     fraction must be STRICTLY below the L2-less one
+                     (cross-worker reuse is real). L2 finds surface as
+                     outcome "warm" — never "hit" — so every payload
+                     re-enters the polish ladder.
+      poisoned_l2  — the L2 document for a solved calibration is
+                     rewritten in place with a VALID stamp but a garbage
+                     payload (far-off rate, bogus slope); a fresh service
+                     with polish_steps=2 and no surrogate must DEGRADE to
+                     a cold re-solve whose answer is bitwise the clean
+                     cold answer: wrong_answer_degradations == 0 is the
+                     gate (the tier can cost wall time, never a wrong
+                     answer).
+
+    value = 2-worker aggregate hit requests/sec. EVERY run (the ci
+    preset included) freezes BENCH_r19_fleet.json."""
+    import pickle
+    import subprocess
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from aiyagari_tpu.config import (
+        AiyagariConfig,
+        EquilibriumConfig,
+        GridSpecConfig,
+        TransitionConfig,
+    )
+    from aiyagari_tpu.serve import ServeConfig, SolveRequest, SolveService
+    from aiyagari_tpu.serve.fleet import Fleet
+    from aiyagari_tpu.serve.load import HttpServiceClient, run_load
+
+    t_start = time.perf_counter()
+    n_req = 3 if quick else 4
+    hit_rounds = 3                       # each primed beta re-requested
+    resolution = 1e-3
+    eq = EquilibriumConfig(max_iter=48, tol=2e-4)
+    trans = TransitionConfig(T=24, max_iter=20, tol=1e-6)
+    base = AiyagariConfig(grid=GridSpecConfig(n_points=grid_size))
+
+    def with_beta(beta):
+        import dataclasses
+
+        return dataclasses.replace(
+            base, preferences=dataclasses.replace(base.preferences,
+                                                  beta=round(beta, 6)))
+
+    betas = np.linspace(0.935, 0.952, n_req)
+    cfgs = [with_beta(b) for b in betas]
+
+    tmp = tempfile.mkdtemp(prefix="aiyagari_fleet_bench_")
+
+    # -- regime 1: AOT restore vs fresh compile walls ---------------------
+    # Both runs in subprocesses against caches rooted in a fresh tmp dir
+    # (the env empties nothing outside it): run 1 pays every trace+compile
+    # and exports, run 2 restores the serialized executables. The gate
+    # compares PER-PROGRAM walls for the programs run 2 restored.
+    aot_cache = os.path.join(tmp, "xla")
+    aot_dir = os.path.join(tmp, "aot")
+    warm_cmd = [sys.executable, "-m", "aiyagari_tpu", "warmup",
+                "--na", str(grid_size), "--families", "", "--aot",
+                "--aot-dir", aot_dir, "--cache-dir", aot_cache, "--json"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def _warm_run():
+        out = subprocess.run(warm_cmd, capture_output=True, text=True,
+                             timeout=600, env=env)
+        if out.returncode != 0:
+            raise RuntimeError(f"warmup subprocess failed "
+                               f"(rc={out.returncode}): {out.stderr[-500:]}")
+        return json.loads(out.stdout)
+
+    fresh = _warm_run()
+    restored = _warm_run()
+    aot_programs = {}
+    restore_ratios = []
+    for name, rec in restored["programs"].items():
+        f_rec = fresh["programs"].get(name, {})
+        row = {"fresh_s": f_rec.get("compile_seconds"),
+               "restore_s": rec["compile_seconds"],
+               "restored": bool(rec.get("restored")),
+               "aot": rec.get("aot", "off")}
+        if row["restored"] and row["fresh_s"]:
+            row["restore_vs_fresh"] = round(
+                row["restore_s"] / row["fresh_s"], 4)
+            restore_ratios.append(row["restore_vs_fresh"])
+        aot_programs[name] = row
+    aot_walls = {
+        "programs": aot_programs,
+        "restored_count": restored["restored"],
+        "fresh_wall_s": fresh["wall_seconds"],
+        "restored_wall_s": restored["wall_seconds"],
+        "worst_restore_vs_fresh": (max(restore_ratios)
+                                   if restore_ratios else None),
+        "gate_met": bool(restore_ratios)
+        and max(restore_ratios) <= 0.5,
+    }
+
+    # -- regime 2: 1-worker vs 2-worker hit throughput --------------------
+    # Real spawned workers over real HTTP; hits bypass the queue (the
+    # service's fast path), so the measured rate is the serving layer.
+    worker_args = dict(
+        grids=(grid_size,), method="egm", max_batch=1, cache_mb=64.0,
+        warm_families="", platform="cpu",
+        extra_args=("--tol", "2e-4", "--max-iter", "48", "--no-warm",
+                    "--no-surrogate"))
+    hit_cfgs = (cfgs * hit_rounds)
+
+    def _drive_worker(port):
+        with HttpServiceClient(base, port, timeout=600.0) as client:
+            prime = run_load(client, [SolveRequest(c) for c in cfgs],
+                             closed=True)
+            hits = run_load(client, [SolveRequest(c) for c in hit_cfgs],
+                            closed=True)
+        return prime, hits
+
+    fleet1 = Fleet(workers=1, **worker_args)
+    fleet1.start(ready_timeout=600)
+    try:
+        _, hits_1 = _drive_worker(fleet1.workers[0].port)
+    finally:
+        fleet1.stop()
+    rps_1 = hits_1["rps"] or 0.0
+
+    ledger_path = os.path.join(tmp, "fleet_ledger.jsonl")
+    fleet2 = Fleet(workers=2, ledger=ledger_path, **worker_args)
+    fleet2.start(ready_timeout=600)
+    try:
+        per_worker = [_drive_worker(w.port)[1] for w in fleet2.workers]
+        # Informational: the same hit schedule round-robined over BOTH
+        # base URLs at once (per-thread keep-alive socket per port).
+        ports = tuple(w.port for w in fleet2.workers)
+        with HttpServiceClient(base, ports, timeout=600.0) as client:
+            concurrent = run_load(client,
+                                  [SolveRequest(c) for c in hit_cfgs])
+        fleet_health = fleet2.health(max_age_s=0.0)
+    finally:
+        fleet2.stop()
+    aggregate_rps = sum(h["rps"] or 0.0 for h in per_worker)
+    throughput = {
+        "single_worker": hits_1,
+        "per_worker": per_worker,
+        "aggregate_rps": round(aggregate_rps, 4),
+        "aggregate_vs_single": (round(aggregate_rps / rps_1, 4)
+                                if rps_1 else None),
+        "semantics": "aggregate fleet capacity: per-worker rates measured "
+                     "sequentially and summed (single-core host; each "
+                     "worker owns the core while measured)",
+        "concurrent_multiport": concurrent,
+        "health": {"workers": len(fleet_health.get("workers", [])),
+                   "l2_hits": fleet_health.get("l2_hits")},
+        "gate_met": bool(rps_1) and aggregate_rps >= 1.6 * rps_1,
+    }
+
+    # -- regimes 3+4: shared in-process services --------------------------
+    def svc_config(**kw):
+        kw.setdefault("method", "egm")
+        kw.setdefault("aggregation", "distribution")
+        kw.setdefault("equilibrium", eq)
+        kw.setdefault("transition", trans)
+        kw.setdefault("warm_pool", False)
+        kw.setdefault("rescue", False)
+        kw.setdefault("surrogate", False)
+        kw.setdefault("max_batch", 1)
+        kw.setdefault("resolution", resolution)
+        return ServeConfig(**kw)
+
+    def cold_frac(row):
+        n = row["requests"] or 1
+        return row["cache_outcomes"].get("cold", 0) / n
+
+    def _serve_pair(l2_dir):
+        """Populate with one service instance, serve the same traffic
+        from a FRESH one (empty L1) — with/without the shared L2."""
+        kw = {"l2_dir": l2_dir} if l2_dir else {}
+        svc = SolveService(svc_config(**kw))
+        svc.start()
+        svc.solve(with_beta(0.9312), timeout=600)   # untimed compile pass
+        run_load(svc, [SolveRequest(c) for c in cfgs], closed=True)
+        svc.stop()
+        svc = SolveService(svc_config(**kw))
+        svc.start()
+        served = run_load(svc, [SolveRequest(c) for c in cfgs],
+                          closed=True)
+        stats = svc.cache.stats()
+        svc.stop()
+        return served, stats
+
+    l2_dir = os.path.join(tmp, "l2")
+    served_on, stats_on = _serve_pair(l2_dir)
+    served_off, _ = _serve_pair(None)
+    frac_on, frac_off = cold_frac(served_on), cold_frac(served_off)
+    l2_cold = {
+        "with_l2": served_on,
+        "without_l2": served_off,
+        "cold_fraction_on": round(frac_on, 4),
+        "cold_fraction_off": round(frac_off, 4),
+        "l2_stats": stats_on.get("l2"),
+        "hits_never_from_l2": served_on["cache_outcomes"].get("hit", 0)
+        == 0,
+        "gate_met": frac_on < frac_off,
+    }
+
+    # -- regime 4: poisoned L2 entry --------------------------------------
+    poison_dir = os.path.join(tmp, "l2poison")
+    target = cfgs[0]
+    svc = SolveService(svc_config(l2_dir=poison_dir))
+    svc.start()
+    ref = svc.solve(target, timeout=600)            # the clean cold answer
+    svc.stop()
+    poisoned_files = 0
+    for fname in os.listdir(poison_dir):
+        if not fname.endswith(".pkl"):
+            continue
+        path = os.path.join(poison_dir, fname)
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+        p = dict(doc["payload"])
+        p["r"] = float(ref.r) + 0.03                # far outside the polish
+        p["slope"] = 1e12                           # secant step ~= 0
+        p["warm"] = None
+        doc["payload"] = p                          # stamp stays VALID
+        with open(path, "wb") as f:
+            pickle.dump(doc, f, protocol=pickle.HIGHEST_PROTOCOL)
+        poisoned_files += 1
+    svc = SolveService(svc_config(l2_dir=poison_dir, polish_steps=2))
+    svc.start()
+    poisoned = svc.solve(target, timeout=600)
+    degr = int(svc.degradations)
+    svc.stop()
+    bitwise_equal = (poisoned.r == ref.r and poisoned.w == ref.w
+                     and poisoned.capital == ref.capital)
+    wrong_answers = 0 if bitwise_equal else 1
+    poison = {
+        "poisoned_files": poisoned_files,
+        "served_from": poisoned.cache,
+        "warm_source": poisoned.warm_source,
+        "degraded": bool(poisoned.degraded),
+        "degradations": degr,
+        "reference_r": float(ref.r),
+        "poisoned_r": float(poisoned.r),
+        "bitwise_equal": bitwise_equal,
+        "wrong_answer_degradations": wrong_answers,
+        "gate_met": bool(poisoned.degraded) and wrong_answers == 0,
+    }
+
+    record = {
+        "metric": "fleet",
+        "value": round(aggregate_rps, 4),
+        "unit": "requests/sec (2-worker aggregate hit traffic)",
+        "grid": grid_size,
+        "requests_per_regime": n_req,
+        "hit_rounds": hit_rounds,
+        "resolution": resolution,
+        "aot_walls": aot_walls,
+        "throughput": throughput,
+        "l2_cold_fraction": l2_cold,
+        "poisoned_l2": poison,
+        "gates": {
+            "aot_restore_le_half_fresh": aot_walls["gate_met"],
+            "aggregate_ge_1p6x_single": throughput["gate_met"],
+            "l2_cold_fraction_below": l2_cold["gate_met"],
+            "poisoned_l2_degrades_bitwise": poison["gate_met"],
+        },
+        "wall_seconds": round(time.perf_counter() - t_start, 3),
+        "platform": jax.default_backend(),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r19_fleet.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+    return record
+
+
 def _run_in_child(timeout_s: float) -> int | None:
     """Re-exec this benchmark in a child process with a hard timeout and relay
     its JSON line. Returns the exit code, or None if the child timed out or
@@ -3594,7 +3896,7 @@ def main() -> int:
                              "pushforward", "egm_fused", "telemetry",
                              "resilience", "mesh2d", "attribution",
                              "observatory", "serve", "amortized",
-                             "calibration", "analysis"],
+                             "fleet", "calibration", "analysis"],
                     default="all",
                     help="'all' (default) emits one JSON line per headline "
                          "metric — reference-scale VFI, K-S panel throughput "
@@ -3765,6 +4067,7 @@ def main() -> int:
         "serve": lambda: bench_serve(args.quick, min(args.grid, 40)),
         "amortized": lambda: bench_amortized(args.quick,
                                              min(args.grid, 40)),
+        "fleet": lambda: bench_fleet(args.quick, min(args.grid, 40)),
         "calibration": lambda: bench_calibration(args.quick,
                                                  min(args.grid, 16)),
         "analysis": lambda: bench_analysis(),
@@ -3785,14 +4088,14 @@ def main() -> int:
                   "transition_fused", "accel", "precision", "pushforward",
                   "egm_fused", "telemetry", "resilience", "mesh2d",
                   "attribution", "observatory", "serve", "amortized",
-                  "calibration", "analysis")
+                  "fleet", "calibration", "analysis")
                  if args.metric == "all" else (args.metric,))
     elif args.metric == "all":
         names = ("vfi", "ks", "ks_large", "scale", "ge", "ge_fused",
                  "sweep", "transition", "transition_fused", "accel",
                  "precision", "pushforward", "egm_fused", "telemetry",
                  "resilience", "mesh2d", "attribution", "observatory",
-                 "serve", "amortized", "calibration", "ks_fine",
+                 "serve", "amortized", "fleet", "calibration", "ks_fine",
                  "scale_vfi")
     else:
         names = (args.metric,)

@@ -22,7 +22,7 @@ CI_METRICS = ("vfi", "scale", "ge", "ge_fused", "sweep", "transition",
               "transition_fused",
               "accel", "precision", "pushforward", "egm_fused", "telemetry",
               "resilience", "mesh2d", "attribution", "observatory",
-              "serve", "amortized", "calibration", "analysis")
+              "serve", "amortized", "fleet", "calibration", "analysis")
 
 
 def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
@@ -64,7 +64,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # demonstrably happened — XLA's peak-memory proxy for the donated
     # build strictly below the undonated build of the identical program,
     # with the donated warm buffer deleted after the call.
-    gf = records[-17]
+    gf = records[-18]
     assert gf["metric"].startswith("aiyagari_ge_fused")
     assert gf["host_converged"] and gf["device_converged"], gf
     assert gf["batched_converged"], gf
@@ -92,7 +92,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
             < frozen_gf["memory_undonated"]["peak_proxy_bytes"])
     assert frozen_gf["donated_input_deleted"] is True
     # The transition record carries the ISSUE 2 acceptance telemetry.
-    tr = records[-15]
+    tr = records[-16]
     assert tr["metric"].startswith("transition_newton")
     assert tr["newton_rounds"] >= 1 and tr["converged"]
     assert tr["sweep_transitions_per_sec"] > 0
@@ -108,7 +108,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # donated build's peak-memory proxy sits strictly below the undonated
     # build of the identical program, and the donated r-path carry is
     # deleted after the call.
-    tf = records[-14]
+    tf = records[-15]
     assert tf["metric"].startswith("transition_fused")
     assert tf["host_converged"] and tf["device_converged"], tf
     assert tf["wall_ratio_device_over_host"] <= 0.8, tf
@@ -141,7 +141,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The accel record carries the ISSUE 3 acceptance telemetry: per-solve
     # iteration counts for the plain and accelerated routes, with
     # accelerated <= plain — an acceleration regression fails tier-1 here.
-    ac = records[-13]
+    ac = records[-14]
     assert ac["metric"].startswith("accel_fixed_point")
     assert ac["egm_sweeps_accel"] <= ac["egm_sweeps_plain"]
     assert ac["dist_sweeps_accel"] <= ac["dist_sweeps_plain"]
@@ -155,7 +155,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # structural (timing-free) claims first: the ladder actually laddered —
     # hot sweeps ran, STOPPED before the pure-f64 count, and a polish
     # certified the reference tolerance with machine-precision mass.
-    pr = records[-12]
+    pr = records[-13]
     assert pr["metric"].startswith("precision_ladder")
     assert pr["egm_sweeps_f32_stage"] > 0
     assert pr["egm_sweeps_f32_stage"] < pr["egm_sweeps_f64"]
@@ -179,7 +179,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # 1.0x the scatter per-sweep wall on this CPU host even at ci sizes
     # (measured 2.9x at grid 200, 8.2x at grid 4000; interleaved minima,
     # so the gate has wide margin against host drift).
-    pw = records[-11]
+    pw = records[-12]
     assert pw["metric"].startswith("pushforward_sweep")
     assert set(pw["routes"]) == {"scatter", "transpose", "banded", "pallas"}
     for name, route in pw["routes"].items():
@@ -207,7 +207,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # The host WALL is advisory only: off-TPU the fused route runs the
     # Pallas interpreter — a correctness vehicle — so no speedup is gated
     # here; the speedup claim is TPU-side (docs/USAGE.md).
-    ef = records[-10]
+    ef = records[-11]
     assert ef["metric"].startswith("egm_fused_sweep")
     assert set(ef["routes"]) == {"xla", "pallas_fused"}
     for name, route in ef["routes"].items():
@@ -233,7 +233,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # .json. The wall-ratio sanity bound below catches a REAL recorder
     # regression (an accidental host callback or sync inflates the
     # recorder-on walls many-fold, far beyond timing noise).
-    tm = records[-9]
+    tm = records[-10]
     assert tm["metric"].startswith("telemetry_recorder")
     assert tm["off_bit_identical"] is True, tm
     assert tm["off_jaxpr_noop"] is True, tm
@@ -250,7 +250,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # sweep quarantined EXACTLY its one poisoned lane with every other
     # lane parity-equal to the clean sweep, and the quarantine machinery
     # costs <= 1.1x a clean sweep (host-side masks only).
-    rs = records[-8]
+    rs = records[-9]
     assert rs["metric"] == "resilience_fault_battery"
     assert rs["value"] == 1.0, rs
     assert rs["recovered"] == rs["points"]
@@ -281,7 +281,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # measure partitioning overhead at equal total work (the frozen
     # BENCH_r12_mesh2d.json documents the measured ordering); the
     # chips-scale claim rides the priced-bytes column.
-    m2 = records[-7]
+    m2 = records[-8]
     assert m2["metric"] == "mesh2d_sweep"
     assert m2["devices"] >= 8, m2
     assert set(m2["topologies"]) == {"unsharded", "scenarios8", "grid8",
@@ -323,7 +323,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # stops fusing and materializes its broadcasts lands at 10-100x), a
     # measured probe with per-candidate walls for every contested knob,
     # and the frozen BENCH_r11_attribution.json artifact.
-    at = records[-6]
+    at = records[-7]
     assert at["metric"] == "route_attribution"
     assert at["value"] >= 10, at
     assert not at["flagged"], at
@@ -362,7 +362,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # two-host shard pair merged back into one run-id-joined, ordered
     # stream with its torn tail tolerated; and the watch table rendered a
     # row per scenario.
-    ob = records[-5]
+    ob = records[-6]
     assert ob["metric"] == "pod_observatory"
     assert ob["devices"] >= 8, ob
     assert set(ob["skew"]["axes"]) == {"scenarios", "grid"}
@@ -409,7 +409,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # acceptance bar; gated at the satellite's >= serial with the 2x
     # claim frozen in BENCH_r14_serve.json). Every request leaves a
     # ledger trail and the serve gauges export.
-    sv = records[-4]
+    sv = records[-5]
     assert sv["metric"] == "serve_load"
     reg = sv["regimes"]
     assert reg["warm"]["p50_s"] <= 0.5 * reg["cold"]["p50_s"], sv
@@ -470,7 +470,7 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     # baselines at p50; and the deliberately-poisoned guesses degraded to
     # cold solves whose answers matched a fresh cold service BITWISE
     # (zero wrong-answer degradations — the correctness band).
-    am = records[-3]
+    am = records[-4]
     assert am["metric"] == "serve_amortized"
     assert am["cold_fraction"] < 0.5, am
     assert am["value"] == am["cold_fraction"], am
@@ -506,6 +506,41 @@ def test_bench_ci_preset_exits_zero_with_full_battery(tmp_path):
     assert frozen_am["wrong_answer_degradations"] == 0
     assert frozen_am["surrogate_vs_cold_p50"] <= 0.6
     assert frozen_am["anchor_warm_vs_cold_p50"] <= 0.6
+    # The fleet record carries the ISSUE 20 acceptance telemetry: the
+    # solve fabric. Four gates — AOT-restored programs start in <= 0.5x
+    # their fresh compile wall (restore is a deserialize, not a retrace);
+    # the 2-worker fleet's aggregate hit throughput is >= 1.6x one worker
+    # (per-worker rates measured sequentially and summed on this
+    # single-core host — aggregate fleet capacity); a fresh service fed
+    # by a shared L2 directory pays a strictly lower cold fraction than
+    # an L2-less one, with every L2 find surfacing as "warm" (never
+    # "hit") so payloads re-enter the polish ladder; and a poisoned L2
+    # document (valid stamp, garbage payload) degrades to a cold re-solve
+    # whose answer is BITWISE the clean cold answer — zero wrong-answer
+    # degradations, the tier's correctness band.
+    fl = records[-3]
+    assert fl["metric"] == "fleet"
+    assert fl["gates"]["aot_restore_le_half_fresh"] is True, fl
+    assert fl["aot_walls"]["restored_count"] >= 1, fl
+    assert fl["aot_walls"]["worst_restore_vs_fresh"] <= 0.5, fl
+    assert fl["gates"]["aggregate_ge_1p6x_single"] is True, fl
+    assert fl["throughput"]["aggregate_vs_single"] >= 1.6, fl
+    assert fl["gates"]["l2_cold_fraction_below"] is True, fl
+    l2 = fl["l2_cold_fraction"]
+    assert l2["cold_fraction_on"] < l2["cold_fraction_off"], fl
+    assert l2["hits_never_from_l2"] is True, fl
+    assert fl["gates"]["poisoned_l2_degrades_bitwise"] is True, fl
+    ps = fl["poisoned_l2"]
+    assert ps["poisoned_files"] >= 1, fl
+    assert ps["degraded"] is True, fl
+    assert ps["bitwise_equal"] is True, fl
+    assert ps["wrong_answer_degradations"] == 0, fl
+    # The frozen artifact the ci battery owns (ISSUE 20 acceptance).
+    with open(os.path.join(bench_dir, "BENCH_r19_fleet.json")) as f:
+        frozen_fl = json.load(f)
+    assert frozen_fl["metric"] == "fleet"
+    assert all(frozen_fl["gates"].values()), frozen_fl["gates"]
+    assert frozen_fl["poisoned_l2"]["wrong_answer_degradations"] == 0
     # The calibration record carries the ISSUE 17 acceptance telemetry:
     # the differentiable solve stack recovered ALL FOUR planted deep
     # parameters (beta, sigma, rho, sigma_e) within 1e-3 by gradient

@@ -1,0 +1,434 @@
+"""Solve fabric units (ISSUE 20): the shared L2 solution tier's
+correctness rule (L2 never answers — cross-worker material re-enters the
+predictor ladder as "warm", and a local converged re-store is what earns
+back "hit"), its loud-but-non-fatal corruption paths (torn payload, stale
+stamp, the two-worker eviction race), the L1 cache's thread-safety under
+a concurrent hammer, the fleet front's pure routing/replay helpers, and
+the /healthz readiness split (503 warming -> 200 ready).
+
+Everything here is solver-free: payloads are plain dicts, services are
+never asked to solve, so the whole file is tier-1 cheap."""
+
+import dataclasses
+import json
+import pickle
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from aiyagari_tpu.config import (
+    AiyagariConfig,
+    EquilibriumConfig,
+    GridSpecConfig,
+)
+from aiyagari_tpu.serve import ServeConfig, SolveService
+from aiyagari_tpu.serve.cache import (
+    SolutionCache,
+    calibration_key,
+    calibration_params,
+)
+from aiyagari_tpu.serve.fleet import grid_class, unacked_from_ledger
+from aiyagari_tpu.serve.tier import L2Tier, TieredSolutionCache
+
+BASE = AiyagariConfig(grid=GridSpecConfig(n_points=40))
+EQ = EquilibriumConfig(max_iter=48, tol=2e-4)
+
+
+def with_beta(beta, base=BASE):
+    return dataclasses.replace(
+        base, preferences=dataclasses.replace(base.preferences,
+                                              beta=round(float(beta), 6)))
+
+
+def svc_config(**kw):
+    kw.setdefault("method", "egm")
+    kw.setdefault("equilibrium", EQ)
+    kw.setdefault("warm_pool", False)
+    kw.setdefault("rescue", False)
+    return ServeConfig(**kw)
+
+
+def tiered(tmp_path, **kw):
+    """One worker's view of a shared L2 directory: its own L1 + its own
+    L2Tier handle on the common dir (exactly the fleet topology)."""
+    kw.setdefault("resolution", 1e-3)
+    l2 = L2Tier(tmp_path, resolution=kw["resolution"])
+    return TieredSolutionCache(1 << 20, l2=l2, **kw)
+
+
+# ---------------------------------------------------------------------------
+# L2 tier: cross-worker semantics
+# ---------------------------------------------------------------------------
+
+
+class TestTierSemantics:
+    def test_write_through_is_warm_never_hit(self, tmp_path):
+        """Worker A's converged solve reaches worker B as warm-start
+        material — outcome 'warm', NEVER 'hit', even on an exact
+        calibration match — so the cross-worker payload re-enters the
+        polish/degrade ladder instead of being replayed verbatim."""
+        a, b = tiered(tmp_path), tiered(tmp_path)
+        cfg = with_beta(0.9500)
+        a.put(cfg, {"r": 0.0123})
+        outcome, entry = b.lookup(cfg)
+        assert outcome == "warm"
+        assert entry.payload["r"] == 0.0123
+        assert entry.promoted
+        # Still warm on the SECOND exact lookup: the promoted L1 entry
+        # must not turn into a hit-server just because it landed in L1.
+        outcome2, _ = b.lookup(cfg)
+        assert outcome2 == "warm"
+
+    def test_promoted_entry_invisible_to_peek(self, tmp_path):
+        """The HTTP fast path's peek must never short-circuit a request
+        onto cross-worker material — peek answers only locally-earned
+        exact entries."""
+        a, b = tiered(tmp_path), tiered(tmp_path)
+        cfg = with_beta(0.9500)
+        a.put(cfg, {"r": 0.0123})
+        assert a.peek(cfg) is not None          # local store: peekable
+        b.lookup(cfg)                           # promotes into B's L1
+        assert b.peek(cfg) is None              # promoted: not peekable
+
+    def test_local_put_earns_hit_back(self, tmp_path):
+        """After worker B's OWN solve converges and re-stores the key,
+        the entry is B's — later exact lookups are ordinary hits."""
+        a, b = tiered(tmp_path), tiered(tmp_path)
+        cfg = with_beta(0.9500)
+        a.put(cfg, {"r": 0.0123})
+        assert b.lookup(cfg)[0] == "warm"
+        b.put(cfg, {"r": 0.0124})
+        outcome, entry = b.lookup(cfg)
+        assert outcome == "hit"
+        assert entry.payload["r"] == 0.0124
+        assert b.peek(cfg) is not None
+
+    def test_neighbor_promotion_within_radius(self, tmp_path):
+        """A nearby (different-bucket) calibration stored by worker A is
+        in-radius warm material for worker B's request."""
+        a, b = tiered(tmp_path), tiered(tmp_path)
+        stored, asked = with_beta(0.9500), with_beta(0.9520)
+        assert calibration_key(stored) != calibration_key(asked)
+        a.put(stored, {"r": 0.0123})
+        outcome, entry = b.lookup(asked)
+        assert outcome == "warm"
+        assert entry.exact == calibration_params(stored)
+
+    def test_out_of_radius_is_miss(self, tmp_path):
+        a = tiered(tmp_path, neighbor_radius=5.0)
+        b = tiered(tmp_path, neighbor_radius=5.0)
+        a.put(with_beta(0.9300), {"r": 0.0123})
+        outcome, entry = b.lookup(with_beta(0.9520))
+        assert outcome == "miss" and entry is None
+
+    def test_resolution_mismatch_rejected(self, tmp_path):
+        """L1/L2 bucket widths must agree or the keys would not line up
+        across workers — construction fails loudly."""
+        l2 = L2Tier(tmp_path, resolution=1e-2)
+        with pytest.raises(ValueError, match="resolution"):
+            TieredSolutionCache(1 << 20, resolution=1e-3, l2=l2)
+
+    def test_stats_nest_l2(self, tmp_path):
+        a = tiered(tmp_path)
+        a.put(with_beta(0.9500), {"r": 0.0123})
+        st = a.stats()
+        assert st["l2"]["writes"] == 1
+        assert st["l2"]["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# L2 tier: corruption is loud, counted, never a wrong answer
+# ---------------------------------------------------------------------------
+
+
+class TestTierCorruption:
+    KEY = calibration_key(with_beta(0.9500))
+    EXACT = calibration_params(with_beta(0.9500))
+
+    def test_torn_payload_degrades_to_miss(self, tmp_path):
+        """A killed writer's half-file (or any non-document pickle) is a
+        counted, warned degradation and an ordinary miss — never an
+        exception, never a deserialized warm start."""
+        tier = L2Tier(tmp_path, resolution=1e-3)
+        assert tier.put(self.KEY, self.EXACT, {"r": 0.0123})
+        tier.path_for(self.KEY).write_bytes(b"\x80\x04torn")
+        with pytest.warns(RuntimeWarning, match="torn_payload"):
+            doc = tier.lookup(self.KEY, self.EXACT, radius=50.0)
+        assert doc is None
+        assert tier.degradations >= 1
+        assert tier.misses == 1 and tier.hits == 0
+
+    def test_wrong_shape_document_degrades(self, tmp_path):
+        """A well-formed pickle that is not a tier document (missing
+        key/exact/payload) degrades the same way as a torn one."""
+        tier = L2Tier(tmp_path, resolution=1e-3)
+        tier.path_for(self.KEY).write_bytes(
+            pickle.dumps({"not": "a document"}))
+        with pytest.warns(RuntimeWarning, match="torn_payload"):
+            assert tier.lookup(self.KEY, self.EXACT, radius=50.0) is None
+        assert tier.degradations >= 1
+
+    def test_stale_stamp_degrades_to_miss(self, tmp_path):
+        """A document written under another jax lowering / silicon /
+        bucket width is stale: skipped loudly, never adopted."""
+        tier = L2Tier(tmp_path, resolution=1e-3)
+        assert tier.put(self.KEY, self.EXACT, {"r": 0.0123})
+        path = tier.path_for(self.KEY)
+        doc = pickle.loads(path.read_bytes())
+        doc["stamp"] = {"version": -1}
+        path.write_bytes(pickle.dumps(doc))
+        with pytest.warns(RuntimeWarning, match="stale_stamp"):
+            assert tier.lookup(self.KEY, self.EXACT, radius=50.0) is None
+        assert tier.degradations >= 1
+        assert tier.hits == 0
+
+    def test_eviction_race_degrades_to_miss(self, tmp_path):
+        """The index says present, the file is gone (the other worker's
+        eviction pass won): a counted 'evicted_during_read' degradation,
+        then a miss."""
+        tier = L2Tier(tmp_path, resolution=1e-3)
+        assert tier.put(self.KEY, self.EXACT, {"r": 0.0123})
+        tier.path_for(self.KEY).unlink()
+        with pytest.warns(RuntimeWarning, match="evicted_during_read"):
+            assert tier.lookup(self.KEY, self.EXACT, radius=50.0) is None
+        assert tier.degradations >= 1
+        assert tier.misses == 1
+
+    def test_unpicklable_payload_skips_l2_keeps_l1(self, tmp_path):
+        """An exotic result object that cannot pickle stays local: the
+        write-through degrades (counted, warned), the solve that produced
+        it is unharmed, and the L1 still serves it as a hit."""
+        cache = tiered(tmp_path)
+        cfg = with_beta(0.9500)
+        with pytest.warns(RuntimeWarning, match="unwritable"):
+            cache.put(cfg, {"r": 0.0123, "fn": lambda x: x})
+        assert cache.l2.writes == 0
+        assert cache.l2.degradations == 1
+        outcome, entry = cache.lookup(cfg)
+        assert outcome == "hit" and entry.payload["r"] == 0.0123
+
+    def test_byte_budget_evicts_oldest(self, tmp_path):
+        """The directory stays within budget by dropping oldest-mtime
+        entries; the survivor is the newest write."""
+        tier = L2Tier(tmp_path, byte_budget=1, resolution=1e-3)
+        keys = []
+        for i, beta in enumerate((0.9400, 0.9450, 0.9500)):
+            cfg = with_beta(beta)
+            k, e = calibration_key(cfg), calibration_params(cfg)
+            keys.append((k, e))
+            assert tier.put(k, e, {"r": 0.01 + i})
+        assert tier.evictions >= 2
+        assert tier.stats()["entries"] == 1
+        assert tier.path_for(keys[-1][0]).exists()
+
+
+# ---------------------------------------------------------------------------
+# L1 cache thread-safety (the hammer)
+# ---------------------------------------------------------------------------
+
+
+class TestCacheConcurrency:
+    def test_concurrent_hammer_stays_consistent(self):
+        """8 threads interleave put/lookup/peek/neighborhood on a small
+        byte budget (constant eviction churn). The audit's contract: no
+        exceptions, every lookup classifies exactly once, and the
+        counters add up."""
+        cache = SolutionCache(1 << 12, resolution=1e-3)
+        cfgs = [with_beta(0.93 + 0.002 * i) for i in range(12)]
+        errors, lookups = [], []
+        start = threading.Barrier(8)
+
+        def worker(seed):
+            try:
+                start.wait(timeout=30)
+                n = 0
+                for step in range(200):
+                    cfg = cfgs[(seed * 7 + step) % len(cfgs)]
+                    op = (seed + step) % 4
+                    if op == 0:
+                        cache.put(cfg, {"r": 0.01, "w": 1.0, "s": seed})
+                    elif op == 1:
+                        outcome, _ = cache.lookup(cfg)
+                        assert outcome in ("hit", "warm", "miss")
+                        n += 1
+                    elif op == 2:
+                        cache.peek(cfg)
+                    else:
+                        cache.neighborhood(cfg)
+                lookups.append(n)
+            except Exception as e:  # noqa: BLE001 — the test IS the catch
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        st = cache.stats()
+        assert st["hits"] + st["warm"] + st["misses"] == sum(lookups)
+        assert st["bytes"] <= 1 << 12 or st["entries"] == 1
+
+    def test_concurrent_tiered_hammer(self, tmp_path):
+        """Two workers' caches on one shared directory, hammered from two
+        threads each: concurrent write-through, promotion, and eviction
+        must neither raise nor ever classify cross-worker material as a
+        hit before a local re-store."""
+        a, b = tiered(tmp_path), tiered(tmp_path)
+        cfgs = [with_beta(0.94 + 0.002 * i) for i in range(6)]
+        errors = []
+        start = threading.Barrier(4)
+
+        def worker(cache, other_stored, seed):
+            try:
+                start.wait(timeout=30)
+                for step in range(40):
+                    cfg = cfgs[(seed + step) % len(cfgs)]
+                    if (seed + step) % 2:
+                        cache.put(cfg, {"r": 0.01, "s": seed})
+                    else:
+                        outcome, _ = cache.lookup(cfg)
+                        assert outcome in ("hit", "warm", "miss")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(c, o, i))
+                   for i, (c, o) in enumerate(
+                       [(a, b), (a, b), (b, a), (b, a)])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# fleet front: pure helpers
+# ---------------------------------------------------------------------------
+
+
+class TestFleetHelpers:
+    def test_grid_class_routes_nearest(self):
+        assert grid_class((40, 100), 40) == 40
+        assert grid_class((40, 100), 95) == 100
+        assert grid_class((100, 40), 1000) == 100
+
+    def test_grid_class_ties_to_smaller(self):
+        assert grid_class((40, 80), 60) == 40
+
+    def test_grid_class_none_is_first_class(self):
+        assert grid_class((100, 40, 40), None) == 40
+
+    def test_grid_class_empty_rejected(self):
+        with pytest.raises(ValueError, match="grid classes"):
+            grid_class((), 40)
+
+    @staticmethod
+    def _ev(kind, rid, *, worker=0, seq=0, run_id="r1"):
+        return {"kind": kind, "rid": rid, "worker": worker, "seq": seq,
+                "run_id": run_id}
+
+    def test_unacked_is_routed_minus_acked(self):
+        events = [
+            self._ev("fleet_route", "a", seq=1),
+            self._ev("fleet_route", "b", worker=1, seq=2),
+            self._ev("fleet_ack", "a", seq=3),
+            self._ev("fleet_route", "c", worker=1, seq=4),
+        ]
+        out = unacked_from_ledger(events)
+        assert [ev["rid"] for ev in out] == ["b", "c"]
+
+    def test_unacked_latest_route_wins_and_sorts_by_seq(self):
+        events = [
+            self._ev("fleet_route", "a", worker=0, seq=5),
+            self._ev("fleet_route", "b", worker=1, seq=2),
+            self._ev("fleet_route", "a", worker=1, seq=7),  # re-route
+        ]
+        out = unacked_from_ledger(events)
+        assert [ev["rid"] for ev in out] == ["b", "a"]
+        assert out[1]["worker"] == 1
+
+    def test_unacked_filters_run_and_worker(self):
+        events = [
+            self._ev("fleet_route", "a", worker=0, seq=1),
+            self._ev("fleet_route", "b", worker=1, seq=2),
+            self._ev("fleet_route", "x", worker=0, seq=3, run_id="r2"),
+        ]
+        assert [ev["rid"] for ev in
+                unacked_from_ledger(events, run_id="r1")] == ["a", "b"]
+        assert [ev["rid"] for ev in
+                unacked_from_ledger(events, worker=1)] == ["b"]
+        assert unacked_from_ledger(events, run_id="r3") == []
+
+    def test_unacked_empty_ledger(self):
+        assert unacked_from_ledger([]) == []
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness split
+# ---------------------------------------------------------------------------
+
+
+class TestReadiness:
+    @staticmethod
+    def _serve(svc, **kw):
+        from aiyagari_tpu.serve.service import _http_server
+
+        httpd = _http_server(svc, BASE, 0, **kw)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, httpd.server_address[1]
+
+    @staticmethod
+    def _request(port, *, method="GET", path="/healthz", body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.read(), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def test_warming_worker_is_503_not_routed(self):
+        """A never-started (still-warming) worker: /healthz is 503
+        {'state': 'warming'} with Retry-After, a VALID solve request is
+        503 after validation (admission waits, rejections don't), and an
+        invalid body still gets its 400 — validation answers while
+        warming."""
+        svc = SolveService(svc_config(max_batch=1))
+        assert svc.ready is False
+        httpd, port = self._serve(svc)
+        try:
+            code, body, headers = self._request(port)
+            assert code == 503
+            payload = json.loads(body)
+            assert payload["ok"] is False and payload["state"] == "warming"
+            assert headers.get("Retry-After") == "1"
+            code, body, headers = self._request(
+                port, method="POST", path="/solve", body=b"{}")
+            assert code == 503 and b"warming" in body
+            assert headers.get("Retry-After") == "1"
+            assert self._request(port, method="POST", path="/solve",
+                                 body=b"{nope")[0] == 400
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_ready_worker_is_200_then_503_after_stop(self):
+        svc = SolveService(svc_config(max_batch=1))
+        httpd, port = self._serve(svc)
+        try:
+            svc.start()
+            assert svc.ready is True
+            code, body, _ = self._request(port)
+            payload = json.loads(body)
+            assert code == 200
+            assert payload["ok"] is True and payload["state"] == "ready"
+            svc.stop()
+            assert svc.ready is False
+            assert self._request(port)[0] == 503
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
